@@ -1,0 +1,29 @@
+"""fisco_bcos_tpu — a TPU-native framework with the capabilities of FISCO-BCOS 3.x.
+
+Architecture (see SURVEY.md for the reference layer map this mirrors):
+
+- ``ops/``       — JAX/XLA batch kernels: 256-bit bigint (Montgomery), keccak256,
+                   sha256, sm3, secp256k1 ECDSA verify/recover, SM2 verify,
+                   width-16 merkle, XOR state-root. These own every batchable hot
+                   loop the reference runs on CPU threads (tbb/OpenMP).
+- ``crypto/``    — the CryptoSuite plugin seam (reference:
+                   bcos-crypto/interfaces/crypto/CryptoSuite.h) with a pure-Python
+                   CPU reference suite and the TPU batch suite.
+- ``parallel/``  — device-mesh sharding of the verification plane (pjit/shard_map
+                   over jax.sharding.Mesh; ICI collectives for validity bitmaps).
+- ``protocol/``  — Transaction/Block/Receipt objects with cached hashes.
+- ``codec/``     — deterministic flat serialization + ABI-lite codec.
+- ``storage/``   — KV backends, StateStorage overlay, Table abstraction.
+- ``ledger/``    — system-table chain schema, merkle proofs, genesis.
+- ``txpool/``    — batch-verifying admission pipeline, nonce checkers, tx sync.
+- ``executor/``  — transaction executor: precompiles, DAG parallelism.
+- ``scheduler/`` — block executive: serial + DMC rounds, key locks.
+- ``consensus/`` — PBFT engine, sealer, block validator (batch quorum checks).
+- ``sync/``      — block download/commit sync.
+- ``gateway/``   — P2P host + front-service module router.
+- ``rpc/``       — JSON-RPC 2.0 API surface.
+- ``node/``      — config loading and dependency wiring (air node).
+- ``models/``    — benchmark workload "contracts" (transfer/smallbank/cpuheavy).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Crypto plugin layer — the CryptoSuite seam (reference: bcos-crypto).
+
+``ref/`` holds the pure-Python CPU reference implementations (golden vectors);
+``suites`` (added with the batch plane) holds the CryptoSuite implementations
+selectable at node boot, mirroring ProtocolInitializer.cpp:51-99's
+sm_crypto ? SM3+SM2+SM4 : Keccak256+Secp256k1+AES choice.
+"""

@@ -1,0 +1,38 @@
+"""Pure-Python reference crypto — the CPU golden-vector source of truth.
+
+The TPU batch kernels in ``fisco_bcos_tpu.ops`` must agree bit-exactly with these
+(SURVEY.md §4: "golden crypto vectors — CPU reference vs TPU batch kernels must
+agree bit-exactly"; any verify disagreement is consensus-fatal).
+"""
+
+from .keccak import keccak256
+from .sha2 import sha256
+from .sm3 import sm3
+from .ecdsa import (
+    SECP256K1,
+    SM2_CURVE,
+    Curve,
+    ecdsa_sign,
+    ecdsa_verify,
+    ecdsa_recover,
+    sm2_sign,
+    sm2_verify,
+    sm2_za,
+    privkey_to_pubkey,
+)
+
+__all__ = [
+    "keccak256",
+    "sha256",
+    "sm3",
+    "SECP256K1",
+    "SM2_CURVE",
+    "Curve",
+    "ecdsa_sign",
+    "ecdsa_verify",
+    "ecdsa_recover",
+    "sm2_sign",
+    "sm2_verify",
+    "sm2_za",
+    "privkey_to_pubkey",
+]

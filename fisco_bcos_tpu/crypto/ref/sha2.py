@@ -1,0 +1,10 @@
+"""SHA-256 reference (stdlib-backed; kept behind one name so golden tests and
+suites import from a single place)."""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()

@@ -1,0 +1,23 @@
+from .bytesutil import (
+    h256,
+    to_hex,
+    from_hex,
+    int_to_bytes32,
+    bytes32_to_int,
+    right160,
+)
+from .error import BcosError, ErrorCode
+from .log import get_logger, metric
+
+__all__ = [
+    "h256",
+    "to_hex",
+    "from_hex",
+    "int_to_bytes32",
+    "bytes32_to_int",
+    "right160",
+    "BcosError",
+    "ErrorCode",
+    "get_logger",
+    "metric",
+]

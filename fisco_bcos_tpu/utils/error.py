@@ -1,0 +1,54 @@
+"""Error type + protocol error codes.
+
+Reference: bcos-utilities/Error.h and
+bcos-framework/protocol/CommonError.h / TransactionStatus.h.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+
+
+class ErrorCode(IntEnum):
+    SUCCESS = 0
+    # Transaction status (reference: bcos-protocol TransactionStatus.h)
+    UNKNOWN = 1
+    OUT_OF_GAS_LIMIT = 2
+    NOT_ENOUGH_CASH = 7
+    BAD_INSTRUCTION = 10
+    REVERT_INSTRUCTION = 12
+    STACK_OVERFLOW = 14
+    STACK_UNDERFLOW = 15
+    PRECOMPILED_ERROR = 24
+    # TxPool (reference: bcos-framework txpool/TxPoolTypeDef.h)
+    NONCE_CHECK_FAIL = 10000
+    BLOCK_LIMIT_CHECK_FAIL = 10001
+    TX_POOL_ALREADY_KNOWN = 10002
+    TX_POOL_NONCE_TOO_OLD = 10003
+    INVALID_CHAIN_ID = 10004
+    INVALID_GROUP_ID = 10005
+    INVALID_SIGNATURE = 10006
+    REQUIRE_PROOF = 10007
+    TX_POOL_FULL = 10008
+    TX_POOL_TIMEOUT = 10009
+    ALREADY_IN_TX_POOL = 10010
+    # Scheduler / executor
+    SCHEDULER_INVALID_BLOCK = 21000
+    SCHEDULER_BLOCK_IN_QUEUE = 21001
+    EXECUTOR_ERROR = 22000
+    DEAD_LOCK = 22001
+    # Consensus
+    CONSENSUS_INVALID_PROPOSAL = 23000
+    CONSENSUS_INVALID_VIEW = 23001
+    CONSENSUS_TIMEOUT = 23002
+    # Storage
+    STORAGE_ERROR = 24000
+    TABLE_NOT_EXIST = 24001
+    TABLE_ALREADY_EXIST = 24002
+
+
+class BcosError(Exception):
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(f"[{code}] {message}")
+        self.code = int(code)
+        self.message = message

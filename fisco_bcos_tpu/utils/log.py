@@ -1,0 +1,65 @@
+"""Structured logging with METRIC-style key/value stage lines.
+
+Reference: bcos-utilities/Log.h LOG_BADGE/LOG_KV/LOG_DESC macros and the METRIC
+badge (bcos-framework/Common.h:24) that the mtail sidecar scrapes into Prometheus
+gauges. We emit the same shape: ``[badge] desc|k1=v1|k2=v2``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+_FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if not _configured:
+        logging.basicConfig(level=logging.INFO, format=_FORMAT)
+        _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure()
+    return logging.getLogger(name)
+
+
+def kv_line(badge: str, desc: str, **kvs: Any) -> str:
+    parts = [f"[{badge}]", desc]
+    for k, v in kvs.items():
+        parts.append(f"{k}={v}")
+    return "|".join(parts)
+
+
+def metric(logger: logging.Logger, desc: str, **kvs: Any) -> None:
+    """Emit a METRIC line (scrapeable, mirrors the reference's mtail contract)."""
+    logger.info(kv_line("METRIC", desc, **kvs))
+
+
+class StageTimer:
+    """Stage-timing helper mirroring the reference's BlockTrace logs
+    (e.g. DMCExecute.0..6 in bcos-scheduler BlockExecutive.cpp:849-1010)."""
+
+    def __init__(self, logger: logging.Logger, badge: str):
+        self._logger = logger
+        self._badge = badge
+        self._t0 = time.monotonic()
+        self._last = self._t0
+        self._stage = 0
+
+    def stage(self, desc: str, **kvs: Any) -> None:
+        now = time.monotonic()
+        self._logger.info(
+            kv_line(
+                f"{self._badge}.{self._stage}",
+                desc,
+                stageMs=round((now - self._last) * 1e3, 3),
+                totalMs=round((now - self._t0) * 1e3, 3),
+                **kvs,
+            )
+        )
+        self._last = now
+        self._stage += 1
