@@ -1,0 +1,7 @@
+"""Batched device kernels — the TPU "crypto & state math plane".
+
+These own every batchable hot loop the reference runs on CPU threads
+(SURVEY.md §3: txpool batch verify, PBFT sealer-signature quorum check,
+state-root XOR hash, merkle builds). Everything here is jit-compatible,
+batch-leading, static-shape JAX.
+"""

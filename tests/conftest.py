@@ -1,12 +1,23 @@
-"""Test configuration: force an 8-device virtual CPU platform so multi-chip
-sharding (jax.sharding.Mesh) is exercised without TPU hardware, exactly as the
-driver's dryrun does."""
+"""Test configuration.
+
+Tests run on an 8-device virtual CPU platform so multi-chip sharding
+(jax.sharding.Mesh) is exercised without TPU hardware, exactly as the driver's
+dryrun does.
+
+Note: this environment's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon (the TPU tunnel), so setting the env var here is too late —
+we must go through jax.config. XLA_FLAGS is still read at first backend init,
+which hasn't happened yet at conftest time.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
