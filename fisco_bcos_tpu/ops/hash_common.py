@@ -1,0 +1,80 @@
+"""Host-side batch padding for the device hash kernels.
+
+The reference hashes one message at a time on CPU threads (OpenSSL EVP behind
+bcos-crypto's Hash interface, tbb::parallel_for for batches). The TPU
+formulation pads a whole batch into a dense ``[B, M, words]`` block tensor plus
+a per-lane block count; the device kernel scans over the M block slots and
+masks inactive lanes. M is rounded up to a power of two to bound the number of
+distinct compiled shapes (XLA needs static shapes).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import numpy as np
+
+
+def _bucket(n: int) -> int:
+    """Round up to a power of two (min 1) to bound recompilation."""
+    m = 1
+    while m < n:
+        m *= 2
+    return m
+
+
+def pad_keccak(
+    msgs: Sequence[bytes], rate: int = 136
+) -> tuple[np.ndarray, np.ndarray]:
+    """Keccak multi-rate padding (0x01 … 0x80 legacy domain).
+
+    Returns (blocks [B, M, rate//8, 2] uint32 little-endian lo/hi lane halves,
+    nblocks [B] int32).
+    """
+    nblocks = np.array([len(m) // rate + 1 for m in msgs], dtype=np.int32)
+    m_max = _bucket(int(nblocks.max()) if len(msgs) else 1)
+    lanes = rate // 8
+    buf = np.zeros((len(msgs), m_max * rate), dtype=np.uint8)
+    for i, m in enumerate(msgs):
+        buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        end = nblocks[i] * rate
+        buf[i, len(m)] ^= 0x01
+        buf[i, end - 1] ^= 0x80
+    words = buf.view("<u4").reshape(len(msgs), m_max, lanes, 2)
+    return words.astype(np.uint32), nblocks
+
+
+def pad_md64(
+    msgs: Sequence[bytes],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Merkle–Damgård padding with 64-bit big-endian length (SHA-256 and SM3
+    share it): 0x80, zeros, bitlen. Returns (blocks [B, M, 16] uint32
+    big-endian words, nblocks [B] int32)."""
+    nblocks = np.array([(len(m) + 8) // 64 + 1 for m in msgs], dtype=np.int32)
+    m_max = _bucket(int(nblocks.max()) if len(msgs) else 1)
+    buf = np.zeros((len(msgs), m_max * 64), dtype=np.uint8)
+    for i, m in enumerate(msgs):
+        buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        buf[i, len(m)] = 0x80
+        end = nblocks[i] * 64
+        buf[i, end - 8 : end] = np.frombuffer(
+            (len(m) * 8).to_bytes(8, "big"), dtype=np.uint8
+        )
+    words = buf.view(">u4").reshape(len(msgs), m_max, 16)
+    return words.astype(np.uint32), nblocks
+
+
+def digest_words_to_bytes_le(words: np.ndarray) -> np.ndarray:
+    """[B, 8] uint32 little-endian words -> [B, 32] uint8 (keccak digests)."""
+    return np.ascontiguousarray(np.asarray(words, dtype="<u4")).view(np.uint8).reshape(
+        *words.shape[:-1], 32
+    )
+
+
+def digest_words_to_bytes_be(words: np.ndarray) -> np.ndarray:
+    """[B, 8] uint32 big-endian words -> [B, 32] uint8 (sha256/sm3 digests)."""
+    return (
+        np.ascontiguousarray(np.asarray(words, dtype=np.uint32).astype(">u4"))
+        .view(np.uint8)
+        .reshape(*words.shape[:-1], 32)
+    )

@@ -1,0 +1,103 @@
+"""Batch SHA-256 on TPU (lane-parallel over messages).
+
+Reference counterpart: bcos-crypto hash/Sha256.h + the sha256 EVM precompile
+(bcos-executor vm/Precompiled.cpp:63). One XLA program hashes the whole batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .hash_common import digest_words_to_bytes_be, pad_md64
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_IV = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _schedule(block):
+    """Message schedule: block [B, 16] -> W [64, B] via scan over a 16-word window."""
+
+    def step(window, _):
+        # window [B, 16] = W[t-16..t-1]
+        w15 = window[:, 1]
+        w2 = window[:, 14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        wt = window[:, 0] + s0 + window[:, 9] + s1
+        return jnp.concatenate([window[:, 1:], wt[:, None]], axis=1), wt
+
+    window, w_rest = lax.scan(step, block, None, length=48)
+    return jnp.concatenate([jnp.moveaxis(block, 1, 0), w_rest], axis=0)
+
+
+def _compress(state, block):
+    """state [B, 8], block [B, 16] -> new state [B, 8]."""
+    w = _schedule(block)  # [64, B]
+
+    def rnd(carry, kw):
+        a, b, c, d, e, f, g, h = carry
+        k, wt = kw
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[:, i] for i in range(8))
+    out, _ = lax.scan(rnd, init, (jnp.asarray(_K), w))
+    return state + jnp.stack(out, axis=1)
+
+
+@jax.jit
+def sha256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """blocks [B, M, 16] uint32 BE words, nblocks [B] -> digests [B, 8] uint32."""
+    bsz, m_max, _ = blocks.shape
+    state0 = jnp.broadcast_to(jnp.asarray(_IV), (bsz, 8))
+
+    def absorb(state, xs):
+        blk, idx = xs
+        new = _compress(state, blk)
+        return jnp.where((idx < nblocks)[:, None], new, state), None
+
+    state, _ = lax.scan(
+        absorb,
+        state0,
+        (jnp.moveaxis(blocks, 1, 0), jnp.arange(m_max, dtype=jnp.int32)),
+    )
+    return state
+
+
+def sha256_batch(msgs) -> np.ndarray:
+    """Host convenience: list of bytes -> [B, 32] uint8 digests."""
+    blocks, nblocks = pad_md64(msgs)
+    words = np.asarray(sha256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks)))
+    return digest_words_to_bytes_be(words)
