@@ -1,0 +1,310 @@
+"""Batch elliptic-curve arithmetic on TPU (secp256k1 and SM2 share one path).
+
+Replaces the reference's per-signature CPU EC stack (wedpr-crypto Rust FFI
+behind bcos-crypto — `wedpr_secp256k1_verify` at
+bcos-crypto/bcos-crypto/signature/secp256k1/Secp256k1Crypto.cpp:57, SM2 at
+signature/sm2/SM2Crypto.cpp:29-91) with batch Jacobian-coordinate kernels over
+the 256-bit Montgomery limb arithmetic in :mod:`fisco_bcos_tpu.ops.bigint`.
+
+Design notes (TPU-first):
+- A point is a (X, Y, Z) tuple of ``[..., 16]`` limb arrays in the Montgomery
+  domain of the curve prime; Z == 0 encodes the point at infinity.
+- All group ops are branch-free: exceptional cases (infinity operands,
+  P == Q, P == -Q) are resolved with lane-wise selects, so one compiled
+  program serves every lane of the batch — consensus-critical code must not
+  diverge per lane.
+- Scalar multiplication is an MSB-first double-and-add `lax.scan` over the 256
+  scalar bits; u1*G + u2*Q uses Shamir's trick (one shared doubling chain).
+  The schedule is identical for every lane; only selects depend on data.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..crypto.ref.ecdsa import SECP256K1, SM2_CURVE, Curve
+from . import bigint
+from .bigint import (
+    Modulus,
+    _const,
+    _sub_with_borrow,
+    add_mod,
+    eq,
+    from_mont,
+    geq,
+    is_zero,
+    make_modulus,
+    mont_inv,
+    mont_mul,
+    mont_pow,
+    mont_sqr,
+    select,
+    sub_mod,
+    to_mont,
+)
+
+_R = 1 << 256
+
+
+@dataclass(frozen=True)
+class CurveCtx:
+    """Device constants for one short-Weierstrass curve (static under jit)."""
+
+    name: str
+    p: Modulus
+    n: Modulus
+    a_is_zero: bool
+    a_m: np.ndarray  # a  in Montgomery(p) domain, [16]
+    b_m: np.ndarray  # b  in Montgomery(p) domain, [16]
+    gx_m: np.ndarray  # G.x in Montgomery(p) domain, [16]
+    gy_m: np.ndarray  # G.y in Montgomery(p) domain, [16]
+    curve: Curve
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        return isinstance(other, CurveCtx) and other.name == self.name
+
+
+def make_curve_ctx(c: Curve) -> CurveCtx:
+    def to_m(x: int) -> np.ndarray:
+        return bigint.int_to_limbs(x * _R % c.p)
+
+    return CurveCtx(
+        name=c.name,
+        p=make_modulus(c.p),
+        n=make_modulus(c.n),
+        a_is_zero=c.a == 0,
+        a_m=to_m(c.a),
+        b_m=to_m(c.b),
+        gx_m=to_m(c.gx),
+        gy_m=to_m(c.gy),
+        curve=c,
+    )
+
+
+SECP256K1_CTX = make_curve_ctx(SECP256K1)
+SM2_CTX = make_curve_ctx(SM2_CURVE)
+
+
+# ---------------------------------------------------------------------------
+# Jacobian group law (Montgomery domain, branch-free)
+# ---------------------------------------------------------------------------
+
+
+def jac_double(P, ctx: CurveCtx):
+    """dbl-2007-bl; 8 sqr + 2 mul (1 mul saved when a == 0).
+
+    Safe without selects: doubling infinity (Z=0) or a 2-torsion point (Y=0)
+    yields Z3 = 0, i.e. infinity, which is the correct group result.
+    """
+    X, Y, Z = P
+    p = ctx.p
+    xx = mont_sqr(X, p)
+    yy = mont_sqr(Y, p)
+    yyyy = mont_sqr(yy, p)
+    zz = mont_sqr(Z, p)
+    t = mont_sqr(add_mod(X, yy, p), p)
+    s = sub_mod(sub_mod(t, xx, p), yyyy, p)
+    s = add_mod(s, s, p)  # S = 2((X+YY)^2 - XX - YYYY)
+    m = add_mod(add_mod(xx, xx, p), xx, p)  # 3*XX
+    if not ctx.a_is_zero:
+        m = add_mod(m, mont_mul(_const(ctx.a_m, X), mont_sqr(zz, p), p), p)
+    x3 = sub_mod(mont_sqr(m, p), add_mod(s, s, p), p)
+    y8 = add_mod(yyyy, yyyy, p)
+    y8 = add_mod(y8, y8, p)
+    y8 = add_mod(y8, y8, p)
+    y3 = sub_mod(mont_mul(m, sub_mod(s, x3, p), p), y8, p)
+    z3 = sub_mod(sub_mod(mont_sqr(add_mod(Y, Z, p), p), yy, p), zz, p)
+    return x3, y3, z3
+
+
+def jac_add(P, Q, ctx: CurveCtx):
+    """add-2007-bl with full exceptional-case handling via selects.
+
+    Handles P or Q at infinity, P == Q (falls back to the doubling formula)
+    and P == -Q (H == 0 forces Z3 = 0, the correct infinity).
+    """
+    X1, Y1, Z1 = P
+    X2, Y2, Z2 = Q
+    p = ctx.p
+    z1z1 = mont_sqr(Z1, p)
+    z2z2 = mont_sqr(Z2, p)
+    u1 = mont_mul(X1, z2z2, p)
+    u2 = mont_mul(X2, z1z1, p)
+    s1 = mont_mul(mont_mul(Y1, Z2, p), z2z2, p)
+    s2 = mont_mul(mont_mul(Y2, Z1, p), z1z1, p)
+    h = sub_mod(u2, u1, p)
+    rr = sub_mod(s2, s1, p)
+    h2 = add_mod(h, h, p)
+    i = mont_sqr(h2, p)
+    j = mont_mul(h, i, p)
+    r2 = add_mod(rr, rr, p)
+    v = mont_mul(u1, i, p)
+    x3 = sub_mod(sub_mod(mont_sqr(r2, p), j, p), add_mod(v, v, p), p)
+    s1j = mont_mul(s1, j, p)
+    y3 = sub_mod(mont_mul(r2, sub_mod(v, x3, p), p), add_mod(s1j, s1j, p), p)
+    z3 = mont_mul(
+        sub_mod(sub_mod(mont_sqr(add_mod(Z1, Z2, p), p), z1z1, p), z2z2, p), h, p
+    )
+    inf1 = is_zero(Z1)
+    inf2 = is_zero(Z2)
+    same = is_zero(h) & is_zero(rr) & ~inf1 & ~inf2
+    dx, dy, dz = jac_double(P, ctx)
+    x = select(inf1, X2, select(inf2, X1, select(same, dx, x3)))
+    y = select(inf1, Y2, select(inf2, Y1, select(same, dy, y3)))
+    z = select(inf1, Z2, select(inf2, Z1, select(same, dz, z3)))
+    return x, y, z
+
+
+def jac_infinity(like: jax.Array):
+    """Point at infinity broadcast over the batch dims of `like` [..., 16]."""
+    z = jnp.zeros_like(like)
+    return z, z, z
+
+
+@partial(jax.jit, static_argnames="ctx")
+def jac_to_affine(P, ctx: CurveCtx):
+    """(X, Y, Z) -> (x, y, inf_mask); affine coords stay in Montgomery domain.
+
+    Infinity lanes get x = y = 0 (mont_inv(0) == 0)."""
+    X, Y, Z = P
+    zinv = mont_inv(Z, ctx.p)
+    zi2 = mont_sqr(zinv, ctx.p)
+    zi3 = mont_mul(zi2, zinv, ctx.p)
+    return mont_mul(X, zi2, ctx.p), mont_mul(Y, zi3, ctx.p), is_zero(Z)
+
+
+def on_curve_mont(x_m: jax.Array, y_m: jax.Array, ctx: CurveCtx) -> jax.Array:
+    """y^2 == x^3 + a*x + b (all Montgomery domain) -> bool[...]."""
+    p = ctx.p
+    rhs = mont_mul(mont_sqr(x_m, p), x_m, p)
+    if not ctx.a_is_zero:
+        rhs = add_mod(rhs, mont_mul(_const(ctx.a_m, x_m), x_m, p), p)
+    rhs = add_mod(rhs, _const(ctx.b_m, x_m), p)
+    return eq(mont_sqr(y_m, p), rhs)
+
+
+def sqrt_mont(a_m: jax.Array, ctx: CurveCtx) -> jax.Array:
+    """Square root mod p for p ≡ 3 (mod 4): a^((p+1)/4). Montgomery domain.
+
+    Caller must check mont_sqr(result) == a to detect non-residues."""
+    assert ctx.curve.p % 4 == 3
+    return mont_pow(a_m, (ctx.curve.p + 1) // 4, ctx.p)
+
+
+# ---------------------------------------------------------------------------
+# Scalar bit plumbing and scalar-field (mod n) helpers
+# ---------------------------------------------------------------------------
+
+
+def scalar_bits_msb(k: jax.Array) -> jax.Array:
+    """[..., 16] plain limbs -> [256, ...] bits, most significant first."""
+    shifts = jnp.arange(16, dtype=jnp.uint32)
+    bits = (k[..., :, None] >> shifts) & jnp.uint32(1)  # [..., limb, bit] LSB-first
+    bits = bits.reshape(k.shape[:-1] + (256,))[..., ::-1]
+    return jnp.moveaxis(bits, -1, 0)
+
+
+def reduce_once(z: jax.Array, mod: Modulus) -> jax.Array:
+    """z mod m for z < 2m (single conditional subtract).
+
+    Valid for hash values vs. both curve orders: n > 2^255 for secp256k1 and
+    SM2, so any 256-bit z satisfies z < 2n; likewise x < p < 2n."""
+    d, borrow = _sub_with_borrow(z, _const(mod.limbs, z))
+    return jnp.where((borrow == 0)[..., None], d, z)
+
+
+def inv_mod(a: jax.Array, mod: Modulus) -> jax.Array:
+    """a^-1 mod m for plain-domain a (0 -> 0). Fermat, batch-parallel."""
+    return from_mont(mont_inv(to_mont(a, mod), mod), mod)
+
+
+def mulmod(a: jax.Array, b: jax.Array, mod: Modulus) -> jax.Array:
+    """a*b mod m for plain-domain a, b: mont_mul(aR, b) = a*b."""
+    return mont_mul(to_mont(a, mod), b, mod)
+
+
+def negmod(a: jax.Array, mod: Modulus) -> jax.Array:
+    """(-a) mod m for plain-domain a < m."""
+    return sub_mod(jnp.zeros_like(a), a, mod)
+
+
+def lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a < b over normalized limbs."""
+    return ~geq(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Scalar multiplication
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames="ctx")
+def shamir_double_mul(k1, P1, k2, P2, ctx: CurveCtx):
+    """k1*P1 + k2*P2 with one shared doubling chain (Shamir's trick).
+
+    k1, k2: [..., 16] plain-domain scalars; P1, P2: (x_m, y_m) affine points in
+    Montgomery domain (must not be infinity — guaranteed for curve points and
+    the generator). Returns a Jacobian point; infinity encoded as Z == 0.
+    This is the replica-side analog of the reference's per-tx `ECDSA_verify`
+    inner loop — 256 iterations, identical schedule on every lane.
+    """
+    one = _const(ctx.p.r1, k1)
+    j1 = (P1[0], P1[1], one)
+    j2 = (P2[0], P2[1], one)
+    j12 = jac_add(j1, j2, ctx)
+    bits = (scalar_bits_msb(k1), scalar_bits_msb(k2))
+    acc0 = jac_infinity(k1)
+
+    def step(acc, bb):
+        b1, b2 = bb
+        acc = jac_double(acc, ctx)
+        w1 = (b1 != 0) & (b2 == 0)
+        w3 = (b1 != 0) & (b2 != 0)
+        ax = select(w3, j12[0], select(w1, j1[0], j2[0]))
+        ay = select(w3, j12[1], select(w1, j1[1], j2[1]))
+        az = select(w3, j12[2], select(w1, j1[2], j2[2]))
+        cx, cy, cz = jac_add(acc, (ax, ay, az), ctx)
+        do = (b1 != 0) | (b2 != 0)
+        return (
+            select(do, cx, acc[0]),
+            select(do, cy, acc[1]),
+            select(do, cz, acc[2]),
+        ), None
+
+    acc, _ = lax.scan(step, acc0, bits)
+    return acc
+
+
+@partial(jax.jit, static_argnames="ctx")
+def scalar_mul(k, P, ctx: CurveCtx):
+    """k*P for affine Montgomery-domain P: plain double-and-add ladder."""
+    one = _const(ctx.p.r1, k)
+    jp = (P[0], P[1], one)
+    acc0 = jac_infinity(k)
+
+    def step(acc, b):
+        acc = jac_double(acc, ctx)
+        cx, cy, cz = jac_add(acc, jp, ctx)
+        do = b != 0
+        return (
+            select(do, cx, acc[0]),
+            select(do, cy, acc[1]),
+            select(do, cz, acc[2]),
+        ), None
+
+    acc, _ = lax.scan(step, acc0, scalar_bits_msb(k))
+    return acc
+
+
+def generator(ctx: CurveCtx, like: jax.Array):
+    """The curve generator broadcast across the batch dims of `like`."""
+    return _const(ctx.gx_m, like), _const(ctx.gy_m, like)

@@ -23,6 +23,17 @@ def _bucket(n: int) -> int:
     return m
 
 
+bucket_pow2 = _bucket  # shared by the EC kernels' host wrappers
+
+
+def pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
+    """Zero-pad a batch array along axis 0 to `rows` (bucketed batch sizes)."""
+    if a.shape[0] == rows:
+        return a
+    pad = np.zeros((rows - a.shape[0],) + a.shape[1:], dtype=a.dtype)
+    return np.concatenate([a, pad], axis=0)
+
+
 def pad_keccak(
     msgs: Sequence[bytes], rate: int = 136
 ) -> tuple[np.ndarray, np.ndarray]:

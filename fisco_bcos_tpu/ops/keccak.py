@@ -13,8 +13,6 @@ rounds; multi-block messages scan over block slots with per-lane masking
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,145 @@
+"""Vectorized wide merkle trees on the batch hash kernels.
+
+Reference counterpart: bcos-crypto/bcos-crypto/merkle/Merkle.h:35-230 (templated
+on hasher and width, default width 16; `generateMerkle` / `generateMerkleProof`
+/ `verifyMerkleProof`) and the 2.x parallel variant
+bcos-protocol/ParallelMerkleProof.cpp:32-100 (tbb::parallel_for). Used for a
+block's transaction/receipt roots (bcos-ledger merkle proofs) — 10k+ leaves per
+block at the reference's headline TPS.
+
+TPU formulation: a level with L nodes is one fixed-row-length batch hash —
+group up to `width` child digests, concatenate (short groups keep their true
+byte length, matching a variable-arity last group), hash all groups in one
+device call. The whole tree is O(log_width N) device calls of shrinking batch
+size instead of N sequential hashes.
+
+Proofs follow the reference's wide-proof shape: per level, the full child
+group of the target node (the verifier re-hashes the group and ascends).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .keccak import keccak256_batch
+from .sha256 import sha256_batch
+from .sm3 import sm3_batch
+
+HashBatchFn = Callable[[Sequence[bytes]], np.ndarray]
+
+_HASHERS: dict[str, HashBatchFn] = {
+    "keccak256": keccak256_batch,
+    "sm3": sm3_batch,
+    "sha256": sha256_batch,
+}
+
+
+@dataclass(frozen=True)
+class MerkleProofItem:
+    """One level of a wide merkle proof: the child group containing the
+    target, plus the target's index within the group."""
+
+    group: tuple[bytes, ...]
+    index: int
+
+
+def _levels(leaves: np.ndarray, width: int, hash_batch: HashBatchFn) -> list[np.ndarray]:
+    """All tree levels bottom-up; level 0 = leaves, last = [1, 32] root."""
+    levels = [leaves]
+    cur = leaves
+    while len(cur) > 1:
+        n = len(cur)
+        groups = [
+            bytes(cur[i : i + width].reshape(-1)) for i in range(0, n, width)
+        ]
+        cur = hash_batch(groups)
+        levels.append(cur)
+    return levels
+
+
+class MerkleTree:
+    """Wide merkle tree over 32-byte leaf hashes.
+
+    `leaves` is a [N, 32] uint8 array (already-hashed items, e.g. tx hashes —
+    the reference also trees over hashes, Merkle.h:43).
+    """
+
+    def __init__(self, leaves: np.ndarray, width: int = 16, hasher: str = "keccak256"):
+        leaves = np.asarray(leaves, dtype=np.uint8)
+        if leaves.ndim != 2 or leaves.shape[1] != 32:
+            raise ValueError("leaves must be [N, 32] uint8")
+        if len(leaves) == 0:
+            raise ValueError("merkle tree needs at least one leaf")
+        if width < 2:
+            raise ValueError("width must be >= 2")
+        self.width = width
+        self.hasher = hasher
+        self._hash_batch = _HASHERS[hasher]
+        self.levels = _levels(leaves, width, self._hash_batch)
+
+    @property
+    def root(self) -> bytes:
+        return bytes(self.levels[-1][0])
+
+    def proof(self, leaf_index: int) -> list[MerkleProofItem]:
+        """Proof for leaf `leaf_index`: one child group per level below root."""
+        if not 0 <= leaf_index < len(self.levels[0]):
+            raise IndexError("leaf index out of range")
+        items: list[MerkleProofItem] = []
+        idx = leaf_index
+        for level in self.levels[:-1]:
+            g0 = (idx // self.width) * self.width
+            group = tuple(bytes(h) for h in level[g0 : g0 + self.width])
+            items.append(MerkleProofItem(group=group, index=idx - g0))
+            idx //= self.width
+        return items
+
+    @staticmethod
+    def verify_proof(
+        leaf: bytes,
+        leaf_index: int,
+        n_leaves: int,
+        proof: list[MerkleProofItem],
+        root: bytes,
+        width: int = 16,
+        hasher: str = "keccak256",
+    ) -> bool:
+        """Recompute the path from a *positioned* leaf up to `root`.
+
+        Binding to (leaf_index, n_leaves) pins the proof depth and every
+        group's size/offset — without it, a truncated proof could certify an
+        internal digest as a leaf (no leaf/inner domain separation exists in
+        the reference's digest-over-digests scheme either, Merkle.h:43; the
+        verifier there likewise knows the leaf count from the block header).
+        """
+        if not 0 <= leaf_index < n_leaves:
+            return False
+        hash_batch = _HASHERS[hasher]
+        cur = leaf
+        idx, size = leaf_index, n_leaves
+        for item in proof:
+            if size <= 1:
+                return False  # proof longer than the tree is deep
+            g0 = (idx // width) * width
+            if item.index != idx - g0:
+                return False
+            if len(item.group) != min(width, size - g0):
+                return False
+            if item.group[item.index] != cur:
+                return False
+            cur = bytes(hash_batch([b"".join(item.group)])[0])
+            idx //= width
+            size = -(-size // width)
+        if size != 1:
+            return False  # proof shorter than the tree is deep
+        return cur == root
+
+
+def merkle_root(
+    leaves: np.ndarray, width: int = 16, hasher: str = "keccak256"
+) -> bytes:
+    """Root only (the hot path for block sealing: tx/receipt roots)."""
+    return MerkleTree(leaves, width=width, hasher=hasher).root

@@ -1,0 +1,67 @@
+"""Merkle layer tests (vs a straightforward host recomputation).
+
+Reference model: bcos-crypto/test/unittests/testMerkle.cpp — roots and proofs
+across widths and leaf counts, negative proof cases.
+"""
+
+import numpy as np
+import pytest
+
+from fisco_bcos_tpu.crypto.ref.keccak import keccak256
+from fisco_bcos_tpu.crypto.ref.sm3 import sm3
+from fisco_bcos_tpu.ops.merkle import MerkleTree, merkle_root
+
+_REF_HASH = {"keccak256": keccak256, "sm3": sm3}
+
+
+def _host_root(leaves, width, hasher):
+    h = _REF_HASH[hasher]
+    cur = [bytes(x) for x in leaves]
+    while len(cur) > 1:
+        cur = [h(b"".join(cur[i : i + width])) for i in range(0, len(cur), width)]
+    return cur[0]
+
+
+@pytest.mark.parametrize("n", [1, 2, 15, 16, 17, 100])
+@pytest.mark.parametrize("width", [2, 16])
+def test_root_matches_host(n, width):
+    rng = np.random.default_rng(n * 31 + width)
+    leaves = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    assert merkle_root(leaves, width=width) == _host_root(leaves, width, "keccak256")
+
+
+def test_sm3_root():
+    rng = np.random.default_rng(5)
+    leaves = rng.integers(0, 256, (33, 32), dtype=np.uint8)
+    assert merkle_root(leaves, hasher="sm3") == _host_root(leaves, 16, "sm3")
+
+
+@pytest.mark.parametrize("width", [2, 16])
+def test_proofs_verify(width):
+    rng = np.random.default_rng(9)
+    leaves = rng.integers(0, 256, (70, 32), dtype=np.uint8)
+    tree = MerkleTree(leaves, width=width)
+    for idx in (0, 1, 37, 69):
+        proof = tree.proof(idx)
+        assert MerkleTree.verify_proof(bytes(leaves[idx]), idx, 70, proof, tree.root, width=width)
+        # wrong leaf fails
+        other = bytes(leaves[(idx + 1) % 70])
+        assert not MerkleTree.verify_proof(other, idx, 70, proof, tree.root, width=width)
+    # tampered root fails
+    bad_root = bytes(tree.root[:-1]) + bytes([tree.root[-1] ^ 1])
+    assert not MerkleTree.verify_proof(bytes(leaves[0]), 0, 70, tree.proof(0), bad_root, width=width)
+
+
+def test_truncated_proof_cannot_certify_internal_node():
+    """A proof with its first level dropped must NOT verify the level-1
+    internal digest as a 'leaf' (depth binding)."""
+    rng = np.random.default_rng(13)
+    leaves = rng.integers(0, 256, (256, 32), dtype=np.uint8)
+    tree = MerkleTree(leaves, width=16)
+    full = tree.proof(0)
+    internal = full[1].group[0]  # hash of leaves 0..15
+    truncated = full[1:]
+    assert not MerkleTree.verify_proof(internal, 0, 256, truncated, tree.root, width=16)
+    # and a proof that's too long fails as well
+    padded = full + [full[-1]]
+    assert not MerkleTree.verify_proof(bytes(leaves[0]), 0, 256, padded, tree.root, width=16)
