@@ -242,6 +242,12 @@ def lt(a: jax.Array, b: jax.Array) -> jax.Array:
     return ~geq(a, b)
 
 
+def valid_scalar(x: jax.Array, ctx: CurveCtx) -> jax.Array:
+    """1 <= x < n (signature component range check, both curves)."""
+    n = _const(ctx.n.limbs, x)
+    return ~is_zero(x) & lt(x, n)
+
+
 # ---------------------------------------------------------------------------
 # Scalar multiplication
 # ---------------------------------------------------------------------------

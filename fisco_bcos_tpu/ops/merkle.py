@@ -117,6 +117,8 @@ class MerkleTree:
         """
         if not 0 <= leaf_index < n_leaves:
             return False
+        if len(leaf) != 32:
+            return False
         hash_batch = _HASHERS[hasher]
         cur = leaf
         idx, size = leaf_index, n_leaves
@@ -127,6 +129,11 @@ class MerkleTree:
             if item.index != idx - g0:
                 return False
             if len(item.group) != min(width, size - g0):
+                return False
+            # every entry must be a digest: without this, a repartition of the
+            # same concatenated bytes forges membership of a 32-byte window
+            # straddling two real digests
+            if any(len(h) != 32 for h in item.group):
                 return False
             if item.group[item.index] != cur:
                 return False

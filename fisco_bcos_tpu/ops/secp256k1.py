@@ -41,15 +41,10 @@ from .ec import (
     reduce_once,
     shamir_double_mul,
     sqrt_mont,
+    valid_scalar,
 )
 
 _CTX = SECP256K1_CTX
-
-
-def _valid_scalar(x: jax.Array, ctx) -> jax.Array:
-    """1 <= x < n."""
-    n = bigint._const(ctx.n.limbs, x)
-    return ~is_zero(x) & lt(x, n)
 
 
 @jax.jit
@@ -61,7 +56,7 @@ def verify_device(z, r, s, qx, qy):
     """
     ctx = _CTX
     p_arr = bigint._const(ctx.p.limbs, qx)
-    valid = _valid_scalar(r, ctx) & _valid_scalar(s, ctx)
+    valid = valid_scalar(r, ctx) & valid_scalar(s, ctx)
     valid &= lt(qx, p_arr) & lt(qy, p_arr)
     qx_m = to_mont(qx, ctx.p)
     qy_m = to_mont(qy, ctx.p)
@@ -87,9 +82,12 @@ def recover_device(z, r, s, v):
     Invalid lanes return qx = qy = 0.
     """
     ctx = _CTX
+    # Exactly the reference's accepted encodings (Secp256k1Crypto.cpp:106):
+    # raw recid 0..3, or v in {27, 28}. 29/30 must NOT alias to 2/3 — the
+    # reference rejects them, and any acceptance difference forks the chain.
+    valid = ((v >= 0) & (v <= 3)) | ((v >= 27) & (v <= 28))
     v = jnp.where(v >= 27, v - 27, v)
-    valid = (v >= 0) & (v <= 3)
-    valid &= _valid_scalar(r, ctx) & _valid_scalar(s, ctx)
+    valid &= valid_scalar(r, ctx) & valid_scalar(s, ctx)
     # x = r + (v & 2 ? n : 0); reject overflow past 2^256 or x >= p
     n_or_0 = jnp.where(
         ((v & 2) != 0)[..., None],

@@ -33,15 +33,11 @@ from .ec import (
     on_curve_mont,
     reduce_once,
     shamir_double_mul,
+    valid_scalar,
 )
 from .sm3 import sm3_batch
 
 _CTX = SM2_CTX
-
-
-def _valid_scalar(x: jax.Array) -> jax.Array:
-    n = bigint._const(_CTX.n.limbs, x)
-    return ~is_zero(x) & lt(x, n)
 
 
 @jax.jit
@@ -53,7 +49,7 @@ def verify_device(e, r, s, qx, qy):
     """
     ctx = _CTX
     p_arr = bigint._const(ctx.p.limbs, qx)
-    valid = _valid_scalar(r) & _valid_scalar(s)
+    valid = valid_scalar(r, ctx) & valid_scalar(s, ctx)
     valid &= lt(qx, p_arr) & lt(qy, p_arr)
     qx_m = to_mont(qx, ctx.p)
     qy_m = to_mont(qy, ctx.p)

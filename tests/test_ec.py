@@ -137,6 +137,15 @@ class TestSecp256k1Batch:
         assert ok27.all()
         np.testing.assert_array_equal(got_pubs27, pubs)
 
+    def test_recover_rejects_v29_v30(self):
+        """v=29/30 must NOT alias to recid 2/3 — the reference rejects them
+        (Secp256k1Crypto.cpp:106 accepts only 0..3 and 27/28)."""
+        hashes, sigs, pubs = self._vectors(2)
+        sigs[0, 64] = 29
+        sigs[1, 64] = 30
+        _, ok = secp256k1.recover_batch(hashes, sigs)
+        assert not ok.any()
+
     def test_recover_invalid_lanes(self):
         hashes, sigs, pubs = self._vectors(3)
         sigs[0, 64] = 9  # bad v

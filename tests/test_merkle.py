@@ -52,6 +52,26 @@ def test_proofs_verify(width):
     assert not MerkleTree.verify_proof(bytes(leaves[0]), 0, 70, tree.proof(0), bad_root, width=width)
 
 
+def test_repartitioned_group_cannot_forge_membership():
+    """Entries in a proof group must each be 32 bytes: repartitioning the
+    same concatenated group bytes (identical parent hash input) must not
+    certify a 32-byte window straddling two real digests as a leaf."""
+    from fisco_bcos_tpu.ops.merkle import MerkleProofItem
+
+    rng = np.random.default_rng(17)
+    leaves = rng.integers(0, 256, (32, 32), dtype=np.uint8)
+    tree = MerkleTree(leaves, width=16)
+    proof = tree.proof(0)
+    cat = b"".join(proof[0].group)  # 16 x 32 = 512 bytes
+    fake_leaf = cat[48:80]  # straddles leaves 1 and 2
+    # 16 entries with the SAME concatenation: 48, 14 x 32, 16 bytes
+    bounds = [0, 48] + [48 + 32 * i for i in range(1, 15)] + [496, 512]
+    forged_group = tuple(cat[bounds[i] : bounds[i + 1]] for i in range(16))
+    assert b"".join(forged_group) == cat and len(forged_group) == 16
+    forged = [MerkleProofItem(group=forged_group, index=1)] + list(proof[1:])
+    assert not MerkleTree.verify_proof(fake_leaf, 1, 32, forged, tree.root, width=16)
+
+
 def test_truncated_proof_cannot_certify_internal_node():
     """A proof with its first level dropped must NOT verify the level-1
     internal digest as a 'leaf' (depth binding)."""
