@@ -65,7 +65,7 @@ def test_repartitioned_group_cannot_forge_membership():
     cat = b"".join(proof[0].group)  # 16 x 32 = 512 bytes
     fake_leaf = cat[48:80]  # straddles leaves 1 and 2
     # 16 entries with the SAME concatenation: 48, 14 x 32, 16 bytes
-    bounds = [0, 48] + [48 + 32 * i for i in range(1, 15)] + [496, 512]
+    bounds = [0, 48] + [48 + 32 * i for i in range(1, 15)] + [512]
     forged_group = tuple(cat[bounds[i] : bounds[i + 1]] for i in range(16))
     assert b"".join(forged_group) == cat and len(forged_group) == 16
     forged = [MerkleProofItem(group=forged_group, index=1)] + list(proof[1:])
