@@ -1,0 +1,67 @@
+"""Fused tx-admission crypto step — the flagship device program.
+
+One device program performs, for a whole block of transactions, what the
+reference does one tx at a time on RPC/txpool threads
+(``TxValidator::verify`` bcos-txpool/txpool/validator/TxValidator.cpp:27-69 →
+``Transaction::verify()`` bcos-framework/protocol/Transaction.h:64-84):
+
+    tx hash (keccak256)  →  ECDSA recover  →  sender = right160(keccak(pub))
+
+The batch enters as pre-padded keccak block tensors plus signature limb
+tensors, and leaves as (sender addresses, validity bitmap, recovered pubkeys).
+Invalid lanes never raise — they lower a validity bit (consensus code must be
+total). See also the #1 batch-verify hot loop in the reference,
+bcos-txpool/sync/TransactionSync.cpp:521-553 (tbb::parallel_for over verify).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..ops import keccak, secp256k1
+from ..ops.address import sender_address_device
+from ..ops.bigint import bytes_be_to_limbs, digest_words_le_to_limbs, limbs_to_bytes_be
+from ..ops.hash_common import bucket_batch, pad_keccak, pad_rows
+
+
+def admission_core(blocks, nblocks, r, s, v):
+    """The fused admission body, unjitted — shared verbatim by the single-chip
+    jit (``admission_step``) and the sharded wrapper
+    (parallel.sharding.sharded_admission), so the two paths cannot drift.
+
+    blocks [B, M, 17, 2] + nblocks [B] are the pre-padded keccak form of each
+    tx's signed payload; (r, s) [B, 16] limbs and v [B] int32 are the 65-byte
+    signature split.
+
+    Returns (addr [B, 20] uint32 bytes, ok bool[B], qx, qy [B, 16] limbs).
+    """
+    words = keccak.keccak256_blocks(blocks, nblocks)
+    z = digest_words_le_to_limbs(words)
+    qx, qy, ok = secp256k1.recover_device(z, r, s, v)
+    addr = sender_address_device(qx, qy)
+    return addr, ok, qx, qy
+
+
+admission_step = jax.jit(admission_core)
+
+
+def admit_batch(payloads, sigs65) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host API: list[bytes] signed payloads + [B, 65] r‖s‖v signatures ->
+    (senders [B, 20] uint8, ok bool[B], pubkeys [B, 64] uint8)."""
+    bsz = len(payloads)
+    bb = bucket_batch(bsz)
+    blocks, nblocks = pad_keccak(list(payloads) + [b""] * (bb - bsz))
+    sigs65 = np.asarray(sigs65, dtype=np.uint8)
+    r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
+    s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
+    v = pad_rows(sigs65[:, 64].astype(np.int32), bb)
+    addr, ok, qx, qy = admission_step(blocks, nblocks, r, s, v)
+    pubs = np.concatenate(
+        [limbs_to_bytes_be(np.asarray(qx)), limbs_to_bytes_be(np.asarray(qy))], axis=-1
+    ).astype(np.uint8)
+    return (
+        np.asarray(addr, dtype=np.uint8)[:bsz],
+        np.asarray(ok)[:bsz],
+        pubs[:bsz],
+    )

@@ -79,6 +79,44 @@ def limbs_to_bytes_be(limbs: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Device-side digest-word -> limb conversion (keeps hash -> EC pipelines fused
+# on device; the reference round-trips through CPU byte buffers instead)
+# ---------------------------------------------------------------------------
+
+
+def _bswap32(w: jax.Array) -> jax.Array:
+    w = w.astype(jnp.uint32)
+    return ((w & 0xFF) << 24) | ((w & 0xFF00) << 8) | ((w >> 8) & 0xFF00) | (w >> 24)
+
+
+def _chunks32_be_to_limbs(chunks: jax.Array) -> jax.Array:
+    """[..., 8] uint32 big-endian-ordered 32-bit chunks -> [..., 16] limbs."""
+    rc = chunks[..., ::-1]  # chunk 7 holds the least-significant 32 bits
+    lo = rc & 0xFFFF
+    hi = rc >> 16
+    return jnp.stack([lo, hi], axis=-1).reshape(*chunks.shape[:-1], LIMBS)
+
+
+def digest_words_le_to_limbs(words: jax.Array) -> jax.Array:
+    """Keccak digest words ([..., 8] uint32 little-endian byte order, digest
+    read as a big-endian 256-bit integer) -> [..., 16] limbs, on device."""
+    return _chunks32_be_to_limbs(_bswap32(words))
+
+
+def digest_words_be_to_limbs(words: jax.Array) -> jax.Array:
+    """SHA-256/SM3 digest words ([..., 8] uint32 big-endian) -> [..., 16] limbs."""
+    return _chunks32_be_to_limbs(words.astype(jnp.uint32))
+
+
+def limbs_to_bytes_device(limbs: jax.Array) -> jax.Array:
+    """[..., 16] limbs -> [..., 32] big-endian bytes (uint32 lanes), on device."""
+    rev = limbs[..., ::-1].astype(jnp.uint32)
+    hi = rev >> 8
+    lo = rev & 0xFF
+    return jnp.stack([hi, lo], axis=-1).reshape(*limbs.shape[:-1], 32)
+
+
+# ---------------------------------------------------------------------------
 # Modulus context (host-precomputed Montgomery constants)
 # ---------------------------------------------------------------------------
 

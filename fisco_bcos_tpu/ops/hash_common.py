@@ -4,7 +4,8 @@ The reference hashes one message at a time on CPU threads (OpenSSL EVP behind
 bcos-crypto's Hash interface, tbb::parallel_for for batches). The TPU
 formulation pads a whole batch into a dense ``[B, M, words]`` block tensor plus
 a per-lane block count; the device kernel scans over the M block slots and
-masks inactive lanes. M is rounded up to a power of two to bound the number of
+masks inactive lanes. M is rounded up to a bounded shape schedule (powers of two, then multiples of
+2048) to bound the number of
 distinct compiled shapes (XLA needs static shapes).
 """
 
@@ -16,14 +17,18 @@ import numpy as np
 
 
 def _bucket(n: int) -> int:
-    """Round up to a power of two (min 1) to bound recompilation."""
-    m = 1
-    while m < n:
-        m *= 2
-    return m
+    """Round up to a bounded set of batch shapes to limit recompilation:
+    powers of two up to 2048, then multiples of 2048 (a 10k-tx block pads to
+    10240 lanes, not 16384 — padding waste stays under 2%)."""
+    if n <= 2048:
+        m = 1
+        while m < n:
+            m *= 2
+        return m
+    return -(-n // 2048) * 2048
 
 
-bucket_pow2 = _bucket  # shared by the EC kernels' host wrappers
+bucket_batch = _bucket  # shared by the EC kernels' host wrappers
 
 
 def pad_rows(a: np.ndarray, rows: int) -> np.ndarray:

@@ -27,7 +27,7 @@ import numpy as np
 
 from . import bigint
 from .bigint import bytes_be_to_limbs, from_mont, limbs_to_bytes_be, to_mont
-from .hash_common import bucket_pow2 as _bucket
+from .hash_common import bucket_batch as _bucket
 from .hash_common import pad_rows as _pad_rows
 from .ec import (
     SECP256K1_CTX,

@@ -23,7 +23,7 @@ import numpy as np
 from ..crypto.ref.ecdsa import SM2_DEFAULT_ID
 from . import bigint
 from .bigint import bytes_be_to_limbs, from_mont, is_zero, to_mont
-from .hash_common import bucket_pow2 as _bucket
+from .hash_common import bucket_batch as _bucket
 from .hash_common import pad_rows as _pad_rows
 from .ec import (
     SM2_CTX,

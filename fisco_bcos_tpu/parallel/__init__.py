@@ -1,0 +1,8 @@
+"""Multi-chip sharding of the verification plane (mesh + collectives)."""
+
+from .sharding import (  # noqa: F401
+    make_mesh,
+    sharded_admission,
+    sharded_state_root,
+    sharded_verify,
+)

@@ -1,0 +1,108 @@
+"""Device-mesh sharding for the batch crypto plane.
+
+The reference scales its hot verify loops with ``tbb::parallel_for`` over CPU
+threads (bcos-txpool/sync/TransactionSync.cpp:521-553) and its state hash the
+same way (bcos-table/src/StateStorage.h:457-486); multi-machine scale comes
+from Tars RPC process sharding. The TPU-native equivalent is a
+``jax.sharding.Mesh``: signature/hash batches are sharded over the ``data``
+axis (lanes ride ICI, not DCN), per-shard results are combined with XLA
+collectives (``psum`` for validity counts and the XOR state root), and the
+validity bitmap is returned fully replicated — the moral equivalent of the
+all-gather of admission results every consensus participant needs.
+
+No NCCL/MPI exists here by design: collectives are emitted by XLA from the
+sharding annotations (see SURVEY.md §2.8 "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..crypto.admission import admission_core
+from ..ops import secp256k1
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D device mesh over the first `n_devices` local devices."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(
+            f"make_mesh: {n} devices requested, only {len(devs)} available"
+        )
+    return Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
+def sharded_verify(mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Batch-sharded secp256k1 verify.
+
+    Returns a jitted fn (z, r, s, qx, qy) -> (ok bool[B], n_valid int32[]);
+    inputs [B, 16] limb tensors with B divisible by the mesh size. `ok` comes
+    back replicated (all-gather), `n_valid` via psum.
+    """
+
+    def local(z, r, s, qx, qy):
+        ok = secp256k1.verify_device(z, r, s, qx, qy)
+        n_valid = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis_name)
+        return jax.lax.all_gather(ok, axis_name, tiled=True), n_valid
+
+    spec = P(axis_name)
+    f = jax.shard_map(
+        local,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(f)
+
+
+def sharded_admission(mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Batch-sharded fused admission (hash → recover → address), the sharded
+    form of crypto.admission.admission_step.
+
+    Returns a jitted fn (blocks, nblocks, r, s, v) ->
+    (addr [B, 20] replicated, ok bool[B] replicated, n_valid int32[]).
+    """
+
+    def local(blocks, nblocks, r, s, v):
+        addr, ok, _qx, _qy = admission_core(blocks, nblocks, r, s, v)
+        n_valid = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis_name)
+        return (
+            jax.lax.all_gather(addr, axis_name, tiled=True),
+            jax.lax.all_gather(ok, axis_name, tiled=True),
+            n_valid,
+        )
+
+    spec = P(axis_name)
+    f = jax.shard_map(
+        local,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(f)
+
+
+def sharded_state_root(mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Order-independent XOR state root over sharded entry digests.
+
+    The reference folds dirty-entry hashes with XOR under tbb
+    (StateStorage.h:457-486 — XOR makes the root order-independent, which is
+    exactly what makes it shardable). fn: digests [B, 8] uint32 -> [8] uint32.
+    """
+
+    def local(digests):
+        partial = jnp.bitwise_xor.reduce(digests, axis=0)
+        # XOR-reduce across shards: psum has no xor variant, so gather + fold.
+        allp = jax.lax.all_gather(partial, axis_name)
+        return jnp.bitwise_xor.reduce(allp, axis=0)
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=(P(axis_name),), out_specs=P(), check_vma=False)
+    return jax.jit(f)
