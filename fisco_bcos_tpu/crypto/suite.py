@@ -1,0 +1,271 @@
+"""CryptoSuite — the crypto plugin seam, with first-class batch APIs.
+
+Mirrors the capability surface of the reference's plugin layer
+(bcos-crypto/interfaces/crypto/CryptoSuite.h:33-69, Signature.h:31-58,
+Hash.h:37-60; suite selection in libinitializer/ProtocolInitializer.cpp:51-99:
+``sm_crypto ? (SM3+SM2+SM4) : (Keccak256+Secp256k1+AES)``) — but where the
+reference's `SignatureCrypto` is single-item only (the TPU batch API is the
+whole point of this build, per BASELINE.json), every hash and signature
+implementation here carries `hash_batch` / `batch_verify` / `batch_recover`
+that run one fused device program over the whole batch.
+
+Single-item calls use the pure-CPU reference implementations (crypto/ref) —
+device round-trips don't pay off below ~hundreds of items; batch calls go to
+the ops kernels. Both produce bit-identical results (golden-vector tested) —
+any divergence would fork a chain.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops import keccak as keccak_ops
+from ..ops import secp256k1 as secp_ops
+from ..ops import sha256 as sha256_ops
+from ..ops import sm2 as sm2_ops
+from ..ops import sm3 as sm3_ops
+from ..utils.bytesutil import right160
+from .ref import ecdsa as ref_ecdsa
+from .ref.keccak import keccak256 as ref_keccak256
+from .ref.sha2 import sha256 as ref_sha256
+from .ref.sm3 import sm3 as ref_sm3
+
+# ---------------------------------------------------------------------------
+# Hash implementations
+# ---------------------------------------------------------------------------
+
+
+class HashImpl:
+    """Hash interface (reference: bcos-crypto Hash.h:37-60 + AnyHasher)."""
+
+    name: str = ""
+
+    def hash(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def hash_batch(self, msgs) -> np.ndarray:
+        """list[bytes] -> [B, 32] uint8 digests, one device program."""
+        raise NotImplementedError
+
+
+class Keccak256(HashImpl):
+    name = "keccak256"
+
+    def hash(self, data: bytes) -> bytes:
+        return ref_keccak256(data)
+
+    def hash_batch(self, msgs) -> np.ndarray:
+        return keccak_ops.keccak256_batch(msgs)
+
+
+class SM3(HashImpl):
+    name = "sm3"
+
+    def hash(self, data: bytes) -> bytes:
+        return ref_sm3(data)
+
+    def hash_batch(self, msgs) -> np.ndarray:
+        return sm3_ops.sm3_batch(msgs)
+
+
+class Sha256(HashImpl):
+    name = "sha256"
+
+    def hash(self, data: bytes) -> bytes:
+        return ref_sha256(data)
+
+    def hash_batch(self, msgs) -> np.ndarray:
+        return sha256_ops.sha256_batch(msgs)
+
+
+# ---------------------------------------------------------------------------
+# Key pairs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KeyPair:
+    """Secret scalar + uncompressed public key (reference: KeyPairInterface)."""
+
+    secret: int
+    pub: bytes  # 64 bytes, x‖y big-endian
+
+    @property
+    def pub_x(self) -> int:
+        return int.from_bytes(self.pub[:32], "big")
+
+    @property
+    def pub_y(self) -> int:
+        return int.from_bytes(self.pub[32:], "big")
+
+
+def _make_keypair(curve: ref_ecdsa.Curve, secret: int | None) -> KeyPair:
+    if secret is None:
+        secret = secrets.randbelow(curve.n - 1) + 1
+    x, y = ref_ecdsa.privkey_to_pubkey(curve, secret)
+    return KeyPair(secret, x.to_bytes(32, "big") + y.to_bytes(32, "big"))
+
+
+# ---------------------------------------------------------------------------
+# Signature implementations
+# ---------------------------------------------------------------------------
+
+
+class SignatureCrypto:
+    """Signature interface (reference: Signature.h:31-58) + batch extension.
+
+    sign/verify/recover operate on 32-byte message hashes. `recover` returns
+    the 64-byte uncompressed public key or raises; batch variants return
+    validity masks instead of raising (invalid lanes lower a bit).
+    """
+
+    name: str = ""
+    sig_len: int = 0
+
+    def generate_keypair(self, secret: int | None = None) -> KeyPair:
+        raise NotImplementedError
+
+    def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
+        raise NotImplementedError
+
+    def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        raise NotImplementedError
+
+    def recover(self, msg_hash: bytes, sig: bytes) -> bytes:
+        raise NotImplementedError
+
+    def batch_verify(
+        self, msg_hashes: np.ndarray, pubs: np.ndarray, sigs: np.ndarray
+    ) -> np.ndarray:
+        raise NotImplementedError
+
+    def batch_recover(
+        self, msg_hashes: np.ndarray, sigs: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+
+class Secp256k1Crypto(SignatureCrypto):
+    """65-byte r‖s‖v signatures, v ∈ {0..3} ∪ {27, 28}
+    (reference: Secp256k1Crypto.cpp:32-136)."""
+
+    name = "secp256k1"
+    sig_len = 65
+
+    def generate_keypair(self, secret: int | None = None) -> KeyPair:
+        return _make_keypair(ref_ecdsa.SECP256K1, secret)
+
+    def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
+        r, s, v = ref_ecdsa.ecdsa_sign(msg_hash, kp.secret)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
+
+    def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:64], "big")
+        p = (int.from_bytes(pub[:32], "big"), int.from_bytes(pub[32:], "big"))
+        return ref_ecdsa.ecdsa_verify(msg_hash, r, s, p)
+
+    def recover(self, msg_hash: bytes, sig: bytes) -> bytes:
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:64], "big")
+        pub = ref_ecdsa.ecdsa_recover(msg_hash, r, s, sig[64])
+        if pub is None:
+            raise ValueError("secp256k1 recover failed")
+        x, y = pub
+        return x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+    def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
+        sigs = np.asarray(sigs, dtype=np.uint8)
+        return secp_ops.verify_batch(
+            np.asarray(msg_hashes, dtype=np.uint8),
+            sigs[:, :32],
+            sigs[:, 32:64],
+            np.asarray(pubs, dtype=np.uint8),
+        )
+
+    def batch_recover(self, msg_hashes, sigs):
+        return secp_ops.recover_batch(
+            np.asarray(msg_hashes, dtype=np.uint8), np.asarray(sigs, dtype=np.uint8)
+        )
+
+
+class SM2Crypto(SignatureCrypto):
+    """128-byte r‖s‖pubkey signatures; "recover" parses the carried pubkey and
+    verifies (reference: SM2Crypto.cpp:29-91 — sign appends the pubkey,
+    recover = parse-pub-then-verify)."""
+
+    name = "sm2"
+    sig_len = 128
+
+    def generate_keypair(self, secret: int | None = None) -> KeyPair:
+        return _make_keypair(ref_ecdsa.SM2_CURVE, secret)
+
+    def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
+        r, s = ref_ecdsa.sm2_sign(msg_hash, kp.secret)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + kp.pub
+
+    def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:64], "big")
+        p = (int.from_bytes(pub[:32], "big"), int.from_bytes(pub[32:], "big"))
+        return ref_ecdsa.sm2_verify(msg_hash, r, s, p)
+
+    def recover(self, msg_hash: bytes, sig: bytes) -> bytes:
+        pub = sig[64:128]
+        if not self.verify(pub, msg_hash, sig[:64] + pub):
+            raise ValueError("sm2 recover: carried pubkey fails verification")
+        return pub
+
+    def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
+        sigs = np.asarray(sigs, dtype=np.uint8)
+        return sm2_ops.verify_batch(
+            np.asarray(msg_hashes, dtype=np.uint8),
+            sigs[:, :32],
+            sigs[:, 32:64],
+            np.asarray(pubs, dtype=np.uint8),
+        )
+
+    def batch_recover(self, msg_hashes, sigs):
+        return sm2_ops.recover_batch(
+            np.asarray(msg_hashes, dtype=np.uint8), np.asarray(sigs, dtype=np.uint8)
+        )
+
+
+# ---------------------------------------------------------------------------
+# The suite
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CryptoSuite:
+    """Hash + signature bundle (reference: CryptoSuite.h:33-69)."""
+
+    hash_impl: HashImpl
+    signature_impl: SignatureCrypto
+
+    def hash(self, data: bytes) -> bytes:
+        return self.hash_impl.hash(data)
+
+    def hash_batch(self, msgs) -> np.ndarray:
+        return self.hash_impl.hash_batch(msgs)
+
+    def calculate_address(self, pub: bytes) -> bytes:
+        """right160(hash(pubkey)) — CryptoSuite.h:56-59."""
+        return right160(self.hash_impl.hash(pub))
+
+    def calculate_address_batch(self, pubs: np.ndarray) -> np.ndarray:
+        digests = self.hash_impl.hash_batch([bytes(p) for p in np.asarray(pubs)])
+        return digests[:, 12:]
+
+
+def ecdsa_suite() -> CryptoSuite:
+    """Keccak256 + secp256k1 (the reference's default, non-SM suite)."""
+    return CryptoSuite(Keccak256(), Secp256k1Crypto())
+
+
+def sm_suite() -> CryptoSuite:
+    """SM3 + SM2 (the reference's sm_crypto=true national suite)."""
+    return CryptoSuite(SM3(), SM2Crypto())

@@ -1,0 +1,51 @@
+"""Deterministic signed-payload vector generation (bench + graft entry).
+
+Signs a small unique payload set with fixed keys and tiles it to the target
+batch — the same trick the reference's TPS harness uses
+(bcos-rpc DuplicateTransactionFactory.cpp duplicates one signed tx N×).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.bigint import bytes_be_to_limbs
+from ..ops.hash_common import pad_keccak
+from .ref import ecdsa as ref_ecdsa
+from .ref.keccak import keccak256
+
+
+def signed_payload_vectors(
+    n: int,
+    unique: int = 8,
+    payload_fn=lambda i: b"fisco-bcos-tpu vector tx %06d" % i,
+    secret_fn=lambda i: 0xC0FFEE + 7919 * i,
+):
+    """-> (payloads list[bytes] len n, sigs65 [n, 65] uint8, digests, pubs),
+    with `unique` distinct signers/payloads tiled to n."""
+    unique = min(n, unique)
+    payloads, sigs, digests, pubs = [], [], [], []
+    for i in range(unique):
+        payload = payload_fn(i)
+        d = secret_fn(i)
+        h = keccak256(payload)
+        r, s, v = ref_ecdsa.ecdsa_sign(h, d)
+        payloads.append(payload)
+        digests.append(h)
+        sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v]))
+        pubs.append(ref_ecdsa.privkey_to_pubkey(ref_ecdsa.SECP256K1, d))
+    reps = -(-n // unique)
+    payloads = (payloads * reps)[:n]
+    sigs65 = np.frombuffer(b"".join(sigs * reps), dtype=np.uint8).reshape(-1, 65)[:n]
+    return payloads, sigs65, digests, pubs
+
+
+def admission_tensors(payloads, sigs65):
+    """Host-padded device tensors for crypto.admission.admission_step:
+    (blocks, nblocks, r, s, v) as numpy arrays."""
+    blocks, nblocks = pad_keccak(payloads)
+    sigs65 = np.asarray(sigs65, dtype=np.uint8)
+    r = bytes_be_to_limbs(sigs65[:, :32])
+    s = bytes_be_to_limbs(sigs65[:, 32:64])
+    v = sigs65[:, 64].astype(np.int32)
+    return blocks, nblocks, r, s, v

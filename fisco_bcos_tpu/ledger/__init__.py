@@ -1,0 +1,3 @@
+"""Ledger: chain data schema, genesis, block access, merkle proofs."""
+
+from .ledger import GenesisConfig, Ledger, LedgerConfig, ConsensusNode  # noqa: F401

@@ -129,7 +129,8 @@ def recover_device(z, r, s, v):
 
 
 # ---------------------------------------------------------------------------
-# Host wrappers (bytes in / bytes out, batch padded to a power of two)
+# Host wrappers (bytes in / bytes out, batch padded per hash_common._bucket:
+# powers of two up to 2048, then multiples of 2048)
 # ---------------------------------------------------------------------------
 
 

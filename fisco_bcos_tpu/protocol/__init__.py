@@ -1,0 +1,11 @@
+"""Protocol objects: Transaction, Receipt, BlockHeader, Block.
+
+The data-object layer the reference defines once as Tars structs and wraps
+with framework interfaces (bcos-framework/protocol/*.h +
+bcos-tars-protocol/protocol/*Impl.*). Canonical bytes come from codec.flat.
+"""
+
+from .transaction import Transaction, TransactionAttribute, TransactionFactory  # noqa: F401
+from .receipt import LogEntry, TransactionReceipt, TransactionStatus  # noqa: F401
+from .block_header import BlockHeader, ParentInfo, SignatureTuple  # noqa: F401
+from .block import Block  # noqa: F401

@@ -1,0 +1,95 @@
+"""Transaction receipt + status codes.
+
+Mirrors bcos-framework/protocol/TransactionReceipt.h and the tars struct
+(bcos-tars-protocol/tars/TransactionReceipt.tars); status values from
+bcos-protocol/TransactionStatus.h.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..crypto.suite import CryptoSuite
+
+
+class TransactionStatus(IntEnum):
+    NONE = 0
+    UNKNOWN = 1
+    OUT_OF_GAS_LIMIT = 2
+    NOT_ENOUGH_CASH = 7
+    BAD_INSTRUCTION = 10
+    REVERT_INSTRUCTION = 12
+    STACK_OVERFLOW = 14
+    STACK_UNDERFLOW = 15
+    PRECOMPILED_ERROR = 24
+    INTERNAL_ERROR = 25
+    TYPE_ERROR = 26
+    CREATE_SYSTEM_RESERVED_ADDRESS = 27
+
+
+@dataclass
+class LogEntry:
+    address: bytes = b""
+    topics: list[bytes] = field(default_factory=list)
+    data: bytes = b""
+
+    def encode_into(self, w: FlatWriter) -> None:
+        w.bytes_(self.address)
+        w.seq(self.topics, lambda w2, t: w2.fixed(t, 32))
+        w.bytes_(self.data)
+
+    @classmethod
+    def decode_from(cls, r: FlatReader) -> "LogEntry":
+        return cls(
+            address=r.bytes_(),
+            topics=r.seq(lambda r2: r2.fixed(32)),
+            data=r.bytes_(),
+        )
+
+
+@dataclass
+class TransactionReceipt:
+    version: int = 0
+    gas_used: int = 0
+    contract_address: bytes = b""
+    status: int = 0
+    output: bytes = b""
+    log_entries: list[LogEntry] = field(default_factory=list)
+    block_number: int = 0
+    effective_gas_price: str = ""
+    _hash: bytes | None = field(default=None, repr=False)
+
+    def encode(self) -> bytes:
+        w = FlatWriter()
+        w.u32(self.version)
+        w.u64(self.gas_used)
+        w.bytes_(self.contract_address)
+        w.u32(self.status)
+        w.bytes_(self.output)
+        w.seq(self.log_entries, lambda w2, e: e.encode_into(w2))
+        w.i64(self.block_number)
+        w.str_(self.effective_gas_price)
+        return w.out()
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "TransactionReceipt":
+        r = FlatReader(buf)
+        rc = cls(
+            version=r.u32(),
+            gas_used=r.u64(),
+            contract_address=r.bytes_(),
+            status=r.u32(),
+            output=r.bytes_(),
+            log_entries=r.seq(LogEntry.decode_from),
+            block_number=r.i64(),
+            effective_gas_price=r.str_(),
+        )
+        r.done()
+        return rc
+
+    def hash(self, suite: CryptoSuite) -> bytes:
+        if self._hash is None:
+            self._hash = suite.hash(self.encode())
+        return self._hash

@@ -1,0 +1,54 @@
+"""In-memory transactional backend (tests + fakers; FakeKVStorage analog)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+from .entry import Entry
+from .interfaces import TransactionalStorage, TraversableStorage, TwoPCParams
+
+
+class MemoryStorage(TransactionalStorage):
+    def __init__(self) -> None:
+        self._data: dict[tuple[str, bytes], Entry] = {}
+        self._pending: dict[int, list[tuple[str, bytes, Entry]]] = {}
+        self._lock = threading.RLock()
+
+    def get_row(self, table: str, key: bytes) -> Entry | None:
+        with self._lock:
+            e = self._data.get((table, bytes(key)))
+            return None if e is None or e.deleted else e.copy()
+
+    def set_row(self, table: str, key: bytes, entry: Entry) -> None:
+        with self._lock:
+            self._data[(table, bytes(key))] = entry.copy()
+
+    def get_primary_keys(self, table: str) -> list[bytes]:
+        with self._lock:
+            return sorted(
+                k for (t, k), e in self._data.items() if t == table and not e.deleted
+            )
+
+    def traverse(self) -> Iterator[tuple[str, bytes, Entry]]:
+        with self._lock:
+            items = list(self._data.items())
+        for (t, k), e in items:
+            yield t, k, e.copy()
+
+    # -- 2PC ------------------------------------------------------------
+
+    def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
+        with self._lock:
+            self._pending[params.number] = [
+                (t, k, e.copy()) for t, k, e in writes.traverse()
+            ]
+
+    def commit(self, params: TwoPCParams) -> None:
+        with self._lock:
+            for t, k, e in self._pending.pop(params.number, []):
+                self._data[(t, bytes(k))] = e
+
+    def rollback(self, params: TwoPCParams) -> None:
+        with self._lock:
+            self._pending.pop(params.number, None)

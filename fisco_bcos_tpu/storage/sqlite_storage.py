@@ -1,0 +1,88 @@
+"""Durable KV backend on sqlite3 (stdlib) with two-phase commit.
+
+Plays the role of bcos-storage's RocksDBStorage.cpp (574 lines: asyncPrepare
+stages a WriteBatch, asyncCommit writes it atomically, asyncRollback drops
+it). Sqlite gives us the same contract — single-writer atomic batches with
+WAL journaling — without a non-baked-in rocksdb dependency; the storage seam
+(interfaces.TransactionalStorage) is what the rest of the stack codes
+against, so swapping in a native engine later is a constructor change.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterator
+
+from .entry import Entry
+from .interfaces import TransactionalStorage, TraversableStorage, TwoPCParams
+
+
+class SQLiteStorage(TransactionalStorage):
+    def __init__(self, path: str = ":memory:") -> None:
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self._pending: dict[int, list[tuple[str, bytes, Entry]]] = {}
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                " tbl TEXT NOT NULL, k BLOB NOT NULL, v BLOB NOT NULL,"
+                " PRIMARY KEY (tbl, k))"
+            )
+            self._conn.commit()
+
+    def get_row(self, table: str, key: bytes) -> Entry | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE tbl=? AND k=?", (table, bytes(key))
+            ).fetchone()
+        if row is None:
+            return None
+        e = Entry.decode(row[0])
+        return None if e.deleted else e
+
+    def set_row(self, table: str, key: bytes, entry: Entry) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (tbl, k, v) VALUES (?, ?, ?)",
+                (table, bytes(key), entry.encode()),
+            )
+            self._conn.commit()
+
+    def get_primary_keys(self, table: str) -> list[bytes]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE tbl=? ORDER BY k", (table,)
+            ).fetchall()
+        return [bytes(k) for k, v in rows if not Entry.decode(v).deleted]
+
+    def traverse(self) -> Iterator[tuple[str, bytes, Entry]]:
+        with self._lock:
+            rows = self._conn.execute("SELECT tbl, k, v FROM kv").fetchall()
+        for t, k, v in rows:
+            yield t, bytes(k), Entry.decode(v)
+
+    # -- 2PC ------------------------------------------------------------
+
+    def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
+        staged = [(t, bytes(k), e.copy()) for t, k, e in writes.traverse()]
+        with self._lock:
+            self._pending[params.number] = staged
+
+    def commit(self, params: TwoPCParams) -> None:
+        with self._lock:
+            staged = self._pending.pop(params.number, [])
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO kv (tbl, k, v) VALUES (?, ?, ?)",
+                [(t, k, e.encode()) for t, k, e in staged],
+            )
+            self._conn.commit()
+
+    def rollback(self, params: TwoPCParams) -> None:
+        with self._lock:
+            self._pending.pop(params.number, None)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

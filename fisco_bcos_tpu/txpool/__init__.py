@@ -1,0 +1,4 @@
+"""TxPool: admission (batch sig-verify on device), pool storage, sealing."""
+
+from .txpool import TxPool, TxSubmitResult  # noqa: F401
+from .validator import TxValidator, batch_admit  # noqa: F401

@@ -1,0 +1,205 @@
+"""TxPool — admission, pool storage, sealing, proposal verification.
+
+Reference: bcos-txpool/TxPool.cpp + txpool/storage/MemoryStorage.cpp. The pool
+holds verified txs keyed by hash; the sealer fetches unsealed batches
+(batchFetchTxs, MemoryStorage.cpp:619-726); consensus verifies proposals by
+hash-presence and batch-verifies any txs it had to fetch
+(batchVerifyProposal, MemoryStorage.cpp:982-1021; importDownloadedTxs'
+tbb-parallel verify at TransactionSync.cpp:521-553 → here one device batch).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..crypto.suite import CryptoSuite
+from ..ledger import Ledger
+from ..protocol.transaction import Transaction, hash_transactions_batch
+from ..utils.error import ErrorCode
+from ..utils.log import get_logger
+from .validator import (
+    LedgerNonceChecker,
+    TxPoolNonceChecker,
+    TxValidator,
+    batch_admit,
+)
+
+_log = get_logger("txpool")
+
+
+@dataclass
+class TxSubmitResult:
+    tx_hash: bytes
+    status: ErrorCode
+    sender: bytes = b""
+
+
+class TxPool:
+    def __init__(
+        self,
+        suite: CryptoSuite,
+        ledger: Ledger,
+        chain_id: str = "chain0",
+        group_id: str = "group0",
+        pool_limit: int = 15000 * 9,
+        block_limit: int = 600,
+    ):
+        self.suite = suite
+        self.ledger = ledger
+        self.pool_limit = pool_limit
+        self._txs: dict[bytes, Transaction] = {}
+        self._sealed: set[bytes] = set()
+        self._lock = threading.RLock()
+        self.pool_nonces = TxPoolNonceChecker()
+        self.ledger_nonces = LedgerNonceChecker(block_limit)
+        self.validator = TxValidator(
+            suite, chain_id, group_id, self.pool_nonces, self.ledger_nonces
+        )
+        # prime the replay window from the chain head
+        head = ledger.block_number()
+        for n in range(max(1, head - block_limit + 1), head + 1):
+            self.ledger_nonces.commit_block(n, ledger.nonces_by_number(n))
+        if head:
+            self.ledger_nonces.commit_block(head, [])
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, tx: Transaction) -> TxSubmitResult:
+        """Single-tx admission (RPC path; TxPool.cpp:68 submitTransaction)."""
+        with self._lock:
+            if len(self._txs) >= self.pool_limit:
+                return TxSubmitResult(b"", ErrorCode.TX_POOL_FULL)
+        h = tx.hash(self.suite)
+        with self._lock:
+            if h in self._txs:
+                return TxSubmitResult(h, ErrorCode.TX_POOL_ALREADY_KNOWN)
+        code = self.validator.verify(tx)
+        if code != ErrorCode.SUCCESS:
+            return TxSubmitResult(h, code)
+        self._insert(tx, h)
+        return TxSubmitResult(h, ErrorCode.SUCCESS, tx.sender)
+
+    def submit_batch(self, txs: list[Transaction]) -> list[TxSubmitResult]:
+        """Batch admission: one device program for every signature
+        (the TPU replacement for the reference's per-tx verify loop)."""
+        hashes = hash_transactions_batch(txs, self.suite)
+        results: list[TxSubmitResult | None] = [None] * len(txs)
+        to_verify: list[int] = []
+        with self._lock:
+            room = self.pool_limit - len(self._txs)
+        for i, (tx, h) in enumerate(zip(txs, hashes)):
+            with self._lock:
+                known = h in self._txs
+            if known:
+                results[i] = TxSubmitResult(h, ErrorCode.TX_POOL_ALREADY_KNOWN)
+                continue
+            code = self.validator.check_static(tx)
+            if code != ErrorCode.SUCCESS:
+                results[i] = TxSubmitResult(h, code)
+                continue
+            if len(to_verify) >= room:
+                results[i] = TxSubmitResult(h, ErrorCode.TX_POOL_FULL)
+                continue
+            to_verify.append(i)
+        if to_verify:
+            ok = batch_admit([txs[i] for i in to_verify], self.suite)
+            for j, i in enumerate(to_verify):
+                if ok[j]:
+                    self._insert(txs[i], hashes[i])
+                    results[i] = TxSubmitResult(
+                        hashes[i], ErrorCode.SUCCESS, txs[i].sender
+                    )
+                else:
+                    results[i] = TxSubmitResult(hashes[i], ErrorCode.INVALID_SIGNATURE)
+        return results  # type: ignore[return-value]
+
+    def _insert(self, tx: Transaction, h: bytes) -> None:
+        with self._lock:
+            self._txs[h] = tx
+        self.pool_nonces.insert(tx.nonce)
+
+    # -- queries -------------------------------------------------------------
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def unsealed_count(self) -> int:
+        with self._lock:
+            return len(self._txs) - len(self._sealed)
+
+    def get(self, h: bytes) -> Transaction | None:
+        with self._lock:
+            return self._txs.get(h)
+
+    def fetch_txs(self, hashes: list[bytes]) -> list[Transaction | None]:
+        """Fill a proposal's metadata with pooled txs (asyncFillBlock)."""
+        with self._lock:
+            return [self._txs.get(h) for h in hashes]
+
+    # -- sealing -------------------------------------------------------------
+
+    def seal_txs(self, limit: int) -> list[Transaction]:
+        """Pick ≤limit unsealed txs and mark them sealed
+        (asyncSealTxs → batchFetchTxs, MemoryStorage.cpp:619)."""
+        out: list[Transaction] = []
+        with self._lock:
+            for h, tx in self._txs.items():
+                if h in self._sealed:
+                    continue
+                self._sealed.add(h)
+                out.append(tx)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def unseal(self, hashes: list[bytes]) -> None:
+        """Return sealed txs to the pool (failed proposal)."""
+        with self._lock:
+            self._sealed.difference_update(hashes)
+
+    # -- proposal verification (consensus path) ------------------------------
+
+    def verify_block(
+        self, tx_hashes: list[bytes], fetch_missing=None
+    ) -> tuple[bool, list[bytes]]:
+        """Hash-presence check for a proposal (asyncVerifyBlock →
+        batchVerifyProposal). Unknown txs are fetched via `fetch_missing`
+        (sync-from-peers hook) and batch-verified on device before import.
+        Returns (all known/valid, missing hashes)."""
+        with self._lock:
+            missing = [h for h in tx_hashes if h not in self._txs]
+        if not missing:
+            return True, []
+        if fetch_missing is None:
+            return False, missing
+        fetched = fetch_missing(missing)
+        got = [t for t in fetched if t is not None]
+        if len(got) != len(missing):
+            return False, missing
+        ok = batch_admit(got, self.suite)
+        if not ok.all():
+            return False, missing
+        for t in got:
+            code = self.validator.check_static(t)
+            if code not in (ErrorCode.SUCCESS, ErrorCode.ALREADY_IN_TX_POOL):
+                return False, missing
+            self._insert(t, t.hash(self.suite))
+        return True, []
+
+    # -- block lifecycle -----------------------------------------------------
+
+    def on_block_committed(self, number: int, tx_hashes: list[bytes]) -> None:
+        """Drop committed txs, advance the nonce window
+        (asyncNotifyBlockResult)."""
+        nonces = []
+        with self._lock:
+            for h in tx_hashes:
+                tx = self._txs.pop(h, None)
+                self._sealed.discard(h)
+                if tx is not None:
+                    nonces.append(tx.nonce)
+                    self.pool_nonces.remove(tx.nonce)
+        self.ledger_nonces.commit_block(number, nonces)
+        _log.info("block %d committed: dropped %d txs", number, len(tx_hashes))
