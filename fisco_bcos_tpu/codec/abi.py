@@ -1,0 +1,154 @@
+"""Minimal Solidity ABI codec.
+
+Reference: bcos-codec/abi/ContractABICodec.* (used by every precompile for
+input parsing and output encoding, e.g.
+bcos-executor/src/precompiled/extension/DagTransferPrecompiled.cpp:44-64's
+name2Selector table). Supports the types the system/benchmark precompiles
+use: uint256/int256, address, bool, string, bytes, bytes32, and dynamic
+arrays of them. Function selector = first 4 bytes of hash("name(type,...)"),
+where the hash is the suite hash (keccak256, or SM3 on SM chains — matching
+the reference's getFuncSelector, common/Utilities.cpp).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_WORD = 32
+
+
+def _pad32(b: bytes, left: bool = True) -> bytes:
+    if len(b) % _WORD == 0 and b:
+        return b
+    pad = _WORD - (len(b) % _WORD or _WORD)
+    return (b"\x00" * pad + b) if left else (b + b"\x00" * pad)
+
+
+def _is_dynamic(typ: str) -> bool:
+    return typ in ("string", "bytes") or typ.endswith("[]")
+
+
+def _encode_static(typ: str, val: Any) -> bytes:
+    if typ.startswith("uint") or typ == "bool":
+        v = int(val)
+        if v < 0:
+            raise ValueError(f"{typ} cannot encode negative {v}")
+        return v.to_bytes(_WORD, "big")
+    if typ.startswith("int"):
+        return int(val).to_bytes(_WORD, "big", signed=True)
+    if typ == "address":
+        b = bytes.fromhex(val[2:] if isinstance(val, str) else val.hex())
+        if isinstance(val, (bytes, bytearray)):
+            b = bytes(val)
+        if len(b) != 20:
+            raise ValueError("address must be 20 bytes")
+        return b"\x00" * 12 + b
+    if typ == "bytes32":
+        b = bytes(val)
+        if len(b) > 32:
+            raise ValueError("bytes32 overflow")
+        return b.ljust(32, b"\x00")
+    raise ValueError(f"unsupported static type {typ}")
+
+
+def _encode_one(typ: str, val: Any) -> bytes:
+    """Encoding of one value; for dynamic types this is the *tail* data."""
+    if typ == "string":
+        val = val.encode() if isinstance(val, str) else bytes(val)
+        return len(val).to_bytes(_WORD, "big") + _pad32(val, left=False)
+    if typ == "bytes":
+        val = bytes(val)
+        return len(val).to_bytes(_WORD, "big") + _pad32(val, left=False)
+    if typ.endswith("[]"):
+        elem = typ[:-2]
+        return len(val).to_bytes(_WORD, "big") + abi_encode([elem] * len(val), val)
+    return _encode_static(typ, val)
+
+
+def abi_encode(types: list[str], values: list[Any]) -> bytes:
+    """Head/tail ABI encoding of a value tuple."""
+    if len(types) != len(values):
+        raise ValueError("types/values length mismatch")
+    heads: list[bytes] = []
+    tails: list[bytes] = []
+    head_len = _WORD * len(types)
+    for typ, val in zip(types, values):
+        if _is_dynamic(typ):
+            offset = head_len + sum(len(t) for t in tails)
+            heads.append(offset.to_bytes(_WORD, "big"))
+            tails.append(_encode_one(typ, val))
+        else:
+            heads.append(_encode_static(typ, val))
+    return b"".join(heads) + b"".join(tails)
+
+
+def _decode_static(typ: str, word: bytes) -> Any:
+    if typ.startswith("uint"):
+        return int.from_bytes(word, "big")
+    if typ == "bool":
+        return bool(int.from_bytes(word, "big"))
+    if typ.startswith("int"):
+        return int.from_bytes(word, "big", signed=True)
+    if typ == "address":
+        return word[12:]
+    if typ == "bytes32":
+        return word
+    raise ValueError(f"unsupported static type {typ}")
+
+
+def _decode_one(typ: str, data: bytes, offset: int) -> Any:
+    if typ == "string" or typ == "bytes":
+        n = int.from_bytes(data[offset : offset + _WORD], "big")
+        raw = data[offset + _WORD : offset + _WORD + n]
+        if len(raw) != n:
+            raise ValueError("abi decode: truncated dynamic data")
+        return raw.decode() if typ == "string" else raw
+    if typ.endswith("[]"):
+        elem = typ[:-2]
+        n = int.from_bytes(data[offset : offset + _WORD], "big")
+        return abi_decode([elem] * n, data[offset + _WORD :])
+    return _decode_static(typ, data[offset : offset + _WORD])
+
+
+def abi_decode(types: list[str], data: bytes) -> list[Any]:
+    out: list[Any] = []
+    for i, typ in enumerate(types):
+        word = data[i * _WORD : (i + 1) * _WORD]
+        if len(word) != _WORD:
+            raise ValueError("abi decode: truncated head")
+        if _is_dynamic(typ):
+            out.append(_decode_one(typ, data, int.from_bytes(word, "big")))
+        else:
+            out.append(_decode_static(typ, word))
+    return out
+
+
+class ABICodec:
+    """Selector-aware codec bound to a crypto suite's hash
+    (reference: ContractABICodec + getFuncSelector)."""
+
+    def __init__(self, hash_fn):
+        self._hash = hash_fn
+
+    def selector(self, signature: str) -> bytes:
+        return self._hash(signature.encode())[:4]
+
+    @staticmethod
+    def _sig_types(signature: str) -> list[str]:
+        inner = signature[signature.index("(") + 1 : signature.rindex(")")]
+        return [t.strip() for t in inner.split(",") if t.strip()]
+
+    def encode_call(self, signature: str, *values: Any) -> bytes:
+        return self.selector(signature) + abi_encode(
+            self._sig_types(signature), list(values)
+        )
+
+    def decode_input(self, signature: str, data: bytes) -> list[Any]:
+        """Decode calldata that includes the 4-byte selector."""
+        return abi_decode(self._sig_types(signature), data[4:])
+
+    def encode_output(self, types: list[str], *values: Any) -> bytes:
+        return abi_encode(types, list(values))
+
+    def decode_output(self, types: list[str], data: bytes) -> list[Any]:
+        return abi_decode(types, data)

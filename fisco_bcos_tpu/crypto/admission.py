@@ -34,21 +34,26 @@ def admission_core(blocks, nblocks, r, s, v):
     tx's signed payload; (r, s) [B, 16] limbs and v [B] int32 are the 65-byte
     signature split.
 
-    Returns (addr [B, 20] uint32 bytes, ok bool[B], qx, qy [B, 16] limbs).
+    Returns (addr [B, 20] uint32 bytes, ok bool[B], qx, qy, z [B, 16] limbs) —
+    z is the tx hash as limbs, returned so callers reuse the digests instead
+    of re-hashing the payloads in a second device pass.
     """
     words = keccak.keccak256_blocks(blocks, nblocks)
     z = digest_words_le_to_limbs(words)
     qx, qy, ok = secp256k1.recover_device(z, r, s, v)
     addr = sender_address_device(qx, qy)
-    return addr, ok, qx, qy
+    return addr, ok, qx, qy, z
 
 
 admission_step = jax.jit(admission_core)
 
 
-def admit_batch(payloads, sigs65) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+def admit_batch(
+    payloads, sigs65
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Host API: list[bytes] signed payloads + [B, 65] r‖s‖v signatures ->
-    (senders [B, 20] uint8, ok bool[B], pubkeys [B, 64] uint8)."""
+    (senders [B, 20] uint8, ok bool[B], pubkeys [B, 64] uint8,
+    tx hashes [B, 32] uint8)."""
     bsz = len(payloads)
     bb = bucket_batch(bsz)
     blocks, nblocks = pad_keccak(list(payloads) + [b""] * (bb - bsz))
@@ -56,7 +61,7 @@ def admit_batch(payloads, sigs65) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
     s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
     v = pad_rows(sigs65[:, 64].astype(np.int32), bb)
-    addr, ok, qx, qy = admission_step(blocks, nblocks, r, s, v)
+    addr, ok, qx, qy, z = admission_step(blocks, nblocks, r, s, v)
     pubs = np.concatenate(
         [limbs_to_bytes_be(np.asarray(qx)), limbs_to_bytes_be(np.asarray(qy))], axis=-1
     ).astype(np.uint8)
@@ -64,4 +69,5 @@ def admit_batch(payloads, sigs65) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         np.asarray(addr, dtype=np.uint8)[:bsz],
         np.asarray(ok)[:bsz],
         pubs[:bsz],
+        limbs_to_bytes_be(np.asarray(z)).astype(np.uint8)[:bsz],
     )

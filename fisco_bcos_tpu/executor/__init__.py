@@ -1,0 +1,11 @@
+"""Transaction execution: precompiles, executive frames, block executor.
+
+Reference: bcos-executor (TransactionExecutor.cpp, executive/, precompiled/).
+The EVM/WASM interpreters are host-side in the reference too (evmone/wabt);
+here execution starts with the precompile registry (system + benchmark
+contracts) — the reference's own TPS benchmarks run on precompiles
+(DagTransfer/SmallBank/CpuHeavy, PrecompiledTypeDef.h:65,112,116).
+"""
+
+from .executor import BlockContext, TransactionExecutor  # noqa: F401
+from .precompiled import PRECOMPILED_ADDRESSES  # noqa: F401

@@ -1,0 +1,60 @@
+"""Precompiled contracts (system + benchmark).
+
+Addresses mirror the reference's map
+(bcos-framework/executor/PrecompiledTypeDef.h:57-116).
+"""
+
+from .base import (  # noqa: F401
+    Precompiled,
+    PrecompiledCallContext,
+    PrecompiledError,
+    PrecompiledResult,
+)
+from .system import (  # noqa: F401
+    ConsensusPrecompiled,
+    CryptoPrecompiled,
+    KVTablePrecompiled,
+    SystemConfigPrecompiled,
+    TableManagerPrecompiled,
+)
+from .bench_contracts import (  # noqa: F401
+    CpuHeavyPrecompiled,
+    DagTransferPrecompiled,
+    SmallBankPrecompiled,
+)
+
+# PrecompiledTypeDef.h:57-66
+SYS_CONFIG_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001000")
+TABLE_MANAGER_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001002")
+CONSENSUS_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001003")
+KV_TABLE_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001009")
+CRYPTO_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100a")
+DAG_TRANSFER_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100c")
+# PrecompiledTypeDef.h:112/116 — benchmark families start at fixed bases
+CPU_HEAVY_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005200")
+SMALLBANK_ADDRESS = bytes.fromhex("0000000000000000000000000000000000006200")
+
+
+def default_registry() -> dict[bytes, Precompiled]:
+    return {
+        SYS_CONFIG_ADDRESS: SystemConfigPrecompiled(),
+        TABLE_MANAGER_ADDRESS: TableManagerPrecompiled(),
+        CONSENSUS_ADDRESS: ConsensusPrecompiled(),
+        KV_TABLE_ADDRESS: KVTablePrecompiled(),
+        CRYPTO_ADDRESS: CryptoPrecompiled(),
+        DAG_TRANSFER_ADDRESS: DagTransferPrecompiled(),
+        CPU_HEAVY_ADDRESS: CpuHeavyPrecompiled(),
+        SMALLBANK_ADDRESS: SmallBankPrecompiled(),
+    }
+
+
+PRECOMPILED_ADDRESSES = {
+    "sys_config": SYS_CONFIG_ADDRESS,
+    "table_manager": TABLE_MANAGER_ADDRESS,
+    "consensus": CONSENSUS_ADDRESS,
+    "kv_table": KV_TABLE_ADDRESS,
+    "crypto": CRYPTO_ADDRESS,
+    "dag_transfer": DAG_TRANSFER_ADDRESS,
+    "cpu_heavy": CPU_HEAVY_ADDRESS,
+    "smallbank": SMALLBANK_ADDRESS,
+}

@@ -1,0 +1,78 @@
+"""Precompiled contract interface.
+
+Reference: bcos-executor/src/precompiled/Precompiled.h (call interface,
+name2Selector dispatch, gas metering via PrecompiledGas) and
+bcos-framework/executor/PrecompiledTypeDef.h (addresses). `criticals` exposes
+the conflict-key declaration the reference encodes via
+ParallelConfigPrecompiled / the registerParallelFunction machinery — it
+drives the DAG executor's dependency analysis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ...codec.abi import ABICodec
+from ...crypto.suite import CryptoSuite
+from ...protocol.receipt import LogEntry, TransactionStatus
+from ...storage.interfaces import StorageInterface
+
+BASE_GAS = 16_000  # flat precompile call gas (PrecompiledGas basic cost)
+
+
+class PrecompiledError(Exception):
+    def __init__(self, msg: str, status: TransactionStatus = TransactionStatus.PRECOMPILED_ERROR):
+        super().__init__(msg)
+        self.status = status
+
+
+@dataclass
+class PrecompiledCallContext:
+    storage: StorageInterface  # tx-scoped overlay
+    suite: CryptoSuite
+    codec: ABICodec
+    sender: bytes = b""
+    origin: bytes = b""
+    to: bytes = b""
+    block_number: int = 0
+    timestamp: int = 0
+    gas_limit: int = 300_000_000
+    static_call: bool = False
+
+
+@dataclass
+class PrecompiledResult:
+    output: bytes = b""
+    gas_used: int = BASE_GAS
+    logs: list[LogEntry] = field(default_factory=list)
+
+
+class Precompiled:
+    """One precompiled contract. Subclasses register selector handlers."""
+
+    parallel = False  # reference: isParallelPrecompiled()
+
+    def __init__(self) -> None:
+        self._methods: dict[bytes, tuple[str, object]] = {}
+
+    def register(self, codec: ABICodec, signature: str, fn) -> None:
+        self._methods[codec.selector(signature)] = (signature, fn)
+
+    def setup(self, codec: ABICodec) -> None:
+        """Called once per codec (suite) to build the selector table."""
+        raise NotImplementedError
+
+    def call(self, ctx: PrecompiledCallContext, data: bytes) -> PrecompiledResult:
+        if not self._methods:
+            self.setup(ctx.codec)
+        entry = self._methods.get(data[:4])
+        if entry is None:
+            raise PrecompiledError(f"unknown selector {data[:4].hex()}")
+        signature, fn = entry
+        args = ctx.codec.decode_input(signature, data)
+        return fn(ctx, *args)
+
+    def criticals(self, codec: ABICodec, data: bytes) -> list[bytes] | None:
+        """Conflict keys for DAG scheduling; None = must run serially
+        (reference: extractConflictFields, TransactionExecutor.cpp:1220)."""
+        return None

@@ -1,0 +1,204 @@
+"""System precompiles: config, consensus membership, tables, crypto.
+
+Reference: bcos-executor/src/precompiled/{SystemConfigPrecompiled,
+ConsensusPrecompiled, TableManagerPrecompiled, KVTablePrecompiled,
+CryptoPrecompiled}.cpp. Each governs one slice of the system tables
+(ledger schema §2.5 of SURVEY.md).
+"""
+
+from __future__ import annotations
+
+from ...ledger.ledger import (
+    CONFIG_GAS_LIMIT,
+    CONFIG_LEADER_PERIOD,
+    CONFIG_TX_COUNT_LIMIT,
+    SYS_CONFIG,
+    SYS_CONSENSUS,
+    ConsensusNode,
+    _decode_nodes,
+    _encode_nodes,
+)
+from ...storage.entry import Entry
+from ...storage.table import create_table, open_table
+from .base import Precompiled, PrecompiledCallContext, PrecompiledError, PrecompiledResult
+
+_VALID_CONFIG_KEYS = {
+    CONFIG_TX_COUNT_LIMIT.decode(),
+    CONFIG_LEADER_PERIOD.decode(),
+    CONFIG_GAS_LIMIT.decode(),
+}
+
+
+class SystemConfigPrecompiled(Precompiled):
+    """setValueByKey/getValueByKey over s_config
+    (SystemConfigPrecompiled.cpp; values take effect at block N+1)."""
+
+    def setup(self, codec):
+        self.register(codec, "setValueByKey(string,string)", self._set)
+        self.register(codec, "getValueByKey(string)", self._get)
+
+    def _set(self, ctx: PrecompiledCallContext, key: str, value: str):
+        if key not in _VALID_CONFIG_KEYS:
+            raise PrecompiledError(f"unknown system config key {key!r}")
+        if not value.isdigit() or int(value) <= 0:
+            raise PrecompiledError(f"invalid system config value {value!r}")
+        e = Entry().set(value.encode())
+        e.set("enable_number", str(ctx.block_number + 1).encode())
+        ctx.storage.set_row(SYS_CONFIG, key.encode(), e)
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+    def _get(self, ctx: PrecompiledCallContext, key: str):
+        e = ctx.storage.get_row(SYS_CONFIG, key.encode())
+        if e is None:
+            raise PrecompiledError(f"system config not found: {key!r}")
+        enable = int(e.get("enable_number").decode() or "0")
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["string", "int256"], e.get().decode(), enable)
+        )
+
+
+class ConsensusPrecompiled(Precompiled):
+    """addSealer/addObserver/remove/setWeight over s_consensus
+    (ConsensusPrecompiled.cpp; node ids are hex-encoded pubkeys)."""
+
+    def setup(self, codec):
+        self.register(codec, "addSealer(string,uint256)", self._add_sealer)
+        self.register(codec, "addObserver(string)", self._add_observer)
+        self.register(codec, "remove(string)", self._remove)
+        self.register(codec, "setWeight(string,uint256)", self._set_weight)
+
+    @staticmethod
+    def _nodes(ctx) -> list[ConsensusNode]:
+        e = ctx.storage.get_row(SYS_CONSENSUS, b"key")
+        return _decode_nodes(e.get()) if e is not None else []
+
+    @staticmethod
+    def _store(ctx, nodes: list[ConsensusNode]) -> None:
+        ctx.storage.set_row(SYS_CONSENSUS, b"key", Entry().set(_encode_nodes(nodes)))
+
+    @staticmethod
+    def _node_id(node_hex: str) -> bytes:
+        nid = bytes.fromhex(node_hex)
+        if len(nid) != 64:
+            raise PrecompiledError("node id must be a 64-byte hex pubkey")
+        return nid
+
+    def _upsert(self, ctx, node_hex: str, node_type: str, weight: int):
+        nid = self._node_id(node_hex)
+        nodes = [n for n in self._nodes(ctx) if n.node_id != nid]
+        nodes.append(
+            ConsensusNode(nid, weight, node_type, enable_number=ctx.block_number + 1)
+        )
+        self._store(ctx, nodes)
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+    def _add_sealer(self, ctx, node_hex: str, weight: int):
+        if weight <= 0:
+            raise PrecompiledError("sealer weight must be positive")
+        return self._upsert(ctx, node_hex, "consensus_sealer", weight)
+
+    def _add_observer(self, ctx, node_hex: str):
+        return self._upsert(ctx, node_hex, "consensus_observer", 0)
+
+    def _remove(self, ctx, node_hex: str):
+        nid = self._node_id(node_hex)
+        nodes = self._nodes(ctx)
+        remaining = [n for n in nodes if n.node_id != nid]
+        if len(remaining) == len(nodes):
+            raise PrecompiledError("node not found")
+        sealers = [n for n in remaining if n.node_type == "consensus_sealer"]
+        if not sealers:
+            raise PrecompiledError("cannot remove the last sealer")
+        self._store(ctx, remaining)
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+    def _set_weight(self, ctx, node_hex: str, weight: int):
+        if weight <= 0:
+            raise PrecompiledError("weight must be positive")
+        nid = self._node_id(node_hex)
+        nodes = self._nodes(ctx)
+        if not any(n.node_id == nid for n in nodes):
+            raise PrecompiledError("node not found")
+        updated = [
+            ConsensusNode(n.node_id, weight if n.node_id == nid else n.weight,
+                          n.node_type, n.enable_number)
+            for n in nodes
+        ]
+        self._store(ctx, updated)
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+
+def _user_table(name: str) -> str:
+    """User tables live under the u_ prefix (reference: /tables BFS path)."""
+    return name if name.startswith("u_") else f"u_{name}"
+
+
+class TableManagerPrecompiled(Precompiled):
+    """createKVTable/createTable into s_tables (TableManagerPrecompiled.cpp)."""
+
+    def setup(self, codec):
+        self.register(codec, "createKVTable(string,string,string)", self._create_kv)
+        self.register(codec, "createTable(string,string)", self._create)
+
+    def _create_kv(self, ctx, name: str, key_field: str, value_field: str):
+        try:
+            create_table(ctx.storage, _user_table(name), key_field, (value_field,))
+        except ValueError as e:
+            raise PrecompiledError(str(e))
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+    def _create(self, ctx, name: str, value_fields_csv: str):
+        fields = tuple(f for f in value_fields_csv.split(",") if f)
+        try:
+            create_table(ctx.storage, _user_table(name), "key", fields or ("value",))
+        except ValueError as e:
+            raise PrecompiledError(str(e))
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+
+class KVTablePrecompiled(Precompiled):
+    """set/get on KV user tables (KVTablePrecompiled.cpp)."""
+
+    def setup(self, codec):
+        self.register(codec, "set(string,string,string)", self._set)
+        self.register(codec, "get(string,string)", self._get)
+
+    def _set(self, ctx, table: str, key: str, value: str):
+        t = open_table(ctx.storage, _user_table(table))
+        if t is None:
+            raise PrecompiledError(f"table not found: {table}")
+        field = t.info.value_fields[0]
+        t.set_row(key.encode(), Entry().set(field, value.encode()))
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+    def _get(self, ctx, table: str, key: str):
+        t = open_table(ctx.storage, _user_table(table))
+        if t is None:
+            raise PrecompiledError(f"table not found: {table}")
+        e = t.get_row(key.encode())
+        ok = e is not None
+        val = e.get(t.info.value_fields[0]).decode() if ok else ""
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["bool", "string"], ok, val)
+        )
+
+
+class CryptoPrecompiled(Precompiled):
+    """keccak256Hash/sm3Hash (CryptoPrecompiled.cpp) — device-batchable ops
+    exposed on-chain; single calls use the CPU reference path."""
+
+    def setup(self, codec):
+        self.register(codec, "keccak256Hash(bytes)", self._keccak)
+        self.register(codec, "sm3(bytes)", self._sm3)
+
+    def _keccak(self, ctx, data: bytes):
+        from ...crypto.ref.keccak import keccak256
+
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["bytes32"], keccak256(data))
+        )
+
+    def _sm3(self, ctx, data: bytes):
+        from ...crypto.ref.sm3 import sm3
+
+        return PrecompiledResult(output=ctx.codec.encode_output(["bytes32"], sm3(data)))

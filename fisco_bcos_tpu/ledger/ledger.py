@@ -216,10 +216,18 @@ class Ledger:
         )
         put(SYS_NUMBER_2_NONCES, num_key, Entry().set(nonces.out()))
 
-        total = self.total_transaction_count() + len(tx_hashes)
+        # totals read through the overlay first so pipelined prewrites see
+        # earlier staged increments, then fall back to committed state
+        def staged_total(key: bytes) -> int:
+            e = out.get_row(SYS_CURRENT_STATE, key)
+            if e is not None:
+                return int(e.get().decode())
+            return self._current_state(key)
+
+        total = staged_total(KEY_TOTAL_TX_COUNT) + len(tx_hashes)
         put(SYS_CURRENT_STATE, KEY_TOTAL_TX_COUNT, Entry().set(str(total).encode()))
         if failed:
-            tfail = self.total_failed_transaction_count() + failed
+            tfail = staged_total(KEY_TOTAL_FAILED_TX_COUNT) + failed
             put(
                 SYS_CURRENT_STATE,
                 KEY_TOTAL_FAILED_TX_COUNT,

@@ -71,7 +71,7 @@ def sharded_admission(mesh: Mesh, axis_name: str = DATA_AXIS):
     """
 
     def local(blocks, nblocks, r, s, v):
-        addr, ok, _qx, _qy = admission_core(blocks, nblocks, r, s, v)
+        addr, ok, _qx, _qy, _z = admission_core(blocks, nblocks, r, s, v)
         n_valid = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis_name)
         return (
             jax.lax.all_gather(addr, axis_name, tiled=True),

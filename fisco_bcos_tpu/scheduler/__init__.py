@@ -1,0 +1,3 @@
+"""Block scheduler: execute proposals, commit via 2PC."""
+
+from .scheduler import Scheduler  # noqa: F401

@@ -1,0 +1,181 @@
+"""Scheduler — block-level execution + the commit 2PC.
+
+Reference: bcos-scheduler/src/SchedulerImpl.cpp (executeBlock:150,
+commitBlock:390, call:621) and BlockExecutive.cpp (fill txs from pool
+:301-357, DAG/DMC dispatch :378-996, state root into the header :998-1061).
+One executor here (the Air form); the DMC multi-executor sharding rides the
+same interface and arrives with the multi-executor manager.
+
+executeBlock splits a proposal into DAG-annotated txs (conflict-parallel,
+Transaction::Attribute::DAG — Transaction.h:45-51) and serial txs, executes,
+then fills the header with stateRoot (device XOR root), receiptsRoot and
+txsRoot (device merkle), and gasUsed. commitBlock stages ledger rows +
+executed state into one 2PC against the durable backend.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..crypto.suite import CryptoSuite
+from ..executor.executor import TransactionExecutor
+from ..ledger import Ledger
+from ..protocol.block import Block
+from ..protocol.block_header import BlockHeader
+from ..protocol.transaction import TransactionAttribute
+from ..storage.interfaces import TransactionalStorage, TwoPCParams
+from ..storage.state_storage import StateStorage
+from ..utils.error import ErrorCode
+from ..utils.log import StageTimer, get_logger
+
+_log = get_logger("scheduler")
+
+
+class SchedulerError(Exception):
+    def __init__(self, code: ErrorCode, msg: str):
+        super().__init__(msg)
+        self.code = code
+
+
+@dataclass
+class ExecutedBlock:
+    header: BlockHeader
+    block: Block
+    tx_hashes: tuple[bytes, ...]  # proposal identity (same number ≠ same block)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        executor: TransactionExecutor,
+        ledger: Ledger,
+        backend: TransactionalStorage,
+        suite: CryptoSuite,
+        txpool=None,
+    ):
+        self.executor = executor
+        self.ledger = ledger
+        self.backend = backend
+        self.suite = suite
+        self.txpool = txpool
+        self._executed: dict[int, ExecutedBlock] = {}
+        self._lock = threading.RLock()
+
+    # -- executeBlock:150 ----------------------------------------------------
+
+    def execute_block(self, block: Block, verify: bool = False) -> BlockHeader:
+        """Execute a proposal; returns the filled header. `verify` asserts
+        the proposal's declared roots match execution (sync path)."""
+        number = block.header.number
+        proposal_ident = tuple(block.tx_hashes(self.suite))
+        with self._lock:
+            cached = self._executed.get(number)
+            if cached is not None and cached.tx_hashes == proposal_ident and not verify:
+                return cached.header  # same proposal re-executed (preExecute cache)
+        timer = StageTimer(_log, f"ExecuteBlock.{number}")
+
+        expected = self.ledger.block_number() + 1
+        if number != expected:
+            raise SchedulerError(
+                ErrorCode.SCHEDULER_INVALID_BLOCK,
+                f"execute out of order: got {number}, expect {expected}",
+            )
+
+        txs = block.transactions
+        if not txs and block.tx_metadata:
+            if self.txpool is None:
+                raise SchedulerError(
+                    ErrorCode.SCHEDULER_INVALID_BLOCK, "no txpool to fill proposal"
+                )
+            fetched = self.txpool.fetch_txs(block.tx_metadata)
+            if any(t is None for t in fetched):
+                raise SchedulerError(
+                    ErrorCode.SCHEDULER_INVALID_BLOCK, "proposal references unknown txs"
+                )
+            txs = fetched
+            block.transactions = txs
+        timer.stage("fillBlock", txs=len(txs))
+
+        self.executor.next_block_header(block.header)
+        dag_idx = [
+            i for i, t in enumerate(txs) if t.attribute & TransactionAttribute.DAG
+        ]
+        serial_idx = [
+            i for i, t in enumerate(txs) if not (t.attribute & TransactionAttribute.DAG)
+        ]
+        receipts = [None] * len(txs)
+        if dag_idx:
+            dag_rcs = self.executor.dag_execute_transactions([txs[i] for i in dag_idx])
+            for i, rc in zip(dag_idx, dag_rcs):
+                receipts[i] = rc
+        if serial_idx:
+            ser_rcs = self.executor.execute_transactions([txs[i] for i in serial_idx])
+            for i, rc in zip(serial_idx, ser_rcs):
+                receipts[i] = rc
+        block.receipts = receipts  # type: ignore[assignment]
+        timer.stage("execute", dag=len(dag_idx), serial=len(serial_idx))
+
+        header = block.header
+        header.gas_used = sum(rc.gas_used for rc in block.receipts)
+        state_root = self.executor.get_hash()
+        txs_root = block.calculate_txs_root(self.suite)
+        receipts_root = block.calculate_receipts_root(self.suite)
+        if verify and (
+            (header.state_root != state_root)
+            or (header.txs_root != txs_root)
+            or (header.receipts_root != receipts_root)
+        ):
+            raise SchedulerError(
+                ErrorCode.SCHEDULER_INVALID_BLOCK,
+                f"block {number} root mismatch on verify",
+            )
+        header.state_root = state_root
+        header.txs_root = txs_root
+        header.receipts_root = receipts_root
+        header.clear_hash_cache()
+        timer.stage("roots", state_root=state_root.hex()[:16])
+
+        with self._lock:
+            self._executed[number] = ExecutedBlock(header, block, proposal_ident)
+        return header
+
+    # -- commitBlock:390 -----------------------------------------------------
+
+    def commit_block(self, header: BlockHeader) -> None:
+        number = header.number
+        with self._lock:
+            cached = self._executed.get(number)
+        if cached is None:
+            raise SchedulerError(
+                ErrorCode.SCHEDULER_INVALID_BLOCK, f"commit of unexecuted block {number}"
+            )
+        if cached.header.hash(self.suite) != header.hash(self.suite):
+            raise SchedulerError(
+                ErrorCode.SCHEDULER_INVALID_BLOCK,
+                f"commit header mismatch for block {number}",
+            )
+        timer = StageTimer(_log, f"CommitBlock.{number}")
+        # carry QC signatures into the stored header
+        cached.block.header = header
+        ledger_writes = StateStorage()
+        self.ledger.prewrite_block(cached.block, ledger_writes)
+        params = TwoPCParams(number=number)
+        self.executor.prepare(params, extra_writes=ledger_writes)
+        timer.stage("prepare")
+        self.executor.commit(params)
+        timer.stage("commit")
+        with self._lock:
+            self._executed.pop(number, None)
+            stale = [n for n in self._executed if n <= number]
+            for n in stale:
+                self._executed.pop(n)
+        if self.txpool is not None:
+            self.txpool.on_block_committed(
+                number, [t.hash(self.suite) for t in cached.block.transactions]
+            )
+
+    # -- call:621 ------------------------------------------------------------
+
+    def call(self, tx) -> "TransactionReceipt":  # noqa: F821
+        return self.executor.call(tx)

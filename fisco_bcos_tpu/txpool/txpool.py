@@ -60,8 +60,6 @@ class TxPool:
         head = ledger.block_number()
         for n in range(max(1, head - block_limit + 1), head + 1):
             self.ledger_nonces.commit_block(n, ledger.nonces_by_number(n))
-        if head:
-            self.ledger_nonces.commit_block(head, [])
 
     # -- admission -----------------------------------------------------------
 
@@ -88,6 +86,7 @@ class TxPool:
         to_verify: list[int] = []
         with self._lock:
             room = self.pool_limit - len(self._txs)
+        batch_nonces: set[str] = set()
         for i, (tx, h) in enumerate(zip(txs, hashes)):
             with self._lock:
                 known = h in self._txs
@@ -95,9 +94,12 @@ class TxPool:
                 results[i] = TxSubmitResult(h, ErrorCode.TX_POOL_ALREADY_KNOWN)
                 continue
             code = self.validator.check_static(tx)
+            if code == ErrorCode.SUCCESS and tx.nonce in batch_nonces:
+                code = ErrorCode.ALREADY_IN_TX_POOL  # intra-batch nonce replay
             if code != ErrorCode.SUCCESS:
                 results[i] = TxSubmitResult(h, code)
                 continue
+            batch_nonces.add(tx.nonce)
             if len(to_verify) >= room:
                 results[i] = TxSubmitResult(h, ErrorCode.TX_POOL_FULL)
                 continue
@@ -181,11 +183,16 @@ class TxPool:
         ok = batch_admit(got, self.suite)
         if not ok.all():
             return False, missing
-        for t in got:
+        # the fetched txs must BE the missing ones — a peer returning valid
+        # but unrelated txs must not make the proposal verify
+        got_hashes = hash_transactions_batch(got, self.suite)
+        if set(got_hashes) != set(missing):
+            return False, missing
+        for t, h in zip(got, got_hashes):
             code = self.validator.check_static(t)
             if code not in (ErrorCode.SUCCESS, ErrorCode.ALREADY_IN_TX_POOL):
                 return False, missing
-            self._insert(t, t.hash(self.suite))
+            self._insert(t, h)
         return True, []
 
     # -- block lifecycle -----------------------------------------------------

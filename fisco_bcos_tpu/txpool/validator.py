@@ -135,11 +135,11 @@ def batch_admit(txs: list[Transaction], suite: CryptoSuite) -> np.ndarray:
         from ..crypto.admission import admit_batch as fused
 
         payloads = [t.encode_data() for t in txs]
-        senders, ok, _pubs = fused(payloads, sigs)
-        # fused path also recomputes hashes; fill caches for later stages
-        from ..protocol.transaction import hash_transactions_batch
-
-        hash_transactions_batch(txs, suite)
+        senders, ok, _pubs, digests = fused(payloads, sigs)
+        # the fused program computed the tx hashes; fill caches from them
+        for t, d in zip(txs, digests):
+            if t._hash is None:
+                t._hash = bytes(d)
     else:
         from ..protocol.transaction import hash_transactions_batch
 

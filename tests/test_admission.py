@@ -36,12 +36,13 @@ def test_digest_words_to_limbs_roundtrip():
 def test_admission_matches_cpu_reference():
     payloads = [b"tx %d " % i + b"z" * (i * 37 % 200) for i in range(6)]
     sigs, pubs = _signed(payloads)
-    addr, ok, pubs_dev = admission.admit_batch(payloads, sigs)
+    addr, ok, pubs_dev, hashes_dev = admission.admit_batch(payloads, sigs)
     assert ok.all()
     for j, (x, y) in enumerate(pubs):
         pub_bytes = x.to_bytes(32, "big") + y.to_bytes(32, "big")
         assert bytes(pubs_dev[j]) == pub_bytes
         assert bytes(addr[j]) == keccak256(pub_bytes)[12:]
+        assert bytes(hashes_dev[j]) == keccak256(payloads[j])
 
 
 def test_admission_rejects_corruption():
@@ -53,12 +54,12 @@ def test_admission_rejects_corruption():
     x, y = pubs[0]
     honest_addr = keccak256(x.to_bytes(32, "big") + y.to_bytes(32, "big"))[12:]
     sigs[0, 5] ^= 0xFF  # flip a byte of r
-    addr, ok, _ = admission.admit_batch(payloads, sigs)
+    addr, ok, _, _ = admission.admit_batch(payloads, sigs)
     assert (not ok[0]) or bytes(addr[0]) != honest_addr
     assert ok[1]
     # malformed: s = 0 must hard-fail range checks
     sigs[1, 32:64] = 0
-    _, ok, _ = admission.admit_batch(payloads, sigs)
+    _, ok, _, _ = admission.admit_batch(payloads, sigs)
     assert not ok[1]
 
 
@@ -66,7 +67,7 @@ def test_graft_entry_single_chip():
     import __graft_entry__ as ge
 
     fn, args = ge.entry()
-    addr, ok, _qx, _qy = fn(*args)
+    addr, ok, *_rest = fn(*args)
     assert np.asarray(ok).all()
     assert addr.shape == (128, 20)
 

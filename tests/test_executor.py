@@ -1,0 +1,264 @@
+"""ABI codec, precompiles, DAG levelization, scheduler execute/commit."""
+
+import pytest
+
+from fisco_bcos_tpu.codec.abi import ABICodec, abi_decode, abi_encode
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor import TransactionExecutor
+from fisco_bcos_tpu.executor.precompiled import (
+    CONSENSUS_ADDRESS,
+    DAG_TRANSFER_ADDRESS,
+    KV_TABLE_ADDRESS,
+    SMALLBANK_ADDRESS,
+    SYS_CONFIG_ADDRESS,
+    TABLE_MANAGER_ADDRESS,
+)
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig, Ledger
+from fisco_bcos_tpu.protocol import Block, BlockHeader, ParentInfo
+from fisco_bcos_tpu.protocol.transaction import TransactionAttribute, TransactionFactory
+from fisco_bcos_tpu.scheduler import Scheduler
+from fisco_bcos_tpu.storage import MemoryStorage
+from fisco_bcos_tpu.txpool import TxPool
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+def test_abi_roundtrip():
+    types = ["uint256", "string", "address", "bool", "bytes"]
+    vals = [123456789, "héllo", b"\x11" * 20, True, b"\x01\x02"]
+    enc = abi_encode(types, vals)
+    assert abi_decode(types, enc) == vals
+    # dynamic arrays
+    enc2 = abi_encode(["uint256[]", "string"], [[1, 2, 3], "x"])
+    assert abi_decode(["uint256[]", "string"], enc2) == [[1, 2, 3], "x"]
+    # selector matches solidity convention (keccak4)
+    sel = CODEC.selector("userTransfer(string,string,uint256)")
+    assert len(sel) == 4
+    call = CODEC.encode_call("userTransfer(string,string,uint256)", "a", "b", 7)
+    assert call[:4] == sel
+    assert CODEC.decode_input("userTransfer(string,string,uint256)", call) == ["a", "b", 7]
+
+
+class Env:
+    def __init__(self):
+        self.store = MemoryStorage()
+        self.ledger = Ledger(self.store, SUITE)
+        self.ledger.build_genesis(
+            GenesisConfig(consensus_nodes=[ConsensusNode(b"\x01" * 64)])
+        )
+        self.pool = TxPool(SUITE, self.ledger)
+        self.executor = TransactionExecutor(self.store, SUITE)
+        self.scheduler = Scheduler(self.executor, self.ledger, self.store, SUITE, self.pool)
+        self.fac = TransactionFactory(SUITE)
+        self.kp = SUITE.signature_impl.generate_keypair(secret=4242)
+        self._nonce = 0
+
+    def tx(self, to, sig, *args, attribute=0):
+        self._nonce += 1
+        return self.fac.create_signed(
+            self.kp,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500,
+            nonce=f"n{self._nonce}",
+            to=to,
+            input=CODEC.encode_call(sig, *args),
+            attribute=attribute,
+        )
+
+    def run_block(self, txs):
+        for t in txs:
+            r = self.pool.submit(t)
+            assert r.status == 0, r
+        sealed = self.pool.seal_txs(len(txs))
+        parent_num = self.ledger.block_number()
+        parent = self.ledger.header_by_number(parent_num)
+        blk = Block(
+            header=BlockHeader(
+                number=parent_num + 1,
+                parent_info=[ParentInfo(parent_num, parent.hash(SUITE))],
+                timestamp=1000 + parent_num,
+            ),
+            transactions=sealed,
+        )
+        header = self.scheduler.execute_block(blk)
+        self.scheduler.commit_block(header)
+        return blk
+
+
+def test_dag_transfer_lifecycle():
+    env = Env()
+    blk = env.run_block(
+        [
+            env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "alice", 100),
+            env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "bob", 50),
+        ]
+    )
+    assert all(rc.status == 0 for rc in blk.receipts)
+    assert env.ledger.block_number() == 1
+
+    blk2 = env.run_block(
+        [
+            env.tx(
+                DAG_TRANSFER_ADDRESS,
+                "userTransfer(string,string,uint256)",
+                "alice",
+                "bob",
+                30,
+                attribute=TransactionAttribute.DAG,
+            ),
+            env.tx(
+                DAG_TRANSFER_ADDRESS,
+                "userDraw(string,uint256)",
+                "bob",
+                10,
+                attribute=TransactionAttribute.DAG,
+            ),
+        ]
+    )
+    assert all(rc.status == 0 for rc in blk2.receipts)
+    # balances via read-only call
+    q = env.tx(DAG_TRANSFER_ADDRESS, "userBalance(string)", "bob")
+    rc = env.scheduler.call(q)
+    ok, bal = CODEC.decode_output(["uint256", "uint256"], rc.output)
+    assert (ok, bal) == (0, 70)
+    q2 = env.tx(DAG_TRANSFER_ADDRESS, "userBalance(string)", "alice")
+    _, bal_a = CODEC.decode_output(["uint256", "uint256"], env.scheduler.call(q2).output)
+    assert bal_a == 70
+
+    # insufficient transfer reverts with code 4, state unchanged
+    blk3 = env.run_block(
+        [
+            env.tx(
+                DAG_TRANSFER_ADDRESS,
+                "userTransfer(string,string,uint256)",
+                "alice",
+                "bob",
+                10_000,
+            )
+        ]
+    )
+    (code,) = CODEC.decode_output(["uint256"], blk3.receipts[0].output)
+    assert code == 4
+    _, bal_a2 = CODEC.decode_output(
+        ["uint256", "uint256"], env.scheduler.call(q2).output
+    )
+    assert bal_a2 == 70
+
+
+def test_dag_levels_respect_conflicts():
+    env = Env()
+    txs = [
+        env.tx(DAG_TRANSFER_ADDRESS, "userTransfer(string,string,uint256)", "a", "b", 1),
+        env.tx(DAG_TRANSFER_ADDRESS, "userTransfer(string,string,uint256)", "c", "d", 1),
+        env.tx(DAG_TRANSFER_ADDRESS, "userTransfer(string,string,uint256)", "b", "c", 1),
+        env.tx(SYS_CONFIG_ADDRESS, "setValueByKey(string,string)", "tx_count_limit", "500"),
+        env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "e", 1),
+    ]
+    levels = env.executor.dag_levels(txs)
+    # tx0 ∥ tx1 (disjoint), tx2 conflicts with both, tx3 serial barrier, tx4 after
+    assert levels[0] == [0, 1]
+    assert levels[1] == [2]
+    assert levels[2] == [3]
+    assert levels[3] == [4]
+
+
+def test_dag_execution_matches_serial():
+    env1, env2 = Env(), Env()
+    mk = lambda env: [
+        env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "u%d" % i, 100)
+        for i in range(6)
+    ] + [
+        env.tx(
+            DAG_TRANSFER_ADDRESS,
+            "userTransfer(string,string,uint256)",
+            "u%d" % i,
+            "u%d" % ((i + 1) % 6),
+            5 + i,
+        )
+        for i in range(6)
+    ]
+    env1.executor.next_block_header(BlockHeader(number=1))
+    rc_serial = env1.executor.execute_transactions(mk(env1))
+    env2.executor.next_block_header(BlockHeader(number=1))
+    rc_dag = env2.executor.dag_execute_transactions(mk(env2))
+    assert [r.encode() for r in rc_serial] == [r.encode() for r in rc_dag]
+    assert env1.executor.get_hash() == env2.executor.get_hash()
+
+
+def test_system_and_kv_precompiles():
+    env = Env()
+    node_hex = ("07" * 64)
+    blk = env.run_block(
+        [
+            env.tx(SYS_CONFIG_ADDRESS, "setValueByKey(string,string)", "tx_count_limit", "2000"),
+            env.tx(CONSENSUS_ADDRESS, "addSealer(string,uint256)", node_hex, 3),
+            env.tx(TABLE_MANAGER_ADDRESS, "createKVTable(string,string,string)", "kv1", "k", "v"),
+        ]
+    )
+    assert all(rc.status == 0 for rc in blk.receipts), [
+        (rc.status, rc.output) for rc in blk.receipts
+    ]
+    assert env.ledger.ledger_config().tx_count_limit == 2000
+    nodes = env.ledger.consensus_nodes()
+    assert any(n.node_id == bytes.fromhex(node_hex) and n.weight == 3 for n in nodes)
+
+    blk2 = env.run_block(
+        [env.tx(KV_TABLE_ADDRESS, "set(string,string,string)", "kv1", "kk", "vv")]
+    )
+    assert blk2.receipts[0].status == 0
+    rc = env.scheduler.call(env.tx(KV_TABLE_ADDRESS, "get(string,string)", "kv1", "kk"))
+    assert CODEC.decode_output(["bool", "string"], rc.output) == [True, "vv"]
+
+    # unknown config key reverts
+    blk3 = env.run_block(
+        [env.tx(SYS_CONFIG_ADDRESS, "setValueByKey(string,string)", "bogus", "1")]
+    )
+    assert blk3.receipts[0].status != 0
+
+
+def test_smallbank():
+    env = Env()
+    blk = env.run_block(
+        [
+            env.tx(SMALLBANK_ADDRESS, "updateBalance(string,uint256)", "alice", 1000),
+            env.tx(SMALLBANK_ADDRESS, "updateSaving(string,uint256)", "alice", 200),
+            env.tx(SMALLBANK_ADDRESS, "sendPayment(string,string,uint256)", "alice", "bob", 400),
+            env.tx(SMALLBANK_ADDRESS, "amalgamate(string,string)", "alice", "bob"),
+        ]
+    )
+    assert all(rc.status == 0 for rc in blk.receipts)
+    rc = env.scheduler.call(env.tx(SMALLBANK_ADDRESS, "getBalance(string)", "bob"))
+    (bal,) = CODEC.decode_output(["uint256"], rc.output)
+    assert bal == 400 + 200  # payment + amalgamated saving
+
+
+def test_unknown_address_and_bad_selector():
+    env = Env()
+    blk = env.run_block([env.tx(b"\x99" * 20, "nope()")])
+    assert blk.receipts[0].status != 0
+    bad = env.tx(DAG_TRANSFER_ADDRESS, "nonexistent(uint256)", 1)
+    blk2 = env.run_block([bad])
+    assert blk2.receipts[0].status != 0
+
+
+def test_commit_rejects_header_mismatch():
+    env = Env()
+    t = env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "x", 1)
+    env.pool.submit(t)
+    sealed = env.pool.seal_txs(1)
+    parent = env.ledger.header_by_number(0)
+    blk = Block(
+        header=BlockHeader(number=1, parent_info=[ParentInfo(0, parent.hash(SUITE))]),
+        transactions=sealed,
+    )
+    header = env.scheduler.execute_block(blk)
+    forged = BlockHeader.decode(header.encode())
+    forged.state_root = b"\xff" * 32
+    from fisco_bcos_tpu.scheduler.scheduler import SchedulerError
+
+    with pytest.raises(SchedulerError):
+        env.scheduler.commit_block(forged)
+    env.scheduler.commit_block(header)
+    assert env.ledger.block_number() == 1
