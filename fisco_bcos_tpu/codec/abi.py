@@ -37,8 +37,9 @@ def _encode_static(typ: str, val: Any) -> bytes:
     if typ.startswith("int"):
         return int(val).to_bytes(_WORD, "big", signed=True)
     if typ == "address":
-        b = bytes.fromhex(val[2:] if isinstance(val, str) else val.hex())
-        if isinstance(val, (bytes, bytearray)):
+        if isinstance(val, str):
+            b = bytes.fromhex(val[2:] if val[:2] in ("0x", "0X") else val)
+        else:
             b = bytes(val)
         if len(b) != 20:
             raise ValueError("address must be 20 bytes")
@@ -97,6 +98,10 @@ def _decode_static(typ: str, word: bytes) -> Any:
 
 
 def _decode_one(typ: str, data: bytes, offset: int) -> Any:
+    # an offset whose length word lies outside the buffer is malformed, not
+    # an empty value (the reference ContractABICodec rejects it too)
+    if offset + _WORD > len(data):
+        raise ValueError("abi decode: dynamic offset out of range")
     if typ == "string" or typ == "bytes":
         n = int.from_bytes(data[offset : offset + _WORD], "big")
         raw = data[offset + _WORD : offset + _WORD + n]

@@ -1,0 +1,6 @@
+"""PBFT consensus: engine, sealer, block validator."""
+
+from .engine import PBFTEngine  # noqa: F401
+from .config import PBFTConfig  # noqa: F401
+from .sealer import Sealer  # noqa: F401
+from .block_validator import BlockValidator  # noqa: F401

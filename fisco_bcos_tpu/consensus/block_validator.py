@@ -1,0 +1,73 @@
+"""Block QC validator — the sync-path signature-list check, batched on device.
+
+Reference: bcos-pbft/core/BlockValidator.cpp:28-177 (asyncCheckBlock:
+checkSealerListAndWeightList:80 then checkSignatureList:141-177 — a
+*sequential* loop verifying every sealer signature on the header hash plus a
+weight-quorum check; SURVEY.md marks it the #2 batch-verify hot loop). Here
+the whole signature list is one device batch verify.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.suite import CryptoSuite
+from ..ledger.ledger import ConsensusNode
+from ..protocol.block_header import BlockHeader
+from ..utils.log import get_logger
+
+_log = get_logger("block-validator")
+
+
+class BlockValidator:
+    def __init__(self, suite: CryptoSuite):
+        self.suite = suite
+
+    def check_block(self, header: BlockHeader, nodes: list[ConsensusNode]) -> bool:
+        """Validate a synced block's QC against the expected committee."""
+        sealers = sorted(
+            (n for n in nodes if n.node_type == "consensus_sealer"),
+            key=lambda n: n.node_id,
+        )
+        if header.number == 0:
+            return True
+        # sealer list / weight list must match the committee exactly
+        if header.sealer_list != [n.node_id for n in sealers]:
+            _log.warning("block %d: sealer list mismatch", header.number)
+            return False
+        if header.consensus_weights != [n.weight for n in sealers]:
+            _log.warning("block %d: weight list mismatch", header.number)
+            return False
+        if not header.signature_list:
+            return False
+        seen: set[int] = set()
+        idxs: list[int] = []
+        for s in header.signature_list:
+            if s.index in seen or not 0 <= s.index < len(sealers):
+                return False
+            seen.add(s.index)
+            idxs.append(s.index)
+
+        sig_len = self.suite.signature_impl.sig_len
+        if any(len(s.signature) != sig_len for s in header.signature_list):
+            return False
+        h = header.hash(self.suite)
+        hashes = np.frombuffer(h * len(idxs), dtype=np.uint8).reshape(-1, 32)
+        pubs = np.frombuffer(
+            b"".join(sealers[i].node_id for i in idxs), dtype=np.uint8
+        ).reshape(-1, 64)
+        sigs = np.frombuffer(
+            b"".join(s.signature for s in header.signature_list), dtype=np.uint8
+        ).reshape(-1, sig_len)
+        ok = self.suite.signature_impl.batch_verify(hashes, pubs, sigs)  # device
+        if not bool(np.asarray(ok).all()):
+            _log.warning("block %d: QC signature verify failed", header.number)
+            return False
+        quorum = (2 * sum(n.weight for n in sealers)) // 3 + 1
+        weight = sum(sealers[i].weight for i in idxs)
+        if weight < quorum:
+            _log.warning(
+                "block %d: QC weight %d below quorum %d", header.number, weight, quorum
+            )
+            return False
+        return True

@@ -1,0 +1,80 @@
+"""PBFT configuration: committee, weights, quorum, leader rotation.
+
+Reference: bcos-pbft/pbft/config/PBFTConfig.* — quorum is weight-based
+(minRequiredQuorum = total*2/3 rounded up via 2f+1 analog), leader rotates
+every `leader_period` blocks and advances with the view
+(leaderIndex = (number / leader_period + view) % n).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto.suite import CryptoSuite, KeyPair
+from ..ledger.ledger import ConsensusNode
+
+
+@dataclass
+class PBFTConfig:
+    suite: CryptoSuite
+    keypair: KeyPair
+    nodes: list[ConsensusNode] = field(default_factory=list)  # sealers, sorted
+    leader_period: int = 1
+
+    def __post_init__(self) -> None:
+        self.nodes = sorted(
+            (n for n in self.nodes if n.node_type == "consensus_sealer"),
+            key=lambda n: n.node_id,
+        )
+
+    @property
+    def node_id(self) -> bytes:
+        return self.keypair.pub
+
+    @property
+    def committee_size(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def total_weight(self) -> int:
+        return sum(n.weight for n in self.nodes)
+
+    @property
+    def quorum(self) -> int:
+        """Weighted 2f+1: smallest q with 3q > 2*total (BlockValidator's
+        minRequiredQuorum)."""
+        return (2 * self.total_weight) // 3 + 1
+
+    def index_of(self, node_id: bytes) -> int | None:
+        for i, n in enumerate(self.nodes):
+            if n.node_id == node_id:
+                return i
+        return None
+
+    @property
+    def my_index(self) -> int | None:
+        return self.index_of(self.node_id)
+
+    def node_at(self, index: int) -> ConsensusNode | None:
+        if 0 <= index < len(self.nodes):
+            return self.nodes[index]
+        return None
+
+    def weight_of(self, index: int) -> int:
+        n = self.node_at(index)
+        return n.weight if n else 0
+
+    def leader_index(self, number: int, view: int) -> int:
+        if not self.nodes:
+            return 0
+        return (number // self.leader_period + view) % len(self.nodes)
+
+    def is_leader(self, number: int, view: int) -> bool:
+        return self.my_index == self.leader_index(number, view)
+
+    def reload(self, nodes: list[ConsensusNode]) -> None:
+        """Committee change from an s_consensus update (dynamic membership)."""
+        self.nodes = sorted(
+            (n for n in nodes if n.node_type == "consensus_sealer"),
+            key=lambda n: n.node_id,
+        )

@@ -1,0 +1,79 @@
+"""Sealer — packages pending txs into block proposals.
+
+Reference: bcos-sealer/Sealer.cpp:94-114 (worker loop: fetch → generate →
+submit to consensus) + SealingManager.cpp:140/230. Proposals here carry full
+txs (see engine.py docstring); the tx-count limit comes from the ledger's
+governed config.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..ledger import Ledger
+from ..protocol.block import Block
+from ..protocol.block_header import BlockHeader, ParentInfo
+from ..txpool import TxPool
+from ..utils.log import get_logger
+from .config import PBFTConfig
+from .engine import PBFTEngine
+
+_log = get_logger("sealer")
+
+
+class Sealer:
+    def __init__(
+        self,
+        config: PBFTConfig,
+        txpool: TxPool,
+        ledger: Ledger,
+        engine: PBFTEngine,
+    ):
+        self.config = config
+        self.txpool = txpool
+        self.ledger = ledger
+        self.engine = engine
+        self.min_seal_txs = 1
+
+    def generate_proposal(self) -> Block | None:
+        """Fetch ≤tx_count_limit unsealed txs and build the next block."""
+        cfg = self.ledger.ledger_config()
+        number = cfg.block_number + 1
+        if not self.config.is_leader(number, self.engine.view):
+            return None
+        txs = self.txpool.seal_txs(cfg.tx_count_limit)
+        if len(txs) < self.min_seal_txs:
+            return None
+        parent_hash = cfg.block_hash
+        suite = self.config.suite
+        header = BlockHeader(
+            version=1,
+            number=number,
+            parent_info=[ParentInfo(cfg.block_number, parent_hash)],
+            timestamp=int(time.time() * 1000),
+            sealer=self.config.my_index if self.config.my_index is not None else 0,
+            sealer_list=[n.node_id for n in self.config.nodes],
+            consensus_weights=[n.weight for n in self.config.nodes],
+        )
+        block = Block(header=header, transactions=txs)
+        header.txs_root = block.calculate_txs_root(suite)
+        header.clear_hash_cache()
+        return block
+
+    def seal_and_submit(self) -> bool:
+        """One sealer iteration (executeWorker): propose if leader and txs
+        are pending. Returns True if a proposal was submitted."""
+        block = self.generate_proposal()
+        if block is None:
+            return False
+        ok = self.engine.submit_proposal(block)
+        if not ok:
+            # give the txs back — not our turn / wrong number
+            self.txpool.unseal([t.hash(self.config.suite) for t in block.transactions])
+        else:
+            _log.info(
+                "proposed block %d with %d txs",
+                block.header.number,
+                len(block.transactions),
+            )
+        return ok

@@ -58,7 +58,6 @@ class TransactionExecutor:
         self.codec = ABICodec(suite.hash)
         self.registry = registry if registry is not None else default_registry()
         self._block: BlockContext | None = None
-        self._prepared: dict[int, StateStorage] = {}
 
     # -- block lifecycle (nextBlockHeader:334 / getHash:1017) ---------------
 
@@ -201,14 +200,11 @@ class TransactionExecutor:
             for t, k, e in extra_writes.traverse():
                 writes.set_row(t, k, e)
         self.backend.prepare(params, writes)
-        self._prepared[params.number] = writes
 
     def commit(self, params: TwoPCParams) -> None:
         self.backend.commit(params)
-        self._prepared.pop(params.number, None)
         self._block = None
 
     def rollback(self, params: TwoPCParams) -> None:
         self.backend.rollback(params)
-        self._prepared.pop(params.number, None)
         self._block = None

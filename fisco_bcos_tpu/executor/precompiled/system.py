@@ -86,6 +86,10 @@ class ConsensusPrecompiled(Precompiled):
     def _upsert(self, ctx, node_hex: str, node_type: str, weight: int):
         nid = self._node_id(node_hex)
         nodes = [n for n in self._nodes(ctx) if n.node_id != nid]
+        if node_type != "consensus_sealer" and not any(
+            n.node_type == "consensus_sealer" for n in nodes
+        ):
+            raise PrecompiledError("cannot demote the last sealer")
         nodes.append(
             ConsensusNode(nid, weight, node_type, enable_number=ctx.block_number + 1)
         )

@@ -1,0 +1,3 @@
+"""Node assembly (dependency wiring)."""
+
+from .node import Node, NodeConfig  # noqa: F401

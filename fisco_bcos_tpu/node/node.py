@@ -1,0 +1,88 @@
+"""Node — wires every subsystem into a running consensus participant.
+
+Reference: libinitializer/Initializer.cpp:121-330 (storage → ledger → txpool
+→ scheduler → executor → PBFT/sealer wiring) + ProtocolInitializer.cpp:51-99
+(crypto suite selection: sm_crypto ? SM3+SM2 : Keccak256+Secp256k1 — the
+seam where this framework's batch suites plug in).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..consensus import BlockValidator, PBFTConfig, PBFTEngine, Sealer
+from ..crypto.suite import CryptoSuite, KeyPair, ecdsa_suite, sm_suite
+from ..executor import TransactionExecutor
+from ..front import FrontService
+from ..ledger import GenesisConfig, Ledger
+from ..scheduler import Scheduler
+from ..storage import MemoryStorage, SQLiteStorage
+from ..storage.interfaces import TransactionalStorage
+from ..txpool import TxPool
+from ..utils.log import get_logger
+
+_log = get_logger("node")
+
+
+@dataclass
+class NodeConfig:
+    """The config.ini/config.genesis analog (bcos-tool/NodeConfig.cpp)."""
+
+    chain_id: str = "chain0"
+    group_id: str = "group0"
+    sm_crypto: bool = False
+    db_path: str = ":memory:"  # sqlite path; ":memory:"/"" -> MemoryStorage
+    block_limit: int = 600
+    pool_limit: int = 15000 * 9
+    genesis: GenesisConfig = field(default_factory=GenesisConfig)
+
+
+class Node:
+    def __init__(self, config: NodeConfig, keypair: KeyPair | None = None):
+        self.config = config
+        self.suite: CryptoSuite = sm_suite() if config.sm_crypto else ecdsa_suite()
+        self.keypair = keypair or self.suite.signature_impl.generate_keypair()
+        self.storage: TransactionalStorage = (
+            MemoryStorage()
+            if config.db_path in ("", ":memory:")
+            else SQLiteStorage(config.db_path)
+        )
+        config.genesis.chain_id = config.chain_id
+        config.genesis.group_id = config.group_id
+        self.ledger = Ledger(self.storage, self.suite)
+        self.ledger.build_genesis(config.genesis)
+        self.txpool = TxPool(
+            self.suite,
+            self.ledger,
+            chain_id=config.chain_id,
+            group_id=config.group_id,
+            pool_limit=config.pool_limit,
+            block_limit=config.block_limit,
+        )
+        self.executor = TransactionExecutor(self.storage, self.suite)
+        self.scheduler = Scheduler(
+            self.executor, self.ledger, self.storage, self.suite, self.txpool
+        )
+        self.front = FrontService(self.keypair.pub)
+        ledger_cfg = self.ledger.ledger_config()
+        self.pbft_config = PBFTConfig(
+            suite=self.suite,
+            keypair=self.keypair,
+            nodes=ledger_cfg.consensus_nodes,
+            leader_period=ledger_cfg.leader_period,
+        )
+        self.engine = PBFTEngine(
+            self.pbft_config, self.scheduler, self.txpool, self.ledger, self.front
+        )
+        self.sealer = Sealer(self.pbft_config, self.txpool, self.ledger, self.engine)
+        self.block_validator = BlockValidator(self.suite)
+
+    @property
+    def node_id(self) -> bytes:
+        return self.keypair.pub
+
+    def block_number(self) -> int:
+        return self.ledger.block_number()
+
+    def is_sealer(self) -> bool:
+        return self.pbft_config.my_index is not None

@@ -69,10 +69,18 @@ class Scheduler:
         the proposal's declared roots match execution (sync path)."""
         number = block.header.number
         proposal_ident = tuple(block.tx_hashes(self.suite))
+        # the lock covers the whole execution: the executor's block context is
+        # shared state, and two interleaved same-height executions would
+        # corrupt each other's state layer
         with self._lock:
             cached = self._executed.get(number)
             if cached is not None and cached.tx_hashes == proposal_ident and not verify:
                 return cached.header  # same proposal re-executed (preExecute cache)
+            return self._execute_block_locked(block, verify, number, proposal_ident)
+
+    def _execute_block_locked(
+        self, block: Block, verify: bool, number: int, proposal_ident
+    ) -> BlockHeader:
         timer = StageTimer(_log, f"ExecuteBlock.{number}")
 
         expected = self.ledger.block_number() + 1
@@ -143,9 +151,12 @@ class Scheduler:
     # -- commitBlock:390 -----------------------------------------------------
 
     def commit_block(self, header: BlockHeader) -> None:
-        number = header.number
         with self._lock:
-            cached = self._executed.get(number)
+            self._commit_block_locked(header)
+
+    def _commit_block_locked(self, header: BlockHeader) -> None:
+        number = header.number
+        cached = self._executed.get(number)
         if cached is None:
             raise SchedulerError(
                 ErrorCode.SCHEDULER_INVALID_BLOCK, f"commit of unexecuted block {number}"

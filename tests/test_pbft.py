@@ -1,0 +1,196 @@
+"""Multi-node PBFT consensus without a network.
+
+The reference's PBFTFixture pattern (bcos-pbft/test/unittests/pbft/
+PBFTFixture.h): N full engines in one process, connected through a
+direct-call front/gateway, driven deterministically.
+"""
+
+import pytest
+
+from fisco_bcos_tpu.codec.abi import ABICodec
+from fisco_bcos_tpu.consensus import BlockValidator
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+from fisco_bcos_tpu.front import InprocGateway
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+from fisco_bcos_tpu.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol.block_header import BlockHeader
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+def make_chain(n_nodes=4, auto=True):
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=10_000 + i) for i in range(n_nodes)
+    ]
+    nodes_cfg = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gateway = InprocGateway(auto=auto)
+    nodes = []
+    for kp in keypairs:
+        cfg = NodeConfig(genesis=GenesisConfig(consensus_nodes=list(nodes_cfg)))
+        node = Node(cfg, keypair=kp)
+        gateway.connect(node.front)
+        nodes.append(node)
+    return nodes, gateway
+
+
+def leader_of(nodes, number, view=0):
+    idx = nodes[0].pbft_config.leader_index(number, view)
+    target = nodes[0].pbft_config.nodes[idx].node_id
+    return next(n for n in nodes if n.node_id == target)
+
+
+def submit_txs(node, count, start=0):
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=777)
+    txs = [
+        fac.create_signed(
+            kp,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500,
+            nonce=f"n{start + i}",
+            to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call("userAdd(string,uint256)", f"u{start + i}", 100),
+        )
+        for i in range(count)
+    ]
+    results = node.txpool.submit_batch(txs)
+    assert all(r.status == 0 for r in results)
+    return txs
+
+
+def test_four_node_happy_path():
+    nodes, gw = make_chain(4)
+    leader = leader_of(nodes, 1)
+    submit_txs(leader, 5)
+    assert leader.sealer.seal_and_submit()
+    # consensus ran synchronously through the in-proc gateway
+    for n in nodes:
+        assert n.block_number() == 1, f"node at height {n.block_number()}"
+    roots = {n.ledger.header_by_number(1).state_root for n in nodes}
+    assert len(roots) == 1 and roots != {b"\x00" * 32}
+    hashes = {n.ledger.block_hash_by_number(1) for n in nodes}
+    assert len(hashes) == 1
+
+    # next block, next leader
+    leader2 = leader_of(nodes, 2)
+    submit_txs(leader2, 3, start=100)
+    assert leader2.sealer.seal_and_submit()
+    for n in nodes:
+        assert n.block_number() == 2
+
+
+def test_qc_validates_and_rejects_tamper():
+    nodes, _ = make_chain(4)
+    leader = leader_of(nodes, 1)
+    submit_txs(leader, 2)
+    assert leader.sealer.seal_and_submit()
+    header = nodes[0].ledger.header_by_number(1)
+    committee = nodes[0].ledger.consensus_nodes()
+    validator = BlockValidator(SUITE)
+    assert validator.check_block(header, committee)
+    # tampered state root invalidates every QC signature
+    forged = BlockHeader.decode(header.encode())
+    forged.state_root = b"\xde" * 32
+    forged.clear_hash_cache()
+    assert not validator.check_block(forged, committee)
+    # dropping signatures below quorum fails
+    pruned = BlockHeader.decode(header.encode())
+    pruned.signature_list = pruned.signature_list[:2]  # quorum for 4×w1 = 3
+    assert not validator.check_block(pruned, committee)
+
+
+def test_non_leader_proposal_rejected():
+    nodes, _ = make_chain(4)
+    not_leader = next(
+        n for n in nodes if not n.pbft_config.is_leader(1, 0)
+    )
+    submit_txs(not_leader, 2)
+    assert not not_leader.sealer.seal_and_submit()
+    assert all(n.block_number() == 0 for n in nodes)
+    # txs were returned to the pool
+    assert not_leader.txpool.unsealed_count() == 2
+
+
+def test_view_change_rotates_leader():
+    nodes, gw = make_chain(4)
+    leader = leader_of(nodes, 1, view=0)
+    # leader goes dark
+    gw.disconnect(leader.node_id)
+    alive = [n for n in nodes if n is not leader]
+    for n in alive:
+        n.engine.on_timeout()
+    for n in alive:
+        assert n.engine.view == 1, f"view={n.engine.view}"
+    # new leader proposes under view 1
+    new_leader = leader_of(nodes, 1, view=1)
+    assert new_leader is not leader
+    submit_txs(new_leader, 3)
+    assert new_leader.sealer.seal_and_submit()
+    for n in alive:
+        assert n.block_number() == 1
+
+
+def test_view_change_preserves_prepared_proposal():
+    nodes, gw = make_chain(4, auto=False)
+    leader = leader_of(nodes, 1, view=0)
+    submit_txs(leader, 4)
+    assert leader.sealer.seal_and_submit()
+    # deliver pre-prepare + prepares so the proposal reaches prepared state,
+    # but drop all commits: block must NOT commit
+    gw.dropped = lambda mod, src, dst: False
+    rounds = 0
+    while True:
+        from fisco_bcos_tpu.consensus.messages import PacketType, PBFTMessage
+
+        with gw._lock:
+            batch, gw._queue = gw._queue, []
+        if not batch or rounds > 50:
+            break
+        rounds += 1
+        for mod, src, dst, payload in batch:
+            msg = PBFTMessage.decode(payload)
+            if msg.packet_type == PacketType.COMMIT:
+                continue  # drop commits
+            with gw._lock:
+                front = gw._fronts.get(dst)
+            if front is not None:
+                front.on_receive(mod, src, payload)
+    assert all(n.block_number() == 0 for n in nodes)
+    prepared = [
+        n
+        for n in nodes
+        if (c := n.engine._caches.get(1)) is not None and c.prepared
+    ]
+    assert prepared, "no node reached prepared state"
+
+    # timeout: view change carries the prepared proposal to the new leader
+    for n in nodes:
+        n.engine.on_timeout()
+    gw.deliver_all()
+    new_leader = leader_of(nodes, 1, view=1)
+    for n in nodes:
+        assert n.engine.view >= 1
+    # the re-proposed block commits with the SAME txs root
+    gw.deliver_all()
+    committed = [n for n in nodes if n.block_number() == 1]
+    assert len(committed) == len(nodes), [n.block_number() for n in nodes]
+
+
+def test_engine_ignores_forged_messages():
+    nodes, _ = make_chain(4)
+    from fisco_bcos_tpu.consensus.messages import PacketType, PBFTMessage
+
+    victim = nodes[0]
+    # unsigned / badly-signed prepare is dropped before any state change
+    forged = PBFTMessage(
+        packet_type=PacketType.PREPARE, view=0, number=1, proposal_hash=b"\x01" * 32
+    )
+    forged.generated_from = 1
+    forged.signature = b"\x00" * 65
+    before = len(victim.engine._caches)
+    victim.engine.handle_message(forged)
+    assert len(victim.engine._caches) == before
