@@ -111,6 +111,10 @@ def _decode_one(typ: str, data: bytes, offset: int) -> Any:
     if typ.endswith("[]"):
         elem = typ[:-2]
         n = int.from_bytes(data[offset : offset + _WORD], "big")
+        # each element needs at least one head word: a declared length beyond
+        # that is malformed, not a multi-terabyte allocation
+        if n > (len(data) - offset - _WORD) // _WORD:
+            raise ValueError("abi decode: array length exceeds calldata")
         return abi_decode([elem] * n, data[offset + _WORD :])
     return _decode_static(typ, data[offset : offset + _WORD])
 

@@ -83,11 +83,23 @@ class PBFTEngine:
         self._caches: dict[int, ProposalCache] = {}
         self._view_changes: dict[int, dict[int, PBFTMessage]] = {}
         self._recover_responses: dict[int, PBFTMessage] = {}
+        # safety lock from new-view proofs: view -> (number, only acceptable
+        # proposal hash); a new leader must re-propose the highest prepared
+        # proposal, and replicas enforce it here
+        self._view_locks: dict[int, tuple[int, bytes]] = {}
         self._lock = threading.RLock()
         self.timeout_state = False
         front.register_module(ModuleID.PBFT, self._on_front_message)
 
     # ------------------------------------------------------------------ utils
+
+    MAX_AHEAD = 256  # waterline: ignore votes far past the chain head
+
+    def _in_waterline(self, number: int) -> bool:
+        """Reject numbers outside (committed, committed + MAX_AHEAD] so one
+        faulty sealer can't grow the vote caches without bound (the
+        reference's waterlines check)."""
+        return self.committed_number < number <= self.committed_number + self.MAX_AHEAD
 
     def _cache(self, number: int) -> ProposalCache:
         return self._caches.setdefault(number, ProposalCache())
@@ -165,7 +177,7 @@ class PBFTEngine:
 
     def _handle_pre_prepare(self, msg: PBFTMessage, from_self: bool = False) -> None:
         with self._lock:
-            if msg.number <= self.committed_number:
+            if not self._in_waterline(msg.number):
                 return
             if msg.view != self.view or self.timeout_state:
                 return
@@ -173,7 +185,21 @@ class PBFTEngine:
                 _log.warning("pre-prepare from non-leader %d", msg.generated_from)
                 return
             cache = self._cache(msg.number)
-            if cache.pre_prepare is not None and cache.pre_prepare.proposal_hash == msg.proposal_hash:
+            if cache.pre_prepare is not None:
+                # accepting a SECOND proposal for the same (number, view) and
+                # voting again is equivocation — PBFT safety forbids it
+                if cache.pre_prepare.proposal_hash != msg.proposal_hash:
+                    _log.warning(
+                        "leader equivocation at %d/%d ignored", msg.number, msg.view
+                    )
+                return
+            lock = self._view_locks.get(msg.view)
+            if lock is not None and lock[0] == msg.number and lock[1] != msg.proposal_hash:
+                _log.warning(
+                    "pre-prepare %d/%d violates new-view prepared lock",
+                    msg.number,
+                    msg.view,
+                )
                 return
             try:
                 block = Block.decode(msg.proposal_data)
@@ -223,7 +249,7 @@ class PBFTEngine:
 
     def _handle_prepare(self, msg: PBFTMessage) -> None:
         with self._lock:
-            if msg.number <= self.committed_number or msg.view != self.view:
+            if not self._in_waterline(msg.number) or msg.view != self.view:
                 return
             cache = self._cache(msg.number)
             cache.prepares[msg.generated_from] = msg  # buffered even pre-proposal
@@ -231,7 +257,7 @@ class PBFTEngine:
 
     def _handle_commit(self, msg: PBFTMessage) -> None:
         with self._lock:
-            if msg.number <= self.committed_number or msg.view != self.view:
+            if not self._in_waterline(msg.number) or msg.view != self.view:
                 return
             cache = self._cache(msg.number)
             cache.commits[msg.generated_from] = msg
@@ -296,7 +322,7 @@ class PBFTEngine:
 
     def _handle_checkpoint(self, msg: PBFTMessage) -> None:
         with self._lock:
-            if msg.number <= self.committed_number:
+            if not self._in_waterline(msg.number):
                 return
             cache = self._cache(msg.number)
             cache.checkpoints[msg.generated_from] = msg
@@ -404,6 +430,7 @@ class PBFTEngine:
             )
             self._sign(nv)
             self._broadcast(nv)
+            self._lock_view_to_prepared(msg.view, list(votes.values()))
             self._enter_view(msg.view)
             self._repropose_from(votes)
 
@@ -422,6 +449,7 @@ class PBFTEngine:
                 return
             weight = 0
             seen: set[int] = set()
+            valid_vcs: list[PBFTMessage] = []
             for vc in vcs:
                 node = self.config.node_at(vc.generated_from)
                 if node is None or vc.generated_from in seen:
@@ -432,10 +460,38 @@ class PBFTEngine:
                     continue
                 seen.add(vc.generated_from)
                 weight += node.weight
+                valid_vcs.append(vc)
             if weight < self.config.quorum:
                 _log.warning("new-view %d with insufficient proof", msg.view)
                 return
+            self._lock_view_to_prepared(msg.view, valid_vcs)
             self._enter_view(msg.view)
+
+    def _lock_view_to_prepared(self, view: int, vcs: list[PBFTMessage]) -> None:
+        """Bind the new view to the highest prepared proposal in the VC
+        proofs: the new leader MUST re-propose it (a prepare quorum may mean
+        some node already committed it — proposing anything else forks)."""
+        best: ViewChangePayload | None = None
+        for m in vcs:
+            try:
+                p = ViewChangePayload.decode(m.payload)
+            except Exception:
+                continue
+            if p.prepared_proposal and (
+                best is None or p.prepared_view > best.prepared_view
+            ):
+                best = p
+        if best is None:
+            self._view_locks.pop(view, None)
+            return
+        try:
+            block = Block.decode(best.prepared_proposal)
+        except Exception:
+            return
+        self._view_locks[view] = (
+            block.header.number,
+            block.header.hash(self.suite),
+        )
 
     def _enter_view(self, view: int) -> None:
         self.view = view
@@ -446,6 +502,7 @@ class PBFTEngine:
             n: c for n, c in self._caches.items() if n > self.committed_number and c.stable
         }
         self._view_changes = {v: m for v, m in self._view_changes.items() if v > view}
+        self._view_locks = {v: l for v, l in self._view_locks.items() if v >= view}
         _log.info("entered view %d (leader=%s)", view,
                   self.config.leader_index(self.committed_number + 1, view))
 
@@ -470,6 +527,21 @@ class PBFTEngine:
         if block.header.number != self.committed_number + 1:
             return
         self.submit_proposal(block)
+
+    # ------------------------------------------------------------------ sync
+
+    def on_synced_block(self, number: int) -> None:
+        """Block sync committed a block out-of-band: fast-forward consensus
+        state (the reference's config->setCommittedProposal on sync)."""
+        with self._lock:
+            if number <= self.committed_number:
+                return
+            self.committed_number = number
+            self.timeout_state = False
+            stale = [n for n in self._caches if n <= number]
+            for n in stale:
+                self._caches.pop(n)
+            self.config.reload(self.ledger.consensus_nodes())
 
     # ---------------------------------------------------------------- recover
 

@@ -56,15 +56,18 @@ class FrontService:
     def set_gateway(self, gw: "GatewayInterface") -> None:
         self._gateway = gw
 
-    # outbound
+    # outbound (no gateway = solo node: messages drop, consensus of one
+    # proceeds locally — same as the reference's single-node Air chain)
     def send_message(self, module_id: int, dst: bytes, payload: bytes) -> None:
         if self._gateway is None:
-            raise RuntimeError("front not connected to a gateway")
+            _log.debug("no gateway: dropping send to %s", dst.hex()[:8])
+            return
         self._gateway.send(int(module_id), self.node_id, dst, payload)
 
     def broadcast(self, module_id: int, payload: bytes) -> None:
         if self._gateway is None:
-            raise RuntimeError("front not connected to a gateway")
+            _log.debug("no gateway: dropping broadcast")
+            return
         self._gateway.broadcast(int(module_id), self.node_id, payload)
 
     # inbound (called by the gateway)

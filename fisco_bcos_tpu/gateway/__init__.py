@@ -1,0 +1,3 @@
+"""P2P gateway: TCP transport between nodes."""
+
+from .tcp import TcpGateway  # noqa: F401

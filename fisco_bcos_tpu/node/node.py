@@ -17,6 +17,7 @@ from ..front import FrontService
 from ..ledger import GenesisConfig, Ledger
 from ..scheduler import Scheduler
 from ..storage import MemoryStorage, SQLiteStorage
+from ..sync import BlockSync, TransactionSync
 from ..storage.interfaces import TransactionalStorage
 from ..txpool import TxPool
 from ..utils.log import get_logger
@@ -76,6 +77,30 @@ class Node:
         )
         self.sealer = Sealer(self.pbft_config, self.txpool, self.ledger, self.engine)
         self.block_validator = BlockValidator(self.suite)
+        self.block_sync = BlockSync(
+            self.ledger,
+            self.scheduler,
+            self.front,
+            consensus=self.engine,
+            validator=self.block_validator,
+        )
+        self.tx_sync = TransactionSync(self.txpool, self.front)
+
+    def warmup(self, batch_sizes: tuple[int, ...] = (8,)) -> None:
+        """Pre-compile the batch admission kernels for the given bucket
+        sizes so the first live proposal doesn't pay XLA compile latency
+        inside the consensus timeout window."""
+        from ..protocol.transaction import Transaction
+        from ..txpool.validator import batch_admit
+
+        for b in batch_sizes:
+            txs = []
+            for i in range(b):
+                tx = Transaction(chain_id=self.config.chain_id, nonce=f"warm{i}")
+                tx.signature = b"\x01" * self.suite.signature_impl.sig_len
+                txs.append(tx)
+            batch_admit(txs, self.suite)  # validity is irrelevant; shapes compile
+        _log.info("crypto kernels warm for batch sizes %s", batch_sizes)
 
     @property
     def node_id(self) -> bytes:

@@ -1,0 +1,89 @@
+"""Node runtime — the worker loops that drive a live node.
+
+Reference: the per-module Worker/Timer threads (bcos-utilities Worker.h,
+Timer.cpp; Sealer::executeWorker Sealer.cpp:94, PBFTTimer, BlockSync worker).
+One background thread ticks: sealer proposal attempts, PBFT timeout (view
+change when no block lands within `consensus_timeout`), block-sync and
+tx-gossip maintenance. The engine stays timer-free (deterministic tests);
+this runtime owns all wall-clock behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.log import get_logger
+from .node import Node
+
+_log = get_logger("runtime")
+
+
+class NodeRuntime:
+    def __init__(
+        self,
+        node: Node,
+        sealer_interval: float = 0.05,
+        consensus_timeout: float = 3.0,
+        sync_interval: float = 0.5,
+    ):
+        self.node = node
+        self.sealer_interval = sealer_interval
+        self.consensus_timeout = consensus_timeout
+        self.sync_interval = sync_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_progress = time.monotonic()
+        self._last_height = node.block_number()
+        self._last_sync = 0.0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="node-runtime", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        _log.info("runtime started (node %s)", self.node.node_id.hex()[:8])
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception:
+                _log.exception("runtime tick failed")
+            self._stop.wait(self.sealer_interval)
+
+    def _tick(self) -> None:
+        node = self.node
+        now = time.monotonic()
+
+        height = node.block_number()
+        if height != self._last_height:
+            self._last_height = height
+            self._last_progress = now
+
+        # seal if we are the leader and have pending txs
+        if node.is_sealer() and node.txpool.unsealed_count() > 0:
+            if node.sealer.seal_and_submit():
+                self._last_progress = now
+
+        # consensus timeout -> view change (only meaningful with peers and
+        # work outstanding)
+        outstanding = node.txpool.pending_count() > 0 or node.engine._caches
+        if (
+            node.is_sealer()
+            and node.pbft_config.committee_size > 1
+            and outstanding
+            and now - self._last_progress > self.consensus_timeout
+        ):
+            _log.warning("consensus timeout at height %d -> view change", height)
+            node.engine.on_timeout()
+            self._last_progress = now
+
+        # periodic sync + gossip
+        if now - self._last_sync > self.sync_interval:
+            self._last_sync = now
+            node.tx_sync.maintain()
+            node.block_sync.maintain()

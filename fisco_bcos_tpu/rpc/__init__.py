@@ -1,0 +1,4 @@
+"""JSON-RPC 2.0 API layer."""
+
+from .jsonrpc import JsonRpcImpl  # noqa: F401
+from .http_server import RpcHttpServer  # noqa: F401

@@ -1,0 +1,69 @@
+"""JSON-RPC over HTTP (stdlib ThreadingHTTPServer).
+
+Reference transport: bcos-rpc over bcos-boostssl ws/http. HTTP POST with
+JSON-RPC 2.0 bodies (single or batch); the ws push channels (AMOP, event
+subscription, block notify) ride the amop/event modules.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.log import get_logger
+from .jsonrpc import JsonRpcImpl
+
+_log = get_logger("rpc-http")
+
+
+class RpcHttpServer:
+    def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1", port: int = 20200):
+        self.impl = impl
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    req = json.loads(body)
+                    if isinstance(req, list):
+                        resp = [outer.impl.handle(r) for r in req]
+                    else:
+                        resp = outer.impl.handle(req)
+                    data = json.dumps(resp).encode()
+                    self.send_response(200)
+                except Exception as e:
+                    data = json.dumps(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": None,
+                            "error": {"code": -32700, "message": f"parse error: {e}"},
+                        }
+                    ).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rpc-http", daemon=True
+        )
+        self._thread.start()
+        _log.info("json-rpc listening on %d", self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

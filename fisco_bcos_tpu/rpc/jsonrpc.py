@@ -1,0 +1,355 @@
+"""JSON-RPC 2.0 method implementations.
+
+Reference: bcos-rpc/jsonrpc/JsonRpcInterface.cpp:16-65 (the method table) and
+JsonRpcImpl_2_0.cpp (implementations; sendTransaction:417 co_awaits the
+txpool). JSON field shapes follow the reference's responses (hex-encoded
+hashes/bytes with 0x prefixes).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..node.node import Node
+from ..protocol.block import Block
+from ..protocol.block_header import BlockHeader
+from ..protocol.receipt import TransactionReceipt
+from ..protocol.transaction import Transaction
+from ..utils.bytesutil import from_hex, to_hex
+from ..utils.error import ErrorCode
+from ..utils.log import get_logger
+
+_log = get_logger("rpc")
+
+
+class JsonRpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def _tx_json(tx: Transaction, suite) -> dict:
+    return {
+        "version": tx.version,
+        "hash": to_hex(tx.hash(suite)),
+        "chainID": tx.chain_id,
+        "groupID": tx.group_id,
+        "blockLimit": tx.block_limit,
+        "nonce": tx.nonce,
+        "to": to_hex(tx.to) if tx.to else "",
+        "from": to_hex(tx.sender) if tx.sender else "",
+        "input": to_hex(tx.input),
+        "abi": tx.abi,
+        "signature": to_hex(tx.signature),
+        "importTime": tx.import_time,
+        "attribute": tx.attribute,
+        "extraData": to_hex(tx.extra_data),
+    }
+
+
+def _receipt_json(rc: TransactionReceipt, tx_hash: bytes, suite) -> dict:
+    return {
+        "version": rc.version,
+        "transactionHash": to_hex(tx_hash),
+        "receiptHash": to_hex(rc.hash(suite)),
+        "blockNumber": rc.block_number,
+        "gasUsed": str(rc.gas_used),
+        "contractAddress": to_hex(rc.contract_address) if rc.contract_address else "",
+        "status": rc.status,
+        "output": to_hex(rc.output),
+        "logEntries": [
+            {
+                "address": to_hex(e.address),
+                "topics": [to_hex(t) for t in e.topics],
+                "data": to_hex(e.data),
+            }
+            for e in rc.log_entries
+        ],
+        "effectiveGasPrice": rc.effective_gas_price,
+    }
+
+
+def _header_json(h: BlockHeader, suite) -> dict:
+    return {
+        "version": h.version,
+        "hash": to_hex(h.hash(suite)),
+        "parentInfo": [
+            {"blockNumber": p.number, "blockHash": to_hex(p.hash)} for p in h.parent_info
+        ],
+        "txsRoot": to_hex(h.txs_root),
+        "receiptsRoot": to_hex(h.receipts_root),
+        "stateRoot": to_hex(h.state_root),
+        "number": h.number,
+        "gasUsed": str(h.gas_used),
+        "timestamp": h.timestamp,
+        "sealer": h.sealer,
+        "sealerList": [to_hex(s) for s in h.sealer_list],
+        "extraData": to_hex(h.extra_data),
+        "consensusWeights": list(h.consensus_weights),
+        "signatureList": [
+            {"index": s.index, "signature": to_hex(s.signature)}
+            for s in h.signature_list
+        ],
+    }
+
+
+def _block_json(blk: Block, suite, with_txs: bool) -> dict:
+    out = _header_json(blk.header, suite)
+    if with_txs:
+        out["transactions"] = [_tx_json(t, suite) for t in blk.transactions]
+    else:
+        out["transactions"] = [to_hex(h) for h in blk.tx_metadata]
+    return out
+
+
+class JsonRpcImpl:
+    """Method table bound to one node (group manager arrives with multi-group)."""
+
+    def __init__(self, node: Node):
+        self.node = node
+        self.suite = node.suite
+        self.methods = {
+            "call": self.call,
+            "sendTransaction": self.send_transaction,
+            "getTransaction": self.get_transaction,
+            "getTransactionReceipt": self.get_transaction_receipt,
+            "getBlockByHash": self.get_block_by_hash,
+            "getBlockByNumber": self.get_block_by_number,
+            "getBlockHashByNumber": self.get_block_hash_by_number,
+            "getBlockNumber": self.get_block_number,
+            "getCode": self.get_code,
+            "getABI": self.get_abi,
+            "getSealerList": self.get_sealer_list,
+            "getObserverList": self.get_observer_list,
+            "getPbftView": self.get_pbft_view,
+            "getPendingTxSize": self.get_pending_tx_size,
+            "getSyncStatus": self.get_sync_status,
+            "getConsensusStatus": self.get_consensus_status,
+            "getSystemConfigByKey": self.get_system_config_by_key,
+            "getTotalTransactionCount": self.get_total_transaction_count,
+            "getPeers": self.get_peers,
+            "getGroupPeers": self.get_group_peers,
+            "getGroupList": self.get_group_list,
+            "getGroupInfo": self.get_group_info,
+            "getGroupInfoList": self.get_group_info_list,
+            "getGroupNodeInfo": self.get_group_node_info,
+        }
+
+    # -- dispatch ------------------------------------------------------------
+
+    def handle(self, request: dict) -> dict:
+        rid = request.get("id")
+        try:
+            method = request.get("method", "")
+            fn = self.methods.get(method)
+            if fn is None:
+                raise JsonRpcError(-32601, f"method not found: {method}")
+            params = request.get("params", [])
+            result = fn(*params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except JsonRpcError as e:
+            return {
+                "jsonrpc": "2.0",
+                "id": rid,
+                "error": {"code": e.code, "message": e.message},
+            }
+        except Exception as e:  # malformed params etc.
+            return {
+                "jsonrpc": "2.0",
+                "id": rid,
+                "error": {"code": -32602, "message": f"invalid params: {e}"},
+            }
+
+    # -- tx methods ----------------------------------------------------------
+
+    def send_transaction(self, group: str, node_name: str, data: str, require_proof: bool = False) -> dict:
+        tx = Transaction.decode(from_hex(data))
+        result = self.node.txpool.submit(tx)
+        if result.status != ErrorCode.SUCCESS:
+            raise JsonRpcError(int(result.status), result.status.name)
+        # gossip promptly so peers can verify proposals carrying this tx
+        self.node.tx_sync.maintain()
+        return {
+            "transactionHash": to_hex(result.tx_hash),
+            "from": to_hex(result.sender),
+            "status": int(result.status),
+        }
+
+    def call(self, group: str, node_name: str, to: str, data: str) -> dict:
+        tx = Transaction(to=from_hex(to), input=from_hex(data))
+        rc = self.node.scheduler.call(tx)
+        return {
+            "blockNumber": self.node.block_number(),
+            "status": rc.status,
+            "output": to_hex(rc.output),
+        }
+
+    def get_transaction(self, group: str, node_name: str, tx_hash: str, proof: bool = False) -> dict:
+        h = from_hex(tx_hash)
+        tx = self.node.ledger.tx_by_hash(h) or self.node.txpool.get(h)
+        if tx is None:
+            raise JsonRpcError(-32602, "transaction not found")
+        out = _tx_json(tx, self.suite)
+        if proof:
+            p = self.node.ledger.tx_proof(h)
+            if p is not None:
+                items, idx, n = p
+                out["txProof"] = {
+                    "index": idx,
+                    "leaves": n,
+                    "path": [[to_hex(g) for g in it.group] for it in items],
+                }
+        return out
+
+    def get_transaction_receipt(self, group: str, node_name: str, tx_hash: str, proof: bool = False) -> dict:
+        h = from_hex(tx_hash)
+        rc = self.node.ledger.receipt_by_hash(h)
+        if rc is None:
+            raise JsonRpcError(-32602, "receipt not found")
+        return _receipt_json(rc, h, self.suite)
+
+    # -- block methods -------------------------------------------------------
+
+    def get_block_number(self, group: str = "", node_name: str = "") -> int:
+        return self.node.block_number()
+
+    def get_block_by_number(
+        self, group: str = "", node_name: str = "", number: int = 0,
+        only_header: bool = False, only_tx_hash: bool = False,
+    ) -> dict:
+        blk = self.node.ledger.block_by_number(int(number), with_txs=not only_tx_hash)
+        if blk is None:
+            raise JsonRpcError(-32602, f"block {number} not found")
+        if only_header:
+            return _header_json(blk.header, self.suite)
+        return _block_json(blk, self.suite, with_txs=not only_tx_hash)
+
+    def get_block_by_hash(
+        self, group: str = "", node_name: str = "", block_hash: str = "",
+        only_header: bool = False, only_tx_hash: bool = False,
+    ) -> dict:
+        n = self.node.ledger.block_number_by_hash(from_hex(block_hash))
+        if n is None:
+            raise JsonRpcError(-32602, "block not found")
+        return self.get_block_by_number(group, node_name, n, only_header, only_tx_hash)
+
+    def get_block_hash_by_number(self, group: str = "", node_name: str = "", number: int = 0) -> str:
+        h = self.node.ledger.block_hash_by_number(int(number))
+        if h is None:
+            raise JsonRpcError(-32602, f"block {number} not found")
+        return to_hex(h)
+
+    # -- contract/code -------------------------------------------------------
+
+    def get_code(self, group: str = "", node_name: str = "", address: str = "") -> str:
+        from ..ledger.ledger import SYS_CODE_BINARY
+
+        e = self.node.storage.get_row(SYS_CODE_BINARY, from_hex(address))
+        return to_hex(e.get()) if e is not None else "0x"
+
+    def get_abi(self, group: str = "", node_name: str = "", address: str = "") -> str:
+        from ..ledger.ledger import SYS_CONTRACT_ABI
+
+        e = self.node.storage.get_row(SYS_CONTRACT_ABI, from_hex(address))
+        return e.get().decode() if e is not None else ""
+
+    # -- status methods ------------------------------------------------------
+
+    def get_sealer_list(self, group: str = "", node_name: str = "") -> list:
+        return [
+            {"nodeID": to_hex(n.node_id, prefix=False), "weight": n.weight}
+            for n in self.node.ledger.consensus_nodes()
+            if n.node_type == "consensus_sealer"
+        ]
+
+    def get_observer_list(self, group: str = "", node_name: str = "") -> list:
+        return [
+            to_hex(n.node_id, prefix=False)
+            for n in self.node.ledger.consensus_nodes()
+            if n.node_type == "consensus_observer"
+        ]
+
+    def get_pbft_view(self, group: str = "", node_name: str = "") -> int:
+        return self.node.engine.view
+
+    def get_pending_tx_size(self, group: str = "", node_name: str = "") -> int:
+        return self.node.txpool.pending_count()
+
+    def get_sync_status(self, group: str = "", node_name: str = "") -> dict:
+        num = self.node.block_number()
+        return {
+            "blockNumber": num,
+            "latestHash": to_hex(self.node.ledger.block_hash_by_number(num) or b""),
+            "genesisHash": to_hex(self.node.ledger.block_hash_by_number(0) or b""),
+            "nodeID": to_hex(self.node.node_id, prefix=False),
+            "isSyncing": False,
+            "knownHighestNumber": max(
+                [num] + [st.number for st in self.node.block_sync._peers.values()]
+            ),
+        }
+
+    def get_consensus_status(self, group: str = "", node_name: str = "") -> dict:
+        cfg = self.node.pbft_config
+        return {
+            "nodeID": to_hex(self.node.node_id, prefix=False),
+            "index": cfg.my_index,
+            "view": self.node.engine.view,
+            "committedNumber": self.node.engine.committed_number,
+            "leaderIndex": cfg.leader_index(self.node.engine.committed_number + 1,
+                                            self.node.engine.view),
+            "committeeSize": cfg.committee_size,
+            "quorum": cfg.quorum,
+            "timeout": self.node.engine.timeout_state,
+        }
+
+    def get_system_config_by_key(self, group: str = "", node_name: str = "", key: str = "") -> dict:
+        v = self.node.ledger.system_config(key.encode())
+        if v is None:
+            raise JsonRpcError(-32602, f"unknown system config {key}")
+        return {"value": v[0], "blockNumber": v[1]}
+
+    def get_total_transaction_count(self, group: str = "", node_name: str = "") -> dict:
+        return {
+            "blockNumber": self.node.block_number(),
+            "transactionCount": self.node.ledger.total_transaction_count(),
+            "failedTransactionCount": self.node.ledger.total_failed_transaction_count(),
+        }
+
+    # -- group/peer methods (single-group node; gateway fills peers) ---------
+
+    def get_peers(self, group: str = "", node_name: str = "") -> dict:
+        peers = list(getattr(self.node.front, "_gateway_peers", []) or [])
+        sync_peers = [to_hex(p, prefix=False) for p in self.node.block_sync._peers]
+        return {"peers": peers or sync_peers}
+
+    def get_group_peers(self, group: str = "", node_name: str = "") -> list:
+        return [to_hex(p, prefix=False) for p in self.node.block_sync._peers]
+
+    def get_group_list(self) -> dict:
+        return {"groupList": [self.node.config.group_id]}
+
+    def get_group_info(self, group: str = "") -> dict:
+        return {
+            "chainID": self.node.config.chain_id,
+            "groupID": self.node.config.group_id,
+            "genesisConfig": {
+                "consensusType": "pbft",
+                "txCountLimit": self.node.ledger.ledger_config().tx_count_limit,
+                "leaderPeriod": self.node.ledger.ledger_config().leader_period,
+            },
+            "nodeList": [self.get_group_node_info(group)],
+        }
+
+    def get_group_info_list(self) -> list:
+        return [self.get_group_info()]
+
+    def get_group_node_info(self, group: str = "", node_name: str = "") -> dict:
+        return {
+            "name": node_name or "node0",
+            "nodeID": to_hex(self.node.node_id, prefix=False),
+            "type": 0 if self.node.is_sealer() else 1,
+            "blockNumber": self.node.block_number(),
+            "timestamp": int(time.time() * 1000),
+        }

@@ -1,0 +1,4 @@
+"""Block download/commit sync + tx gossip."""
+
+from .block_sync import BlockSync  # noqa: F401
+from .tx_sync import TransactionSync  # noqa: F401

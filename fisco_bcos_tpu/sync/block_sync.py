@@ -1,0 +1,208 @@
+"""Block sync — download, verify, execute, commit.
+
+Reference: bcos-sync/bcos-sync/BlockSync.cpp (peer status registry
+state/SyncPeerStatus.cpp, download queue state/DownloadingQueue.cpp) with the
+commit path DownloadingQueue::applyBlock:260 → scheduler executeBlock(verify)
+:281 → BlockValidator QC check :407 → commitBlock:483. The QC check — every
+sealer signature on the header — is one device batch here (the #2 hot loop).
+
+Protocol (over ModuleID.BLOCK_SYNC): nodes broadcast their status on commit
+and on `maintain()`; a node behind a peer requests a block range; responses
+carry full blocks (header + QC + txs). Timers live in the node runtime —
+`maintain()` is the explicit tick, keeping multi-node tests deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from enum import IntEnum
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..consensus.block_validator import BlockValidator
+from ..front.front import FrontService, ModuleID
+from ..ledger import Ledger
+from ..protocol.block import Block
+from ..scheduler.scheduler import Scheduler, SchedulerError
+from ..utils.log import get_logger
+
+_log = get_logger("block-sync")
+
+MAX_BLOCKS_PER_REQUEST = 32
+
+
+class SyncPacket(IntEnum):
+    STATUS = 0
+    REQUEST = 1
+    RESPONSE = 2
+
+
+@dataclass
+class SyncStatus:
+    number: int
+    block_hash: bytes
+    genesis_hash: bytes
+
+
+def _encode_status(s: SyncStatus) -> bytes:
+    w = FlatWriter()
+    w.u8(int(SyncPacket.STATUS))
+    w.i64(s.number)
+    w.fixed(s.block_hash, 32)
+    w.fixed(s.genesis_hash, 32)
+    return w.out()
+
+
+def _encode_request(start: int, count: int) -> bytes:
+    w = FlatWriter()
+    w.u8(int(SyncPacket.REQUEST))
+    w.i64(start)
+    w.i64(count)
+    return w.out()
+
+
+def _encode_response(blocks: list[bytes]) -> bytes:
+    w = FlatWriter()
+    w.u8(int(SyncPacket.RESPONSE))
+    w.seq(blocks, lambda w2, b: w2.bytes_(b))
+    return w.out()
+
+
+class BlockSync:
+    def __init__(
+        self,
+        ledger: Ledger,
+        scheduler: Scheduler,
+        front: FrontService,
+        consensus=None,  # PBFTEngine, notified on synced commits
+        validator: BlockValidator | None = None,
+    ):
+        self.ledger = ledger
+        self.scheduler = scheduler
+        self.front = front
+        self.consensus = consensus
+        self.suite = ledger.suite
+        self.validator = validator or BlockValidator(self.suite)
+        self._peers: dict[bytes, SyncStatus] = {}
+        self._requested_to: int = 0
+        self._lock = threading.RLock()
+        self._genesis_hash = ledger.block_hash_by_number(0) or b"\x00" * 32
+        front.register_module(ModuleID.BLOCK_SYNC, self._on_message)
+
+    # -- outbound ------------------------------------------------------------
+
+    def broadcast_status(self) -> None:
+        num = self.ledger.block_number()
+        st = SyncStatus(
+            number=num,
+            block_hash=self.ledger.block_hash_by_number(num) or b"\x00" * 32,
+            genesis_hash=self._genesis_hash,
+        )
+        self.front.broadcast(ModuleID.BLOCK_SYNC, _encode_status(st))
+
+    def maintain(self) -> None:
+        """One sync tick: advertise status, request missing blocks from the
+        best peer (maintainDownloadingQueue analog)."""
+        self.broadcast_status()
+        self._request_missing()
+
+    def _request_missing(self) -> None:
+        my_number = self.ledger.block_number()
+        with self._lock:
+            best = None
+            for nid, st in self._peers.items():
+                if st.genesis_hash != self._genesis_hash:
+                    continue
+                if st.number > my_number and (best is None or st.number > best[1].number):
+                    best = (nid, st)
+            if best is None:
+                return
+            nid, st = best
+            start = my_number + 1
+            if self._requested_to >= start:
+                return  # outstanding request covers it
+            count = min(st.number - my_number, MAX_BLOCKS_PER_REQUEST)
+            self._requested_to = start + count - 1
+        _log.info("requesting blocks [%d, %d) from %s", start, start + count, nid.hex()[:8])
+        self.front.send_message(ModuleID.BLOCK_SYNC, nid, _encode_request(start, count))
+
+    # -- inbound -------------------------------------------------------------
+
+    def _on_message(self, src: bytes, payload: bytes) -> None:
+        try:
+            r = FlatReader(payload)
+            pkt = SyncPacket(r.u8())
+            if pkt == SyncPacket.STATUS:
+                st = SyncStatus(r.i64(), r.fixed(32), r.fixed(32))
+                r.done()
+                self._on_status(src, st)
+            elif pkt == SyncPacket.REQUEST:
+                start, count = r.i64(), r.i64()
+                r.done()
+                self._on_request(src, start, count)
+            elif pkt == SyncPacket.RESPONSE:
+                blocks = r.seq(lambda r2: r2.bytes_())
+                r.done()
+                self._on_response(src, blocks)
+        except Exception as e:
+            _log.warning("bad sync message from %s: %s", src.hex()[:8], e)
+
+    def _on_status(self, src: bytes, st: SyncStatus) -> None:
+        with self._lock:
+            self._peers[src] = st
+        if st.number > self.ledger.block_number():
+            self._request_missing()
+
+    def _on_request(self, src: bytes, start: int, count: int) -> None:
+        count = max(0, min(count, MAX_BLOCKS_PER_REQUEST))
+        blocks: list[bytes] = []
+        for n in range(start, start + count):
+            blk = self.ledger.block_by_number(n, with_txs=True)
+            if blk is None:
+                break
+            blocks.append(blk.encode())
+        if blocks:
+            self.front.send_message(ModuleID.BLOCK_SYNC, src, _encode_response(blocks))
+
+    def _on_response(self, src: bytes, raw_blocks: list[bytes]) -> None:
+        applied = 0
+        for raw in raw_blocks:
+            try:
+                block = Block.decode(raw)
+            except Exception:
+                _log.warning("undecodable block from %s", src.hex()[:8])
+                break
+            if not self._apply_block(block):
+                break
+            applied += 1
+        with self._lock:
+            self._requested_to = 0  # allow the next request round
+        if applied:
+            self.broadcast_status()
+            self._request_missing()
+
+    # -- the commit path (applyBlock:260) ------------------------------------
+
+    def _apply_block(self, block: Block) -> bool:
+        number = block.header.number
+        if number != self.ledger.block_number() + 1:
+            return False
+        # QC first: a forged block must not reach execution
+        committee = self.ledger.consensus_nodes()
+        if not self.validator.check_block(block.header, committee):
+            _log.warning("block %d: QC validation failed", number)
+            return False
+        parent = self.ledger.block_hash_by_number(number - 1)
+        if block.header.parent_info and block.header.parent_info[0].hash != parent:
+            _log.warning("block %d: parent hash mismatch", number)
+            return False
+        try:
+            header = self.scheduler.execute_block(block, verify=True)
+            self.scheduler.commit_block(header)
+        except SchedulerError as e:
+            _log.warning("block %d: apply failed: %s", number, e)
+            return False
+        if self.consensus is not None:
+            self.consensus.on_synced_block(number)
+        _log.info("synced block %d (%d txs)", number, len(block.transactions))
+        return True

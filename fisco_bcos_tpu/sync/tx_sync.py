@@ -1,0 +1,133 @@
+"""Transaction sync — gossip + missing-tx fetch.
+
+Reference: bcos-txpool/sync/TransactionSync.cpp (maintainTransactions:78
+broadcast, onReceiveTxsRequest:165, requestMissedTxs:204,
+importDownloadedTxs:521 — the tbb-parallel verify loop that is one device
+batch here via TxPool.submit_batch).
+"""
+
+from __future__ import annotations
+
+import threading
+from enum import IntEnum
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..front.front import FrontService, ModuleID
+from ..protocol.transaction import Transaction
+from ..txpool import TxPool
+from ..utils.log import get_logger
+
+_log = get_logger("tx-sync")
+
+
+class TxsPacket(IntEnum):
+    PUSH = 0
+    REQUEST = 1
+    RESPONSE = 2
+
+
+def _encode_txs(pkt: TxsPacket, txs: list[bytes]) -> bytes:
+    w = FlatWriter()
+    w.u8(int(pkt))
+    w.seq(txs, lambda w2, b: w2.bytes_(b))
+    return w.out()
+
+
+def _encode_request(hashes: list[bytes]) -> bytes:
+    w = FlatWriter()
+    w.u8(int(TxsPacket.REQUEST))
+    w.seq(hashes, lambda w2, h: w2.fixed(h, 32))
+    return w.out()
+
+
+class TransactionSync:
+    def __init__(self, txpool: TxPool, front: FrontService):
+        self.txpool = txpool
+        self.front = front
+        self.suite = txpool.suite
+        self._broadcasted: set[bytes] = set()
+        self._responses: dict[bytes, Transaction] = {}
+        self._lock = threading.RLock()
+        front.register_module(ModuleID.TXS_SYNC, self._on_message)
+
+    # -- gossip (maintainTransactions:78) ------------------------------------
+
+    def maintain(self) -> None:
+        """Broadcast txs not yet gossiped (called on a timer / after RPC
+        submissions)."""
+        to_send: list[bytes] = []
+        with self._lock:
+            with self.txpool._lock:
+                items = list(self.txpool._txs.items())
+            for h, tx in items:
+                if h not in self._broadcasted:
+                    self._broadcasted.add(h)
+                    to_send.append(tx.encode())
+            # forget hashes that already left the pool
+            if len(self._broadcasted) > 4 * max(1, len(items)):
+                live = {h for h, _ in items}
+                self._broadcasted &= live
+        if to_send:
+            self.front.broadcast(
+                ModuleID.TXS_SYNC, _encode_txs(TxsPacket.PUSH, to_send)
+            )
+
+    # -- missing-tx fetch (requestMissedTxs:204) -----------------------------
+
+    def fetch_missing(self, hashes: list[bytes], from_node: bytes) -> list[Transaction | None]:
+        """Synchronously request missing txs from a peer (the proposal-verify
+        fetch hook). Returns them in request order; relies on the transport
+        delivering the response before this returns (in-process gateway) or
+        on retry at the next verify attempt."""
+        with self._lock:
+            self._responses.clear()
+        self.front.send_message(ModuleID.TXS_SYNC, from_node, _encode_request(hashes))
+        with self._lock:
+            return [self._responses.get(h) for h in hashes]
+
+    # -- inbound -------------------------------------------------------------
+
+    def _on_message(self, src: bytes, payload: bytes) -> None:
+        try:
+            r = FlatReader(payload)
+            pkt = TxsPacket(r.u8())
+            if pkt == TxsPacket.PUSH:
+                raw = r.seq(lambda r2: r2.bytes_())
+                r.done()
+                self._on_push(raw)
+            elif pkt == TxsPacket.REQUEST:
+                hashes = r.seq(lambda r2: r2.fixed(32))
+                r.done()
+                self._on_request(src, hashes)
+            elif pkt == TxsPacket.RESPONSE:
+                raw = r.seq(lambda r2: r2.bytes_())
+                r.done()
+                self._on_response(raw)
+        except Exception as e:
+            _log.warning("bad tx-sync message from %s: %s", src.hex()[:8], e)
+
+    def _on_push(self, raw: list[bytes]) -> None:
+        txs = []
+        for b in raw:
+            try:
+                txs.append(Transaction.decode(b))
+            except Exception:
+                continue
+        if txs:
+            # device batch verify + admission (importDownloadedTxs:521)
+            self.txpool.submit_batch(txs)
+
+    def _on_request(self, src: bytes, hashes: list[bytes]) -> None:
+        found = [t.encode() for t in self.txpool.fetch_txs(hashes) if t is not None]
+        self.front.send_message(
+            ModuleID.TXS_SYNC, src, _encode_txs(TxsPacket.RESPONSE, found)
+        )
+
+    def _on_response(self, raw: list[bytes]) -> None:
+        with self._lock:
+            for b in raw:
+                try:
+                    tx = Transaction.decode(b)
+                except Exception:
+                    continue
+                self._responses[tx.hash(self.suite)] = tx

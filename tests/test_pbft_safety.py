@@ -1,0 +1,92 @@
+"""PBFT safety regressions: equivocation, waterlines, new-view locks, ABI DoS."""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+import pytest  # noqa: E402
+from test_pbft import leader_of, make_chain, submit_txs  # noqa: E402
+
+from fisco_bcos_tpu.codec.abi import abi_decode  # noqa: E402
+from fisco_bcos_tpu.consensus.messages import PacketType, PBFTMessage  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+
+SUITE = ecdsa_suite()
+
+
+def test_leader_equivocation_ignored():
+    nodes, gw = make_chain(4, auto=False)
+    leader = leader_of(nodes, 1)
+    submit_txs(leader, 2)
+    assert leader.sealer.seal_and_submit()
+    # capture the real pre-prepare and forge a second one with a different hash
+    from fisco_bcos_tpu.protocol.block import Block
+
+    replica = next(n for n in nodes if n is not leader)
+    with gw._lock:
+        batch = list(gw._queue)
+    pre = next(
+        PBFTMessage.decode(p)
+        for m, s, d, p in batch
+        if PBFTMessage.decode(p).packet_type == PacketType.PRE_PREPARE
+    )
+    blk = Block.decode(pre.proposal_data)
+    blk.header.timestamp += 1  # different block, same height
+    blk.header.clear_hash_cache()
+    equiv = PBFTMessage(
+        packet_type=PacketType.PRE_PREPARE,
+        view=pre.view,
+        number=pre.number,
+        proposal_hash=blk.header.hash(SUITE),
+        proposal_data=blk.encode(),
+    )
+    equiv.generated_from = pre.generated_from
+    equiv.signature = b""
+    # sign with the leader's key (Byzantine leader equivocating)
+    kp = leader.keypair
+    equiv.sign(SUITE, kp)
+    equiv.generated_from = pre.generated_from
+
+    replica.engine.handle_message(pre)  # replica accepts the first proposal
+    first_hash = replica.engine._caches[1].pre_prepare.proposal_hash
+    assert first_hash == pre.proposal_hash
+    replica.engine.handle_message(equiv)
+    assert replica.engine._caches[1].pre_prepare.proposal_hash == first_hash
+    # only one prepare signed by the replica (no second vote)
+    my_idx = replica.pbft_config.my_index
+    assert replica.engine._caches[1].prepares[my_idx].proposal_hash == first_hash
+
+
+def test_waterline_bounds_vote_caches():
+    nodes, _ = make_chain(4)
+    victim, sender = nodes[0], nodes[1]
+    idx = sender.pbft_config.my_index
+    for number in (10_000, 10**8):
+        msg = PBFTMessage(
+            packet_type=PacketType.PREPARE,
+            view=0,
+            number=number,
+            proposal_hash=b"\x01" * 32,
+        )
+        msg.generated_from = idx
+        msg.sign(SUITE, sender.keypair)
+        msg.generated_from = idx
+        victim.engine.handle_message(msg)
+    assert 10_000 not in victim.engine._caches
+    assert 10**8 not in victim.engine._caches
+    # in-waterline numbers still cache
+    msg = PBFTMessage(
+        packet_type=PacketType.PREPARE, view=0, number=5, proposal_hash=b"\x01" * 32
+    )
+    msg.generated_from = idx
+    msg.sign(SUITE, sender.keypair)
+    msg.generated_from = idx
+    victim.engine.handle_message(msg)
+    assert 5 in victim.engine._caches
+
+
+def test_abi_rejects_huge_array_length():
+    # array length word of 2^40 with no backing data must raise, not allocate
+    data = (32).to_bytes(32, "big") + (2**40).to_bytes(32, "big")
+    with pytest.raises(ValueError):
+        abi_decode(["uint256[]"], data)

@@ -1,0 +1,163 @@
+"""Live-node tests: JSON-RPC over HTTP on a solo chain, and real TCP P2P."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from fisco_bcos_tpu.codec.abi import ABICodec
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+from fisco_bcos_tpu.gateway import TcpGateway
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+from fisco_bcos_tpu.node import Node, NodeConfig
+from fisco_bcos_tpu.node.runtime import NodeRuntime
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+from fisco_bcos_tpu.rpc import JsonRpcImpl, RpcHttpServer
+from fisco_bcos_tpu.utils.bytesutil import to_hex
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+def wait_until(cond, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def rpc_call(port, method, *params):
+    req = {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)}
+    data = json.dumps(req).encode()
+    r = urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{port}", data=data,
+            headers={"Content-Type": "application/json"},
+        ),
+        timeout=10,
+    )
+    return json.loads(r.read())
+
+
+def make_signed_tx(nonce, sig, *args):
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=0xFACE)
+    return fac.create_signed(
+        kp,
+        chain_id="chain0",
+        group_id="group0",
+        block_limit=500,
+        nonce=nonce,
+        to=DAG_TRANSFER_ADDRESS,
+        input=CODEC.encode_call(sig, *args),
+    )
+
+
+@pytest.fixture
+def solo_node():
+    kp = SUITE.signature_impl.generate_keypair(secret=0x5010)
+    cfg = NodeConfig(
+        genesis=GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub, weight=1)])
+    )
+    node = Node(cfg, keypair=kp)
+    runtime = NodeRuntime(node, sealer_interval=0.02)
+    server = RpcHttpServer(JsonRpcImpl(node), port=0)
+    runtime.start()
+    server.start()
+    yield node, server.port
+    server.stop()
+    runtime.stop()
+
+
+def test_solo_chain_rpc_end_to_end(solo_node):
+    node, port = solo_node
+    assert rpc_call(port, "getBlockNumber")["result"] == 0
+
+    tx = make_signed_tx("rpc-1", "userAdd(string,uint256)", "carol", 500)
+    resp = rpc_call(port, "sendTransaction", "group0", "node0", to_hex(tx.encode()))
+    assert "result" in resp, resp
+    tx_hash = resp["result"]["transactionHash"]
+
+    assert wait_until(lambda: node.block_number() >= 1)
+    rc = rpc_call(port, "getTransactionReceipt", "group0", "node0", tx_hash)["result"]
+    assert rc["status"] == 0 and rc["blockNumber"] >= 1
+
+    got_tx = rpc_call(port, "getTransaction", "group0", "node0", tx_hash, True)["result"]
+    assert got_tx["hash"] == tx_hash and got_tx["nonce"] == "rpc-1"
+    assert "txProof" in got_tx
+
+    blk = rpc_call(port, "getBlockByNumber", "group0", "node0", rc["blockNumber"])["result"]
+    assert any(t["hash"] == tx_hash for t in blk["transactions"])
+    assert rpc_call(
+        port, "getBlockHashByNumber", "group0", "node0", rc["blockNumber"]
+    )["result"] == blk["hash"]
+
+    # read-only call sees the committed state
+    out = rpc_call(
+        port, "call", "group0", "node0", to_hex(DAG_TRANSFER_ADDRESS),
+        to_hex(CODEC.encode_call("userBalance(string)", "carol")),
+    )["result"]
+    ok, bal = CODEC.decode_output(["uint256", "uint256"], bytes.fromhex(out["output"][2:]))
+    assert (ok, bal) == (0, 500)
+
+    status = rpc_call(port, "getConsensusStatus")["result"]
+    assert status["committeeSize"] == 1 and status["committedNumber"] >= 1
+    totals = rpc_call(port, "getTotalTransactionCount")["result"]
+    assert totals["transactionCount"] >= 1
+    cfgv = rpc_call(port, "getSystemConfigByKey", "group0", "node0", "tx_count_limit")
+    assert cfgv["result"]["value"] == "1000"
+    # error path: unknown method
+    assert "error" in rpc_call(port, "bogusMethod")
+
+
+def test_four_nodes_over_tcp():
+    keypairs = [SUITE.signature_impl.generate_keypair(secret=7000 + i) for i in range(4)]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    nodes, gateways, runtimes = [], [], []
+    try:
+        for kp in keypairs:
+            cfg = NodeConfig(genesis=GenesisConfig(consensus_nodes=list(committee)))
+            node = Node(cfg, keypair=kp)
+            gw = TcpGateway(kp.pub)
+            gw.connect(node.front)
+            gw.start()
+            nodes.append(node)
+            gateways.append(gw)
+        # full mesh dial (each dials those after it)
+        for i, gw in enumerate(gateways):
+            for other in gateways[i + 1 :]:
+                assert gw.connect_peer(other.host, other.port)
+        assert wait_until(
+            lambda: all(len(gw.peers()) == 3 for gw in gateways), timeout=10
+        ), [len(g.peers()) for g in gateways]
+
+        nodes[0].warmup(batch_sizes=(8,))  # jit cache is process-wide
+        for node in nodes:
+            rt = NodeRuntime(node, sealer_interval=0.05, consensus_timeout=60.0)
+            rt.start()
+            runtimes.append(rt)
+
+        # submit to ONE node; gossip + consensus must spread and commit
+        entry = nodes[0]
+        txs = [
+            make_signed_tx(f"tcp-{i}", "userAdd(string,uint256)", f"tcpu{i}", 100)
+            for i in range(8)
+        ]
+        res = entry.txpool.submit_batch(txs)
+        assert all(r.status == 0 for r in res)
+
+        assert wait_until(
+            lambda: all(n.block_number() >= 1 for n in nodes), timeout=60
+        ), [n.block_number() for n in nodes]
+        h = min(n.block_number() for n in nodes)
+        roots = {n.ledger.header_by_number(h).state_root for n in nodes}
+        assert len(roots) == 1
+    finally:
+        for rt in runtimes:
+            rt.stop()
+        for gw in gateways:
+            gw.stop()

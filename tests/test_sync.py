@@ -1,0 +1,96 @@
+"""Block sync + tx gossip across the in-process gateway."""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+from test_pbft import leader_of, make_chain, submit_txs  # noqa: E402
+
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.front import InprocGateway  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig  # noqa: E402
+from fisco_bcos_tpu.node import Node, NodeConfig  # noqa: E402
+
+SUITE = ecdsa_suite()
+
+
+def test_lagging_node_catches_up():
+    nodes, gw = make_chain(4)
+    # node 3 goes offline; chain advances 3 blocks without it
+    laggard = nodes[3]
+    gw.disconnect(laggard.node_id)
+    for height in (1, 2, 3):
+        leader = leader_of(nodes, height)
+        if leader is laggard:
+            continue
+        submit_txs(leader, 3, start=height * 10)
+        assert leader.sealer.seal_and_submit()
+    alive_height = nodes[0].block_number()
+    assert alive_height >= 2
+    assert laggard.block_number() == 0
+
+    # reconnect and sync
+    gw.connect(laggard.front)
+    nodes[0].block_sync.broadcast_status()
+    laggard.block_sync.maintain()
+    assert laggard.block_number() == alive_height
+    assert (
+        laggard.ledger.header_by_number(alive_height).state_root
+        == nodes[0].ledger.header_by_number(alive_height).state_root
+    )
+    # consensus state fast-forwarded
+    assert laggard.engine.committed_number == alive_height
+    # and the laggard can now participate in the next block
+    leader = leader_of(nodes, alive_height + 1)
+    submit_txs(leader, 2, start=500)
+    assert leader.sealer.seal_and_submit()
+    assert laggard.block_number() == alive_height + 1
+
+
+def test_sync_rejects_forged_blocks():
+    nodes, gw = make_chain(4)
+    leader = leader_of(nodes, 1)
+    submit_txs(leader, 2)
+    assert leader.sealer.seal_and_submit()
+
+    # a fifth node with the same genesis but outside the committee forges a block
+    outsider_kp = SUITE.signature_impl.generate_keypair(secret=66666)
+    # same genesis (same committee order) as make_chain built
+    committee = [ConsensusNode(n.node_id, weight=1) for n in nodes]
+    cfg = NodeConfig(genesis=GenesisConfig(consensus_nodes=committee))
+    outsider = Node(cfg, keypair=outsider_kp)
+    gw.connect(outsider.front)
+
+    blk = nodes[0].ledger.block_by_number(1, with_txs=True)
+    blk.header.signature_list = blk.header.signature_list[:1]  # below quorum
+    assert not outsider.block_sync._apply_block(blk)
+    assert outsider.block_number() == 0
+
+    # the genuine block applies cleanly
+    genuine = nodes[0].ledger.block_by_number(1, with_txs=True)
+    assert outsider.block_sync._apply_block(genuine)
+    assert outsider.block_number() == 1
+
+
+def test_tx_gossip_spreads_to_peers():
+    nodes, gw = make_chain(4)
+    leader = leader_of(nodes, 1)
+    submit_txs(leader, 4)
+    assert all(n.txpool.pending_count() == 0 for n in nodes if n is not leader)
+    leader.tx_sync.maintain()
+    for n in nodes:
+        assert n.txpool.pending_count() == 4
+    # gossip is idempotent
+    leader.tx_sync.maintain()
+    for n in nodes:
+        assert n.txpool.pending_count() == 4
+
+
+def test_fetch_missing_txs():
+    nodes, _ = make_chain(2)
+    holder, asker = nodes[0], nodes[1]
+    txs = submit_txs(holder, 3)
+    hashes = [t.hash(SUITE) for t in txs]
+    got = asker.tx_sync.fetch_missing(hashes, holder.node_id)
+    assert all(g is not None for g in got)
+    assert [g.hash(SUITE) for g in got] == hashes
