@@ -380,15 +380,27 @@ class PBFTEngine:
     def _send_view_change(self) -> None:
         prepared_proposal = b""
         prepared_view = -1
+        prepare_proof: list[bytes] = []
         number = self.committed_number + 1
         cache = self._caches.get(number)
-        if cache is not None and cache.prepared and cache.block is not None:
+        if (
+            cache is not None
+            and cache.prepared
+            and cache.block is not None
+            and cache.pre_prepare is not None
+        ):
             prepared_proposal = cache.block.encode()
-            prepared_view = cache.pre_prepare.view if cache.pre_prepare else -1
+            prepared_view = cache.pre_prepare.view
+            prepare_proof = [
+                m.encode()
+                for m in cache.prepares.values()
+                if m.proposal_hash == cache.pre_prepare.proposal_hash
+            ]
         payload = ViewChangePayload(
             committed_number=self.committed_number,
             prepared_view=prepared_view,
             prepared_proposal=prepared_proposal,
+            prepare_proof=prepare_proof,
         )
         msg = PBFTMessage(
             packet_type=PacketType.VIEW_CHANGE,
@@ -400,9 +412,11 @@ class PBFTEngine:
         self._broadcast(msg)
         self._handle_view_change(msg)
 
+    MAX_VIEW_AHEAD = 256  # waterline for view-change caches (like MAX_AHEAD)
+
     def _handle_view_change(self, msg: PBFTMessage) -> None:
         with self._lock:
-            if msg.view <= self.view:
+            if msg.view <= self.view or msg.view > self.view + self.MAX_VIEW_AHEAD:
                 return
             votes = self._view_changes.setdefault(msg.view, {})
             votes[msg.generated_from] = msg
@@ -467,31 +481,64 @@ class PBFTEngine:
             self._lock_view_to_prepared(msg.view, valid_vcs)
             self._enter_view(msg.view)
 
+    def _verified_prepared(
+        self, payload: ViewChangePayload
+    ) -> tuple[int, Block, bytes] | None:
+        """Validate a VC's prepared claim against its prepare-quorum
+        certificate. Returns (prepared_view, block, proposal_hash) only when
+        a weighted quorum of correctly-signed PREPAREs for exactly this
+        proposal backs the claim — an unproven assertion is worthless."""
+        if not payload.prepared_proposal:
+            return None
+        try:
+            block = Block.decode(payload.prepared_proposal)
+        except Exception:
+            return None
+        proposal_hash = block.header.hash(self.suite)
+        weight = 0
+        seen: set[int] = set()
+        for raw in payload.prepare_proof:
+            try:
+                pm = PBFTMessage.decode(raw)
+            except Exception:
+                continue
+            if (
+                pm.packet_type != PacketType.PREPARE
+                or pm.view != payload.prepared_view
+                or pm.number != block.header.number
+                or pm.proposal_hash != proposal_hash
+                or pm.generated_from in seen
+            ):
+                continue
+            node = self.config.node_at(pm.generated_from)
+            if node is None or not pm.verify(self.suite, node.node_id):
+                continue
+            seen.add(pm.generated_from)
+            weight += node.weight
+        if weight < self.config.quorum:
+            return None
+        return payload.prepared_view, block, proposal_hash
+
     def _lock_view_to_prepared(self, view: int, vcs: list[PBFTMessage]) -> None:
-        """Bind the new view to the highest prepared proposal in the VC
-        proofs: the new leader MUST re-propose it (a prepare quorum may mean
-        some node already committed it — proposing anything else forks)."""
-        best: ViewChangePayload | None = None
+        """Bind the new view to the highest *proven* prepared proposal in the
+        VC set: the new leader MUST re-propose it (a prepare quorum may mean
+        some node already committed it — proposing anything else forks).
+        Quorum intersection guarantees any valid 2f+1 VC set contains the
+        prepared proposal of any block that committed anywhere."""
+        best: tuple[int, Block, bytes] | None = None
         for m in vcs:
             try:
                 p = ViewChangePayload.decode(m.payload)
             except Exception:
                 continue
-            if p.prepared_proposal and (
-                best is None or p.prepared_view > best.prepared_view
-            ):
-                best = p
+            proven = self._verified_prepared(p)
+            if proven is not None and (best is None or proven[0] > best[0]):
+                best = proven
         if best is None:
             self._view_locks.pop(view, None)
             return
-        try:
-            block = Block.decode(best.prepared_proposal)
-        except Exception:
-            return
-        self._view_locks[view] = (
-            block.header.number,
-            block.header.hash(self.suite),
-        )
+        _view, block, proposal_hash = best
+        self._view_locks[view] = (block.header.number, proposal_hash)
 
     def _enter_view(self, view: int) -> None:
         self.view = view
@@ -507,23 +554,19 @@ class PBFTEngine:
                   self.config.leader_index(self.committed_number + 1, view))
 
     def _repropose_from(self, votes: dict[int, PBFTMessage]) -> None:
-        """New leader re-proposes the highest prepared proposal, if any."""
-        best: ViewChangePayload | None = None
+        """New leader re-proposes the highest *proven* prepared proposal."""
+        best: tuple[int, Block, bytes] | None = None
         for m in votes.values():
             try:
                 p = ViewChangePayload.decode(m.payload)
             except Exception:
                 continue
-            if p.prepared_proposal and (
-                best is None or p.prepared_view > best.prepared_view
-            ):
-                best = p
+            proven = self._verified_prepared(p)
+            if proven is not None and (best is None or proven[0] > best[0]):
+                best = proven
         if best is None:
             return
-        try:
-            block = Block.decode(best.prepared_proposal)
-        except Exception:
-            return
+        block = best[1]
         if block.header.number != self.committed_number + 1:
             return
         self.submit_proposal(block)

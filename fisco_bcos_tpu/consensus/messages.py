@@ -94,23 +94,28 @@ class PBFTMessage:
 @dataclass
 class ViewChangePayload:
     """Proof carried by ViewChange: the latest committed number plus any
-    prepared-but-uncommitted proposal (PBFTViewChangeMsg analog)."""
+    prepared-but-uncommitted proposal WITH its prepare-quorum certificate
+    (PBFTViewChangeMsg analog). The certificate is what makes the claim
+    trustworthy — an unproven "prepared" assertion from one replica must
+    never influence the new view's proposal choice."""
 
     committed_number: int = 0
     prepared_view: int = -1
     prepared_proposal: bytes = b""  # encoded Block, or empty
+    prepare_proof: list[bytes] = field(default_factory=list)  # encoded PREPAREs
 
     def encode(self) -> bytes:
         w = FlatWriter()
         w.i64(self.committed_number)
         w.i64(self.prepared_view)
         w.bytes_(self.prepared_proposal)
+        w.seq(self.prepare_proof, lambda w2, b: w2.bytes_(b))
         return w.out()
 
     @classmethod
     def decode(cls, buf: bytes) -> "ViewChangePayload":
         r = FlatReader(buf)
-        p = cls(r.i64(), r.i64(), r.bytes_())
+        p = cls(r.i64(), r.i64(), r.bytes_(), r.seq(lambda r2: r2.bytes_()))
         r.done()
         return p
 

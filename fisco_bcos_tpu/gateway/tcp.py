@@ -144,11 +144,13 @@ class TcpGateway(GatewayInterface):
             self._drop(peer)
 
     def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+        # one frame for everyone: receivers never read dst, and compressing
+        # the payload once beats once-per-peer
+        frame = self._frame_for(module_id, b"\x00" * 64, payload)
         with self._lock:
             peers = list(self._peers.values())
         for peer in peers:
-            dst = peer.node_id or b"\x00" * 64
-            if not peer.send(self._frame_for(module_id, dst, payload)):
+            if not peer.send(frame):
                 self._drop(peer)
 
     # -- internals -----------------------------------------------------------
@@ -203,7 +205,13 @@ class TcpGateway(GatewayInterface):
                 continue
             if flags & _FLAG_COMPRESSED:
                 try:
-                    payload = zlib.decompress(payload)
+                    # cap the inflated size: a decompression bomb from a peer
+                    # must not exhaust memory
+                    d = zlib.decompressobj()
+                    payload = d.decompress(payload, _MAX_FRAME)
+                    if d.unconsumed_tail:
+                        _log.warning("oversized frame from %s dropped", src.hex()[:8])
+                        continue
                 except zlib.error:
                     _log.warning("corrupt compressed frame from %s", src.hex()[:8])
                     continue

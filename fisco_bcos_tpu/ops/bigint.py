@@ -156,38 +156,51 @@ def make_modulus(m: int) -> Modulus:
 # ---------------------------------------------------------------------------
 
 
-# Scan bodies live at module level so eager-mode calls hit jax's trace cache
-# (a closure defined per call would force a fresh lowering every invocation).
+# Carries are a carry-lookahead problem, not a sequential one: a 32-step
+# lax.scan per normalization made every mont_mul ~130 sequential device steps
+# (the throughput ceiling of the whole EC plane). Instead: one split pass
+# reduces arbitrary column sums to "limbs + {0,1} increments", and the
+# remaining binary carry chain is Kogge-Stone — generate/propagate pairs
+# combined with lax.associative_scan in log2(L) depth.
 
 
-def _carry_step(carry, col):
-    tot = col + carry
-    return tot >> 16, tot & _MASK
+def _gp_combine(x, y):
+    """(generate, propagate) composition — associative."""
+    gx, px = x
+    gy, py = y
+    return gy | (py & gx), py & px
 
 
-def _borrow_step(borrow, ab):
-    ai, bi = ab
-    t = ai + jnp.uint32(0x10000) - bi - borrow
-    return jnp.uint32(1) - (t >> 16), t & _MASK
+def _ks_carry_in(g: jax.Array, p: jax.Array) -> jax.Array:
+    """Carry INTO each position given per-position generate/propagate."""
+    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=-1)
+    return jnp.concatenate([jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1)
+
+
+def _shift_up(x: jax.Array) -> jax.Array:
+    """[..., L] -> [..., L] shifted one limb toward the high end."""
+    return jnp.concatenate([jnp.zeros_like(x[..., :1]), x[..., :-1]], axis=-1)
 
 
 def _carry_normalize(cols: jax.Array) -> jax.Array:
     """Propagate carries: [..., L] uint32 column sums (< 2^27) -> [..., L+1]
     normalized 16-bit limbs (the extra limb is the final carry-out)."""
-    x = jnp.moveaxis(cols, -1, 0)
-    carry, limbs = lax.scan(_carry_step, jnp.zeros(x.shape[1:], jnp.uint32), x)
-    limbs = jnp.moveaxis(limbs, 0, -1)
-    return jnp.concatenate([limbs, (carry & _MASK)[..., None]], axis=-1)
+    cols = jnp.concatenate([cols, jnp.zeros_like(cols[..., :1])], axis=-1)
+    s = (cols & _MASK) + _shift_up(cols >> 16)  # < 2^16 + 2^11 < 2^17
+    t = (s & _MASK) + _shift_up(s >> 16)  # ≤ 2^16 (increments are {0,1})
+    g = t > _MASK
+    p = t == _MASK
+    return (t + _ks_carry_in(g, p).astype(jnp.uint32)) & _MASK
 
 
 def _sub_with_borrow(a: jax.Array, b: jax.Array):
     """(a - b) limbwise -> (diff [..., L] normalized, borrow_out [...] in {0,1})."""
-    x = jnp.moveaxis(a, -1, 0)
-    y = jnp.moveaxis(b, -1, 0)
-    borrow, limbs = lax.scan(
-        _borrow_step, jnp.zeros(x.shape[1:], jnp.uint32), (x, y)
-    )
-    return jnp.moveaxis(limbs, 0, -1), borrow
+    g = a < b  # borrow generated regardless of incoming borrow
+    p = a == b  # incoming borrow propagates
+    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=-1)
+    bin_ = jnp.concatenate([jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1)
+    diff = (a + jnp.uint32(0x10000) - b - bin_.astype(jnp.uint32)) & _MASK
+    return diff, G[..., -1].astype(jnp.uint32)
 
 
 def _add_raw(a: jax.Array, b: jax.Array) -> jax.Array:

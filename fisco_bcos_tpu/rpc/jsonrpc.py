@@ -286,7 +286,7 @@ class JsonRpcImpl:
             "nodeID": to_hex(self.node.node_id, prefix=False),
             "isSyncing": False,
             "knownHighestNumber": max(
-                [num] + [st.number for st in self.node.block_sync._peers.values()]
+                [num] + [st.number for st in self.node.block_sync.peer_statuses()]
             ),
         }
 
@@ -320,12 +320,13 @@ class JsonRpcImpl:
     # -- group/peer methods (single-group node; gateway fills peers) ---------
 
     def get_peers(self, group: str = "", node_name: str = "") -> dict:
-        peers = list(getattr(self.node.front, "_gateway_peers", []) or [])
-        sync_peers = [to_hex(p, prefix=False) for p in self.node.block_sync._peers]
-        return {"peers": peers or sync_peers}
+        gw = self.node.front._gateway
+        gw_peers = gw.peers() if gw is not None and hasattr(gw, "peers") else []
+        peers = gw_peers or self.node.block_sync.peer_ids()
+        return {"peers": [to_hex(p, prefix=False) for p in peers]}
 
     def get_group_peers(self, group: str = "", node_name: str = "") -> list:
-        return [to_hex(p, prefix=False) for p in self.node.block_sync._peers]
+        return [to_hex(p, prefix=False) for p in self.node.block_sync.peer_ids()]
 
     def get_group_list(self) -> dict:
         return {"groupList": [self.node.config.group_id]}

@@ -85,9 +85,19 @@ class BlockSync:
         self.validator = validator or BlockValidator(self.suite)
         self._peers: dict[bytes, SyncStatus] = {}
         self._requested_to: int = 0
+        self._requested_at: float = 0.0
+        self.request_timeout: float = 10.0
         self._lock = threading.RLock()
         self._genesis_hash = ledger.block_hash_by_number(0) or b"\x00" * 32
         front.register_module(ModuleID.BLOCK_SYNC, self._on_message)
+
+    def peer_ids(self) -> list[bytes]:
+        with self._lock:
+            return list(self._peers)
+
+    def peer_statuses(self) -> list[SyncStatus]:
+        with self._lock:
+            return list(self._peers.values())
 
     # -- outbound ------------------------------------------------------------
 
@@ -107,6 +117,8 @@ class BlockSync:
         self._request_missing()
 
     def _request_missing(self) -> None:
+        import time as _time
+
         my_number = self.ledger.block_number()
         with self._lock:
             best = None
@@ -119,10 +131,14 @@ class BlockSync:
                 return
             nid, st = best
             start = my_number + 1
+            now = _time.monotonic()
             if self._requested_to >= start:
-                return  # outstanding request covers it
+                # an unanswered request must not stall sync forever: decay it
+                if now - self._requested_at < self.request_timeout:
+                    return
             count = min(st.number - my_number, MAX_BLOCKS_PER_REQUEST)
             self._requested_to = start + count - 1
+            self._requested_at = now
         _log.info("requesting blocks [%d, %d) from %s", start, start + count, nid.hex()[:8])
         self.front.send_message(ModuleID.BLOCK_SYNC, nid, _encode_request(start, count))
 

@@ -41,13 +41,15 @@ def _encode_request(hashes: list[bytes]) -> bytes:
 
 
 class TransactionSync:
-    def __init__(self, txpool: TxPool, front: FrontService):
+    def __init__(self, txpool: TxPool, front: FrontService, fetch_timeout: float = 3.0):
         self.txpool = txpool
         self.front = front
         self.suite = txpool.suite
+        self.fetch_timeout = fetch_timeout
         self._broadcasted: set[bytes] = set()
         self._responses: dict[bytes, Transaction] = {}
         self._lock = threading.RLock()
+        self._response_cv = threading.Condition(self._lock)
         front.register_module(ModuleID.TXS_SYNC, self._on_message)
 
     # -- gossip (maintainTransactions:78) ------------------------------------
@@ -76,14 +78,26 @@ class TransactionSync:
 
     def fetch_missing(self, hashes: list[bytes], from_node: bytes) -> list[Transaction | None]:
         """Synchronously request missing txs from a peer (the proposal-verify
-        fetch hook). Returns them in request order; relies on the transport
-        delivering the response before this returns (in-process gateway) or
-        on retry at the next verify attempt."""
-        with self._lock:
-            self._responses.clear()
+        fetch hook). Responses arrive on transport threads; block until every
+        requested hash is answered or `fetch_timeout` passes. The response
+        cache is append-only during the wait, so concurrent fetches can
+        coexist (each waits for its own hash set)."""
+        wanted = set(hashes)
         self.front.send_message(ModuleID.TXS_SYNC, from_node, _encode_request(hashes))
-        with self._lock:
-            return [self._responses.get(h) for h in hashes]
+        import time as _time
+
+        deadline = _time.monotonic() + self.fetch_timeout
+        with self._response_cv:
+            while not wanted.issubset(self._responses):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                self._response_cv.wait(remaining)
+            out = [self._responses.get(h) for h in hashes]
+            # prune answered entries once consumed (bounded cache)
+            for h in hashes:
+                self._responses.pop(h, None)
+            return out
 
     # -- inbound -------------------------------------------------------------
 
@@ -124,10 +138,11 @@ class TransactionSync:
         )
 
     def _on_response(self, raw: list[bytes]) -> None:
-        with self._lock:
+        with self._response_cv:
             for b in raw:
                 try:
                     tx = Transaction.decode(b)
                 except Exception:
                     continue
                 self._responses[tx.hash(self.suite)] = tx
+            self._response_cv.notify_all()

@@ -85,6 +85,37 @@ def test_waterline_bounds_vote_caches():
     assert 5 in victim.engine._caches
 
 
+def test_forged_prepared_claim_rejected():
+    # A VC claiming a prepared proposal WITHOUT a prepare-quorum certificate
+    # must not influence the new view's lock or re-proposal.
+    nodes, _ = make_chain(4)
+    from fisco_bcos_tpu.consensus.messages import ViewChangePayload
+    from fisco_bcos_tpu.protocol.block import Block
+    from fisco_bcos_tpu.protocol.block_header import BlockHeader
+
+    engine = nodes[0].engine
+    forged_block = Block(header=BlockHeader(number=1, timestamp=666))
+    payload = ViewChangePayload(
+        committed_number=0,
+        prepared_view=999,  # inflated claim
+        prepared_proposal=forged_block.encode(),
+        prepare_proof=[],  # no certificate
+    )
+    assert engine._verified_prepared(payload) is None
+
+    # even with self-signed bogus "prepares" below quorum it stays rejected
+    byz = nodes[1]
+    h = forged_block.header.hash(SUITE)
+    pm = PBFTMessage(
+        packet_type=PacketType.PREPARE, view=999, number=1, proposal_hash=h
+    )
+    pm.generated_from = byz.pbft_config.my_index
+    pm.sign(SUITE, byz.keypair)
+    pm.generated_from = byz.pbft_config.my_index
+    payload.prepare_proof = [pm.encode()]
+    assert engine._verified_prepared(payload) is None
+
+
 def test_abi_rejects_huge_array_length():
     # array length word of 2^40 with no backing data must raise, not allocate
     data = (32).to_bytes(32, "big") + (2**40).to_bytes(32, "big")
