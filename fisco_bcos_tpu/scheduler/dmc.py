@@ -1,0 +1,235 @@
+"""DMC — Deterministic Multi-Contract scheduling across executor shards.
+
+Reference: bcos-scheduler/src/BlockExecutive.cpp DMCExecute:832-996 (round
+loop: per-contract DmcExecutor::go under tbb, join batch status, paused ⇒
+next round), DmcExecutor.cpp (per-(executor, contract) message pools, status
+ERROR/NEED_PREPARE/PAUSED/FINISHED, cross-contract calls migrating messages
+via schedulerOut), DmcStepRecorder.h:15-60 (per-round checksums of every
+message sent/received — the cross-executor nondeterminism detector).
+
+This is the "state sharded by contract address across executors" axis of the
+reference's parallelism inventory (SURVEY.md §2.8). Executors are
+ExecutorShard objects (in-process here; the interface is what a remote
+executor service implements). Each round: every shard executes its pending
+txs against its own state view; cross-contract calls pause the tx and
+migrate a message to the target contract's shard; the scheduler joins round
+results, detects deadlocks on key locks, and loops until all finish.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from ..protocol.receipt import TransactionReceipt, TransactionStatus
+from ..protocol.transaction import Transaction
+from ..utils.log import get_logger
+from .key_locks import GraphKeyLocks
+
+_log = get_logger("dmc")
+
+
+class MsgType(IntEnum):
+    TXHASH = 0
+    MESSAGE = 1  # call request
+    FINISHED = 2
+    REVERT = 3
+
+
+@dataclass
+class ExecutionMessage:
+    """Scheduler <-> executor unit (bcos-framework ExecutionMessage analog)."""
+
+    type: MsgType = MsgType.MESSAGE
+    context_id: int = 0  # tx index in the block
+    seq: int = 0
+    from_addr: bytes = b""
+    to_addr: bytes = b""
+    sender: bytes = b""  # tx origin
+    data: bytes = b""
+    static_call: bool = False
+    status: int = 0
+    gas_used: int = 0
+    logs: list = field(default_factory=list)
+    key_locks: list = field(default_factory=list)
+
+
+class DmcStepRecorder:
+    """Running checksums of messages per DMC round (DmcStepRecorder.h).
+    Divergent checksums across executors/replicas expose nondeterminism."""
+
+    def __init__(self) -> None:
+        self.round = 0
+        self._send = hashlib.sha256()
+        self._recv = hashlib.sha256()
+        self.history: list[tuple[int, str, str]] = []
+
+    @staticmethod
+    def _digest_msg(m: ExecutionMessage) -> bytes:
+        return b"|".join(
+            [
+                bytes([m.type]),
+                m.context_id.to_bytes(8, "little"),
+                m.seq.to_bytes(8, "little"),
+                m.from_addr,
+                m.to_addr,
+                m.data,
+                m.status.to_bytes(4, "little", signed=True),
+            ]
+        )
+
+    def record_send(self, msgs: list[ExecutionMessage]) -> None:
+        for m in msgs:
+            self._send.update(self._digest_msg(m))
+
+    def record_recv(self, msgs: list[ExecutionMessage]) -> None:
+        for m in msgs:
+            self._recv.update(self._digest_msg(m))
+
+    def next_round(self) -> tuple[str, str]:
+        send, recv = self._send.hexdigest()[:16], self._recv.hexdigest()[:16]
+        self.history.append((self.round, send, recv))
+        _log.debug("DMC round %d checksums send=%s recv=%s", self.round, send, recv)
+        self.round += 1
+        return send, recv
+
+
+class ExecutorShard:
+    """One executor's per-contract execution of DMC messages.
+
+    In-process implementation of the remote-executor contract
+    (ParallelTransactionExecutorInterface::dmcExecuteTransactions). Executes
+    against the block storage through the shared precompile registry; a
+    cross-contract call returns a PAUSED message for migration instead of
+    executing inline.
+    """
+
+    def __init__(self, executor, name: str = "executor0"):
+        self.executor = executor  # TransactionExecutor (owns block storage)
+        self.name = name
+
+    def execute(
+        self, contract: bytes, msgs: list[ExecutionMessage]
+    ) -> list[ExecutionMessage]:
+        out: list[ExecutionMessage] = []
+        block = self.executor._block
+        assert block is not None, "next_block_header first"
+        for m in msgs:
+            tx = Transaction(to=m.to_addr, input=m.data)
+            tx.force_sender(m.sender)
+            rc = self.executor._execute_one(tx, block)
+            out.append(
+                ExecutionMessage(
+                    type=MsgType.FINISHED if rc.status == 0 else MsgType.REVERT,
+                    context_id=m.context_id,
+                    seq=m.seq,
+                    from_addr=m.to_addr,
+                    to_addr=m.from_addr,
+                    sender=m.sender,
+                    data=rc.output,
+                    status=rc.status,
+                    gas_used=rc.gas_used,
+                    logs=rc.log_entries,
+                )
+            )
+        return out
+
+
+class DmcExecutor:
+    """Per-contract message pool + round driver (DmcExecutor.cpp)."""
+
+    def __init__(self, contract: bytes, shard: ExecutorShard):
+        self.contract = contract
+        self.shard = shard
+        self.pool: list[ExecutionMessage] = []
+
+    def schedule_in(self, msg: ExecutionMessage) -> None:
+        self.pool.append(msg)
+
+    def go(self, recorder: DmcStepRecorder) -> list[ExecutionMessage]:
+        """Execute everything pending for this contract; returns results
+        (FINISHED/REVERT) and migrated messages."""
+        msgs, self.pool = self.pool, []
+        if not msgs:
+            return []
+        msgs.sort(key=lambda m: (m.context_id, m.seq))  # determinism
+        recorder.record_send(msgs)
+        results = self.shard.execute(self.contract, msgs)
+        recorder.record_recv(results)
+        return results
+
+
+class DMCScheduler:
+    """Round loop over per-contract DmcExecutors (BlockExecutive::DMCExecute).
+
+    `shard_of(contract)` maps contracts to ExecutorShards — the Air form has
+    one shard; Pro/Max register several (TarsRemoteExecutorManager analog is
+    the ExecutorManager in scheduler/executor_manager.py).
+    """
+
+    def __init__(self, shard_of, max_rounds: int = 1000):
+        self.shard_of = shard_of
+        self.max_rounds = max_rounds
+        self.recorder = DmcStepRecorder()
+        self.key_locks = GraphKeyLocks()
+
+    def execute(self, txs: list[Transaction]) -> list[TransactionReceipt]:
+        dmc: dict[bytes, DmcExecutor] = {}
+
+        def executor_for(contract: bytes) -> DmcExecutor:
+            if contract not in dmc:
+                dmc[contract] = DmcExecutor(contract, self.shard_of(contract))
+            return dmc[contract]
+
+        receipts: list[TransactionReceipt | None] = [None] * len(txs)
+        for i, tx in enumerate(txs):
+            executor_for(tx.to).schedule_in(
+                ExecutionMessage(
+                    type=MsgType.MESSAGE,
+                    context_id=i,
+                    from_addr=b"",
+                    to_addr=tx.to,
+                    sender=tx.sender,
+                    data=tx.input,
+                )
+            )
+
+        for _ in range(self.max_rounds):
+            pending = [d for d in dmc.values() if d.pool]
+            if not pending:
+                break
+            # deterministic shard order (the reference joins a parallel_for;
+            # ordering of *results* is fixed by (context_id, seq))
+            for d in sorted(pending, key=lambda d: d.contract):
+                for res in d.go(self.recorder):
+                    if res.type in (MsgType.FINISHED, MsgType.REVERT):
+                        if res.to_addr == b"":  # top-level completion
+                            rc = TransactionReceipt(
+                                status=res.status,
+                                output=res.data,
+                                gas_used=res.gas_used,
+                            )
+                            rc.log_entries = res.logs
+                            receipts[res.context_id] = rc
+                        else:  # response migrates back to the calling contract
+                            executor_for(res.to_addr).schedule_in(res)
+                    else:  # outbound call migrates to the target contract
+                        executor_for(res.to_addr).schedule_in(res)
+            victims = self.key_locks.detect_deadlock()
+            if victims:
+                victim = victims[0]
+                _log.warning("deadlock: reverting context %s", victim)
+                self.key_locks.release_all(victim)
+                receipts[victim] = TransactionReceipt(
+                    status=int(TransactionStatus.REVERT_INSTRUCTION),
+                    output=b"deadlock victim",
+                )
+            self.recorder.next_round()
+        missing = [i for i, rc in enumerate(receipts) if rc is None]
+        for i in missing:
+            receipts[i] = TransactionReceipt(
+                status=int(TransactionStatus.INTERNAL_ERROR),
+                output=b"unfinished after max DMC rounds",
+            )
+        return receipts  # type: ignore[return-value]

@@ -1,0 +1,47 @@
+"""Executor shard registry + contract dispatch.
+
+Reference: bcos-scheduler/src/ExecutorManager.h:29-37 (addExecutor /
+dispatchExecutor(contract) — contracts hash-partitioned across registered
+executors) and TarsRemoteExecutorManager.cpp (remote discovery + heartbeat;
+here: liveness flags toggled by the caller, and dispatch skips dead shards —
+the SchedulerManager term-switch analog)."""
+
+from __future__ import annotations
+
+from ..utils.log import get_logger
+from .dmc import ExecutorShard
+
+_log = get_logger("executor-manager")
+
+
+class ExecutorManager:
+    def __init__(self) -> None:
+        self._shards: list[ExecutorShard] = []
+        self._alive: dict[str, bool] = {}
+
+    def add_executor(self, shard: ExecutorShard) -> None:
+        if any(s.name == shard.name for s in self._shards):
+            raise ValueError(f"executor exists: {shard.name}")
+        self._shards.append(shard)
+        self._alive[shard.name] = True
+        _log.info("executor %s registered (%d total)", shard.name, len(self._shards))
+
+    def remove_executor(self, name: str) -> None:
+        self._shards = [s for s in self._shards if s.name != name]
+        self._alive.pop(name, None)
+
+    def set_alive(self, name: str, alive: bool) -> None:
+        if name in self._alive:
+            self._alive[name] = alive
+
+    @property
+    def size(self) -> int:
+        return len(self._shards)
+
+    def dispatch(self, contract: bytes) -> ExecutorShard:
+        """Stable contract -> shard mapping over the live shard set."""
+        live = [s for s in self._shards if self._alive.get(s.name)]
+        if not live:
+            raise RuntimeError("no live executors")
+        idx = int.from_bytes(contract[-4:] or b"\x00", "big") % len(live)
+        return live[idx]

@@ -1,0 +1,87 @@
+"""Key-lock wait-for graph with deadlock detection.
+
+Reference: bcos-scheduler/src/GraphKeyLocks.{h,cpp} (boost::graph adjacency
+list; acquireKeyLock / detectDeadLock — DFS cycle detection picks a victim tx
+to revert). Here: plain adjacency sets + iterative DFS; same contract.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..utils.log import get_logger
+
+_log = get_logger("key-locks")
+
+
+class GraphKeyLocks:
+    """Tracks which execution context holds/waits for which (contract, key)
+    lock. Contexts are opaque hashables (the DMC scheduler uses
+    (contract, context_id))."""
+
+    def __init__(self) -> None:
+        self._holders: dict[tuple, set] = defaultdict(set)  # key -> contexts
+        self._held: dict = defaultdict(set)  # context -> keys
+        self._waiting: dict = {}  # context -> key it blocks on
+
+    def acquire(self, ctx, key: tuple) -> bool:
+        """Try to take `key` for `ctx`. Multiple readers of the same contract
+        round share keys only when no other context holds it (the reference
+        grants shared acquisition to the same contract context only)."""
+        holders = self._holders[key]
+        if not holders or holders == {ctx}:
+            holders.add(ctx)
+            self._held[ctx].add(key)
+            self._waiting.pop(ctx, None)
+            return True
+        self._waiting[ctx] = key
+        return False
+
+    def release_all(self, ctx) -> None:
+        for key in self._held.pop(ctx, set()):
+            holders = self._holders.get(key)
+            if holders:
+                holders.discard(ctx)
+                if not holders:
+                    del self._holders[key]
+        self._waiting.pop(ctx, None)
+
+    def _edges(self, ctx):
+        """Wait-for edges: ctx -> every holder of the key ctx waits on."""
+        key = self._waiting.get(ctx)
+        if key is None:
+            return
+        for holder in self._holders.get(key, ()):
+            if holder != ctx:
+                yield holder
+
+    def detect_deadlock(self) -> list:
+        """Find one wait-for cycle; returns the contexts on it (the caller
+        reverts one as victim — the reference picks via DFS order too)."""
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color: dict = defaultdict(int)
+        for start in list(self._waiting):
+            if color[start] != WHITE:
+                continue
+            stack = [(start, iter(list(self._edges(start))))]
+            color[start] = GRAY
+            path = [start]
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if color[nxt] == GRAY:
+                        # cycle: slice the current path from nxt
+                        i = path.index(nxt)
+                        return path[i:]
+                    if color[nxt] == WHITE:
+                        color[nxt] = GRAY
+                        path.append(nxt)
+                        stack.append((nxt, iter(list(self._edges(nxt)))))
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = BLACK
+                    path.pop()
+                    stack.pop()
+        return []
