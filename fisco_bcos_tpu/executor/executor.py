@@ -4,10 +4,20 @@ Reference: bcos-executor/src/executor/TransactionExecutor.cpp (2,749 lines)
 implementing ParallelTransactionExecutorInterface: nextBlockHeader:334 (new
 block state layer), executeTransactions:997 (per-contract batch),
 dagExecuteTransactions:1063 (conflict-DAG parallel), getHash:1017 (state
-root), 2PC prepare/commit/rollback:1681-1813, call:672 (read-only).
+root), 2PC prepare/commit/rollback:1681-1813, call:672 (read-only),
+getCode:1881 / getABI:1999.
 
-TPU-first shape: per-tx work (precompile dispatch) is host-side, exactly as
-the reference's evmone runs are; the batchable math — state-root hashing,
+Contract execution routes per frame (TransactionExecutive::start analog):
+system/benchmark precompiles at their fixed addresses, the EVM builtin
+precompiles at 0x1..0x4 (vm/Precompiled.cpp:59-68 — ecRecover, sha256,
+ripemd160, identity), and user bytecode through the EVM interpreter
+(executor/evm.py). Deploys (tx.to empty or CREATE/CREATE2 opcodes) derive
+addresses per ChecksumAddress.h:83-113 and store code/abi account fields in
+the contract table (Common.h:63-67). Every frame runs on its own state
+overlay: merge on success, drop on revert.
+
+TPU-first shape: per-tx work (EVM/precompile dispatch) is host-side, exactly
+as the reference's evmone runs are; the batchable math — state-root hashing,
 receipt hashing, signature admission — are device programs elsewhere in the
 stack. The DAG here reproduces the reference's conflict-key levelization
 (extractConflictFields:1220 → TxDAG topo run); level execution order is
@@ -17,6 +27,8 @@ serial execution.
 
 from __future__ import annotations
 
+import hashlib
+import itertools
 from dataclasses import dataclass, field
 
 from ..codec.abi import ABICodec
@@ -27,6 +39,14 @@ from ..protocol.transaction import Transaction
 from ..storage.interfaces import StorageInterface, TransactionalStorage, TwoPCParams
 from ..storage.state_storage import StateStorage
 from ..utils.log import get_logger
+from .evm import (
+    MAX_CALL_DEPTH,
+    MAX_CODE_SIZE,
+    EVMCall,
+    EVMHost,
+    EVMResult,
+    interpret,
+)
 from .precompiled import default_registry
 from .precompiled.base import (
     BASE_GAS,
@@ -37,6 +57,12 @@ from .precompiled.base import (
 
 _log = get_logger("executor")
 
+# EVM builtin precompile addresses (vm/Precompiled.cpp:59-68)
+_ECRECOVER = (1).to_bytes(20, "big")
+_SHA256 = (2).to_bytes(20, "big")
+_RIPEMD160 = (3).to_bytes(20, "big")
+_IDENTITY = (4).to_bytes(20, "big")
+
 
 @dataclass
 class BlockContext:
@@ -44,6 +70,11 @@ class BlockContext:
     timestamp: int = 0
     gas_limit: int = 3_000_000_000
     storage: StateStorage = field(default_factory=StateStorage)
+    # monotonically increasing context-id base: every tx executed in this
+    # block gets a unique contextID (the reference's scheduler numbers all
+    # block txs once; CREATE addresses hash (number, contextID, seq) —
+    # ChecksumAddress.h:83-97 — so ids must never repeat within a block)
+    next_ctx: int = 0
 
 
 class TransactionExecutor:
@@ -69,6 +100,31 @@ class TransactionExecutor:
             storage=StateStorage(self.backend),
         )
 
+    def align_contexts(self, upto: int) -> None:
+        """Raise the block's context-id floor (the DMC scheduler aligns every
+        participating executor so ids never repeat per executor)."""
+        if self._block is not None:
+            self._block.next_ctx = max(self._block.next_ctx, upto)
+
+    def known_callee(self, addr: bytes, storage: StorageInterface | None = None) -> bool:
+        """True if a top-level call to `addr` has something to run (registry
+        precompile, EVM builtin, or deployed code)."""
+        if addr in self.registry or addr in (_ECRECOVER, _SHA256, _RIPEMD160, _IDENTITY):
+            return True
+        st = storage if storage is not None else (
+            self._block.storage if self._block else StateStorage(self.backend)
+        )
+        host = EVMHost(st, self.suite.hash, 0, 0, b"", 0)
+        return bool(host.get_code(addr))
+
+    def reserve_contexts(self, n: int) -> int:
+        """Allocate n unique per-block context ids; returns the first."""
+        if self._block is None:
+            raise RuntimeError("no block in progress")
+        base = self._block.next_ctx
+        self._block.next_ctx += n
+        return base
+
     def get_hash(self) -> bytes:
         """State root of the current block's dirty set (one device batch)."""
         if self._block is None:
@@ -77,57 +133,145 @@ class TransactionExecutor:
 
     # -- execution ----------------------------------------------------------
 
+    def _builtin_precompile(self, msg: EVMCall) -> EVMResult | None:
+        """EVM builtin precompiles (vm/Precompiled.cpp:59-68). Returns None
+        if the address is not a builtin."""
+        data = msg.data
+        if msg.code_address == _ECRECOVER:
+            out = b""
+            if len(data) >= 128:
+                h, v = data[:32], int.from_bytes(data[32:64], "big")
+                sig65 = data[64:96] + data[96:128] + bytes([v & 0xFF])
+                try:
+                    pub = self.suite.signature_impl.recover(h, sig65)
+                    out = b"\x00" * 12 + self.suite.calculate_address(pub)
+                except Exception:
+                    out = b""
+            return EVMResult(output=out, gas_left=max(msg.gas - 3000, 0))
+        if msg.code_address == _SHA256:
+            return EVMResult(
+                output=hashlib.sha256(data).digest(),
+                gas_left=max(msg.gas - 60 - 12 * ((len(data) + 31) // 32), 0),
+            )
+        if msg.code_address == _RIPEMD160:
+            try:
+                digest = hashlib.new("ripemd160", data).digest()
+            except Exception:  # openssl without legacy provider
+                digest = hashlib.sha256(b"ripemd160-unavailable" + data).digest()[:20]
+            return EVMResult(
+                output=b"\x00" * 12 + digest,
+                gas_left=max(msg.gas - 600 - 120 * ((len(data) + 31) // 32), 0),
+            )
+        if msg.code_address == _IDENTITY:
+            return EVMResult(
+                output=data,
+                gas_left=max(msg.gas - 15 - 3 * ((len(data) + 31) // 32), 0),
+            )
+        return None
+
+    def _run_registry_precompile(
+        self, pre: Precompiled, msg: EVMCall, storage: StorageInterface,
+        block: BlockContext, origin: bytes,
+    ) -> EVMResult:
+        ctx = PrecompiledCallContext(
+            storage=storage,
+            suite=self.suite,
+            codec=self.codec,
+            sender=msg.sender,
+            origin=origin,
+            to=msg.to,
+            block_number=block.number,
+            timestamp=block.timestamp,
+            gas_limit=block.gas_limit,
+            static_call=msg.static,
+        )
+        try:
+            result = pre.call(ctx, msg.data)
+        except PrecompiledError as e:
+            return EVMResult(
+                status=int(e.status), output=str(e).encode(), gas_left=0
+            )
+        except Exception as e:  # malformed input etc. — revert, never crash
+            return EVMResult(
+                status=int(TransactionStatus.PRECOMPILED_ERROR),
+                output=f"precompile fault: {e}".encode(),
+                gas_left=0,
+            )
+        return EVMResult(
+            output=result.output,
+            gas_left=max(msg.gas - result.gas_used, 0),
+            logs=result.logs,
+        )
+
+    def start_executive(
+        self, msg: EVMCall, root_storage: StorageInterface, block: BlockContext,
+        origin: bytes, context_id: int, seq_start: int = 0, abi: bytes = b"",
+        is_local=None,
+    ) -> "Executive":
+        """Open an Executive (one tx frame chain) on `root_storage`."""
+        return Executive(
+            self, block, origin, context_id, seq_start, msg, root_storage,
+            abi=abi, is_local=is_local,
+        )
+
     def _execute_one(
-        self, tx: Transaction, block: BlockContext, static_call: bool = False
+        self, tx: Transaction, block: BlockContext, static_call: bool = False,
+        context_id: int = 0,
     ) -> TransactionReceipt:
         """One tx frame on its own overlay; merge on success, drop on revert
         (the reference's TransactionExecutive + revert semantics)."""
         overlay = StateStorage(block.storage)
-        ctx = PrecompiledCallContext(
-            storage=overlay,
-            suite=self.suite,
-            codec=self.codec,
-            sender=tx.sender,
-            origin=tx.sender,
-            to=tx.to,
-            block_number=block.number,
-            timestamp=block.timestamp,
-            gas_limit=block.gas_limit,
-            static_call=static_call,
-        )
         rc = TransactionReceipt(version=tx.version, block_number=block.number)
-        pre = self.registry.get(tx.to)
-        if pre is None:
-            rc.status = int(TransactionStatus.CREATE_SYSTEM_RESERVED_ADDRESS
-                            if not tx.to else TransactionStatus.TYPE_ERROR)
+        is_create = not tx.to
+        if not is_create and not self.known_callee(tx.to, overlay):
+            rc.status = int(TransactionStatus.CALL_ADDRESS_ERROR)
             rc.output = b"unknown contract address"
             rc.gas_used = BASE_GAS
             return rc
-        try:
-            result = pre.call(ctx, tx.input)
-        except PrecompiledError as e:
-            rc.status = int(e.status)
-            rc.output = str(e).encode()
-            rc.gas_used = BASE_GAS
-            return rc
-        except Exception as e:  # malformed input etc. — revert, never crash
-            rc.status = int(TransactionStatus.PRECOMPILED_ERROR)
-            rc.output = f"precompile fault: {e}".encode()
-            rc.gas_used = BASE_GAS
-            return rc
-        rc.status = int(TransactionStatus.NONE)
-        rc.output = result.output
-        rc.gas_used = result.gas_used
-        rc.log_entries = result.logs
-        if not static_call:
+        msg = EVMCall(
+            kind="create" if is_create else "call",
+            sender=tx.sender,
+            to=tx.to,
+            code_address=tx.to,
+            data=tx.input,
+            gas=block.gas_limit,
+            static=static_call,
+        )
+        ex = self.start_executive(
+            msg, overlay, block, tx.sender, context_id,
+            abi=tx.abi.encode() if is_create else b"",
+        )
+        state, res = ex.step(None)
+        assert state == "done", "serial executive cannot pause"
+        rc.status = int(res.status)
+        rc.output = res.output
+        rc.gas_used = max(block.gas_limit - res.gas_left, BASE_GAS)
+        rc.log_entries = res.logs
+        rc.contract_address = res.create_address
+        if res.ok and not static_call:
             overlay.merge_into_prev()
         return rc
+
+    # -- code/abi access (getCode:1881 / getABI:1999) -----------------------
+
+    def get_code(self, addr: bytes) -> bytes:
+        host = EVMHost(StateStorage(self.backend), self.suite.hash, 0, 0, b"", 0)
+        return host.get_code(addr)
+
+    def get_abi(self, addr: bytes) -> bytes:
+        host = EVMHost(StateStorage(self.backend), self.suite.hash, 0, 0, b"", 0)
+        return host.get_abi(addr)
+
 
     def execute_transactions(self, txs: list[Transaction]) -> list[TransactionReceipt]:
         """Serial batch on the current block (executeTransactions:997)."""
         if self._block is None:
             raise RuntimeError("call next_block_header first")
-        return [self._execute_one(tx, self._block) for tx in txs]
+        base = self.reserve_contexts(len(txs))
+        return [
+            self._execute_one(tx, self._block, context_id=base + i)
+            for i, tx in enumerate(txs)
+        ]
 
     # -- DAG parallel (dagExecuteTransactions:1063) -------------------------
 
@@ -177,9 +321,12 @@ class TransactionExecutor:
         if self._block is None:
             raise RuntimeError("call next_block_header first")
         receipts: list[TransactionReceipt | None] = [None] * len(txs)
+        base = self.reserve_contexts(len(txs))
         for level in self.dag_levels(txs):
             for i in level:
-                receipts[i] = self._execute_one(txs[i], self._block)
+                receipts[i] = self._execute_one(
+                    txs[i], self._block, context_id=base + i
+                )
         return receipts  # type: ignore[return-value]
 
     # -- read-only call (call:672) ------------------------------------------
@@ -208,3 +355,143 @@ class TransactionExecutor:
     def rollback(self, params: TwoPCParams) -> None:
         self.backend.rollback(params)
         self._block = None
+
+
+class _ExecFrame:
+    __slots__ = ("gen", "overlay", "msg", "create_addr", "abi")
+
+    def __init__(self, gen, overlay, msg, create_addr=b"", abi=b""):
+        self.gen = gen
+        self.overlay = overlay
+        self.msg = msg
+        self.create_addr = create_addr
+        self.abi = abi
+
+
+class Executive:
+    """One transaction frame chain — the reference's TransactionExecutive /
+    CoroutineTransactionExecutive (executive/CoroutineTransactionExecutive.cpp)
+    rebuilt on Python generators.
+
+    Frames are explicit (a stack of interpreter generators over nested state
+    overlays), so the executive can *pause* at any external call the driver
+    declares non-local (`is_local`): ``step`` returns ("external", EVMCall)
+    and the DMC scheduler migrates the request to the target contract's shard,
+    resuming later with the EVMResult. The serial path passes no `is_local`
+    (everything local) and runs straight to ("done", EVMResult).
+    """
+
+    def __init__(self, executor: TransactionExecutor, block: BlockContext,
+                 origin: bytes, context_id: int, seq_start: int,
+                 msg: EVMCall, root_storage: StorageInterface,
+                 abi: bytes = b"", is_local=None):
+        self.ex = executor
+        self.block = block
+        self.origin = origin
+        self.context_id = context_id
+        # creates inside this executive draw sub-sequence numbers from the
+        # spawning message's seq (the reference threads newSeq through
+        # ExecutionMessages; TransactionExecutive.cpp:95-115)
+        self.seq = itertools.count(seq_start << 12)
+        self.frames: list[_ExecFrame] = []
+        self.root_storage = root_storage
+        self._opened = False
+        self._start_msg = msg
+        self._start_abi = abi
+        self.is_local = is_local if is_local is not None else (lambda addr: True)
+
+    def _host(self, overlay: StorageInterface) -> EVMHost:
+        return EVMHost(
+            overlay, self.ex.suite.hash, self.block.number,
+            self.block.timestamp, self.origin, self.block.gas_limit,
+        )
+
+    def _open(self, msg: EVMCall, parent: StorageInterface,
+              abi: bytes = b"") -> EVMResult | None:
+        """Resolve a call/create request: either an immediate EVMResult
+        (builtins, precompiles, codeless calls, errors) or None with a new
+        interpreter frame pushed."""
+        if msg.depth >= MAX_CALL_DEPTH:
+            return EVMResult(status=int(TransactionStatus.OUT_OF_STACK))
+        overlay = StateStorage(parent)
+        host = self._host(overlay)
+        if msg.kind in ("create", "create2"):
+            if msg.salt is not None:
+                addr = host.create2_address(msg.sender, msg.salt, msg.data)
+            else:
+                addr = host.create_address(
+                    self.block.number, self.context_id, next(self.seq)
+                )
+            if host.account_exists(addr):
+                return EVMResult(
+                    status=int(TransactionStatus.CONTRACT_ADDRESS_ALREADY_USED)
+                )
+            run_msg = EVMCall(
+                kind="call", sender=msg.sender, to=addr, code_address=addr,
+                data=b"", gas=msg.gas, value=msg.value, depth=msg.depth,
+            )
+            gen = interpret(host, run_msg, msg.data)
+            self.frames.append(_ExecFrame(gen, overlay, msg, addr, abi))
+            return None
+        builtin = self.ex._builtin_precompile(msg)
+        if builtin is not None:
+            return builtin
+        pre = self.ex.registry.get(msg.code_address)
+        if pre is not None:
+            res = self.ex._run_registry_precompile(
+                pre, msg, overlay, self.block, self.origin
+            )
+            if res.ok and not msg.static:
+                overlay.merge_into_prev()
+            return res
+        code = host.get_code(msg.code_address)
+        if not code:
+            # call to codeless address succeeds with empty output (EVM rule);
+            # top-level txs to unknown addresses are rejected by execute()
+            return EVMResult(status=0, output=b"", gas_left=msg.gas)
+        gen = interpret(host, msg, code)
+        self.frames.append(_ExecFrame(gen, overlay, msg))
+        return None
+
+    def step(self, response: EVMResult | None):
+        """Advance until done or paused on a non-local call.
+
+        Returns ("done", EVMResult) or ("external", EVMCall)."""
+        if not self._opened:
+            self._opened = True
+            immediate = self._open(self._start_msg, self.root_storage,
+                                   self._start_abi)
+            if immediate is not None:
+                return ("done", immediate)
+            response = None
+        while self.frames:
+            fr = self.frames[-1]
+            try:
+                req = fr.gen.send(response)
+            except StopIteration as si:
+                res: EVMResult = si.value
+                self.frames.pop()
+                if fr.create_addr:
+                    if res.ok:
+                        if len(res.output) > MAX_CODE_SIZE:
+                            res = EVMResult(status=int(TransactionStatus.OUT_OF_GAS))
+                        else:
+                            self._host(fr.overlay).set_code(
+                                fr.create_addr, res.output, fr.abi
+                            )
+                            res = EVMResult(
+                                status=0, output=b"", gas_left=res.gas_left,
+                                logs=res.logs, create_address=fr.create_addr,
+                            )
+                            fr.overlay.merge_into_prev()
+                elif res.ok and not fr.msg.static:
+                    fr.overlay.merge_into_prev()
+                response = res
+                continue
+            # external request from the top frame
+            if req.kind in ("create", "create2") or self.is_local(req.code_address):
+                immediate = self._open(req, fr.overlay)
+                response = immediate  # None → frame pushed, drive it next
+            else:
+                return ("external", req)
+        return ("done", response)

@@ -1,36 +1,23 @@
-"""Vectorized 256-bit modular arithmetic for TPU (uint32 lanes).
+"""Host-side 256-bit limb/byte conversions + device digest→limb adapters.
 
-Replaces the reference's CPU bignum (wedpr-crypto Rust FFI / OpenSSL BN used by
-bcos-crypto's secp256k1/SM2 paths) with a batch formulation XLA can fuse:
+Number layout: a 256-bit value is 16 little-endian 16-bit limbs. Host-side
+(numpy) arrays here are **batch-major** ``[B, 16]`` — the stable public
+layout of the crypto suite APIs; the device math core
+(:mod:`fisco_bcos_tpu.ops.limb`) is **limb-major** ``[16, T]`` for full VPU
+lane utilization and transposes at its entry points.
 
-- A 256-bit number is 16 little-endian 16-bit limbs stored in a uint32 array of
-  shape ``[..., 16]`` (leading dims are the batch). 16-bit limbs keep every
-  partial product (≤ (2^16-1)^2) and every column sum inside uint32 — TPUs have
-  no native 64-bit integer path worth using.
-- Products are computed as one batched outer product (``[..., 16, 16]``) and
-  accumulated along anti-diagonals; carry propagation is a short
-  ``lax.scan`` along the limb axis (sequential over 32 limbs, vectorized over
-  the batch — the batch is where the parallelism lives).
-- Modular reduction is full-word Montgomery (REDC with R = 2^256), uniform for
-  any odd modulus, so secp256k1's p/n and SM2's p/n share one code path.
-
-All entry points are jit-safe, shape-polymorphic in the batch dims, and use no
-data-dependent control flow (selects only) — consensus-critical code must be
-constant-shape and branch-free on device.
+The device-side converters keep hash → EC pipelines fused on device (the
+reference round-trips through CPU byte buffers between OpenSSL EVP hashing
+and wedpr EC calls instead).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 LIMBS = 16  # 16 x 16-bit limbs = 256 bits
-_MASK = jnp.uint32(0xFFFF)
 _R = 1 << 256
 
 
@@ -79,8 +66,8 @@ def limbs_to_bytes_be(limbs: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Device-side digest-word -> limb conversion (keeps hash -> EC pipelines fused
-# on device; the reference round-trips through CPU byte buffers instead)
+# Device-side digest-word -> limb conversion (keeps hash -> EC pipelines
+# fused on device)
 # ---------------------------------------------------------------------------
 
 
@@ -114,258 +101,3 @@ def limbs_to_bytes_device(limbs: jax.Array) -> jax.Array:
     hi = rev >> 8
     lo = rev & 0xFF
     return jnp.stack([hi, lo], axis=-1).reshape(*limbs.shape[:-1], 32)
-
-
-# ---------------------------------------------------------------------------
-# Modulus context (host-precomputed Montgomery constants)
-# ---------------------------------------------------------------------------
-
-
-@dataclass(frozen=True)
-class Modulus:
-    """Montgomery context for an odd modulus m < 2^256 (device constants)."""
-
-    m_int: int
-    limbs: np.ndarray = field(repr=False)  # [16] m
-    mprime: np.ndarray = field(repr=False)  # [16] -m^-1 mod 2^256
-    r1: np.ndarray = field(repr=False)  # [16] R mod m   (Montgomery one)
-    r2: np.ndarray = field(repr=False)  # [16] R^2 mod m (to-Montgomery factor)
-
-    def __hash__(self):
-        return hash(self.m_int)
-
-    def __eq__(self, other):
-        return isinstance(other, Modulus) and self.m_int == other.m_int
-
-
-def make_modulus(m: int) -> Modulus:
-    if m % 2 == 0 or not 2 < m < _R:
-        raise ValueError("modulus must be odd and < 2^256")
-    mprime = (-pow(m, -1, _R)) % _R
-    return Modulus(
-        m_int=m,
-        limbs=int_to_limbs(m),
-        mprime=int_to_limbs(mprime),
-        r1=int_to_limbs(_R % m),
-        r2=int_to_limbs((_R * _R) % m),
-    )
-
-
-# ---------------------------------------------------------------------------
-# Carry machinery (lax.scan along the limb axis, batch-vectorized)
-# ---------------------------------------------------------------------------
-
-
-# Carries are a carry-lookahead problem, not a sequential one: a 32-step
-# lax.scan per normalization made every mont_mul ~130 sequential device steps
-# (the throughput ceiling of the whole EC plane). Instead: one split pass
-# reduces arbitrary column sums to "limbs + {0,1} increments", and the
-# remaining binary carry chain is Kogge-Stone — generate/propagate pairs
-# combined with lax.associative_scan in log2(L) depth.
-
-
-def _gp_combine(x, y):
-    """(generate, propagate) composition — associative."""
-    gx, px = x
-    gy, py = y
-    return gy | (py & gx), py & px
-
-
-def _ks_carry_in(g: jax.Array, p: jax.Array) -> jax.Array:
-    """Carry INTO each position given per-position generate/propagate."""
-    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=-1)
-    return jnp.concatenate([jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1)
-
-
-def _shift_up(x: jax.Array) -> jax.Array:
-    """[..., L] -> [..., L] shifted one limb toward the high end."""
-    return jnp.concatenate([jnp.zeros_like(x[..., :1]), x[..., :-1]], axis=-1)
-
-
-def _carry_normalize(cols: jax.Array) -> jax.Array:
-    """Propagate carries: [..., L] uint32 column sums (< 2^27) -> [..., L+1]
-    normalized 16-bit limbs (the extra limb is the final carry-out)."""
-    cols = jnp.concatenate([cols, jnp.zeros_like(cols[..., :1])], axis=-1)
-    s = (cols & _MASK) + _shift_up(cols >> 16)  # < 2^16 + 2^11 < 2^17
-    t = (s & _MASK) + _shift_up(s >> 16)  # ≤ 2^16 (increments are {0,1})
-    g = t > _MASK
-    p = t == _MASK
-    return (t + _ks_carry_in(g, p).astype(jnp.uint32)) & _MASK
-
-
-def _sub_with_borrow(a: jax.Array, b: jax.Array):
-    """(a - b) limbwise -> (diff [..., L] normalized, borrow_out [...] in {0,1})."""
-    g = a < b  # borrow generated regardless of incoming borrow
-    p = a == b  # incoming borrow propagates
-    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=-1)
-    bin_ = jnp.concatenate([jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1)
-    diff = (a + jnp.uint32(0x10000) - b - bin_.astype(jnp.uint32)) & _MASK
-    return diff, G[..., -1].astype(jnp.uint32)
-
-
-def _add_raw(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Exact limbwise add of two normalized arrays -> [..., L+1] normalized."""
-    return _carry_normalize(a + b)
-
-
-# ---------------------------------------------------------------------------
-# Multiplication (batched outer product + anti-diagonal accumulation)
-# ---------------------------------------------------------------------------
-
-
-_DIAG_CACHE: dict = {}
-
-
-def _diag_mats(out_limbs: int):
-    """Constant 0/1 matrices turning the flattened outer product into column
-    sums: lo16 parts land in column i+j, hi16 parts in column i+j+1. Shapes
-    [256, out_limbs] — the accumulation becomes one integer matmul per part,
-    which XLA maps onto the MXU instead of a serial scatter chain."""
-    key = out_limbs
-    if key not in _DIAG_CACHE:
-        a_lo = np.zeros((LIMBS * LIMBS, out_limbs), dtype=np.int32)
-        a_hi = np.zeros((LIMBS * LIMBS, out_limbs), dtype=np.int32)
-        for i in range(LIMBS):
-            for j in range(LIMBS):
-                if i + j < out_limbs:
-                    a_lo[i * LIMBS + j, i + j] = 1
-                if i + j + 1 < out_limbs:
-                    a_hi[i * LIMBS + j, i + j + 1] = 1
-        _DIAG_CACHE[key] = (a_lo, a_hi)
-    return _DIAG_CACHE[key]
-
-
-def _mul_columns(a: jax.Array, b: jax.Array, out_limbs: int) -> jax.Array:
-    """Column sums of a*b: [..., 16] x [..., 16] -> [..., out_limbs] raw columns.
-
-    Column k collects lo16(a_i*b_j) for i+j=k and hi16 for i+j=k-1; every
-    column sum is < 32 * 2^16 + 2^16 < 2^22, well inside int32/uint32.
-    """
-    prod = a[..., :, None] * b[..., None, :]  # [..., 16, 16] — each < 2^32 ✔
-    lo = (prod & _MASK).astype(jnp.int32).reshape(a.shape[:-1] + (LIMBS * LIMBS,))
-    hi = (prod >> 16).astype(jnp.int32).reshape(a.shape[:-1] + (LIMBS * LIMBS,))
-    a_lo, a_hi = _diag_mats(out_limbs)
-    cols = lo @ jnp.asarray(a_lo) + hi @ jnp.asarray(a_hi)
-    return cols.astype(jnp.uint32)
-
-
-@jax.jit
-def mul_full(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Full 256x256 -> 512-bit product: [..., 16] x [..., 16] -> [..., 32]."""
-    return _carry_normalize(_mul_columns(a, b, 32))[..., :32]
-
-
-@jax.jit
-def mul_low(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Low 256 bits of the product (mod 2^256) -> [..., 16]."""
-    return _carry_normalize(_mul_columns(a, b, LIMBS))[..., :LIMBS]
-
-
-# ---------------------------------------------------------------------------
-# Montgomery arithmetic
-# ---------------------------------------------------------------------------
-
-
-def _const(mod_arr: np.ndarray, like: jax.Array) -> jax.Array:
-    """Broadcast a [16] host constant across the batch dims of `like`."""
-    c = jnp.asarray(mod_arr)
-    return jnp.broadcast_to(c, like.shape[:-1] + (LIMBS,))
-
-
-@partial(jax.jit, static_argnames="mod")
-def redc(t: jax.Array, mod: Modulus) -> jax.Array:
-    """Montgomery reduction: t [..., 32] (t < m*R) -> t*R^-1 mod m, [..., 16]."""
-    t_lo = t[..., :LIMBS]
-    m_val = mul_low(t_lo, _const(mod.mprime, t_lo))
-    mm = mul_full(m_val, _const(mod.limbs, t_lo))  # [..., 32]
-    s = _carry_normalize(t + mm)  # [..., 33]; low 16 limbs are zero
-    res17 = s[..., LIMBS:]  # [..., 17] — value < 2m < 2^257
-    m17 = jnp.pad(_const(mod.limbs, t_lo), [(0, 0)] * (t_lo.ndim - 1) + [(0, 1)])
-    diff, borrow = _sub_with_borrow(res17, m17)
-    res = jnp.where((borrow == 0)[..., None], diff, res17)
-    return res[..., :LIMBS]
-
-
-@partial(jax.jit, static_argnames="mod")
-def mont_mul(a: jax.Array, b: jax.Array, mod: Modulus) -> jax.Array:
-    return redc(mul_full(a, b), mod)
-
-
-@partial(jax.jit, static_argnames="mod")
-def mont_sqr(a: jax.Array, mod: Modulus) -> jax.Array:
-    return redc(mul_full(a, a), mod)
-
-
-@partial(jax.jit, static_argnames="mod")
-def to_mont(a: jax.Array, mod: Modulus) -> jax.Array:
-    return mont_mul(a, _const(mod.r2, a), mod)
-
-
-@partial(jax.jit, static_argnames="mod")
-def from_mont(a: jax.Array, mod: Modulus) -> jax.Array:
-    pad = [(0, 0)] * (a.ndim - 1) + [(0, LIMBS)]
-    return redc(jnp.pad(a, pad), mod)
-
-
-@partial(jax.jit, static_argnames="mod")
-def add_mod(a: jax.Array, b: jax.Array, mod: Modulus) -> jax.Array:
-    """(a + b) mod m for normalized a, b < m."""
-    s = _add_raw(a, b)  # [..., 17]
-    m17 = jnp.pad(_const(mod.limbs, a), [(0, 0)] * (a.ndim - 1) + [(0, 1)])
-    diff, borrow = _sub_with_borrow(s, m17)
-    return jnp.where((borrow == 0)[..., None], diff, s)[..., :LIMBS]
-
-
-@partial(jax.jit, static_argnames="mod")
-def sub_mod(a: jax.Array, b: jax.Array, mod: Modulus) -> jax.Array:
-    """(a - b) mod m for normalized a, b < m."""
-    diff, borrow = _sub_with_borrow(a, b)
-    plus_m = _add_raw(diff, _const(mod.limbs, a))[..., :LIMBS]
-    return jnp.where((borrow == 0)[..., None], diff, plus_m)
-
-
-def is_zero(a: jax.Array) -> jax.Array:
-    return jnp.all(a == 0, axis=-1)
-
-
-def eq(a: jax.Array, b: jax.Array) -> jax.Array:
-    return jnp.all(a == b, axis=-1)
-
-
-def geq(a: jax.Array, b: jax.Array) -> jax.Array:
-    """a >= b elementwise over the batch (normalized limbs)."""
-    _, borrow = _sub_with_borrow(a, b)
-    return borrow == 0
-
-
-def select(cond: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
-    """cond [...] -> cond ? a : b over [..., 16] operands."""
-    return jnp.where(cond[..., None], a, b)
-
-
-@partial(jax.jit, static_argnames=("e", "mod"))
-def mont_pow(a: jax.Array, e: int, mod: Modulus) -> jax.Array:
-    """a^e mod m (a in Montgomery domain, e a fixed Python int exponent).
-
-    MSB-first square-and-multiply via lax.scan over the (static) bit string —
-    constant-time across lanes, ~2 mulmods per bit.
-    """
-    if e == 0:
-        return _const(mod.r1, a)
-    bits = np.array(
-        [(e >> i) & 1 for i in range(e.bit_length() - 1, -1, -1)], dtype=np.uint32
-    )
-    acc0 = _const(mod.r1, a)
-
-    def step(acc, bit):
-        acc = mont_sqr(acc, mod)
-        withmul = mont_mul(acc, a, mod)
-        return jnp.where((bit != 0), withmul, acc), None
-
-    acc, _ = lax.scan(step, acc0, jnp.asarray(bits))
-    return acc
-
-
-def mont_inv(a: jax.Array, mod: Modulus) -> jax.Array:
-    """Modular inverse via Fermat (modulus must be prime). Returns 0 for a=0."""
-    return mont_pow(a, mod.m_int - 2, mod)

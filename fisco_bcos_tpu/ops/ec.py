@@ -4,313 +4,403 @@ Replaces the reference's per-signature CPU EC stack (wedpr-crypto Rust FFI
 behind bcos-crypto — `wedpr_secp256k1_verify` at
 bcos-crypto/bcos-crypto/signature/secp256k1/Secp256k1Crypto.cpp:57, SM2 at
 signature/sm2/SM2Crypto.cpp:29-91) with batch Jacobian-coordinate kernels over
-the 256-bit Montgomery limb arithmetic in :mod:`fisco_bcos_tpu.ops.bigint`.
+the limb-major field arithmetic in :mod:`fisco_bcos_tpu.ops.limb`.
 
-Design notes (TPU-first):
-- A point is a (X, Y, Z) tuple of ``[..., 16]`` limb arrays in the Montgomery
-  domain of the curve prime; Z == 0 encodes the point at infinity.
+TPU-first design:
+- A point is an (X, Y, Z) tuple of ``[16, T]`` limb-major arrays in the
+  curve's field domain (plain for the pseudo-Mersenne fast path, Montgomery
+  for SM2); Z == 0 encodes infinity. The batch lives in the minor axis so
+  every op runs at full VPU lane utilization.
 - All group ops are branch-free: exceptional cases (infinity operands,
-  P == Q, P == -Q) are resolved with lane-wise selects, so one compiled
-  program serves every lane of the batch — consensus-critical code must not
-  diverge per lane.
-- Scalar multiplication is an MSB-first double-and-add `lax.scan` over the 256
-  scalar bits; u1*G + u2*Q uses Shamir's trick (one shared doubling chain).
-  The schedule is identical for every lane; only selects depend on data.
+  P == Q, P == -Q) are resolved with lane-wise selects — one compiled
+  program serves honest and adversarial lanes alike (consensus code must
+  not diverge per lane).
+- ``dual_mul_windowed`` computes u1*G + u2*Q with 4-bit windows and one
+  shared doubling chain (Shamir): a 15-entry runtime Jacobian table for Q,
+  and a host-precomputed affine table {c*G} so G contributions are cheap
+  mixed (Z=1) additions with no runtime table build. This replaces round
+  1's bit-at-a-time ladder (256 doublings + 256 full additions) with 256
+  doublings + 64 full + 64 mixed additions.
+- The whole ladder is a ``lax.scan`` over 64 window steps; table selects
+  are 15-way masked chains (schedule identical on every lane).
+
+The same functions run inside the Pallas TPU kernels (see
+:mod:`fisco_bcos_tpu.ops.pallas_ec`) and under plain XLA on CPU; integer
+semantics make both paths bit-identical — mandatory for consensus.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from functools import partial
+from dataclasses import dataclass, field
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..crypto.ref.ecdsa import SECP256K1, SM2_CURVE, Curve
-from . import bigint
-from .bigint import (
-    Modulus,
-    _const,
-    _sub_with_borrow,
-    add_mod,
+from ..crypto.ref.ecdsa import SECP256K1, SM2_CURVE, Curve, point_add
+from . import limb
+from .limb import (
+    FoldField,
+    MontField,
+    const_rows,
     eq,
-    from_mont,
-    geq,
     is_zero,
-    make_modulus,
-    mont_inv,
-    mont_mul,
-    mont_pow,
-    mont_sqr,
+    lt,
+    make_fold_field,
+    make_mont_field,
     select,
-    sub_mod,
-    to_mont,
+    sub_borrow,
 )
 
 _R = 1 << 256
+WINDOW = 4
+N_WINDOWS = 256 // WINDOW  # 64
 
 
 @dataclass(frozen=True)
-class CurveCtx:
-    """Device constants for one short-Weierstrass curve (static under jit)."""
+class CurveOps:
+    """Static device context for one short-Weierstrass curve."""
 
     name: str
-    p: Modulus
-    n: Modulus
-    a_is_zero: bool
-    a_m: np.ndarray  # a  in Montgomery(p) domain, [16]
-    b_m: np.ndarray  # b  in Montgomery(p) domain, [16]
-    gx_m: np.ndarray  # G.x in Montgomery(p) domain, [16]
-    gy_m: np.ndarray  # G.y in Montgomery(p) domain, [16]
     curve: Curve
+    F: FoldField | MontField  # field of the curve prime p
+    Fn: FoldField | None  # scalar field mod n (None -> plain-limb helpers)
+    a_is_zero: bool
+    a_enc: np.ndarray  # a in field domain, [16]
+    b_enc: np.ndarray  # b in field domain, [16]
+    p_limbs: np.ndarray = field(repr=False)
+    n_limbs: np.ndarray = field(repr=False)
 
     def __hash__(self):
         return hash(self.name)
 
     def __eq__(self, other):
-        return isinstance(other, CurveCtx) and other.name == self.name
+        return isinstance(other, CurveOps) and other.name == self.name
 
 
-def make_curve_ctx(c: Curve) -> CurveCtx:
-    def to_m(x: int) -> np.ndarray:
-        return bigint.int_to_limbs(x * _R % c.p)
-
-    return CurveCtx(
+def _make_curve_ops(c: Curve) -> CurveOps:
+    # Pseudo-Mersenne fast path when p = 2^256 - small (secp256k1); generic
+    # Montgomery otherwise (SM2's p has a 225-bit complement).
+    F = make_fold_field(c.p) if _R - c.p < 1 << 132 else make_mont_field(c.p)
+    Fn = make_fold_field(c.n) if _R - c.n < 1 << 132 else None
+    return CurveOps(
         name=c.name,
-        p=make_modulus(c.p),
-        n=make_modulus(c.n),
-        a_is_zero=c.a == 0,
-        a_m=to_m(c.a),
-        b_m=to_m(c.b),
-        gx_m=to_m(c.gx),
-        gy_m=to_m(c.gy),
         curve=c,
+        F=F,
+        Fn=Fn,
+        a_is_zero=c.a == 0,
+        a_enc=F.enc(c.a),
+        b_enc=F.enc(c.b),
+        p_limbs=limb.int_to_rows(c.p),
+        n_limbs=limb.int_to_rows(c.n),
     )
 
 
-SECP256K1_CTX = make_curve_ctx(SECP256K1)
-SM2_CTX = make_curve_ctx(SM2_CURVE)
+SECP256K1_OPS = _make_curve_ops(SECP256K1)
+SM2_OPS = _make_curve_ops(SM2_CURVE)
 
 
 # ---------------------------------------------------------------------------
-# Jacobian group law (Montgomery domain, branch-free)
+# Jacobian group law (field domain, branch-free)
 # ---------------------------------------------------------------------------
 
 
-def jac_double(P, ctx: CurveCtx):
-    """dbl-2007-bl; 8 sqr + 2 mul (1 mul saved when a == 0).
-
-    Safe without selects: doubling infinity (Z=0) or a 2-torsion point (Y=0)
-    yields Z3 = 0, i.e. infinity, which is the correct group result.
-    """
+def jac_double(P, C: CurveOps):
+    """dbl-2007-bl. Safe without selects: doubling infinity (Z=0) or a
+    2-torsion point (Y=0) yields Z3 = 0 — the correct group result."""
     X, Y, Z = P
-    p = ctx.p
-    xx = mont_sqr(X, p)
-    yy = mont_sqr(Y, p)
-    yyyy = mont_sqr(yy, p)
-    zz = mont_sqr(Z, p)
-    t = mont_sqr(add_mod(X, yy, p), p)
-    s = sub_mod(sub_mod(t, xx, p), yyyy, p)
-    s = add_mod(s, s, p)  # S = 2((X+YY)^2 - XX - YYYY)
-    m = add_mod(add_mod(xx, xx, p), xx, p)  # 3*XX
-    if not ctx.a_is_zero:
-        m = add_mod(m, mont_mul(_const(ctx.a_m, X), mont_sqr(zz, p), p), p)
-    x3 = sub_mod(mont_sqr(m, p), add_mod(s, s, p), p)
-    y8 = add_mod(yyyy, yyyy, p)
-    y8 = add_mod(y8, y8, p)
-    y8 = add_mod(y8, y8, p)
-    y3 = sub_mod(mont_mul(m, sub_mod(s, x3, p), p), y8, p)
-    z3 = sub_mod(sub_mod(mont_sqr(add_mod(Y, Z, p), p), yy, p), zz, p)
+    F = C.F
+    xx = F.sqr(X)
+    yy = F.sqr(Y)
+    yyyy = F.sqr(yy)
+    zz = F.sqr(Z)
+    t = F.sqr(F.add(X, yy))
+    s = F.sub(F.sub(t, xx), yyyy)
+    s = F.add(s, s)  # S = 2((X+YY)^2 - XX - YYYY)
+    m = F.add(F.add(xx, xx), xx)  # 3*XX
+    if not C.a_is_zero:
+        m = F.add(m, F.mul(const_rows(C.a_enc, X), F.sqr(zz)))
+    x3 = F.sub(F.sqr(m), F.add(s, s))
+    y8 = F.add(yyyy, yyyy)
+    y8 = F.add(y8, y8)
+    y8 = F.add(y8, y8)
+    y3 = F.sub(F.mul(m, F.sub(s, x3)), y8)
+    z3 = F.sub(F.sub(F.sqr(F.add(Y, Z)), yy), zz)
     return x3, y3, z3
 
 
-def jac_add(P, Q, ctx: CurveCtx):
-    """add-2007-bl with full exceptional-case handling via selects.
-
-    Handles P or Q at infinity, P == Q (falls back to the doubling formula)
-    and P == -Q (H == 0 forces Z3 = 0, the correct infinity).
-    """
+def jac_add(P, Q, C: CurveOps):
+    """add-2007-bl with full exceptional-case handling via selects."""
     X1, Y1, Z1 = P
     X2, Y2, Z2 = Q
-    p = ctx.p
-    z1z1 = mont_sqr(Z1, p)
-    z2z2 = mont_sqr(Z2, p)
-    u1 = mont_mul(X1, z2z2, p)
-    u2 = mont_mul(X2, z1z1, p)
-    s1 = mont_mul(mont_mul(Y1, Z2, p), z2z2, p)
-    s2 = mont_mul(mont_mul(Y2, Z1, p), z1z1, p)
-    h = sub_mod(u2, u1, p)
-    rr = sub_mod(s2, s1, p)
-    h2 = add_mod(h, h, p)
-    i = mont_sqr(h2, p)
-    j = mont_mul(h, i, p)
-    r2 = add_mod(rr, rr, p)
-    v = mont_mul(u1, i, p)
-    x3 = sub_mod(sub_mod(mont_sqr(r2, p), j, p), add_mod(v, v, p), p)
-    s1j = mont_mul(s1, j, p)
-    y3 = sub_mod(mont_mul(r2, sub_mod(v, x3, p), p), add_mod(s1j, s1j, p), p)
-    z3 = mont_mul(
-        sub_mod(sub_mod(mont_sqr(add_mod(Z1, Z2, p), p), z1z1, p), z2z2, p), h, p
-    )
+    F = C.F
+    z1z1 = F.sqr(Z1)
+    z2z2 = F.sqr(Z2)
+    u1 = F.mul(X1, z2z2)
+    u2 = F.mul(X2, z1z1)
+    s1 = F.mul(F.mul(Y1, Z2), z2z2)
+    s2 = F.mul(F.mul(Y2, Z1), z1z1)
+    h = F.sub(u2, u1)
+    rr = F.sub(s2, s1)
+    h2 = F.add(h, h)
+    i = F.sqr(h2)
+    j = F.mul(h, i)
+    r2 = F.add(rr, rr)
+    v = F.mul(u1, i)
+    x3 = F.sub(F.sub(F.sqr(r2), j), F.add(v, v))
+    s1j = F.mul(s1, j)
+    y3 = F.sub(F.mul(r2, F.sub(v, x3)), F.add(s1j, s1j))
+    z3 = F.mul(F.sub(F.sub(F.sqr(F.add(Z1, Z2)), z1z1), z2z2), h)
     inf1 = is_zero(Z1)
     inf2 = is_zero(Z2)
     same = is_zero(h) & is_zero(rr) & ~inf1 & ~inf2
-    dx, dy, dz = jac_double(P, ctx)
+    dx, dy, dz = jac_double(P, C)
     x = select(inf1, X2, select(inf2, X1, select(same, dx, x3)))
     y = select(inf1, Y2, select(inf2, Y1, select(same, dy, y3)))
     z = select(inf1, Z2, select(inf2, Z1, select(same, dz, z3)))
     return x, y, z
 
 
+def jac_add_mixed(P, A, C: CurveOps):
+    """P + (x2, y2) for affine A (Z2 = 1, A must not be infinity) — madd,
+    7M+4S vs the 11M+5S full addition. Exceptional cases via selects."""
+    X1, Y1, Z1 = P
+    X2, Y2 = A
+    F = C.F
+    z1z1 = F.sqr(Z1)
+    u2 = F.mul(X2, z1z1)
+    s2 = F.mul(F.mul(Y2, Z1), z1z1)
+    h = F.sub(u2, X1)
+    hh = F.sqr(h)
+    i = F.add(hh, hh)
+    i = F.add(i, i)  # 4*HH
+    j = F.mul(h, i)
+    rr = F.sub(s2, Y1)
+    rr = F.add(rr, rr)  # 2*(S2-Y1)
+    v = F.mul(X1, i)
+    x3 = F.sub(F.sub(F.sqr(rr), j), F.add(v, v))
+    y1j = F.mul(Y1, j)
+    y3 = F.sub(F.mul(rr, F.sub(v, x3)), F.add(y1j, y1j))
+    z3 = F.sub(F.sub(F.sqr(F.add(Z1, h)), z1z1), hh)
+    inf1 = is_zero(Z1)
+    one = C.F.one(X1)
+    same = is_zero(h) & is_zero(rr) & ~inf1
+    dx, dy, dz = jac_double(P, C)
+    x = select(inf1, X2, select(same, dx, x3))
+    y = select(inf1, Y2, select(same, dy, y3))
+    z = select(inf1, one, select(same, dz, z3))
+    return x, y, z
+
+
 def jac_infinity(like: jax.Array):
-    """Point at infinity broadcast over the batch dims of `like` [..., 16]."""
+    """Point at infinity: (1, 1, 0) in any domain-encoding (Z=0 is the flag;
+    X/Y values are never read for infinity lanes)."""
     z = jnp.zeros_like(like)
-    return z, z, z
+    one = jnp.zeros_like(like).at[0].set(1)
+    return one, one, z
 
 
-@partial(jax.jit, static_argnames="ctx")
-def jac_to_affine(P, ctx: CurveCtx):
-    """(X, Y, Z) -> (x, y, inf_mask); affine coords stay in Montgomery domain.
+def jac_to_affine(P, C: CurveOps):
+    """(X, Y, Z) -> (x, y, inf_mask); affine coords stay in the field domain.
 
-    Infinity lanes get x = y = 0 (mont_inv(0) == 0)."""
+    Infinity lanes get x = y = 0 (F.inv(0) == 0)."""
     X, Y, Z = P
-    zinv = mont_inv(Z, ctx.p)
-    zi2 = mont_sqr(zinv, ctx.p)
-    zi3 = mont_mul(zi2, zinv, ctx.p)
-    return mont_mul(X, zi2, ctx.p), mont_mul(Y, zi3, ctx.p), is_zero(Z)
+    F = C.F
+    zinv = F.inv(Z)
+    zi2 = F.sqr(zinv)
+    zi3 = F.mul(zi2, zinv)
+    return F.mul(X, zi2), F.mul(Y, zi3), is_zero(Z)
 
 
-def on_curve_mont(x_m: jax.Array, y_m: jax.Array, ctx: CurveCtx) -> jax.Array:
-    """y^2 == x^3 + a*x + b (all Montgomery domain) -> bool[...]."""
-    p = ctx.p
-    rhs = mont_mul(mont_sqr(x_m, p), x_m, p)
-    if not ctx.a_is_zero:
-        rhs = add_mod(rhs, mont_mul(_const(ctx.a_m, x_m), x_m, p), p)
-    rhs = add_mod(rhs, _const(ctx.b_m, x_m), p)
-    return eq(mont_sqr(y_m, p), rhs)
-
-
-def sqrt_mont(a_m: jax.Array, ctx: CurveCtx) -> jax.Array:
-    """Square root mod p for p ≡ 3 (mod 4): a^((p+1)/4). Montgomery domain.
-
-    Caller must check mont_sqr(result) == a to detect non-residues."""
-    assert ctx.curve.p % 4 == 3
-    return mont_pow(a_m, (ctx.curve.p + 1) // 4, ctx.p)
+def on_curve(x_enc: jax.Array, y_enc: jax.Array, C: CurveOps) -> jax.Array:
+    """y^2 == x^3 + a*x + b (field domain) -> bool[T]."""
+    F = C.F
+    rhs = F.mul(F.sqr(x_enc), x_enc)
+    if not C.a_is_zero:
+        rhs = F.add(rhs, F.mul(const_rows(C.a_enc, x_enc), x_enc))
+    rhs = F.add(rhs, const_rows(C.b_enc, x_enc))
+    return eq(F.sqr(y_enc), rhs)
 
 
 # ---------------------------------------------------------------------------
-# Scalar bit plumbing and scalar-field (mod n) helpers
+# Scalar-range helpers (plain-domain limbs)
 # ---------------------------------------------------------------------------
 
 
-def scalar_bits_msb(k: jax.Array) -> jax.Array:
-    """[..., 16] plain limbs -> [256, ...] bits, most significant first."""
-    shifts = jnp.arange(16, dtype=jnp.uint32)
-    bits = (k[..., :, None] >> shifts) & jnp.uint32(1)  # [..., limb, bit] LSB-first
-    bits = bits.reshape(k.shape[:-1] + (256,))[..., ::-1]
-    return jnp.moveaxis(bits, -1, 0)
+def valid_scalar(x: jax.Array, C: CurveOps) -> jax.Array:
+    """1 <= x < n (signature component range check)."""
+    return ~is_zero(x) & lt(x, const_rows(C.n_limbs, x))
 
 
-def reduce_once(z: jax.Array, mod: Modulus) -> jax.Array:
-    """z mod m for z < 2m (single conditional subtract).
-
-    Valid for hash values vs. both curve orders: n > 2^255 for secp256k1 and
-    SM2, so any 256-bit z satisfies z < 2n; likewise x < p < 2n."""
-    d, borrow = _sub_with_borrow(z, _const(mod.limbs, z))
-    return jnp.where((borrow == 0)[..., None], d, z)
+def reduce_mod_n(z: jax.Array, C: CurveOps) -> jax.Array:
+    """z mod n for z < 2n (single conditional subtract; n > 2^255 for both
+    curves, so any 256-bit z qualifies)."""
+    return limb.cond_sub(z, C.n_limbs)
 
 
-def inv_mod(a: jax.Array, mod: Modulus) -> jax.Array:
-    """a^-1 mod m for plain-domain a (0 -> 0). Fermat, batch-parallel."""
-    return from_mont(mont_inv(to_mont(a, mod), mod), mod)
-
-
-def mulmod(a: jax.Array, b: jax.Array, mod: Modulus) -> jax.Array:
-    """a*b mod m for plain-domain a, b: mont_mul(aR, b) = a*b."""
-    return mont_mul(to_mont(a, mod), b, mod)
-
-
-def negmod(a: jax.Array, mod: Modulus) -> jax.Array:
-    """(-a) mod m for plain-domain a < m."""
-    return sub_mod(jnp.zeros_like(a), a, mod)
-
-
-def lt(a: jax.Array, b: jax.Array) -> jax.Array:
-    """a < b over normalized limbs."""
-    return ~geq(a, b)
-
-
-def valid_scalar(x: jax.Array, ctx: CurveCtx) -> jax.Array:
-    """1 <= x < n (signature component range check, both curves)."""
-    n = _const(ctx.n.limbs, x)
-    return ~is_zero(x) & lt(x, n)
+def add_mod_n(a: jax.Array, b: jax.Array, C: CurveOps) -> jax.Array:
+    """(a + b) mod n for plain a, b < n (no field object needed)."""
+    return limb.cond_sub(limb.add_widen(a, b), C.n_limbs)
 
 
 # ---------------------------------------------------------------------------
-# Scalar multiplication
+# Fixed-base comb table for G (host-precomputed from curve constants)
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames="ctx")
-def shamir_double_mul(k1, P1, k2, P2, ctx: CurveCtx):
-    """k1*P1 + k2*P2 with one shared doubling chain (Shamir's trick).
+@lru_cache(maxsize=None)
+def g_comb_table(name: str) -> np.ndarray:
+    """[30, 16] uint32: field-domain affine coordinates of c * G for window
+    value c in 1..15 — rows 0..14 hold the x coordinates, rows 15..29 the y
+    coordinates (the 30-row leading axis keeps the 16-limb axis off the TPU
+    lane dimension).
 
-    k1, k2: [..., 16] plain-domain scalars; P1, P2: (x_m, y_m) affine points in
-    Montgomery domain (must not be infinity — guaranteed for curve points and
-    the generator). Returns a Jacobian point; infinity encoded as Z == 0.
-    This is the replica-side analog of the reference's per-tx `ECDSA_verify`
-    inner loop — 256 iterations, identical schedule on every lane.
+    G is a compile-time constant, so its window table is precomputed on the
+    host in affine form — the ladder adds G contributions with cheap mixed
+    (Z=1) additions and no runtime table build. The table is
+    position-independent: in the MSB-first shared-doubling ladder each
+    window's contribution picks up its 2^(4i) factor from the remaining
+    doublings, exactly like the Q term."""
+    C = {SECP256K1_OPS.name: SECP256K1_OPS, SM2_OPS.name: SM2_OPS}[name]
+    c = C.curve
+    tab = np.zeros((30, limb.LIMBS), dtype=np.uint32)
+    acc = None
+    for k in range(1, 16):
+        acc = point_add(c, acc, (c.gx, c.gy))
+        assert acc is not None  # k*G is never infinity (k < n)
+        tab[k - 1] = C.F.enc(acc[0])
+        tab[15 + k - 1] = C.F.enc(acc[1])
+    return tab
+
+
+def scalar_windows(k: jax.Array) -> jax.Array:
+    """[16, T] plain limbs -> [64, T] 4-bit windows, LSB-first order."""
+    rep = jnp.repeat(k, 16 // WINDOW, axis=0)  # [64, T]
+    shifts = limb.dev_vec((np.arange(N_WINDOWS) % (16 // WINDOW)) * WINDOW)
+    return (rep >> shifts[:, None]) & jnp.uint32(0xF)
+
+
+def _point_table(t1, C: CurveOps):
+    """Window table [15, 16, T] x/y/z of k*P for k = 1..15, built with a
+    scan of 14 uniform additions (a uniform body keeps the traced program
+    small; compile time matters on both the XLA-CPU and Mosaic paths)."""
+
+    def step(prev, _):
+        nxt = jac_add(prev, t1, C)
+        return nxt, nxt
+
+    _, rest = lax.scan(step, t1, None, length=14)
+    tq_x = jnp.concatenate([t1[0][None], rest[0]], axis=0)
+    tq_y = jnp.concatenate([t1[1][None], rest[1]], axis=0)
+    tq_z = jnp.concatenate([t1[2][None], rest[2]], axis=0)
+    return tq_x, tq_y, tq_z
+
+
+def _select15(tab: jax.Array, w: jax.Array):
+    """tab [15, ..., T], w [T] in 0..15 -> tab[w-1] (w==0 lanes get tab[0],
+    callers must mask). 15-way masked chain — branch-free."""
+    sel = tab[0]
+    for c in range(2, 16):
+        sel = select(w == c, tab[c - 1], sel)
+    return sel
+
+
+def dual_mul_windowed(k1, k2, Q, C: CurveOps, g_table: jax.Array):
+    """k1*G + k2*Q — the ECDSA/SM2 verification kernel.
+
+    k1, k2: [16, T] plain-domain scalars (< n); Q: (x, y) field-domain affine
+    (not infinity; garbage lanes are fine — callers mask validity).
+    g_table: device copy of :func:`g_comb_table` ([30, 16]).
+
+    Schedule: 64 scan steps, each 4 doublings + one full addition (Q table)
+    + one mixed addition (G table), all lane-uniform.
     """
-    one = _const(ctx.p.r1, k1)
-    j1 = (P1[0], P1[1], one)
-    j2 = (P2[0], P2[1], one)
-    j12 = jac_add(j1, j2, ctx)
-    bits = (scalar_bits_msb(k1), scalar_bits_msb(k2))
+    F = C.F
+    one = F.one(k1)
+    t1 = (Q[0], Q[1], one)
+    tq_x, tq_y, tq_z = _point_table(t1, C)
+
+    w1 = scalar_windows(k1)[::-1]  # MSB-first [64, T]
+    w2 = scalar_windows(k2)[::-1]
+
     acc0 = jac_infinity(k1)
 
-    def step(acc, bb):
-        b1, b2 = bb
-        acc = jac_double(acc, ctx)
-        w1 = (b1 != 0) & (b2 == 0)
-        w3 = (b1 != 0) & (b2 != 0)
-        ax = select(w3, j12[0], select(w1, j1[0], j2[0]))
-        ay = select(w3, j12[1], select(w1, j1[1], j2[1]))
-        az = select(w3, j12[2], select(w1, j1[2], j2[2]))
-        cx, cy, cz = jac_add(acc, (ax, ay, az), ctx)
-        do = (b1 != 0) | (b2 != 0)
-        return (
-            select(do, cx, acc[0]),
-            select(do, cy, acc[1]),
-            select(do, cz, acc[2]),
-        ), None
+    def step(acc, xs):
+        w1_i, w2_i = xs
+        for _ in range(WINDOW):
+            acc = jac_double(acc, C)
+        # Q term (full Jacobian addition)
+        qx = _select15(tq_x, w2_i)
+        qy = _select15(tq_y, w2_i)
+        qz = _select15(tq_z, w2_i)
+        added = jac_add(acc, (qx, qy, qz), C)
+        acc = select(w2_i == 0, acc, added)
+        # G term (mixed addition against the affine constant table)
+        gx = _select15(g_table[:15][:, :, None], w1_i)  # [16, T]
+        gy = _select15(g_table[15:][:, :, None], w1_i)
+        madded = jac_add_mixed(acc, (gx, gy), C)
+        acc = select(w1_i == 0, acc, madded)
+        return acc, None
 
-    acc, _ = lax.scan(step, acc0, bits)
+    acc, _ = lax.scan(step, acc0, (w1, w2))
     return acc
 
 
-@partial(jax.jit, static_argnames="ctx")
-def scalar_mul(k, P, ctx: CurveCtx):
-    """k*P for affine Montgomery-domain P: plain double-and-add ladder."""
-    one = _const(ctx.p.r1, k)
-    jp = (P[0], P[1], one)
-    acc0 = jac_infinity(k)
+def scalar_mul(k, P, C: CurveOps):
+    """k*P for field-domain affine P — windowed, no G-comb (generic point).
 
-    def step(acc, b):
-        acc = jac_double(acc, ctx)
-        cx, cy, cz = jac_add(acc, jp, ctx)
-        do = b != 0
-        return (
-            select(do, cx, acc[0]),
-            select(do, cy, acc[1]),
-            select(do, cz, acc[2]),
-        ), None
+    Used by tests and non-hot paths; the hot kernels go through
+    :func:`dual_mul_windowed`."""
+    F = C.F
+    one = F.one(k)
+    t1 = (P[0], P[1], one)
+    tq_x, tq_y, tq_z = _point_table(t1, C)
+    w = scalar_windows(k)[::-1]
 
-    acc, _ = lax.scan(step, acc0, scalar_bits_msb(k))
+    def step(acc, w_i):
+        for _ in range(WINDOW):
+            acc = jac_double(acc, C)
+        added = jac_add(
+            acc, (_select15(tq_x, w_i), _select15(tq_y, w_i), _select15(tq_z, w_i)), C
+        )
+        return select(w_i == 0, acc, added), None
+
+    acc, _ = lax.scan(step, jac_infinity(k), w)
     return acc
 
 
-def generator(ctx: CurveCtx, like: jax.Array):
-    """The curve generator broadcast across the batch dims of `like`."""
-    return _const(ctx.gx_m, like), _const(ctx.gy_m, like)
+def generator_affine(C: CurveOps, like: jax.Array):
+    """The curve generator (field domain) broadcast over T."""
+    return (
+        const_rows(C.F.enc(C.curve.gx), like),
+        const_rows(C.F.enc(C.curve.gy), like),
+    )
+
+
+# Re-exported plain-limb helpers used by the signature kernels
+__all__ = [
+    "CurveOps",
+    "SECP256K1_OPS",
+    "SM2_OPS",
+    "jac_double",
+    "jac_add",
+    "jac_add_mixed",
+    "jac_infinity",
+    "jac_to_affine",
+    "on_curve",
+    "valid_scalar",
+    "reduce_mod_n",
+    "add_mod_n",
+    "g_comb_table",
+    "scalar_windows",
+    "dual_mul_windowed",
+    "scalar_mul",
+    "generator_affine",
+    "eq",
+    "is_zero",
+    "lt",
+    "select",
+    "sub_borrow",
+]

@@ -1,0 +1,467 @@
+"""Limb-major 256-bit modular arithmetic — the TPU-native bignum core.
+
+Replaces the reference's CPU bignum (wedpr-crypto Rust FFI / OpenSSL BN behind
+bcos-crypto's secp256k1/SM2 paths) with a formulation shaped for the TPU VPU:
+
+- A 256-bit number is 16 little-endian 16-bit limbs in a uint32 array of
+  shape ``[L, T]`` — **limb-major**: the minor (lane) axis is the batch, so
+  every elementwise op runs at full 128-lane VPU utilization. (The round-1
+  layout ``[B, 16]`` put the 16-limb axis in the lanes — 12.5% utilization —
+  and was the single biggest cost of the 1.36× bench result.)
+- Multiplication is 16 unrolled rows of vector MACs with 16-bit lo/hi
+  splitting (every partial product and column sum stays inside uint32);
+  there are no matmuls — int32 matmul does not map to the MXU.
+- Carry propagation is Kogge–Stone over the limb axis
+  (``lax.associative_scan``, log₂ depth), never a sequential scan.
+- Modular reduction is **pseudo-Mersenne folding** (``FoldField``) for
+  moduli of the form 2^256 − c with small c — secp256k1's p and n both
+  qualify — and word Montgomery (``MontField``) for arbitrary odd moduli
+  (SM2). Both present the same field-ops protocol so the EC layer in
+  :mod:`fisco_bcos_tpu.ops.ec` is generic over them.
+
+Everything here is plain ``jnp`` on values — the same functions run inside a
+Pallas TPU kernel (VMEM-resident, the fast path) and under ordinary XLA on
+CPU (the portable/correctness path); integer semantics make the two
+bit-identical by construction, which is what consensus code requires.
+
+Host-side byte/int conversions stay in :mod:`fisco_bcos_tpu.ops.bigint`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+LIMBS = 16
+LIMB_BITS = 16
+_MASK = jnp.uint32(0xFFFF)
+_R = 1 << 256
+
+
+def int_to_rows(x: int, width: int = LIMBS) -> np.ndarray:
+    """Python int -> [width] uint32 little-endian 16-bit limbs."""
+    if not 0 <= x < 1 << (LIMB_BITS * width):
+        raise ValueError("int_to_rows: out of range")
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & 0xFFFF for i in range(width)], dtype=np.uint32
+    )
+
+
+def rows_to_ints(a) -> list[int]:
+    """[L, T] limbs -> list of T Python ints (host-side, for tests)."""
+    a = np.asarray(a)
+    return [
+        sum(int(a[i, j]) << (LIMB_BITS * i) for i in range(a.shape[0]))
+        for j in range(a.shape[1])
+    ]
+
+
+def dev_vec(arr, dtype=jnp.uint32) -> jax.Array:
+    """1-D host constant -> device vector assembled from scalar constants.
+
+    Pallas kernel bodies may not capture array constants (only scalars), so
+    every host-side table/constant that flows into the shared field code is
+    built this way; XLA constant-folds the stack outside Pallas."""
+    return jnp.stack([jnp.array(int(v), dtype) for v in arr])
+
+
+def const_rows(limbs_np: np.ndarray, t: int | jax.Array) -> jax.Array:
+    """[L] host constant -> [L, T] broadcast (T from an int or a like-array)."""
+    if not isinstance(t, int):
+        t = t.shape[-1]
+    return jnp.stack(
+        [jnp.full((t,), int(v), jnp.uint32) for v in limbs_np]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Carry machinery (Kogge–Stone along the limb axis = axis 0)
+# ---------------------------------------------------------------------------
+
+
+def _gp_combine(x, y):
+    gx, px = x
+    gy, py = y
+    return gy | (py & gx), py & px
+
+
+def _shift_up(x: jax.Array) -> jax.Array:
+    """[L, T] -> [L, T] shifted one limb toward the high end (axis 0)."""
+    return jnp.concatenate([jnp.zeros_like(x[:1]), x[:-1]], axis=0)
+
+
+def _carry_in(g: jax.Array, p: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-position carry/borrow-in from generate/propagate; also returns the
+    final carry-out row (both bool [T])."""
+    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=0)
+    cin = jnp.concatenate([jnp.zeros_like(G[:1]), G[:-1]], axis=0)
+    return cin, G[-1]
+
+
+def carry_norm(cols: jax.Array) -> jax.Array:
+    """Carry-propagate column sums: [L, T] uint32 (each < 2^27) ->
+    [L+1, T] normalized 16-bit limbs (top row = final carry-out)."""
+    cols = jnp.concatenate([cols, jnp.zeros_like(cols[:1])], axis=0)
+    s = (cols & _MASK) + _shift_up(cols >> LIMB_BITS)  # < 2^16 + 2^11
+    t = (s & _MASK) + _shift_up(s >> LIMB_BITS)  # ≤ 2^16; increments {0,1}
+    g = t > _MASK
+    p = t == _MASK
+    cin, _ = _carry_in(g, p)
+    return (t + cin.astype(jnp.uint32)) & _MASK
+
+
+def sub_borrow(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(a - b) limbwise over axis 0 -> (diff [L, T], borrow_out bool [T])."""
+    g = a < b
+    p = a == b
+    bin_, bout = _carry_in(g, p)
+    diff = (a + jnp.uint32(0x10000) - b - bin_.astype(jnp.uint32)) & _MASK
+    return diff, bout
+
+
+def is_zero(a: jax.Array) -> jax.Array:
+    return jnp.all(a == 0, axis=0)
+
+
+def eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.all(a == b, axis=0)
+
+
+def geq(a: jax.Array, b: jax.Array) -> jax.Array:
+    _, borrow = sub_borrow(a, b)
+    return ~borrow
+
+
+def lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    _, borrow = sub_borrow(a, b)
+    return borrow
+
+
+def select(cond: jax.Array, a, b):
+    """cond [T] -> cond ? a : b over [..., T] operands (or tuples of them)."""
+    if isinstance(a, tuple):
+        return tuple(select(cond, x, y) for x, y in zip(a, b))
+    shape = (1,) * (a.ndim - 1) + cond.shape
+    return jnp.where(cond.reshape(shape), a, b)
+
+
+# ---------------------------------------------------------------------------
+# Multiplication (unrolled row MACs with 16-bit splitting; no matmuls)
+# ---------------------------------------------------------------------------
+
+
+def mul_cols(a: jax.Array, b: jax.Array, out: int = 2 * LIMBS) -> jax.Array:
+    """Column sums of a*b: [16, T] x [16, T] -> [out, T] raw columns.
+
+    Column k collects lo16(a_i*b_j) for i+j == k and hi16 for i+j == k-1;
+    every column sum is < 32 * 2^16 < 2^22, inside uint32.
+    """
+    t = a.shape[1]
+    acc = jnp.zeros((out, t), jnp.uint32)
+    for i in range(LIMBS):
+        prod = a[i][None, :] * b  # [16, T], each element < 2^32
+        lo = prod & _MASK
+        hi = prod >> LIMB_BITS
+        n_lo = min(LIMBS, out - i)
+        if n_lo > 0:
+            acc = acc.at[i : i + n_lo].add(lo[:n_lo])
+        n_hi = min(LIMBS, out - i - 1)
+        if n_hi > 0:
+            acc = acc.at[i + 1 : i + 1 + n_hi].add(hi[:n_hi])
+    return acc
+
+
+def mul_const_cols(
+    hi: jax.Array, c_limbs: np.ndarray, out: int
+) -> jax.Array:
+    """Column sums of hi * c for a small host constant c: [H, T] x [C] ->
+    [out, T] raw columns (same lo/hi splitting as :func:`mul_cols`)."""
+    t = hi.shape[1]
+    h = hi.shape[0]
+    acc = jnp.zeros((out, t), jnp.uint32)
+    for k, cval in enumerate(np.asarray(c_limbs, dtype=np.uint64)):
+        cval = int(cval)
+        if cval == 0:
+            continue
+        prod = hi * jnp.uint32(cval)  # < 2^32
+        lo = prod & _MASK
+        hi16 = prod >> LIMB_BITS
+        n_lo = min(h, out - k)
+        if n_lo > 0:
+            acc = acc.at[k : k + n_lo].add(lo[:n_lo])
+        n_hi = min(h, out - k - 1)
+        if n_hi > 0:
+            acc = acc.at[k + 1 : k + 1 + n_hi].add(hi16[:n_hi])
+    return acc
+
+
+def add_widen(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Exact add of two normalized arrays (equal or different widths) ->
+    [max(L)+1, T] normalized."""
+    w = max(a.shape[0], b.shape[0])
+    t = a.shape[1]
+
+    def pad(x):
+        if x.shape[0] == w:
+            return x
+        return jnp.concatenate(
+            [x, jnp.zeros((w - x.shape[0], t), jnp.uint32)], axis=0
+        )
+
+    return carry_norm(pad(a) + pad(b))
+
+
+def cond_sub(x: jax.Array, m_limbs: np.ndarray) -> jax.Array:
+    """x - m if x >= m else x, for normalized x < 2m. Returns [16, T]."""
+    w = x.shape[0]
+    m_pad = np.zeros(w, dtype=np.uint32)
+    m_pad[: LIMBS] = m_limbs
+    mc = const_rows(m_pad, x)
+    diff, borrow = sub_borrow(x, mc)
+    return select(~borrow, diff, x)[:LIMBS]
+
+
+# ---------------------------------------------------------------------------
+# Field protocols
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FoldField:
+    """GF(m) for pseudo-Mersenne m = 2^256 - c (c ≤ ~2^130): plain-domain
+    values, reduction by folding hi*c back into the low words.
+
+    secp256k1's p (c = 2^32 + 977) and n (c ≈ 1.27*2^128) both qualify —
+    this is the fast path for the north-star kernel, replacing the generic
+    Montgomery REDC of round 1 (3 wide products per mul) with one wide
+    product plus cheap constant folds.
+    """
+
+    m_int: int
+    c_limbs: np.ndarray = field(repr=False)
+    m_limbs: np.ndarray = field(repr=False)
+
+    def __hash__(self):
+        return hash(("fold", self.m_int))
+
+    def __eq__(self, other):
+        return isinstance(other, FoldField) and other.m_int == self.m_int
+
+    # -- domain conversions (plain domain: all identity) --
+    def enc(self, v: int) -> np.ndarray:
+        return int_to_rows(v % self.m_int)
+
+    def from_plain(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def to_plain(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def one(self, t) -> jax.Array:
+        return const_rows(int_to_rows(1), t)
+
+    # -- reduction --
+    def reduce_wide(self, x: jax.Array, bound: int) -> jax.Array:
+        """x (normalized limbs, value < bound, bound exclusive) -> x mod m.
+
+        Folds value = lo + hi*2^256 ≡ lo + hi*c (mod m) until the static
+        value bound drops below 2m, then one conditional subtract. Any
+        contribution the static column clamp drops is provably zero (a
+        nonzero write at column k implies value ≥ 2^(16k) > bound).
+        """
+        c_int = _R - self.m_int
+        while bound > 2 * self.m_int:
+            lo, hi = x[:LIMBS], x[LIMBS:]
+            if hi.shape[0] == 0:
+                break
+            hi_max = (bound - 1) >> 256
+            bound = (_R - 1) + hi_max * c_int + 1
+            width = max((bound - 1).bit_length() + 15, 17 * 16) // 16
+            cols = mul_const_cols(hi, self.c_limbs, width)
+            cols = cols.at[:LIMBS].add(lo)
+            x = carry_norm(cols)[:width]
+        return cond_sub(x, self.m_limbs)
+
+    def reduce1(self, x: jax.Array) -> jax.Array:
+        """x < 2m (16 limbs) -> x mod m (one conditional subtract)."""
+        return cond_sub(x, self.m_limbs)
+
+    # -- field ops --
+    def mul(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        wide = carry_norm(mul_cols(a, b))[: 2 * LIMBS]
+        return self.reduce_wide(wide, (_R - 1) ** 2 + 1)
+
+    def sqr(self, a: jax.Array) -> jax.Array:
+        return self.mul(a, a)
+
+    def add(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return cond_sub(add_widen(a, b), self.m_limbs)
+
+    def sub(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        diff, borrow = sub_borrow(a, b)
+        plus = add_widen(diff, const_rows(self.m_limbs, a))[:LIMBS]
+        return select(borrow, plus, diff)
+
+    def neg(self, a: jax.Array) -> jax.Array:
+        return self.sub(jnp.zeros_like(a), a)
+
+    def inv(self, a: jax.Array) -> jax.Array:
+        """a^-1 mod m for prime m (Fermat); 0 -> 0."""
+        return pow_static(self, a, self.m_int - 2)
+
+    def sqrt(self, a: jax.Array) -> jax.Array:
+        """Square root candidate for m ≡ 3 (mod 4): a^((m+1)/4). Caller must
+        check sqr(result) == a to detect non-residues."""
+        assert self.m_int % 4 == 3
+        return pow_static(self, a, (self.m_int + 1) // 4)
+
+
+def make_fold_field(m: int) -> FoldField:
+    c = _R - m
+    if not 0 < c < 1 << 132:
+        raise ValueError("FoldField needs m = 2^256 - c with small c")
+    nc = (c.bit_length() + 15) // 16
+    return FoldField(
+        m_int=m, c_limbs=int_to_rows(c, nc), m_limbs=int_to_rows(m)
+    )
+
+
+@dataclass(frozen=True)
+class MontField:
+    """GF(m) for arbitrary odd m < 2^256: Montgomery-domain values (x·R mod m,
+    R = 2^256), word REDC reduction. The generic path (SM2's p and n)."""
+
+    m_int: int
+    m_limbs: np.ndarray = field(repr=False)
+    mprime: np.ndarray = field(repr=False)  # -m^-1 mod 2^256
+    r1: np.ndarray = field(repr=False)  # R mod m (the field's 1)
+    r2: np.ndarray = field(repr=False)  # R^2 mod m
+
+    def __hash__(self):
+        return hash(("mont", self.m_int))
+
+    def __eq__(self, other):
+        return isinstance(other, MontField) and other.m_int == self.m_int
+
+    def enc(self, v: int) -> np.ndarray:
+        return int_to_rows((v % self.m_int) * _R % self.m_int)
+
+    def one(self, t) -> jax.Array:
+        return const_rows(self.r1, t)
+
+    def redc(self, t: jax.Array) -> jax.Array:
+        """t [32, T] (t < m*R) -> t*R^-1 mod m, [16, T]."""
+        m_val = carry_norm(
+            mul_cols(t[:LIMBS], const_rows(self.mprime, t), out=LIMBS)
+        )[:LIMBS]
+        mm = carry_norm(mul_cols(m_val, const_rows(self.m_limbs, t)))[
+            : 2 * LIMBS
+        ]
+        s = add_widen(t, mm)  # [33, T]; low 16 limbs are zero
+        return cond_sub(s[LIMBS:], self.m_limbs)
+
+    def from_plain(self, x: jax.Array) -> jax.Array:
+        return self.mul(x, const_rows(self.r2, x))
+
+    def to_plain(self, x: jax.Array) -> jax.Array:
+        pad = jnp.zeros((LIMBS, x.shape[1]), jnp.uint32)
+        return self.redc(jnp.concatenate([x, pad], axis=0))
+
+    def mul(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return self.redc(carry_norm(mul_cols(a, b))[: 2 * LIMBS])
+
+    def sqr(self, a: jax.Array) -> jax.Array:
+        return self.mul(a, a)
+
+    def add(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return cond_sub(add_widen(a, b), self.m_limbs)
+
+    def sub(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        diff, borrow = sub_borrow(a, b)
+        plus = add_widen(diff, const_rows(self.m_limbs, a))[:LIMBS]
+        return select(borrow, plus, diff)
+
+    def neg(self, a: jax.Array) -> jax.Array:
+        return self.sub(jnp.zeros_like(a), a)
+
+    def inv(self, a: jax.Array) -> jax.Array:
+        return pow_static(self, a, self.m_int - 2)
+
+    def sqrt(self, a: jax.Array) -> jax.Array:
+        assert self.m_int % 4 == 3
+        return pow_static(self, a, (self.m_int + 1) // 4)
+
+
+@lru_cache(maxsize=None)
+def make_mont_field(m: int) -> MontField:
+    if m % 2 == 0 or not 2 < m < _R:
+        raise ValueError("modulus must be odd and < 2^256")
+    return MontField(
+        m_int=m,
+        m_limbs=int_to_rows(m),
+        mprime=int_to_rows((-pow(m, -1, _R)) % _R),
+        r1=int_to_rows(_R % m),
+        r2=int_to_rows(_R * _R % m),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Windowed exponentiation with a static exponent
+# ---------------------------------------------------------------------------
+
+_POW_W = 4
+
+
+def _exp_windows(e: int) -> np.ndarray:
+    """Static exponent -> MSB-first 4-bit windows (leading zeros stripped)."""
+    if e <= 0:
+        raise ValueError("pow_static needs a positive exponent")
+    nw = (e.bit_length() + _POW_W - 1) // _POW_W
+    return np.array(
+        [(e >> (_POW_W * i)) & 0xF for i in range(nw - 1, -1, -1)],
+        dtype=np.uint32,
+    )
+
+
+def pow_static(F, a: jax.Array, e: int) -> jax.Array:
+    """a^e in field F for a fixed Python-int exponent.
+
+    4-bit windows, MSB first: per window 4 squarings + one table multiply
+    selected branch-free from the 15 precomputed odd/even powers. The loop is
+    a ``fori_loop`` so the compiled program stays small; the table select is
+    a 15-way masked chain (lane-uniform schedule, data only in selects).
+    """
+    wins = _exp_windows(e)
+
+    # table[c-1] = a^c for c in 1..15, built as a scan (14 sequential muls
+    # with a uniform body keep the traced program small — compile time
+    # matters on both the XLA-CPU and Mosaic paths)
+    def _tab_step(prev, _):
+        nxt = F.mul(prev, a)
+        return nxt, nxt
+
+    _, rest = lax.scan(_tab_step, a, None, length=14)
+    tab = jnp.concatenate([a[None], rest], axis=0)  # [15, 16, T]
+
+    first = int(wins[0])
+    assert first != 0
+    acc0 = tab[first - 1]
+    if len(wins) == 1:
+        return acc0
+
+    def body(acc, c):
+        for _ in range(_POW_W):
+            acc = F.sqr(acc)
+        sel = tab[0]
+        for k in range(2, 16):
+            sel = select(c == k, tab[k - 1], sel)
+        with_mul = F.mul(acc, sel)
+        return select(c == 0, acc, with_mul), None
+
+    acc, _ = lax.scan(body, acc0, dev_vec(wins[1:]))
+    return acc

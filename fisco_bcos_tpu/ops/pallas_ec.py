@@ -1,0 +1,170 @@
+"""Pallas TPU kernels for the batch EC signature programs.
+
+The ``*_core`` bodies in :mod:`fisco_bcos_tpu.ops.secp256k1` are plain jnp
+over limb-major ``[16, T]`` tiles, so the whole program — pseudo-Mersenne
+field folds, Fermat inversions, the windowed ladder with its comb table —
+runs inside one ``pallas_call`` with every intermediate VMEM-resident. Under
+plain XLA the same chain of ~5k elementwise ops round-trips each [16, B]
+intermediate through HBM; keeping it on-chip is worth an order of magnitude
+(this was the main lever for the round-2 north-star target).
+
+Grid: 1-D over batch tiles of ``TILE`` lanes; each program owns [16, TILE]
+blocks of every operand. The affine G window table ([30, 16] uint32) is
+replicated into VMEM for every program.
+
+CPU/virtual-mesh execution never routes here (see ``_use_pallas``) — the XLA
+path produces bit-identical results by integer semantics.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MAX_TILE = 512
+MIN_TILE = 128
+
+# Test hook: run the kernels through the Pallas interpreter (CPU) so kernel
+# semantics and the no-captured-constants restriction are exercised without
+# TPU hardware. Toggled by tests; never set on the hot path.
+INTERPRET = False
+
+
+def _tile(b: int) -> int:
+    for t in (MAX_TILE, 256, MIN_TILE):
+        if b % t == 0:
+            return t
+    raise ValueError(f"pallas EC batch must be a multiple of {MIN_TILE}, got {b}")
+
+
+def _pad_lanes(x: jnp.ndarray, b_pad: int) -> jnp.ndarray:
+    """Zero-pad the lane (minor) axis of [rows, B] to b_pad."""
+    if x.shape[-1] == b_pad:
+        return x
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, b_pad - x.shape[-1])])
+
+
+def _recover_kernel(z_ref, r_ref, s_ref, v_ref, gt_ref, qx_ref, qy_ref, ok_ref):
+    from .secp256k1 import recover_core
+
+    qx, qy, ok = recover_core(
+        z_ref[:], r_ref[:], s_ref[:], v_ref[0], gt_ref[:]
+    )
+    qx_ref[:] = qx
+    qy_ref[:] = qy
+    ok_ref[0] = ok.astype(jnp.int32)
+
+
+def _verify_kernel(z_ref, r_ref, s_ref, qx_ref, qy_ref, gt_ref, ok_ref):
+    from .secp256k1 import verify_core
+
+    ok = verify_core(
+        z_ref[:], r_ref[:], s_ref[:], qx_ref[:], qy_ref[:], gt_ref[:]
+    )
+    ok_ref[0] = ok.astype(jnp.int32)
+
+
+def _limb_spec(tile: int):
+    return pl.BlockSpec((16, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+
+def _row_spec(tile: int):
+    return pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+
+def _gt_spec():
+    return pl.BlockSpec((30, 16), lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+
+@lru_cache(maxsize=None)
+def _recover_call(b: int, interpret: bool = False):
+    tile = _tile(b)
+
+    @jax.jit
+    def run(z, r, s, v, gt):
+        qx, qy, ok = pl.pallas_call(
+            _recover_kernel,
+            interpret=interpret,
+            grid=(b // tile,),
+            in_specs=[
+                _limb_spec(tile),
+                _limb_spec(tile),
+                _limb_spec(tile),
+                _row_spec(tile),
+                _gt_spec(),
+            ],
+            out_specs=(
+                _limb_spec(tile),
+                _limb_spec(tile),
+                _row_spec(tile),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((16, b), jnp.uint32),
+                jax.ShapeDtypeStruct((16, b), jnp.uint32),
+                jax.ShapeDtypeStruct((1, b), jnp.int32),
+            ),
+        )(z, r, s, v, gt)
+        return qx.T, qy.T, ok[0] != 0
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _verify_call(b: int, interpret: bool = False):
+    tile = _tile(b)
+
+    @jax.jit
+    def run(z, r, s, qx, qy, gt):
+        ok = pl.pallas_call(
+            _verify_kernel,
+            interpret=interpret,
+            grid=(b // tile,),
+            in_specs=[_limb_spec(tile)] * 5 + [_gt_spec()],
+            out_specs=_row_spec(tile),
+            out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+        )(z, r, s, qx, qy, gt)
+        return ok[0] != 0
+
+    return run
+
+
+def recover_pallas(z, r, s, v):
+    """[B, 16] batch-major limbs + [B] v -> (qx, qy [B, 16], ok bool[B])."""
+    from .ec import g_comb_table
+    from .secp256k1 import SECP256K1_OPS
+
+    b = z.shape[0]
+    b_pad = max(MIN_TILE, -(-b // MIN_TILE) * MIN_TILE)
+    gt = jnp.asarray(g_comb_table(SECP256K1_OPS.name))
+    qx, qy, ok = _recover_call(b_pad, INTERPRET)(
+        _pad_lanes(jnp.asarray(z).T, b_pad),
+        _pad_lanes(jnp.asarray(r).T, b_pad),
+        _pad_lanes(jnp.asarray(s).T, b_pad),
+        _pad_lanes(jnp.asarray(v).reshape(1, b).astype(jnp.int32), b_pad),
+        gt,
+    )
+    return qx[:b], qy[:b], ok[:b]
+
+
+def verify_pallas(z, r, s, qx, qy):
+    """[B, 16] batch-major limb inputs -> ok bool[B]."""
+    from .ec import g_comb_table
+    from .secp256k1 import SECP256K1_OPS
+
+    b = z.shape[0]
+    b_pad = max(MIN_TILE, -(-b // MIN_TILE) * MIN_TILE)
+    gt = jnp.asarray(g_comb_table(SECP256K1_OPS.name))
+    ok = _verify_call(b_pad, INTERPRET)(
+        _pad_lanes(jnp.asarray(z).T, b_pad),
+        _pad_lanes(jnp.asarray(r).T, b_pad),
+        _pad_lanes(jnp.asarray(s).T, b_pad),
+        _pad_lanes(jnp.asarray(qx).T, b_pad),
+        _pad_lanes(jnp.asarray(qy).T, b_pad),
+        gt,
+    )
+    return ok[:b]

@@ -8,15 +8,21 @@ and the PBFT/BlockSync signature-list check
 (bcos-pbft/bcos-pbft/core/BlockValidator.cpp:141-177) invoke one tx at a time on
 CPU threads. Here a whole block's signatures are one device program.
 
+Two execution paths share one body (bit-identical by integer semantics):
+- **Pallas TPU kernel** (:mod:`fisco_bcos_tpu.ops.pallas_ec`): the entire
+  recover/verify program — field folds, windowed ladder, comb table — runs
+  VMEM-resident over batch tiles. This is the fast path.
+- **Plain XLA**: the same ``*_core`` functions jitted directly; used on CPU
+  (tests, the virtual multi-chip mesh) and as fallback.
+
 Semantics match the reference:
 - 65-byte signature r‖s‖v; v ∈ {0..3} or {27, 28} (Secp256k1Crypto.cpp:106-108).
 - recover returns the uncompressed public key (x‖y, 64 bytes); the sender
-  address is right160(keccak256(pubkey)) (CryptoSuite.h:56-59) — address
-  derivation lives in fisco_bcos_tpu.crypto.suite, on top of the keccak kernel.
+  address is right160(keccak256(pubkey)) (CryptoSuite.h:56-59).
 
 Invalid lanes never raise: every failure mode (bad range, off-curve pubkey,
-non-residue x, infinity result) lowers a validity bit, so one compiled program
-serves adversarial and honest inputs alike — mandatory for consensus code.
+non-residue x, infinity result) lowers a validity bit — one compiled program
+serves adversarial and honest inputs alike, mandatory for consensus code.
 """
 
 from __future__ import annotations
@@ -25,112 +31,139 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import bigint
-from .bigint import bytes_be_to_limbs, from_mont, limbs_to_bytes_be, to_mont
-from .hash_common import bucket_batch as _bucket
-from .hash_common import pad_rows as _pad_rows
+from . import limb
+from .bigint import bytes_be_to_limbs, limbs_to_bytes_be
 from .ec import (
-    SECP256K1_CTX,
-    generator,
-    inv_mod,
+    SECP256K1_OPS,
+    dual_mul_windowed,
+    g_comb_table,
     jac_to_affine,
-    lt,
-    mulmod,
-    negmod,
-    on_curve_mont,
-    reduce_once,
-    shamir_double_mul,
-    sqrt_mont,
+    on_curve,
+    reduce_mod_n,
     valid_scalar,
 )
+from .hash_common import bucket_batch as _bucket
+from .hash_common import pad_rows as _pad_rows
+from .limb import const_rows, eq, is_zero, lt, select
 
-_CTX = SECP256K1_CTX
+_C = SECP256K1_OPS
 
 
-@jax.jit
-def verify_device(z, r, s, qx, qy):
-    """Batch ECDSA verify. All inputs [..., 16] plain-domain limbs.
+def _g_table() -> jnp.ndarray:
+    return jnp.asarray(g_comb_table(_C.name))
 
-    z: message hash; (r, s): signature; (qx, qy): affine public key.
-    Returns bool[...]: signature valid.
+
+# ---------------------------------------------------------------------------
+# Core bodies (limb-major [16, T]; run under Pallas or plain XLA)
+# ---------------------------------------------------------------------------
+
+
+def verify_core(z, r, s, qx, qy, g_table):
+    """Batch ECDSA verify. z/r/s/qx/qy: [16, T] plain-domain limb-major.
+
+    Returns bool[T]: signature valid.
     """
-    ctx = _CTX
-    p_arr = bigint._const(ctx.p.limbs, qx)
-    valid = valid_scalar(r, ctx) & valid_scalar(s, ctx)
-    valid &= lt(qx, p_arr) & lt(qy, p_arr)
-    qx_m = to_mont(qx, ctx.p)
-    qy_m = to_mont(qy, ctx.p)
-    valid &= on_curve_mont(qx_m, qy_m, ctx)
-    z_n = reduce_once(z, ctx.n)
-    w = inv_mod(s, ctx.n)
-    u1 = mulmod(z_n, w, ctx.n)
-    u2 = mulmod(r, w, ctx.n)
-    R = shamir_double_mul(u1, generator(ctx, qx), u2, (qx_m, qy_m), ctx)
-    x_m, _, inf = jac_to_affine(R, ctx)
-    x_aff = from_mont(x_m, ctx.p)
-    x_n = reduce_once(x_aff, ctx.n)
-    return valid & ~inf & bigint.eq(x_n, r)
+    C = _C
+    F, Fn = C.F, C.Fn
+    p_rows = const_rows(C.p_limbs, z)
+    valid = valid_scalar(r, C) & valid_scalar(s, C)
+    valid &= lt(qx, p_rows) & lt(qy, p_rows)
+    qx_e = F.from_plain(qx)
+    qy_e = F.from_plain(qy)
+    valid &= on_curve(qx_e, qy_e, C)
+    z_n = reduce_mod_n(z, C)
+    w = Fn.inv(s)
+    u1 = Fn.mul(z_n, w)
+    u2 = Fn.mul(reduce_mod_n(r, C), w)
+    R = dual_mul_windowed(u1, u2, (qx_e, qy_e), C, g_table)
+    x_e, _, inf = jac_to_affine(R, C)
+    x_n = reduce_mod_n(F.to_plain(x_e), C)
+    return valid & ~inf & eq(x_n, r)
 
 
-@jax.jit
-def recover_device(z, r, s, v):
+def recover_core(z, r, s, v, g_table):
     """Batch ECDSA public-key recovery.
 
-    z, r, s: [..., 16] plain-domain limbs; v: [...] int32 recovery id
-    (0..3, or 27/28 per the reference's accepted encodings).
-    Returns (qx, qy, ok): plain-domain affine pubkey limbs + validity mask.
-    Invalid lanes return qx = qy = 0.
+    z, r, s: [16, T] plain limb-major; v: [T] int32 recovery id (0..3 or
+    27/28, exactly the reference's accepted encodings —
+    Secp256k1Crypto.cpp:106; 29/30 must NOT alias to 2/3).
+    Returns (qx, qy [16, T] plain limbs, ok bool[T]); invalid lanes 0.
     """
-    ctx = _CTX
-    # Exactly the reference's accepted encodings (Secp256k1Crypto.cpp:106):
-    # raw recid 0..3, or v in {27, 28}. 29/30 must NOT alias to 2/3 — the
-    # reference rejects them, and any acceptance difference forks the chain.
+    C = _C
+    F, Fn = C.F, C.Fn
     valid = ((v >= 0) & (v <= 3)) | ((v >= 27) & (v <= 28))
     v = jnp.where(v >= 27, v - 27, v)
-    valid &= valid_scalar(r, ctx) & valid_scalar(s, ctx)
+    valid &= valid_scalar(r, C) & valid_scalar(s, C)
     # x = r + (v & 2 ? n : 0); reject overflow past 2^256 or x >= p
-    n_or_0 = jnp.where(
-        ((v & 2) != 0)[..., None],
-        bigint._const(ctx.n.limbs, r),
-        jnp.zeros_like(r),
+    n_or_0 = select(
+        (v & 2) != 0, const_rows(C.n_limbs, r), jnp.zeros_like(r)
     )
-    x17 = bigint._add_raw(r, n_or_0)  # [..., 17]
-    overflow = x17[..., 16] != 0
-    x = x17[..., :16]
-    p_arr = bigint._const(ctx.p.limbs, r)
-    valid &= ~overflow & lt(x, p_arr)
-    # y from the curve equation y^2 = x^3 + b (a = 0); p ≡ 3 (mod 4) so
-    # sqrt = pow((p+1)/4)
-    x_m = to_mont(x, ctx.p)
-    y2_m = bigint.add_mod(
-        bigint.mont_mul(bigint.mont_sqr(x_m, ctx.p), x_m, ctx.p),
-        bigint._const(ctx.b_m, x_m),
-        ctx.p,
-    )
-    y_m = sqrt_mont(y2_m, ctx)
-    valid &= bigint.eq(bigint.mont_sqr(y_m, ctx.p), y2_m)  # x^3+b must be a QR
-    y_plain = from_mont(y_m, ctx.p)
-    flip = (y_plain[..., 0] & 1).astype(jnp.int32) != (v & 1)
-    y_m = jnp.where(flip[..., None], bigint.sub_mod(jnp.zeros_like(y_m), y_m, ctx.p), y_m)
+    x17 = limb.add_widen(r, n_or_0)  # [17, T]
+    overflow = x17[16] != 0
+    x = x17[:16]
+    valid &= ~overflow & lt(x, const_rows(C.p_limbs, r))
+    # y from the curve equation y^2 = x^3 + b (a = 0); p ≡ 3 (mod 4)
+    y2 = F.add(F.mul(F.sqr(x), x), const_rows(C.b_enc, x))
+    y = F.sqrt(y2)
+    valid &= eq(F.sqr(y), y2)  # x^3 + b must be a quadratic residue
+    flip = (y[0] & 1).astype(jnp.int32) != (v & 1)  # plain-domain parity
+    y = select(flip, F.neg(y), y)
     # Q = r^-1 * (s*R - z*G)
-    rinv = inv_mod(r, ctx.n)
-    z_n = reduce_once(z, ctx.n)
-    u1 = negmod(mulmod(z_n, rinv, ctx.n), ctx.n)
-    u2 = mulmod(s, rinv, ctx.n)
-    Q = shamir_double_mul(u1, generator(ctx, r), u2, (x_m, y_m), ctx)
-    qx_m, qy_m, inf = jac_to_affine(Q, ctx)
+    rinv = Fn.inv(r)
+    z_n = reduce_mod_n(z, C)
+    u1 = Fn.neg(Fn.mul(z_n, rinv))
+    u2 = Fn.mul(s, rinv)
+    Q = dual_mul_windowed(u1, u2, (x, y), C, g_table)
+    qx_e, qy_e, inf = jac_to_affine(Q, C)
     valid &= ~inf
-    qx = from_mont(qx_m, ctx.p)
-    qy = from_mont(qy_m, ctx.p)
-    zero = jnp.zeros_like(qx)
-    qx = jnp.where(valid[..., None], qx, zero)
-    qy = jnp.where(valid[..., None], qy, zero)
+    qx = select(valid, F.to_plain(qx_e), jnp.zeros_like(x))
+    qy = select(valid, F.to_plain(qy_e), jnp.zeros_like(x))
     return qx, qy, valid
 
 
 # ---------------------------------------------------------------------------
-# Host wrappers (bytes in / bytes out, batch padded per hash_common._bucket:
-# powers of two up to 2048, then multiples of 2048)
+# Device entry points ([B, 16] batch-major public API, kept from round 1)
+# ---------------------------------------------------------------------------
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@jax.jit
+def _verify_xla(z, r, s, qx, qy):
+    ok = verify_core(z.T, r.T, s.T, qx.T, qy.T, _g_table())
+    return ok
+
+
+@jax.jit
+def _recover_xla(z, r, s, v):
+    qx, qy, ok = recover_core(z.T, r.T, s.T, v, _g_table())
+    return qx.T, qy.T, ok
+
+
+def verify_device(z, r, s, qx, qy):
+    """Batch ECDSA verify. All inputs [B, 16] plain-domain limbs (batch
+    major); returns bool[B]."""
+    if _use_pallas():
+        from .pallas_ec import verify_pallas
+
+        return verify_pallas(z, r, s, qx, qy)
+    return _verify_xla(z, r, s, qx, qy)
+
+
+def recover_device(z, r, s, v):
+    """Batch ECDSA recover. z/r/s: [B, 16] limbs; v: [B] int32.
+    Returns (qx, qy [B, 16] plain limbs, ok bool[B])."""
+    if _use_pallas():
+        from .pallas_ec import recover_pallas
+
+        return recover_pallas(z, r, s, v)
+    return _recover_xla(z, r, s, v)
+
+
+# ---------------------------------------------------------------------------
+# Host wrappers (bytes in / bytes out, batch padded per hash_common._bucket)
 # ---------------------------------------------------------------------------
 
 

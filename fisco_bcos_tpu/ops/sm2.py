@@ -12,6 +12,11 @@ messages, so e-derivation itself runs on the batch SM3 kernel.
 
 Verification: t = (r + s) mod n (t ≠ 0); (x1, y1) = s*G + t*Q;
 valid iff (e + x1) mod n == r.
+
+The EC plane is the limb-major windowed ladder shared with secp256k1
+(:mod:`fisco_bcos_tpu.ops.ec`); SM2's prime has a 225-bit complement, so the
+field is the generic Montgomery path (``limb.MontField``) rather than the
+pseudo-Mersenne fold.
 """
 
 from __future__ import annotations
@@ -21,47 +26,54 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto.ref.ecdsa import SM2_DEFAULT_ID
-from . import bigint
-from .bigint import bytes_be_to_limbs, from_mont, is_zero, to_mont
-from .hash_common import bucket_batch as _bucket
-from .hash_common import pad_rows as _pad_rows
+from .bigint import bytes_be_to_limbs
 from .ec import (
-    SM2_CTX,
-    generator,
+    SM2_OPS,
+    add_mod_n,
+    dual_mul_windowed,
+    g_comb_table,
     jac_to_affine,
-    lt,
-    on_curve_mont,
-    reduce_once,
-    shamir_double_mul,
+    on_curve,
+    reduce_mod_n,
     valid_scalar,
 )
+from .hash_common import bucket_batch as _bucket
+from .hash_common import pad_rows as _pad_rows
+from .limb import const_rows, eq, is_zero, lt
 from .sm3 import sm3_batch
 
-_CTX = SM2_CTX
+_C = SM2_OPS
+
+
+def verify_core(e, r, s, qx, qy, g_table):
+    """Batch SM2 verify, limb-major [16, T] plain-domain inputs.
+
+    e: SM3(ZA ‖ M) digest as an integer; (r, s): signature; (qx, qy): affine
+    public key. Returns bool[T]. Runs under Pallas or plain XLA.
+    """
+    C = _C
+    F = C.F
+    p_rows = const_rows(C.p_limbs, e)
+    valid = valid_scalar(r, C) & valid_scalar(s, C)
+    valid &= lt(qx, p_rows) & lt(qy, p_rows)
+    qx_e = F.from_plain(qx)
+    qy_e = F.from_plain(qy)
+    valid &= on_curve(qx_e, qy_e, C)
+    t = add_mod_n(reduce_mod_n(r, C), s, C)
+    valid &= ~is_zero(t)
+    P1 = dual_mul_windowed(s, t, (qx_e, qy_e), C, g_table)
+    x1_e, _, inf = jac_to_affine(P1, C)
+    x1 = reduce_mod_n(F.to_plain(x1_e), C)
+    e_n = reduce_mod_n(e, C)
+    R = add_mod_n(e_n, x1, C)
+    return valid & ~inf & eq(R, r)
 
 
 @jax.jit
 def verify_device(e, r, s, qx, qy):
-    """Batch SM2 verify. All inputs [..., 16] plain-domain limbs.
-
-    e: SM3(ZA ‖ M) digest as an integer; (r, s): signature; (qx, qy): affine
-    public key. Returns bool[...].
-    """
-    ctx = _CTX
-    p_arr = bigint._const(ctx.p.limbs, qx)
-    valid = valid_scalar(r, ctx) & valid_scalar(s, ctx)
-    valid &= lt(qx, p_arr) & lt(qy, p_arr)
-    qx_m = to_mont(qx, ctx.p)
-    qy_m = to_mont(qy, ctx.p)
-    valid &= on_curve_mont(qx_m, qy_m, ctx)
-    t = bigint.add_mod(r, s, ctx.n)
-    valid &= ~is_zero(t)
-    P1 = shamir_double_mul(s, generator(ctx, qx), t, (qx_m, qy_m), ctx)
-    x1_m, _, inf = jac_to_affine(P1, ctx)
-    x1 = reduce_once(from_mont(x1_m, ctx.p), ctx.n)
-    e_n = reduce_once(e, ctx.n)
-    R = bigint.add_mod(e_n, x1, ctx.n)
-    return valid & ~inf & bigint.eq(R, r)
+    """Batch SM2 verify. All inputs [B, 16] plain-domain batch-major limbs."""
+    gt = jnp.asarray(g_comb_table(_C.name))
+    return verify_core(e.T, r.T, s.T, qx.T, qy.T, gt)
 
 
 # ---------------------------------------------------------------------------
@@ -77,7 +89,7 @@ def sm2_e_batch(
     ZA inputs are fixed-length, so both SM3 passes run on the device kernel."""
     msg_hashes = np.asarray(msg_hashes, dtype=np.uint8)
     pubkeys = np.asarray(pubkeys, dtype=np.uint8)
-    c = _CTX.curve
+    c = _C.curve
     entl = (len(user_id) * 8).to_bytes(2, "big")
     prefix = np.frombuffer(
         entl

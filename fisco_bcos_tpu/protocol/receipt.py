@@ -15,18 +15,37 @@ from ..crypto.suite import CryptoSuite
 
 
 class TransactionStatus(IntEnum):
+    """Values match bcos-protocol/TransactionStatus.h:32-63 exactly — they
+    are visible through receipts and the RPC API."""
+
     NONE = 0
     UNKNOWN = 1
     OUT_OF_GAS_LIMIT = 2
     NOT_ENOUGH_CASH = 7
     BAD_INSTRUCTION = 10
-    REVERT_INSTRUCTION = 12
-    STACK_OVERFLOW = 14
-    STACK_UNDERFLOW = 15
-    PRECOMPILED_ERROR = 24
-    INTERNAL_ERROR = 25
-    TYPE_ERROR = 26
-    CREATE_SYSTEM_RESERVED_ADDRESS = 27
+    BAD_JUMP_DESTINATION = 11
+    OUT_OF_GAS = 12
+    OUT_OF_STACK = 13
+    STACK_UNDERFLOW = 14
+    PRECOMPILED_ERROR = 15
+    REVERT_INSTRUCTION = 16
+    CONTRACT_ADDRESS_ALREADY_USED = 17
+    PERMISSION_DENIED = 18
+    CALL_ADDRESS_ERROR = 19
+    GAS_OVERFLOW = 20
+    CONTRACT_FROZEN = 21
+    ACCOUNT_FROZEN = 22
+    ACCOUNT_ABOLISHED = 23
+    # txpool admission errors (TransactionStatus.h:54-63)
+    NONCE_CHECK_FAIL = 10000
+    BLOCK_LIMIT_CHECK_FAIL = 10001
+    TXPOOL_IS_FULL = 10002
+    MALFORM = 10003
+    ALREADY_IN_TXPOOL = 10004
+    TX_ALREADY_IN_CHAIN = 10005
+    INVALID_CHAIN_ID = 10006
+    INVALID_GROUP_ID = 10007
+    INVALID_SIGNATURE = 10008
 
 
 @dataclass

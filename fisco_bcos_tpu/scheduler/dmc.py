@@ -4,16 +4,30 @@ Reference: bcos-scheduler/src/BlockExecutive.cpp DMCExecute:832-996 (round
 loop: per-contract DmcExecutor::go under tbb, join batch status, paused ⇒
 next round), DmcExecutor.cpp (per-(executor, contract) message pools, status
 ERROR/NEED_PREPARE/PAUSED/FINISHED, cross-contract calls migrating messages
-via schedulerOut), DmcStepRecorder.h:15-60 (per-round checksums of every
-message sent/received — the cross-executor nondeterminism detector).
+via f_onSchedulerOut :239), GraphKeyLocks.{h,cpp} (wait-for graph, deadlock
+revert), DmcStepRecorder.h:15-60 (per-round checksums of every message sent/
+received — the cross-executor nondeterminism detector).
 
 This is the "state sharded by contract address across executors" axis of the
-reference's parallelism inventory (SURVEY.md §2.8). Executors are
-ExecutorShard objects (in-process here; the interface is what a remote
-executor service implements). Each round: every shard executes its pending
-txs against its own state view; cross-contract calls pause the tx and
-migrate a message to the target contract's shard; the scheduler joins round
-results, detects deadlocks on key locks, and loops until all finish.
+reference's parallelism inventory (SURVEY.md §2.8). The live path:
+
+- A tx starts an :class:`~fisco_bcos_tpu.executor.executor.Executive` on its
+  contract's shard. When the contract calls a contract on ANOTHER shard, the
+  executive **pauses** (generator parked) and a MESSAGE migrates to the
+  target shard, where it runs as a sub-executive of the same context; its
+  FINISHED/REVERT response migrates back and resumes the parked frames —
+  the CoroutineTransactionExecutive suspend/resume protocol without native
+  stacks.
+- **Key locks**: every executive tracks the (table, key) rows it touched.
+  Completion (and every pause) must acquire those locks in
+  :class:`GraphKeyLocks`; a conflict means another in-flight context owns
+  the row, so the executive's work is discarded and the whole context chain
+  retries in a later round (optimistic execution + round-boundary lock
+  validation — same observable protocol as the reference's in-execution
+  acquisition, with the wait-for graph feeding the same deadlock detector).
+- **Deadlock**: a wait-for cycle reverts one victim context
+  (DmcExecutor::detectLockAndRevert analog): its executives are dropped
+  everywhere, its locks released, and the tx gets a REVERT receipt.
 """
 
 from __future__ import annotations
@@ -22,8 +36,11 @@ import hashlib
 from dataclasses import dataclass, field
 from enum import IntEnum
 
+from ..executor.evm import EVMCall, EVMResult
 from ..protocol.receipt import TransactionReceipt, TransactionStatus
 from ..protocol.transaction import Transaction
+from ..storage.entry import Entry
+from ..storage.state_storage import StateStorage
 from ..utils.log import get_logger
 from .key_locks import GraphKeyLocks
 
@@ -46,13 +63,21 @@ class ExecutionMessage:
     seq: int = 0
     from_addr: bytes = b""
     to_addr: bytes = b""
-    sender: bytes = b""  # tx origin
+    sender: bytes = b""  # frame sender (caller contract or tx origin)
+    origin: bytes = b""  # tx origin
     data: bytes = b""
     static_call: bool = False
+    create: bool = False
+    kind: str = "call"  # call|delegatecall|callcode|staticcall (frame kind)
+    storage_addr: bytes = b""  # storage context (≠ to_addr for delegatecall)
+    value: int = 0
+    abi: bytes = b""
+    gas: int = 0
     status: int = 0
     gas_used: int = 0
     logs: list = field(default_factory=list)
     key_locks: list = field(default_factory=list)
+    create_address: bytes = b""
 
 
 class DmcStepRecorder:
@@ -95,45 +120,208 @@ class DmcStepRecorder:
         return send, recv
 
 
+class TrackingStorage(StateStorage):
+    """Overlay that records every (table, key) it touches — the executive's
+    read/write set, which becomes its key-lock claim (the reference's
+    HostContext acquires key locks during execution; DmcExecutor.cpp ships
+    them on ExecutionMessages)."""
+
+    def __init__(self, prev):
+        super().__init__(prev)
+        self.touched: set[tuple[str, bytes]] = set()
+
+    def get_row(self, table: str, key: bytes):
+        self.touched.add((table, bytes(key)))
+        return super().get_row(table, key)
+
+    def set_row(self, table: str, key: bytes, entry: Entry) -> None:
+        self.touched.add((table, bytes(key)))
+        super().set_row(table, key, entry)
+
+
+@dataclass
+class _Parked:
+    executive: object  # Executive
+    storage: TrackingStorage
+    start_msg: ExecutionMessage
+    out_seq: int  # seq of the outbound request we wait on
+
+
 class ExecutorShard:
-    """One executor's per-contract execution of DMC messages.
+    """One executor shard: runs executives for its contracts, parks them on
+    cross-shard calls (ParallelTransactionExecutorInterface::
+    dmcExecuteTransactions + CoroutineTransactionExecutive analog).
 
-    In-process implementation of the remote-executor contract
-    (ParallelTransactionExecutorInterface::dmcExecuteTransactions). Executes
-    against the block storage through the shared precompile registry; a
-    cross-contract call returns a PAUSED message for migration instead of
-    executing inline.
-    """
+    All of a context's frames on this shard — the original executive and any
+    sub-executives migrated in from other shards — share ONE context-scoped
+    overlay (`_ctx_storage`), so the whole tx commits or vanishes atomically
+    across shards when the scheduler settles the top-level result. Lock
+    claims happen at every pause/completion boundary; a conflict aborts the
+    WHOLE context, which the scheduler restarts from its original tx in a
+    later round (optimistic execution + round-boundary lock validation; the
+    wait-for graph feeds the same deadlock detector as the reference)."""
 
-    def __init__(self, executor, name: str = "executor0"):
+    def __init__(self, executor, name: str = "executor0", owns=None):
         self.executor = executor  # TransactionExecutor (owns block storage)
         self.name = name
+        self.owns = owns if owns is not None else (lambda addr: True)
+        self.parked: dict[tuple[int, int], _Parked] = {}
+        self._next_seq: dict[int, int] = {}
+        self._ctx_storage: dict[int, TrackingStorage] = {}
+
+    def _alloc_seq(self, ctx: int) -> int:
+        n = self._next_seq.get(ctx, 1)
+        self._next_seq[ctx] = n + 1
+        return n
+
+    def ctx_storage(self, ctx: int) -> TrackingStorage:
+        st = self._ctx_storage.get(ctx)
+        if st is None:
+            block = self.executor._block
+            assert block is not None
+            st = TrackingStorage(block.storage)
+            self._ctx_storage[ctx] = st
+        return st
+
+    def cancel_context(self, ctx: int) -> None:
+        """Drop every trace of a context (retry restart or deadlock revert)."""
+        for key in [k for k in self.parked if k[0] == ctx]:
+            del self.parked[key]
+        self._ctx_storage.pop(ctx, None)
+        self._next_seq.pop(ctx, None)
+
+    def commit_context(self, ctx: int) -> None:
+        """Merge the context overlay into the block state (top-level OK)."""
+        st = self._ctx_storage.pop(ctx, None)
+        if st is not None and st.dirty_count():
+            st.merge_into_prev()
+        self._next_seq.pop(ctx, None)
 
     def execute(
-        self, contract: bytes, msgs: list[ExecutionMessage]
+        self, contract: bytes, msgs: list[ExecutionMessage], locks: GraphKeyLocks,
     ) -> list[ExecutionMessage]:
         out: list[ExecutionMessage] = []
         block = self.executor._block
         assert block is not None, "next_block_header first"
         for m in msgs:
-            tx = Transaction(to=m.to_addr, input=m.data)
-            tx.force_sender(m.sender)
-            rc = self.executor._execute_one(tx, block)
-            out.append(
-                ExecutionMessage(
-                    type=MsgType.FINISHED if rc.status == 0 else MsgType.REVERT,
-                    context_id=m.context_id,
-                    seq=m.seq,
-                    from_addr=m.to_addr,
-                    to_addr=m.from_addr,
-                    sender=m.sender,
-                    data=rc.output,
-                    status=rc.status,
-                    gas_used=rc.gas_used,
-                    logs=rc.log_entries,
+            if m.type in (MsgType.FINISHED, MsgType.REVERT):
+                parked = self.parked.pop((m.context_id, m.seq), None)
+                if parked is None:
+                    continue  # canceled context
+                res = EVMResult(
+                    status=m.status, output=m.data,
+                    gas_left=max(parked.executive.block.gas_limit - m.gas_used, 0),
+                    create_address=m.create_address,
                 )
-            )
+                res.logs = list(m.logs)
+                state, payload = parked.executive.step(res)
+                out.extend(
+                    self._settle(
+                        parked.start_msg, parked.storage, parked.executive,
+                        state, payload, locks,
+                    )
+                )
+            else:
+                is_top = m.from_addr == b"" and m.seq == 0
+                if is_top and not m.create and not self.executor.known_callee(
+                    m.to_addr, self.ctx_storage(m.context_id)
+                ):
+                    # same rejection the serial path performs (executor.py)
+                    out.append(ExecutionMessage(
+                        type=MsgType.REVERT, context_id=m.context_id,
+                        seq=m.seq, from_addr=m.to_addr, to_addr=m.from_addr,
+                        sender=m.sender, origin=m.origin,
+                        data=b"unknown contract address",
+                        status=int(TransactionStatus.CALL_ADDRESS_ERROR),
+                    ))
+                    continue
+                storage = self.ctx_storage(m.context_id)
+                call = EVMCall(
+                    kind="create" if m.create else (m.kind or "call"),
+                    sender=m.sender,
+                    to=(m.storage_addr or m.to_addr) if not m.create else b"",
+                    code_address=m.to_addr,
+                    data=m.data,
+                    # only top-level frames default to the block gas limit; a
+                    # migrated sub-call keeps its forwarded gas (even 0)
+                    gas=block.gas_limit if is_top else m.gas,
+                    value=m.value,
+                    static=m.static_call,
+                )
+                ex = self.executor.start_executive(
+                    call, storage, block, m.origin or m.sender, m.context_id,
+                    seq_start=m.seq, abi=m.abi, is_local=self.owns,
+                )
+                state, payload = ex.step(None)
+                out.extend(self._settle(m, storage, ex, state, payload, locks))
         return out
+
+    def _settle(
+        self, start: ExecutionMessage, storage: TrackingStorage, executive,
+        state: str, payload, locks: GraphKeyLocks,
+    ) -> list[ExecutionMessage]:
+        ctx = start.context_id
+        if state == "external":
+            req: EVMCall = payload
+            # claim the rows touched so far; a conflict aborts the context
+            if not self._claim(ctx, storage, locks):
+                return [ExecutionMessage(type=MsgType.TXHASH, context_id=ctx)]
+            seq = self._alloc_seq(ctx)
+            self.parked[(ctx, seq)] = _Parked(executive, storage, start, seq)
+            return [
+                ExecutionMessage(
+                    type=MsgType.MESSAGE,
+                    context_id=ctx,
+                    seq=seq,
+                    from_addr=start.to_addr,
+                    to_addr=req.code_address,
+                    storage_addr=req.to,
+                    kind=req.kind,
+                    value=req.value,
+                    sender=req.sender,
+                    origin=start.origin or start.sender,
+                    data=req.data,
+                    static_call=req.static,
+                    gas=req.gas,
+                    key_locks=sorted(storage.touched),
+                )
+            ]
+        # done (top-level or migrated sub-call); commit is the scheduler's
+        # job once the TOP frame settles — nothing merges here
+        res: EVMResult = payload
+        if res.ok and not self._claim(ctx, storage, locks):
+            return [ExecutionMessage(type=MsgType.TXHASH, context_id=ctx)]
+        return [
+            ExecutionMessage(
+                type=MsgType.FINISHED if res.ok else MsgType.REVERT,
+                context_id=ctx,
+                seq=start.seq,
+                from_addr=start.to_addr,
+                to_addr=start.from_addr,
+                sender=start.sender,
+                origin=start.origin,
+                data=res.output,
+                status=res.status,
+                gas_used=max(
+                    (self.executor._block.gas_limit if self.executor._block else 0)
+                    - res.gas_left,
+                    0,
+                ),
+                logs=res.logs,
+                create_address=res.create_address,
+            )
+        ]
+
+    def _claim(self, ctx: int, storage: TrackingStorage, locks: GraphKeyLocks) -> bool:
+        """Claim every touched row. On conflict the context keeps the locks
+        it already holds (from pre-conflict progress) and `acquire` records
+        the wait-for edge — that is what lets genuine cross-shard lock cycles
+        form and reach the deadlock detector, exactly like the reference's
+        held-until-commit key locks (GraphKeyLocks.cpp)."""
+        for key in sorted(storage.touched):
+            if not locks.acquire(ctx, key):
+                return False
+        return True
 
 
 class DmcExecutor:
@@ -147,15 +335,15 @@ class DmcExecutor:
     def schedule_in(self, msg: ExecutionMessage) -> None:
         self.pool.append(msg)
 
-    def go(self, recorder: DmcStepRecorder) -> list[ExecutionMessage]:
+    def go(self, recorder: DmcStepRecorder, locks: GraphKeyLocks) -> list[ExecutionMessage]:
         """Execute everything pending for this contract; returns results
-        (FINISHED/REVERT) and migrated messages."""
+        (FINISHED/REVERT), migrated requests (MESSAGE) and retries."""
         msgs, self.pool = self.pool, []
         if not msgs:
             return []
         msgs.sort(key=lambda m: (m.context_id, m.seq))  # determinism
         recorder.record_send(msgs)
-        results = self.shard.execute(self.contract, msgs)
+        results = self.shard.execute(self.contract, msgs, locks)
         recorder.record_recv(results)
         return results
 
@@ -173,63 +361,134 @@ class DMCScheduler:
         self.max_rounds = max_rounds
         self.recorder = DmcStepRecorder()
         self.key_locks = GraphKeyLocks()
+        self._shards: set = set()
+
+    def _cancel_everywhere(self, ctx: int, dmc: dict) -> None:
+        for s in self._shards:
+            s.cancel_context(ctx)
+        for d in dmc.values():
+            d.pool = [m for m in d.pool if m.context_id != ctx]
 
     def execute(self, txs: list[Transaction]) -> list[TransactionReceipt]:
         dmc: dict[bytes, DmcExecutor] = {}
 
         def executor_for(contract: bytes) -> DmcExecutor:
             if contract not in dmc:
-                dmc[contract] = DmcExecutor(contract, self.shard_of(contract))
+                shard = self.shard_of(contract)
+                self._shards.add(shard)
+                shard.executor.align_contexts(getattr(self, "_ctx_end", 0))
+                dmc[contract] = DmcExecutor(contract, shard)
             return dmc[contract]
 
-        receipts: list[TransactionReceipt | None] = [None] * len(txs)
-        for i, tx in enumerate(txs):
-            executor_for(tx.to).schedule_in(
-                ExecutionMessage(
-                    type=MsgType.MESSAGE,
-                    context_id=i,
-                    from_addr=b"",
-                    to_addr=tx.to,
-                    sender=tx.sender,
-                    data=tx.input,
-                )
+        def start_message(i: int) -> ExecutionMessage:
+            tx = txs[i]
+            return ExecutionMessage(
+                type=MsgType.MESSAGE,
+                context_id=self._ctx_base + i,
+                from_addr=b"",
+                to_addr=tx.to,
+                sender=tx.sender,
+                origin=tx.sender,
+                data=tx.input,
+                create=not tx.to,
+                abi=tx.abi.encode() if not tx.to else b"",
             )
+
+        receipts: list[TransactionReceipt | None] = [None] * len(txs)
+        reverted: set[int] = set()
+        retry_ctxs: list[int] = []
+        # every block executes on fresh lock/recorder state (the reference
+        # builds per-BlockExecutive structures); leaked locks from a previous
+        # block would alias context ids across blocks
+        self.key_locks = GraphKeyLocks()
+        # context ids must be block-unique per executor (CREATE addresses
+        # hash the contextID — ChecksumAddress.h:83-97): take the highest
+        # floor any participating executor has reached and align them all
+        executors = {self.shard_of(tx.to).executor for tx in txs}
+        base = max(
+            (ex._block.next_ctx if ex._block else 0) for ex in executors
+        )
+        for ex in executors:
+            ex.align_contexts(base + len(txs))
+        self._ctx_base = base
+        self._ctx_end = base + len(txs)
+        for i, tx in enumerate(txs):
+            executor_for(tx.to).schedule_in(start_message(i))
 
         for _ in range(self.max_rounds):
             pending = [d for d in dmc.values() if d.pool]
-            if not pending:
+            if not pending and not retry_ctxs:
                 break
-            # deterministic shard order (the reference joins a parallel_for;
-            # ordering of *results* is fixed by (context_id, seq))
+            # restart conflicted contexts from their original tx
+            for ctx in sorted(set(retry_ctxs)):
+                if ctx not in reverted and receipts[ctx - self._ctx_base] is None:
+                    executor_for(txs[ctx - self._ctx_base].to).schedule_in(
+                        start_message(ctx - self._ctx_base)
+                    )
+            retry_ctxs = []
+            pending = [d for d in dmc.values() if d.pool]
+            # deterministic shard order; results are JOINED at the round
+            # barrier before any re-scheduling — messages produced in round N
+            # run in round N+1 (the reference joins its parallel_for the same
+            # way, BlockExecutive.cpp:882-958), which is also what allows
+            # genuine lock cycles to form instead of being serialized away
+            round_results: list[ExecutionMessage] = []
             for d in sorted(pending, key=lambda d: d.contract):
-                for res in d.go(self.recorder):
-                    if res.type in (MsgType.FINISHED, MsgType.REVERT):
-                        if res.to_addr == b"":  # top-level completion
+                round_results.extend(d.go(self.recorder, self.key_locks))
+            for res in round_results:
+                    ctx = res.context_id
+                    if ctx in reverted:
+                        continue
+                    if res.type == MsgType.TXHASH:
+                        # lock conflict: whole-context restart in a later
+                        # round (waiting edge already recorded for deadlock
+                        # detection)
+                        self._cancel_everywhere(ctx, dmc)
+                        retry_ctxs.append(ctx)
+                    elif res.type in (MsgType.FINISHED, MsgType.REVERT):
+                        if res.to_addr == b"" and res.seq == 0:
+                            # top-level settled: commit/discard atomically
+                            # across every shard, then release locks
+                            if res.type == MsgType.FINISHED:
+                                for s in sorted(self._shards, key=lambda s: s.name):
+                                    s.commit_context(ctx)
+                            else:
+                                for s in self._shards:
+                                    s.cancel_context(ctx)
+                            self.key_locks.release_all(ctx)
                             rc = TransactionReceipt(
                                 status=res.status,
                                 output=res.data,
                                 gas_used=res.gas_used,
+                                contract_address=res.create_address,
                             )
                             rc.log_entries = res.logs
-                            receipts[res.context_id] = rc
-                        else:  # response migrates back to the calling contract
+                            receipts[ctx - self._ctx_base] = rc
+                        else:  # response migrates back to the caller's shard
                             executor_for(res.to_addr).schedule_in(res)
                     else:  # outbound call migrates to the target contract
                         executor_for(res.to_addr).schedule_in(res)
             victims = self.key_locks.detect_deadlock()
             if victims:
-                victim = victims[0]
+                victim = max(victims)  # deterministic choice: highest ctx id
                 _log.warning("deadlock: reverting context %s", victim)
+                reverted.add(victim)
+                self._cancel_everywhere(victim, dmc)
                 self.key_locks.release_all(victim)
-                receipts[victim] = TransactionReceipt(
+                retry_ctxs = [c for c in retry_ctxs if c != victim]
+                receipts[victim - self._ctx_base] = TransactionReceipt(
                     status=int(TransactionStatus.REVERT_INSTRUCTION),
                     output=b"deadlock victim",
                 )
             self.recorder.next_round()
         missing = [i for i, rc in enumerate(receipts) if rc is None]
         for i in missing:
+            # drop the unfinished context's executives/overlays everywhere so
+            # nothing leaks into the next block
+            self._cancel_everywhere(self._ctx_base + i, dmc)
+            self.key_locks.release_all(self._ctx_base + i)
             receipts[i] = TransactionReceipt(
-                status=int(TransactionStatus.INTERNAL_ERROR),
+                status=int(TransactionStatus.UNKNOWN),
                 output=b"unfinished after max DMC rounds",
             )
         return receipts  # type: ignore[return-value]

@@ -24,6 +24,9 @@ class ExecutorManager:
             raise ValueError(f"executor exists: {shard.name}")
         self._shards.append(shard)
         self._alive[shard.name] = True
+        # a shard owns exactly the contracts this manager dispatches to it —
+        # cross-shard calls pause/migrate (DmcExecutor.cpp f_onSchedulerOut)
+        shard.owns = lambda addr, s=shard: self.dispatch(addr) is s
         _log.info("executor %s registered (%d total)", shard.name, len(self._shards))
 
     def remove_executor(self, name: str) -> None:

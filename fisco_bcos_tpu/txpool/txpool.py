@@ -71,7 +71,7 @@ class TxPool:
         h = tx.hash(self.suite)
         with self._lock:
             if h in self._txs:
-                return TxSubmitResult(h, ErrorCode.TX_POOL_ALREADY_KNOWN)
+                return TxSubmitResult(h, ErrorCode.ALREADY_IN_TX_POOL)
         code = self.validator.verify(tx)
         if code != ErrorCode.SUCCESS:
             return TxSubmitResult(h, code)
@@ -91,7 +91,7 @@ class TxPool:
             with self._lock:
                 known = h in self._txs
             if known:
-                results[i] = TxSubmitResult(h, ErrorCode.TX_POOL_ALREADY_KNOWN)
+                results[i] = TxSubmitResult(h, ErrorCode.ALREADY_IN_TX_POOL)
                 continue
             code = self.validator.check_static(tx)
             if code == ErrorCode.SUCCESS and tx.nonce in batch_nonces:

@@ -60,7 +60,7 @@ class LedgerNonceChecker:
             ):
                 return ErrorCode.BLOCK_LIMIT_CHECK_FAIL
             if tx.nonce in self._nonces:
-                return ErrorCode.TX_POOL_NONCE_TOO_OLD
+                return ErrorCode.TX_ALREADY_IN_CHAIN
         return ErrorCode.SUCCESS
 
     def commit_block(self, number: int, nonces: list[str]) -> None:

@@ -1,7 +1,11 @@
 """Error type + protocol error codes.
 
-Reference: bcos-utilities/Error.h and
-bcos-framework/protocol/CommonError.h / TransactionStatus.h.
+Reference: bcos-utilities/Error.h and bcos-framework CommonError.h. The
+transaction/receipt status family lives ONLY in
+:class:`fisco_bcos_tpu.protocol.receipt.TransactionStatus` (one copy of
+TransactionStatus.h); this enum carries the module-level error codes —
+txpool admission values match TransactionStatus.h:54-63 exactly because the
+reference reports them through the same numeric space.
 """
 
 from __future__ import annotations
@@ -11,27 +15,17 @@ from enum import IntEnum
 
 class ErrorCode(IntEnum):
     SUCCESS = 0
-    # Transaction status (reference: bcos-protocol TransactionStatus.h)
-    UNKNOWN = 1
-    OUT_OF_GAS_LIMIT = 2
-    NOT_ENOUGH_CASH = 7
-    BAD_INSTRUCTION = 10
-    REVERT_INSTRUCTION = 12
-    STACK_OVERFLOW = 14
-    STACK_UNDERFLOW = 15
-    PRECOMPILED_ERROR = 24
-    # TxPool (reference: bcos-framework txpool/TxPoolTypeDef.h)
+    # TxPool admission (reference: bcos-protocol TransactionStatus.h:54-63)
     NONCE_CHECK_FAIL = 10000
     BLOCK_LIMIT_CHECK_FAIL = 10001
-    TX_POOL_ALREADY_KNOWN = 10002
-    TX_POOL_NONCE_TOO_OLD = 10003
-    INVALID_CHAIN_ID = 10004
-    INVALID_GROUP_ID = 10005
-    INVALID_SIGNATURE = 10006
-    REQUIRE_PROOF = 10007
-    TX_POOL_FULL = 10008
-    TX_POOL_TIMEOUT = 10009
-    ALREADY_IN_TX_POOL = 10010
+    TX_POOL_FULL = 10002
+    MALFORM = 10003
+    ALREADY_IN_TX_POOL = 10004
+    TX_ALREADY_IN_CHAIN = 10005
+    INVALID_CHAIN_ID = 10006
+    INVALID_GROUP_ID = 10007
+    INVALID_SIGNATURE = 10008
+    REQUEST_NOT_BELONG_TO_THE_GROUP = 10009
     # Scheduler / executor
     SCHEDULER_INVALID_BLOCK = 21000
     SCHEDULER_BLOCK_IN_QUEUE = 21001

@@ -20,6 +20,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache"))
+# One shared batch bucket for every device-crypto test — each distinct batch
+# shape is a multi-minute XLA compile on the single-core CPU host.
+os.environ.setdefault("FISCO_TEST_BUCKET", "32")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,131 @@
+"""Tiny two-pass EVM assembler + hand-written contract fixtures for tests
+(no solc in the image; mirrors the role of the reference's test/solidity/
+fixtures for bcos-executor's unit tests)."""
+
+
+OPS = {
+    "STOP": 0x00, "ADD": 0x01, "MUL": 0x02, "SUB": 0x03, "DIV": 0x04,
+    "LT": 0x10, "GT": 0x11, "EQ": 0x14, "ISZERO": 0x15, "AND": 0x16,
+    "OR": 0x17, "NOT": 0x19, "SHL": 0x1B, "SHR": 0x1C,
+    "SHA3": 0x20, "ADDRESS": 0x30, "CALLER": 0x33, "CALLVALUE": 0x34,
+    "CALLDATALOAD": 0x35, "CALLDATASIZE": 0x36, "CALLDATACOPY": 0x37,
+    "CODESIZE": 0x38, "CODECOPY": 0x39, "RETURNDATASIZE": 0x3D,
+    "RETURNDATACOPY": 0x3E, "NUMBER": 0x43, "TIMESTAMP": 0x42,
+    "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52, "SLOAD": 0x54,
+    "SSTORE": 0x55, "JUMP": 0x56, "JUMPI": 0x57, "GAS": 0x5A,
+    "JUMPDEST": 0x5B, "LOG1": 0xA1,
+    "CREATE": 0xF0, "CALL": 0xF1, "RETURN": 0xF3, "DELEGATECALL": 0xF4,
+    "STATICCALL": 0xFA, "REVERT": 0xFD,
+}
+for _i in range(1, 17):
+    OPS[f"DUP{_i}"] = 0x7F + _i
+    OPS[f"SWAP{_i}"] = 0x8F + _i
+
+
+def asm(*items) -> bytes:
+    """Two-pass assembler: items are mnemonics, ("PUSH", int|bytes),
+    ("label", name) definitions, or ("ref", name) 2-byte label pushes."""
+    # pass 1: layout
+    sizes = []
+    for it in items:
+        if isinstance(it, str):
+            sizes.append(1)
+        elif it[0] == "PUSH":
+            v = it[1]
+            data = v if isinstance(v, bytes) else v.to_bytes(max((v.bit_length() + 7) // 8, 1), "big")
+            sizes.append(1 + len(data))
+        elif it[0] == "label":
+            sizes.append(1)  # JUMPDEST
+        elif it[0] == "ref":
+            sizes.append(3)  # PUSH2 <addr16>
+        else:
+            raise ValueError(it)
+    offsets = {}
+    pos = 0
+    for it, sz in zip(items, sizes):
+        if isinstance(it, tuple) and it[0] == "label":
+            offsets[it[1]] = pos
+        pos += sz
+    # pass 2: emit
+    out = bytearray()
+    for it in items:
+        if isinstance(it, str):
+            out.append(OPS[it])
+        elif it[0] == "PUSH":
+            v = it[1]
+            data = v if isinstance(v, bytes) else v.to_bytes(max((v.bit_length() + 7) // 8, 1), "big")
+            out.append(0x5F + len(data))
+            out.extend(data)
+        elif it[0] == "label":
+            out.append(OPS["JUMPDEST"])
+        elif it[0] == "ref":
+            out.append(0x61)  # PUSH2
+            out.extend(offsets[it[1]].to_bytes(2, "big"))
+    return bytes(out)
+
+
+def _deployer(runtime: bytes) -> bytes:
+    """Init code: codecopy the runtime to memory and return it."""
+    prefix_len = 0
+    # fixed-point the prefix size (the runtime's code offset depends on it)
+    for _ in range(3):
+        prefix = asm(
+            ("PUSH", len(runtime)), ("PUSH", prefix_len), ("PUSH", 0), "CODECOPY",
+            ("PUSH", len(runtime)), ("PUSH", 0), "RETURN",
+        )
+        prefix_len = len(prefix)
+    return prefix + runtime
+
+
+def counter_runtime(codec) -> bytes:
+    """Counter: inc() bumps slot 0; get() returns it; unknown selector reverts."""
+    inc_sel = int.from_bytes(codec.selector("inc()"), "big")
+    get_sel = int.from_bytes(codec.selector("get()"), "big")
+    return asm(
+        ("PUSH", 0), "CALLDATALOAD", ("PUSH", 224), "SHR",
+        "DUP1", ("PUSH", inc_sel), "EQ", ("ref", "inc"), "JUMPI",
+        "DUP1", ("PUSH", get_sel), "EQ", ("ref", "get"), "JUMPI",
+        ("PUSH", 0), ("PUSH", 0), "REVERT",
+        ("label", "inc"),
+        ("PUSH", 0), "SLOAD", ("PUSH", 1), "ADD", ("PUSH", 0), "SSTORE", "STOP",
+        ("label", "get"),
+        ("PUSH", 0), "SLOAD", ("PUSH", 0), "MSTORE",
+        ("PUSH", 32), ("PUSH", 0), "RETURN",
+    )
+
+
+def caller_runtime(codec) -> bytes:
+    """Calls inc() on the address given in calldata word 0; reverts if the
+    inner call fails."""
+    inc_sel = int.from_bytes(codec.selector("inc()"), "big")
+    return asm(
+        # mem[0..32] = selector word (selector in top 4 bytes)
+        ("PUSH", inc_sel), ("PUSH", 224), "SHL", ("PUSH", 0), "MSTORE",
+        # out_size, out_off, in_size, in_off, value
+        ("PUSH", 0), ("PUSH", 0), ("PUSH", 4), ("PUSH", 0), ("PUSH", 0),
+        ("PUSH", 0), "CALLDATALOAD",  # to (low 20 bytes used)
+        "GAS",
+        "CALL",
+        ("ref", "ok"), "JUMPI",
+        ("PUSH", 0), ("PUSH", 0), "REVERT",
+        ("label", "ok"), "STOP",
+    )
+
+
+
+def pingpong_runtime() -> bytes:
+    """Writes its own slot 0, then (if calldata word 0 is a nonzero address)
+    calls that address with 32 zero bytes — the cross-shard/deadlock fixture
+    (the reference's MockDeadLockExecutor scenario, on real bytecode)."""
+    return asm(
+        ("PUSH", 1), ("PUSH", 0), "SSTORE",
+        ("PUSH", 0), "CALLDATALOAD",
+        "DUP1", "ISZERO", ("ref", "end"), "JUMPI",
+        # stack: [addr]
+        ("PUSH", 0), ("PUSH", 0), ("PUSH", 32), ("PUSH", 0), ("PUSH", 0),
+        "DUP6", "GAS", "CALL",
+        ("ref", "done"), "JUMPI",
+        ("PUSH", 0), ("PUSH", 0), "REVERT",
+        ("label", "done"), "STOP",
+        ("label", "end"), "STOP",
+    )

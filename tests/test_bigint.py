@@ -1,11 +1,14 @@
-"""ops/bigint vs exact Python int arithmetic (random + adversarial cases)."""
+"""Limb-major bignum core (ops/limb) + host conversions (ops/bigint) vs
+exact Python int arithmetic (random + adversarial cases)."""
 
 import random
 
+import jax.numpy as jnp
 import numpy as np
 
 from fisco_bcos_tpu.crypto.ref import SECP256K1, SM2_CURVE
 from fisco_bcos_tpu.ops import bigint as bi
+from fisco_bcos_tpu.ops import limb
 
 P = SECP256K1.p
 N = SECP256K1.n
@@ -18,77 +21,87 @@ def rand256(below):
     return rng.randrange(0, below)
 
 
+def to_rows(xs, width=16):
+    return jnp.asarray(
+        np.stack([limb.int_to_rows(x, width) for x in xs], axis=1)
+    )
+
+
 def test_limb_conversions_roundtrip():
     xs = [0, 1, P - 1, N, (1 << 256) - 1] + [rand256(1 << 256) for _ in range(5)]
     limbs = bi.ints_to_limbs(xs)
     assert bi.limbs_to_ints(limbs) == xs
-    # byte conversions
     data = np.stack(
         [np.frombuffer(x.to_bytes(32, "big"), dtype=np.uint8) for x in xs]
     )
     limbs2 = bi.bytes_be_to_limbs(data)
     assert bi.limbs_to_ints(limbs2) == xs
     assert np.array_equal(bi.limbs_to_bytes_be(limbs2), data)
+    # limb-major row conversions
+    assert limb.rows_to_ints(np.stack([limb.int_to_rows(x) for x in xs], axis=1)) == xs
 
 
-def test_mul_full_and_low():
-    xs = [rand256(1 << 256) for _ in range(8)] + [0, 1, (1 << 256) - 1]
-    ys = [rand256(1 << 256) for _ in range(8)] + [(1 << 256) - 1, 1, (1 << 256) - 1]
-    a = bi.ints_to_limbs(xs)
-    b = bi.ints_to_limbs(ys)
-    full = np.asarray(bi.mul_full(a, b))
-    low = np.asarray(bi.mul_low(a, b))
-    got_full = bi.limbs_to_ints(full)
-    got_low = bi.limbs_to_ints(low)
-    for x, y, gf, gl in zip(xs, ys, got_full, got_low):
-        assert gf == x * y
-        assert gl == (x * y) % (1 << 256)
+def test_mul_cols_full_product():
+    xs = [rand256(1 << 256) for _ in range(5)] + [0, 1, (1 << 256) - 1]
+    ys = [rand256(1 << 256) for _ in range(5)] + [(1 << 256) - 1, 1, (1 << 256) - 1]
+    a, b = to_rows(xs), to_rows(ys)
+    wide = np.asarray(limb.carry_norm(limb.mul_cols(a, b)))[:32]
+    got = limb.rows_to_ints(wide)
+    for x, y, g in zip(xs, ys, got):
+        assert g == x * y
 
 
-def test_mod_ops_match_python():
+def test_field_ops_match_python():
     for m in (P, N, SM2P, SM2_CURVE.n):
-        mod = bi.make_modulus(m)
-        xs = [rand256(m) for _ in range(6)] + [0, 1, m - 1]
-        ys = [rand256(m) for _ in range(6)] + [m - 1, m - 1, m - 1]
-        a = bi.ints_to_limbs(xs)
-        b = bi.ints_to_limbs(ys)
-        add = bi.limbs_to_ints(np.asarray(bi.add_mod(a, b, mod)))
-        sub = bi.limbs_to_ints(np.asarray(bi.sub_mod(a, b, mod)))
-        am = bi.to_mont(a, mod)
-        bm = bi.to_mont(b, mod)
-        mul = bi.limbs_to_ints(np.asarray(bi.from_mont(bi.mont_mul(am, bm, mod), mod)))
-        sqr = bi.limbs_to_ints(np.asarray(bi.from_mont(bi.mont_sqr(am, mod), mod)))
-        back = bi.limbs_to_ints(np.asarray(bi.from_mont(am, mod)))
-        for x, y, ga, gs, gm, gq, gb in zip(xs, ys, add, sub, mul, sqr, back):
-            assert ga == (x + y) % m
-            assert gs == (x - y) % m
-            assert gm == (x * y) % m
-            assert gq == (x * x) % m
-            assert gb == x
+        if (1 << 256) - m < 1 << 132:
+            F = limb.make_fold_field(m)
+            enc = lambda vs: to_rows(vs)
+            dec = limb.rows_to_ints
+        else:
+            F = limb.make_mont_field(m)
+            enc = lambda vs, _m=m: to_rows([v * (1 << 256) % _m for v in vs])
+            dec = lambda arr, _m=m: [
+                v * pow(1 << 256, -1, _m) % _m for v in limb.rows_to_ints(arr)
+            ]
+        xs = [rand256(m) for _ in range(5)] + [0, 1, m - 1]
+        ys = [rand256(m) for _ in range(5)] + [m - 1, m - 1, m - 1]
+        a, b = enc(xs), enc(ys)
+        assert dec(np.asarray(F.mul(a, b))) == [x * y % m for x, y in zip(xs, ys)]
+        assert dec(np.asarray(F.add(a, b))) == [(x + y) % m for x, y in zip(xs, ys)]
+        assert dec(np.asarray(F.sub(a, b))) == [(x - y) % m for x, y in zip(xs, ys)]
+        assert dec(np.asarray(F.sqr(a))) == [x * x % m for x in xs]
 
 
-def test_pow_and_inverse():
-    mod = bi.make_modulus(P)
-    xs = [rand256(P) for _ in range(4)] + [1, P - 1]
-    a = bi.to_mont(bi.ints_to_limbs(xs), mod)
-    inv = bi.limbs_to_ints(np.asarray(bi.from_mont(bi.mont_inv(a, mod), mod)))
+def test_inverse_and_sqrt():
+    F = limb.make_fold_field(P)
+    xs = [rand256(P) for _ in range(4)] + [0, 1, P - 1]
+    inv = limb.rows_to_ints(np.asarray(F.inv(to_rows(xs))))
     for x, gi in zip(xs, inv):
-        assert gi == pow(x, P - 2, P)
-        assert (gi * x) % P == 1
-    # fixed exponent pow: sqrt exponent (p ≡ 3 mod 4)
-    e = (P + 1) // 4
-    powd = bi.limbs_to_ints(np.asarray(bi.from_mont(bi.mont_pow(a, e, mod), mod)))
-    for x, gp in zip(xs, powd):
-        assert gp == pow(x, e, P)
+        assert gi == (pow(x, -1, P) if x else 0)
+    qrs = [pow(rand256(P), 2, P) for _ in range(6)]
+    roots = limb.rows_to_ints(np.asarray(F.sqrt(to_rows(qrs))))
+    for q, root in zip(qrs, roots):
+        assert pow(root, 2, P) == q
 
 
-def test_compare_and_select():
+def test_compare_select_subborrow():
     xs = [5, 7, 7, 0, (1 << 256) - 1]
     ys = [7, 5, 7, 0, 1]
-    a = bi.ints_to_limbs(xs)
-    b = bi.ints_to_limbs(ys)
-    assert list(np.asarray(bi.geq(a, b))) == [False, True, True, True, True]
-    assert list(np.asarray(bi.eq(a, b))) == [False, False, True, True, False]
-    assert list(np.asarray(bi.is_zero(a))) == [False, False, False, True, False]
-    sel = bi.limbs_to_ints(np.asarray(bi.select(bi.geq(a, b), a, b)))
+    a, b = to_rows(xs), to_rows(ys)
+    assert list(np.asarray(limb.geq(a, b))) == [False, True, True, True, True]
+    assert list(np.asarray(limb.eq(a, b))) == [False, False, True, True, False]
+    assert list(np.asarray(limb.is_zero(a))) == [False, False, False, True, False]
+    sel = limb.rows_to_ints(np.asarray(limb.select(limb.geq(a, b), a, b)))
     assert sel == [7, 7, 7, 0, (1 << 256) - 1]
+    diff, borrow = limb.sub_borrow(a, b)
+    for x, y, d, bo in zip(xs, ys, limb.rows_to_ints(np.asarray(diff)), np.asarray(borrow)):
+        assert d == (x - y) % (1 << 256)
+        assert bool(bo) == (x < y)
+
+
+def test_pow_static_windows():
+    F = limb.make_fold_field(N)
+    xs = [rand256(N) for _ in range(4)]
+    for e in (2, 3, 17, (N + 1) // 2, N - 2):
+        got = limb.rows_to_ints(np.asarray(limb.pow_static(F, to_rows(xs), e)))
+        assert got == [pow(x, e, N) for x in xs]

@@ -8,6 +8,7 @@ from fisco_bcos_tpu.executor.precompiled import (
     SMALLBANK_ADDRESS,
 )
 from fisco_bcos_tpu.protocol.block_header import BlockHeader
+from fisco_bcos_tpu.protocol.receipt import TransactionStatus
 from fisco_bcos_tpu.protocol.transaction import Transaction
 from fisco_bcos_tpu.scheduler.dmc import DMCScheduler, DmcStepRecorder, ExecutorShard
 from fisco_bcos_tpu.scheduler.executor_manager import ExecutorManager
@@ -123,3 +124,88 @@ def test_step_recorder_flags_divergence():
     r1.record_send([m])
     r2.record_send([ExecutionMessage(type=MsgType.MESSAGE, context_id=1, data=b"abd")])
     assert r1.next_round() != r2.next_round()
+
+
+# ---------------------------------------------------------------------------
+# Live cross-shard migration + deadlock (EVM contracts over two shards)
+# ---------------------------------------------------------------------------
+
+from fisco_bcos_tpu.executor.evm import contract_table  # noqa: E402
+
+from evm_asm import _deployer, pingpong_runtime  # noqa: E402
+
+
+def _deploy_pingpong_pair(executor):
+    rc_a, rc_b = executor.execute_transactions(
+        [
+            Transaction(to=b"", input=_deployer(pingpong_runtime()), sender=b"\xaa" * 20),
+            Transaction(to=b"", input=_deployer(pingpong_runtime()), sender=b"\xaa" * 20),
+        ]
+    )
+    assert rc_a.status == 0 and rc_b.status == 0
+    return rc_a.contract_address, rc_b.contract_address
+
+
+def _slot0(executor, addr):
+    row = executor._block.storage.get_row(contract_table(addr), (0).to_bytes(32, "big"))
+    return int.from_bytes(row.get(), "big") if row else 0
+
+
+def _two_shards(executor, a, b):
+    """Shard 1 owns everything except B; shard 2 owns B."""
+    s1 = ExecutorShard(executor, "shard1", owns=lambda c: c != b)
+    s2 = ExecutorShard(executor, "shard2", owns=lambda c: c == b)
+    return s1, s2, (lambda c: s2 if c == b else s1)
+
+
+def test_cross_shard_call_migrates_and_commits():
+    executor = _env()
+    a, b = _deploy_pingpong_pair(executor)
+    s1, s2, shard_of = _two_shards(executor, a, b)
+    sched = DMCScheduler(shard_of)
+    tx = Transaction(to=a, input=b"\x00" * 12 + b, sender=b"\xbb" * 20)
+    tx.force_sender(b"\xbb" * 20)
+    receipts = sched.execute([tx])
+    assert receipts[0].status == 0, receipts[0].output
+    # the call really migrated: more than one DMC round ran
+    assert sched.recorder.round >= 2
+    # both contracts' writes committed atomically
+    assert _slot0(executor, a) == 1
+    assert _slot0(executor, b) == 1
+    # nothing left parked
+    assert not s1.parked and not s2.parked
+
+
+def test_cross_shard_matches_single_shard():
+    # 2-shard topology
+    ex1 = _env()
+    a1, b1 = _deploy_pingpong_pair(ex1)
+    _, _, shard_of = _two_shards(ex1, a1, b1)
+    tx = Transaction(to=a1, input=b"\x00" * 12 + b1, sender=b"\xbb" * 20)
+    r2 = DMCScheduler(shard_of).execute([tx])
+    # single shard topology, same workload
+    ex2 = _env()
+    a2, b2 = _deploy_pingpong_pair(ex2)
+    solo = ExecutorShard(ex2, "solo")
+    tx2 = Transaction(to=a2, input=b"\x00" * 12 + b2, sender=b"\xbb" * 20)
+    r1 = DMCScheduler(lambda c: solo).execute([tx2])
+    assert [(rc.status, rc.output) for rc in r1] == [(rc.status, rc.output) for rc in r2]
+    # identical state either way (addresses are derived identically)
+    assert ex1.get_hash() == ex2.get_hash()
+
+
+def test_deadlock_reverts_victim_through_live_path():
+    executor = _env()
+    a, b = _deploy_pingpong_pair(executor)
+    s1, s2, shard_of = _two_shards(executor, a, b)
+    sched = DMCScheduler(shard_of)
+    tx1 = Transaction(to=a, input=b"\x00" * 12 + b, sender=b"\xbb" * 20)  # A -> B
+    tx2 = Transaction(to=b, input=b"\x00" * 12 + a, sender=b"\xcc" * 20)  # B -> A
+    receipts = sched.execute([tx1, tx2])
+    # ctx1 is the deterministic victim; ctx0 completes after the revert
+    assert receipts[0].status == 0, receipts[0].output
+    assert receipts[1].status == int(TransactionStatus.REVERT_INSTRUCTION)
+    assert receipts[1].output == b"deadlock victim"
+    # ctx0's atomic commit hit both shards
+    assert _slot0(executor, a) == 1
+    assert _slot0(executor, b) == 1

@@ -6,13 +6,21 @@ trips incl. negative cases). CPU reference and device batch kernels must agree
 bit-exactly: any disagreement is consensus-fatal (BASELINE.json north star).
 """
 
-import secrets
-
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from fisco_bcos_tpu.crypto.ref import ecdsa as ref
-from fisco_bcos_tpu.ops import bigint, ec, secp256k1, sm2
+from fisco_bcos_tpu.ops import ec, limb, secp256k1, sm2
+
+
+def _rows(vals):
+    return jnp.asarray(np.stack([limb.int_to_rows(v) for v in vals], axis=1))
+
+
+def _aff_ints(C, t):
+    dec = lambda a: limb.rows_to_ints(np.asarray(C.F.to_plain(a)))
+    return list(zip(dec(t[0]), dec(t[1])))
 
 
 def _keypair(curve, seed):
@@ -29,60 +37,73 @@ def _pub_bytes(pub):
 
 
 class TestJacobianGroupLaw:
-    def test_add_double_match_reference(self):
+    def test_add_double_mixed_and_exceptional(self):
+        """One fused batch over the exceptional-case matrix: generic add,
+        P == Q (double fallback), P == -Q (infinity), and doubling."""
         c = ref.SECP256K1
-        ctx = ec.SECP256K1_CTX
-        pts = [ref.point_mul(c, k, (c.gx, c.gy)) for k in (1, 2, 3, 7, 1 << 200)]
-        xs = bigint.ints_to_limbs([p[0] for p in pts])
-        ys = bigint.ints_to_limbs([p[1] for p in pts])
-        xm = bigint.to_mont(xs, ctx.p)
-        ym = bigint.to_mont(ys, ctx.p)
-        one = bigint._const(ctx.p.r1, xm)
-        # double every point
-        dx, dy, dz = ec.jac_double((xm, ym, one), ctx)
-        ax, ay, inf = ec.jac_to_affine((dx, dy, dz), ctx)
-        got_x = bigint.limbs_to_ints(bigint.from_mont(ax, ctx.p))
-        got_y = bigint.limbs_to_ints(bigint.from_mont(ay, ctx.p))
-        for i, p in enumerate(pts):
-            want = ref.point_add(c, p, p)
-            assert (got_x[i], got_y[i]) == want
-            assert not bool(inf[i])
-
-    def test_add_exceptional_cases(self):
-        c = ref.SECP256K1
-        ctx = ec.SECP256K1_CTX
+        C = ec.SECP256K1_OPS
         g = (c.gx, c.gy)
         g2 = ref.point_add(c, g, g)
-        # lanes: G+2G (generic), G+G (same -> double), G+(-G) (infinity)
-        p_pts = [g, g, g]
-        q_pts = [g2, g, (c.gx, c.p - c.gy)]
-        px = bigint.to_mont(bigint.ints_to_limbs([p[0] for p in p_pts]), ctx.p)
-        py = bigint.to_mont(bigint.ints_to_limbs([p[1] for p in p_pts]), ctx.p)
-        qx = bigint.to_mont(bigint.ints_to_limbs([q[0] for q in q_pts]), ctx.p)
-        qy = bigint.to_mont(bigint.ints_to_limbs([q[1] for q in q_pts]), ctx.p)
-        one = bigint._const(ctx.p.r1, px)
-        rx, ry, rz = ec.jac_add((px, py, one), (qx, qy, one), ctx)
-        ax, ay, inf = ec.jac_to_affine((rx, ry, rz), ctx)
-        got_x = bigint.limbs_to_ints(bigint.from_mont(ax, ctx.p))
-        got_y = bigint.limbs_to_ints(bigint.from_mont(ay, ctx.p))
+        p_pts = [g, g, g, g2]
+        q_pts = [g2, g, (c.gx, c.p - c.gy), g2]
+        enc = lambda vals: C.F.from_plain(_rows(vals))
+        px = enc([p[0] for p in p_pts])
+        py = enc([p[1] for p in p_pts])
+        qx = enc([q[0] for q in q_pts])
+        qy = enc([q[1] for q in q_pts])
+        one = C.F.one(px)
+        aff = _aff_ints(C, ec.jac_to_affine(ec.jac_add((px, py, one), (qx, qy, one), C), C)[:2])
+        inf = np.asarray(ec.jac_to_affine(ec.jac_add((px, py, one), (qx, qy, one), C), C)[2])
         g3 = ref.point_add(c, g, g2)
-        assert (got_x[0], got_y[0]) == g3 and not bool(inf[0])
-        assert (got_x[1], got_y[1]) == g2 and not bool(inf[1])
-        assert bool(inf[2])
+        g4 = ref.point_add(c, g2, g2)
+        assert aff[0] == g3 and not inf[0]
+        assert aff[1] == g2 and not inf[1]
+        assert inf[2]
+        assert aff[3] == g4 and not inf[3]
+        # mixed addition (affine operand) hits the same matrix
+        maff_pt = ec.jac_to_affine(ec.jac_add_mixed((px, py, one), (qx, qy), C), C)
+        maff = _aff_ints(C, maff_pt[:2])
+        minf = np.asarray(maff_pt[2])
+        assert maff[0] == g3 and maff[1] == g2 and minf[2] and maff[3] == g4
+        # doubling
+        daff_pt = ec.jac_to_affine(ec.jac_double((px, py, one), C), C)
+        daff = _aff_ints(C, daff_pt[:2])
+        assert daff[0] == g2 and daff[3] == g4
 
-    @pytest.mark.parametrize("ctx,c", [(ec.SECP256K1_CTX, ref.SECP256K1), (ec.SM2_CTX, ref.SM2_CURVE)])
-    def test_scalar_mul(self, ctx, c):
+    @pytest.mark.parametrize(
+        "C,c", [(ec.SECP256K1_OPS, ref.SECP256K1), (ec.SM2_OPS, ref.SM2_CURVE)]
+    )
+    def test_scalar_mul(self, C, c):
         ks = [1, 2, 5, c.n - 1]
-        k = bigint.ints_to_limbs(ks)
-        gx, gy = ec.generator(ctx, bigint.to_mont(k, ctx.p))
-        R = ec.scalar_mul(k, (gx, gy), ctx)
-        ax, ay, inf = ec.jac_to_affine(R, ctx)
-        got_x = bigint.limbs_to_ints(bigint.from_mont(ax, ctx.p))
-        got_y = bigint.limbs_to_ints(bigint.from_mont(ay, ctx.p))
+        k = _rows(ks)
+        Q = ec.generator_affine(C, k)
+        pt = ec.jac_to_affine(ec.scalar_mul(k, Q, C), C)
+        aff = _aff_ints(C, pt[:2])
+        inf = np.asarray(pt[2])
         for i, kk in enumerate(ks):
             want = ref.point_mul(c, kk, (c.gx, c.gy))
-            assert (got_x[i], got_y[i]) == want
+            assert aff[i] == want
             assert not bool(inf[i])
+
+    def test_dual_mul_matches_reference(self):
+        c = ref.SECP256K1
+        C = ec.SECP256K1_OPS
+        gt = jnp.asarray(ec.g_comb_table(C.name))
+        Qpt = ref.point_mul(c, 9, (c.gx, c.gy))
+        u1s = [0, 1, 3, 0xDEADBEEF, c.n - 1]
+        u2s = [1, 1, 5, 0xCAFE, c.n - 2]
+        Q = (_rows([Qpt[0]] * 5), _rows([Qpt[1]] * 5))
+        pt = ec.jac_to_affine(
+            ec.dual_mul_windowed(_rows(u1s), _rows(u2s), Q, C, gt), C
+        )
+        aff = _aff_ints(C, pt[:2])
+        for i, (u1, u2) in enumerate(zip(u1s, u2s)):
+            want = ref.point_add(
+                c,
+                ref.point_mul(c, u1, (c.gx, c.gy)),
+                ref.point_mul(c, u2 * 9 % c.n, (c.gx, c.gy)),
+            )
+            assert aff[i] == want
 
 
 class TestSecp256k1Batch:

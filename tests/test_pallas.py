@@ -1,0 +1,84 @@
+"""Pallas kernel semantics via the interpreter (no TPU hardware needed).
+
+The TPU fast path (ops/pallas_ec) wraps the exact same ``*_core`` bodies the
+XLA path jits, so correctness is shared — but the Pallas wrapper adds its own
+failure modes (captured-constant restriction, block specs, grid padding).
+The interpreter executes the real pallas_call pipeline on CPU and must
+reproduce the Python-reference results bit-exactly.
+"""
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fisco_bcos_tpu.crypto.ref import ecdsa as ref
+from fisco_bcos_tpu.ops import pallas_ec
+from fisco_bcos_tpu.ops.bigint import bytes_be_to_limbs, limbs_to_ints
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    pallas_ec.INTERPRET = True
+    yield
+    pallas_ec.INTERPRET = False
+
+
+def _vectors(n):
+    hashes, sigs, pubs = [], [], []
+    for i in range(n):
+        d = 0xFACE + i * 104729
+        h = hashlib.sha256(b"pallas %d" % i).digest()
+        r, s, v = ref.ecdsa_sign(h, d)
+        hashes.append(h)
+        sigs.append((r, s, v))
+        pubs.append(ref.privkey_to_pubkey(ref.SECP256K1, d))
+    z = bytes_be_to_limbs(np.frombuffer(b"".join(hashes), np.uint8).reshape(n, 32))
+    r = bytes_be_to_limbs(
+        np.stack([np.frombuffer(rr.to_bytes(32, "big"), np.uint8) for rr, _, _ in sigs])
+    )
+    s = bytes_be_to_limbs(
+        np.stack([np.frombuffer(ss.to_bytes(32, "big"), np.uint8) for _, ss, _ in sigs])
+    )
+    v = np.array([vv for _, _, vv in sigs], np.int32)
+    return z, r, s, v, pubs
+
+
+def test_recover_and_verify_interpret_match_reference():
+    n = 3
+    z, r, s, v, pubs = _vectors(n)
+    qx, qy, ok = pallas_ec.recover_pallas(
+        jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v)
+    )
+    ok = np.asarray(ok)
+    got_x = limbs_to_ints(np.asarray(qx)[:n])
+    got_y = limbs_to_ints(np.asarray(qy)[:n])
+    for i in range(n):
+        assert ok[i]
+        assert (got_x[i], got_y[i]) == pubs[i]
+    # padding lanes (zero signatures) must come back invalid, not crash
+    assert not ok[n:].any()
+
+    qxl = bytes_be_to_limbs(
+        np.stack([np.frombuffer(x.to_bytes(32, "big"), np.uint8) for x, _ in pubs])
+    )
+    qyl = bytes_be_to_limbs(
+        np.stack([np.frombuffer(y.to_bytes(32, "big"), np.uint8) for _, y in pubs])
+    )
+    okv = np.asarray(
+        pallas_ec.verify_pallas(
+            jnp.asarray(z), jnp.asarray(r), jnp.asarray(s),
+            jnp.asarray(qxl), jnp.asarray(qyl),
+        )
+    )
+    assert okv[:n].all()
+    s_bad = s.copy()
+    s_bad[0, 0] ^= 1
+    okv2 = np.asarray(
+        pallas_ec.verify_pallas(
+            jnp.asarray(z), jnp.asarray(r), jnp.asarray(s_bad),
+            jnp.asarray(qxl), jnp.asarray(qyl),
+        )
+    )
+    assert not okv2[0] and okv2[1:n].all()

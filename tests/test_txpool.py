@@ -44,7 +44,7 @@ def test_submit_single_and_duplicates():
     r = pool.submit(tx)
     assert r.status == ErrorCode.SUCCESS
     assert r.sender == tx.sender != b""
-    assert pool.submit(tx).status == ErrorCode.TX_POOL_ALREADY_KNOWN
+    assert pool.submit(tx).status == ErrorCode.ALREADY_IN_TX_POOL
     # same nonce, different payload -> rejected by pool nonce checker
     (tx2,) = _txs(suite, 1)
     tx2.input = b"different"
@@ -101,7 +101,7 @@ def test_batch_submit_seal_commit_cycle():
     assert pool.pending_count() == 8
     # resubmission -> already known
     again = pool.submit_batch(txs[:2])
-    assert all(r.status == ErrorCode.TX_POOL_ALREADY_KNOWN for r in again)
+    assert all(r.status == ErrorCode.ALREADY_IN_TX_POOL for r in again)
 
     sealed = pool.seal_txs(5)
     assert len(sealed) == 5 and pool.unsealed_count() == 3
@@ -125,7 +125,7 @@ def test_batch_submit_seal_commit_cycle():
     assert pool.pending_count() == 4  # 3 unsealed + imported extra
     # committed nonce replays are rejected
     replay = _txs(suite, 1)[0]
-    assert pool.submit(replay).status == ErrorCode.TX_POOL_NONCE_TOO_OLD
+    assert pool.submit(replay).status == ErrorCode.TX_ALREADY_IN_CHAIN
 
 
 def test_batch_submit_marks_invalid_signature():
