@@ -1,0 +1,167 @@
+"""Air-node entrypoint: ``python -m fisco_bcos_tpu -c config.ini -g config.genesis``.
+
+Reference: fisco-bcos-air/main.cpp:36-70 (signal handlers + AirNodeInitializer
+init/start) and libinitializer/Initializer.cpp:121-330 (the wiring itself,
+which here lives in node/node.py).  One OS process runs one node: TCP P2P
+gateway, JSON-RPC server, and the runtime worker loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+import time
+
+from .gateway import TcpGateway
+from .node import Node
+from .node.runtime import NodeRuntime
+from .rpc import JsonRpcImpl, RpcHttpServer
+from .tool.config import ChainOptions, load_chain_options, load_keypair
+from .utils.log import get_logger
+
+_log = get_logger("main")
+
+
+def _peer_maintainer(gw: TcpGateway, opts: ChainOptions, stop: threading.Event):
+    """Keep dialing the static peer list until every address is connected
+    (reference: Service::heartBeat reconnect loop, bcos-gateway
+    libp2p/Service.cpp).  Dials are cheap; connected peers re-register."""
+    own = (opts.p2p_listen_ip, opts.p2p_listen_port)
+    addrs = [(p.host, p.port) for p in opts.peers if (p.host, p.port) != own]
+    while not stop.is_set():
+        if len(gw.peers()) < len(addrs):
+            for host, port in addrs:
+                if stop.is_set():
+                    break
+                gw.connect_peer(host, port)
+        stop.wait(2.0)
+
+
+def build_node(opts: ChainOptions):
+    """Assemble a live node from ChainOptions: Node + gateway + RPC + runtime.
+    Returns (node, gateway, rpc_server, runtime, stop_event)."""
+    from .crypto.suite import ecdsa_suite, sm_suite
+
+    suite = sm_suite() if opts.node.sm_crypto else ecdsa_suite()
+    kp = load_keypair(opts.private_key_path, suite)
+    node = Node(opts.node, keypair=kp)
+
+    srv_ssl = cli_ssl = rpc_ssl = None
+    if opts.enable_ssl:
+        from .gateway.tls import make_client_context, make_server_context
+
+        srv_ssl = make_server_context(opts.ca_cert, opts.node_cert, opts.node_key)
+        cli_ssl = make_client_context(opts.ca_cert, opts.node_cert, opts.node_key)
+        rpc_ssl = make_server_context(
+            opts.ca_cert, opts.node_cert, opts.node_key, require_client_cert=False
+        )
+    gw = TcpGateway(
+        kp.pub,
+        host=opts.p2p_listen_ip,
+        port=opts.p2p_listen_port,
+        ssl_context=srv_ssl,
+        client_ssl_context=cli_ssl,
+    )
+    gw.connect(node.front)
+    from .utils.metrics import bind_node_metrics
+
+    server = RpcHttpServer(
+        JsonRpcImpl(node),
+        host=opts.rpc_listen_ip,
+        port=opts.rpc_listen_port,
+        ssl_context=rpc_ssl,
+        metrics=bind_node_metrics(node),
+    )
+    ws = None
+    if opts.ws_listen_port:
+        from .rpc.event_sub import EventSubEngine
+        from .rpc.ws_server import WsService
+
+        ws = WsService(
+            JsonRpcImpl(node),
+            event_engine=EventSubEngine(node.ledger, node.suite),
+            amop=node.amop,
+            host=opts.rpc_listen_ip,
+            port=opts.ws_listen_port,
+            ssl_context=rpc_ssl,
+        )
+        node.scheduler.on_committed.append(ws.on_block_committed)
+
+    runtime = NodeRuntime(
+        node,
+        sealer_interval=opts.sealer_interval,
+        consensus_timeout=opts.consensus_timeout,
+        sync_interval=opts.sync_interval,
+    )
+    stop = threading.Event()
+    return node, gw, server, ws, runtime, stop
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="fisco-bcos-tpu", description=__doc__)
+    ap.add_argument("-c", "--config", default="config.ini")
+    ap.add_argument("-g", "--genesis", default="config.genesis")
+    ap.add_argument(
+        "--warmup",
+        type=int,
+        default=0,
+        metavar="B",
+        help="pre-compile admission kernels for batch bucket B before serving",
+    )
+    args = ap.parse_args(argv)
+
+    opts = load_chain_options(args.config, args.genesis)
+    logging.basicConfig(
+        level=getattr(logging, opts.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    node, gw, server, ws, runtime, stop = build_node(opts)
+    _log.info(
+        "node %s | chain %s group %s | p2p %s:%d rpc %s:%d | sealer=%s",
+        node.node_id.hex()[:16],
+        opts.node.chain_id,
+        opts.node.group_id,
+        opts.p2p_listen_ip,
+        gw.port,
+        opts.rpc_listen_ip,
+        opts.rpc_listen_port,
+        node.is_sealer(),
+    )
+
+    if args.warmup:
+        node.warmup(batch_sizes=(args.warmup,))
+
+    gw.start()
+    dialer = threading.Thread(
+        target=_peer_maintainer, args=(gw, opts, stop), name="peer-dial", daemon=True
+    )
+    dialer.start()
+    server.start()
+    if ws is not None:
+        ws.start()
+    runtime.start()
+
+    def _shutdown(signum, frame):
+        _log.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    try:
+        while not stop.is_set():
+            time.sleep(0.2)
+    finally:
+        runtime.stop()
+        if ws is not None:
+            ws.stop()
+        server.stop()
+        gw.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""SCALE codec — the WASM/liquid contract parameter encoding.
+
+Reference: bcos-codec/scale/{Scale.h, ScaleEncoderStream.cpp,
+ScaleDecoderStream.cpp} (parity-SCALE: compact length-prefixed vectors,
+little-endian fixed-width ints, single-byte bools, 0x00/0x01 options).
+Type descriptors are strings, mirroring how the ABI codec names types:
+
+    u8 u16 u32 u64 u128 i8..i128 bool string bytes
+    compact                      (compact-encoded unsigned integer)
+    vec<T>   option<T>   (T1,T2,...)   [T;N]
+"""
+
+from __future__ import annotations
+
+
+class ScaleError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Compact integers (the SCALE hallmark)
+# ---------------------------------------------------------------------------
+
+
+def encode_compact(n: int) -> bytes:
+    if n < 0:
+        raise ScaleError("compact is unsigned")
+    if n < 1 << 6:
+        return bytes([n << 2])
+    if n < 1 << 14:
+        return ((n << 2) | 0b01).to_bytes(2, "little")
+    if n < 1 << 30:
+        return ((n << 2) | 0b10).to_bytes(4, "little")
+    data = n.to_bytes((n.bit_length() + 7) // 8, "little")
+    if len(data) > 67:
+        raise ScaleError("compact too large")
+    return bytes([((len(data) - 4) << 2) | 0b11]) + data
+
+
+def decode_compact(buf: bytes, pos: int = 0) -> tuple[int, int]:
+    """Returns (value, new_pos)."""
+    if pos >= len(buf):
+        raise ScaleError("truncated compact")
+    mode = buf[pos] & 0b11
+    if mode == 0b00:
+        return buf[pos] >> 2, pos + 1
+    if mode == 0b01:
+        if pos + 2 > len(buf):
+            raise ScaleError("truncated compact16")
+        return int.from_bytes(buf[pos : pos + 2], "little") >> 2, pos + 2
+    if mode == 0b10:
+        if pos + 4 > len(buf):
+            raise ScaleError("truncated compact32")
+        return int.from_bytes(buf[pos : pos + 4], "little") >> 2, pos + 4
+    nbytes = (buf[pos] >> 2) + 4
+    if pos + 1 + nbytes > len(buf):
+        raise ScaleError("truncated big compact")
+    return int.from_bytes(buf[pos + 1 : pos + 1 + nbytes], "little"), pos + 1 + nbytes
+
+
+# ---------------------------------------------------------------------------
+# Type-driven encode/decode
+# ---------------------------------------------------------------------------
+
+_INTS = {f"u{b}": (b // 8, False) for b in (8, 16, 32, 64, 128)}
+_INTS.update({f"i{b}": (b // 8, True) for b in (8, 16, 32, 64, 128)})
+
+
+def _split_tuple(inner: str) -> list[str]:
+    parts, depth, cur = [], 0, ""
+    for ch in inner:
+        if ch in "<([":
+            depth += 1
+        elif ch in ">)]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur.strip())
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        parts.append(cur.strip())
+    return parts
+
+
+def scale_encode(typ: str, value) -> bytes:
+    typ = typ.strip()
+    if typ in _INTS:
+        size, signed = _INTS[typ]
+        return int(value).to_bytes(size, "little", signed=signed)
+    if typ == "bool":
+        return b"\x01" if value else b"\x00"
+    if typ == "compact":
+        return encode_compact(int(value))
+    if typ == "string":
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        return encode_compact(len(data)) + data
+    if typ == "bytes":
+        data = bytes(value)
+        return encode_compact(len(data)) + data
+    if typ.startswith("vec<") and typ.endswith(">"):
+        inner = typ[4:-1]
+        out = encode_compact(len(value))
+        for v in value:
+            out += scale_encode(inner, v)
+        return out
+    if typ.startswith("option<") and typ.endswith(">"):
+        if value is None:
+            return b"\x00"
+        return b"\x01" + scale_encode(typ[7:-1], value)
+    if typ.startswith("(") and typ.endswith(")"):
+        parts = _split_tuple(typ[1:-1])
+        if len(parts) != len(value):
+            raise ScaleError(f"tuple arity mismatch: {typ}")
+        return b"".join(scale_encode(t, v) for t, v in zip(parts, value))
+    if typ.startswith("[") and typ.endswith("]") and ";" in typ:
+        inner, _, n = typ[1:-1].rpartition(";")
+        n = int(n)
+        if len(value) != n:
+            raise ScaleError(f"fixed array length mismatch: {typ}")
+        return b"".join(scale_encode(inner.strip(), v) for v in value)
+    raise ScaleError(f"unknown SCALE type: {typ}")
+
+
+def scale_decode(typ: str, buf: bytes, pos: int = 0) -> tuple[object, int]:
+    typ = typ.strip()
+    if typ in _INTS:
+        size, signed = _INTS[typ]
+        if pos + size > len(buf):
+            raise ScaleError(f"truncated {typ}")
+        return int.from_bytes(buf[pos : pos + size], "little", signed=signed), pos + size
+    if typ == "bool":
+        if pos >= len(buf) or buf[pos] not in (0, 1):
+            raise ScaleError("bad bool")
+        return buf[pos] == 1, pos + 1
+    if typ == "compact":
+        return decode_compact(buf, pos)
+    if typ in ("string", "bytes"):
+        n, pos = decode_compact(buf, pos)
+        if pos + n > len(buf):
+            raise ScaleError("truncated bytes")
+        raw = bytes(buf[pos : pos + n])
+        return (raw.decode() if typ == "string" else raw), pos + n
+    if typ.startswith("vec<") and typ.endswith(">"):
+        inner = typ[4:-1]
+        n, pos = decode_compact(buf, pos)
+        out = []
+        for _ in range(n):
+            v, pos = scale_decode(inner, buf, pos)
+            out.append(v)
+        return out, pos
+    if typ.startswith("option<") and typ.endswith(">"):
+        if pos >= len(buf) or buf[pos] not in (0, 1):
+            raise ScaleError("bad option tag")
+        if buf[pos] == 0:
+            return None, pos + 1
+        return scale_decode(typ[7:-1], buf, pos + 1)
+    if typ.startswith("(") and typ.endswith(")"):
+        parts = _split_tuple(typ[1:-1])
+        out = []
+        for t in parts:
+            v, pos = scale_decode(t, buf, pos)
+            out.append(v)
+        return tuple(out), pos
+    if typ.startswith("[") and typ.endswith("]") and ";" in typ:
+        inner, _, n = typ[1:-1].rpartition(";")
+        out = []
+        for _ in range(int(n)):
+            v, pos = scale_decode(inner.strip(), buf, pos)
+            out.append(v)
+        return out, pos
+    raise ScaleError(f"unknown SCALE type: {typ}")
+
+
+def scale_decode_exact(typ: str, buf: bytes):
+    v, pos = scale_decode(typ, buf)
+    if pos != len(buf):
+        raise ScaleError(f"{len(buf) - pos} trailing bytes")
+    return v

@@ -9,10 +9,12 @@ stable checkpoint → ledger commit), and view change
 (handleViewChangeMsg:1193 / handleNewViewMsg:1273).
 
 Differences kept deliberate and documented:
-- Proposals carry full txs (the reference ships hash metadata + tx-sync
-  fetch; the sync module will restore that); replica admission still
-  batch-verifies every carried signature in one device program — the #1
-  consensus hot loop runs on TPU.
+- Proposals carry tx-hash metadata (SealingManager ships TransactionMetaData);
+  replicas fill from the pool and synchronously fetch stragglers from the
+  leader via tx-sync (asyncVerifyBlock's fetch-then-recheck), with fetched
+  signatures batch-verified in one device program. Full-tx proposals remain
+  accepted (view-change re-proposals carry the filled block so a new node
+  can vote without a pool).
 - Execution happens at commit-quorum inside the handler (the reference
   pipelines via StateMachine::asyncApply worker threads); checkpoint
   signatures then form the QC stored in the header's signature_list, exactly
@@ -24,6 +26,7 @@ Differences kept deliberate and documented:
 
 from __future__ import annotations
 
+import queue
 import threading
 from dataclasses import dataclass, field
 
@@ -70,6 +73,7 @@ class PBFTEngine:
         txpool: TxPool,
         ledger: Ledger,
         front: FrontService,
+        consensus_storage: "ConsensusStorage | None" = None,
     ):
         self.config = config
         self.scheduler = scheduler
@@ -80,6 +84,15 @@ class PBFTEngine:
         self.view = 0
         self.to_view = 0  # view we are trying to change to
         self.committed_number = ledger.block_number()
+        # durable consensus state (pbft/storage/LedgerStorage.cpp analog):
+        # restores view + vote guards + the prepared proposal after a crash
+        self.cstore = consensus_storage
+        self._recovered_prepared: tuple[int, int, bytes, list[bytes]] | None = None
+        if self.cstore is not None:
+            self.view = self.to_view = self.cstore.load_view()
+            rp = self.cstore.load_prepared()
+            if rp is not None and rp[0] == self.committed_number + 1:
+                self._recovered_prepared = rp
         self._caches: dict[int, ProposalCache] = {}
         self._view_changes: dict[int, dict[int, PBFTMessage]] = {}
         self._recover_responses: dict[int, PBFTMessage] = {}
@@ -89,7 +102,47 @@ class PBFTEngine:
         self._view_locks: dict[int, tuple[int, bytes]] = {}
         self._lock = threading.RLock()
         self.timeout_state = False
+        # set by node wiring: (hashes, from_node_id) -> list[Transaction|None]
+        # (TransactionSync.fetch_missing — the proposal straggler fetch)
+        self.fetch_missing_fn = None
+        # live deployments dispatch PBFT messages on one consensus worker
+        # thread (the reference's single PBFTEngine worker, PBFTEngine.cpp:40)
+        # so a blocking tx fetch can't stall the gateway reader that must
+        # deliver the fetch response; deterministic tests dispatch inline.
+        self._worker_queue: "queue.SimpleQueue | None" = None
+        self._worker: threading.Thread | None = None
         front.register_module(ModuleID.PBFT, self._on_front_message)
+
+    # ----------------------------------------------------------------- worker
+
+    def start_worker(self) -> None:
+        if self._worker is not None:
+            return
+        self._worker_queue = queue.SimpleQueue()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="pbft-worker", daemon=True
+        )
+        self._worker.start()
+
+    def stop_worker(self) -> None:
+        q = self._worker_queue
+        if q is not None:
+            q.put(None)
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        self._worker = None
+        self._worker_queue = None
+
+    def _worker_loop(self) -> None:
+        q = self._worker_queue
+        while True:
+            msg = q.get()
+            if msg is None:
+                return
+            try:
+                self.handle_message(msg)
+            except Exception:
+                _log.exception("pbft worker failed on %s", msg.packet_type.name)
 
     # ------------------------------------------------------------------ utils
 
@@ -147,7 +200,11 @@ class PBFTEngine:
         except Exception:
             _log.warning("undecodable pbft message from %s", src.hex()[:8])
             return
-        self.handle_message(msg)
+        q = self._worker_queue
+        if q is not None:
+            q.put(msg)
+        else:
+            self.handle_message(msg)
 
     def handle_message(self, msg: PBFTMessage) -> None:
         node = self.config.node_at(msg.generated_from)
@@ -175,44 +232,78 @@ class PBFTEngine:
 
     # ------------------------------------------------------------ pre-prepare
 
+    def _pre_prepare_gate(self, msg: PBFTMessage) -> bool:
+        """The admissibility checks for a pre-prepare (run under the lock,
+        twice: before the lock-free verify and again before voting)."""
+        if not self._in_waterline(msg.number):
+            return False
+        if msg.view != self.view or self.timeout_state:
+            return False
+        if msg.generated_from != self.config.leader_index(msg.number, msg.view):
+            _log.warning("pre-prepare from non-leader %d", msg.generated_from)
+            return False
+        cache = self._cache(msg.number)
+        if cache.pre_prepare is not None:
+            # accepting a SECOND proposal for the same (number, view) and
+            # voting again is equivocation — PBFT safety forbids it
+            if cache.pre_prepare.proposal_hash != msg.proposal_hash:
+                _log.warning(
+                    "leader equivocation at %d/%d ignored", msg.number, msg.view
+                )
+            return False
+        lock = self._view_locks.get(msg.view)
+        if lock is not None and lock[0] == msg.number and lock[1] != msg.proposal_hash:
+            _log.warning(
+                "pre-prepare %d/%d violates new-view prepared lock",
+                msg.number,
+                msg.view,
+            )
+            return False
+        return True
+
     def _handle_pre_prepare(self, msg: PBFTMessage, from_self: bool = False) -> None:
         with self._lock:
-            if not self._in_waterline(msg.number):
+            if not self._pre_prepare_gate(msg):
                 return
-            if msg.view != self.view or self.timeout_state:
+            leader = self.config.node_at(msg.generated_from)
+        # decode + verify + tx fill run OUTSIDE the lock: the metadata fetch
+        # can block on tx-sync for seconds, and votes/other handlers must
+        # keep flowing meanwhile (the reference verifies on txpool threads)
+        try:
+            block = Block.decode(msg.proposal_data)
+        except Exception:
+            _log.warning("undecodable proposal %d", msg.number)
+            return
+        if block.header.hash(self.suite) != msg.proposal_hash:
+            return
+        if block.header.number != msg.number:
+            return
+        if not self._verify_and_fill(
+            block, leader.node_id if leader else None, from_self
+        ):
+            _log.warning("proposal %d failed verification", msg.number)
+            return
+        with self._lock:
+            if not self._pre_prepare_gate(msg):  # state may have moved
                 return
-            if msg.generated_from != self.config.leader_index(msg.number, msg.view):
-                _log.warning("pre-prepare from non-leader %d", msg.generated_from)
-                return
-            cache = self._cache(msg.number)
-            if cache.pre_prepare is not None:
-                # accepting a SECOND proposal for the same (number, view) and
-                # voting again is equivocation — PBFT safety forbids it
-                if cache.pre_prepare.proposal_hash != msg.proposal_hash:
+            if self.cstore is not None:
+                # crash-safe equivocation guard: a vote for a different hash
+                # at this (number, view) may already be on the wire from a
+                # previous life of this process
+                pv = self.cstore.load_vote(msg.number)
+                if (
+                    pv is not None
+                    and pv[0] == msg.view
+                    and pv[1] != msg.proposal_hash
+                ):
                     _log.warning(
-                        "leader equivocation at %d/%d ignored", msg.number, msg.view
+                        "refusing conflicting re-vote at %d/%d after restart",
+                        msg.number,
+                        msg.view,
                     )
-                return
-            lock = self._view_locks.get(msg.view)
-            if lock is not None and lock[0] == msg.number and lock[1] != msg.proposal_hash:
-                _log.warning(
-                    "pre-prepare %d/%d violates new-view prepared lock",
-                    msg.number,
-                    msg.view,
-                )
-                return
-            try:
-                block = Block.decode(msg.proposal_data)
-            except Exception:
-                _log.warning("undecodable proposal %d", msg.number)
-                return
-            if block.header.hash(self.suite) != msg.proposal_hash:
-                return
-            if block.header.number != msg.number:
-                return
-            if not from_self and not self._verify_proposal(block):
-                _log.warning("proposal %d failed verification", msg.number)
-                return
+                    return
+                self.cstore.save_vote(msg.number, msg.view, msg.proposal_hash)
+            cache = self._cache(msg.number)
             cache.pre_prepare = msg
             cache.block = block
             prepare = PBFTMessage(
@@ -229,20 +320,45 @@ class PBFTEngine:
             self._check_prepared_quorum(msg.number, cache)
             self._check_commit_quorum(msg.number, cache)
 
-    def _verify_proposal(self, block: Block) -> bool:
-        """Replica-side admission: batch-verify every carried signature on
-        device (the reference's asyncVerifyBlock + importDownloadedTxs hot
-        loop), then static checks per tx."""
-        txs = block.transactions
-        if not txs:
-            return True
-        ok = batch_admit(txs, self.suite)
-        if not bool(ok.all()):
-            return False
-        for t in txs:
-            code = self.txpool.validator.check_static(t)
-            if code not in (ErrorCode.SUCCESS, ErrorCode.ALREADY_IN_TX_POOL):
+    def _verify_and_fill(
+        self, block: Block, leader_id: bytes | None, from_self: bool
+    ) -> bool:
+        """Proposal verification + tx fill (asyncVerifyBlock + asyncFillBlock).
+
+        Metadata proposals: every hash must be pooled (stragglers fetched
+        from the leader via tx-sync and batch-verified on device before
+        import — TxPool.verify_block), then the block is filled in metadata
+        order. Full-tx proposals (view-change re-proposals): carried
+        signatures batch-verified on device. Both paths end with the header
+        txs_root recomputed against the device merkle — binding votes to tx
+        *content*, not just the hash list.
+        """
+        if block.tx_metadata and not block.transactions:
+            fetch = None
+            if self.fetch_missing_fn is not None and leader_id is not None:
+                fetch = lambda hs: self.fetch_missing_fn(hs, leader_id)  # noqa: E731
+            ok, missing = self.txpool.verify_block(block.tx_metadata, fetch)
+            if not ok:
+                _log.warning("proposal missing %d txs", len(missing))
                 return False
+            txs = self.txpool.fetch_txs(block.tx_metadata)
+            if any(t is None for t in txs):
+                return False
+            block.transactions = txs  # fill in metadata order
+        elif block.transactions and not from_self:
+            # full-tx proposal: device batch admission of carried signatures
+            ok = batch_admit(block.transactions, self.suite)
+            if not bool(ok.all()):
+                return False
+            for t in block.transactions:
+                code = self.txpool.validator.check_static(t)
+                if code not in (ErrorCode.SUCCESS, ErrorCode.ALREADY_IN_TX_POOL):
+                    return False
+        if block.transactions and block.header.txs_root != block.calculate_txs_root(
+            self.suite
+        ):
+            _log.warning("proposal txs_root mismatch at %d", block.header.number)
+            return False
         return True
 
     # ------------------------------------------------------- prepare / commit
@@ -273,6 +389,15 @@ class PBFTEngine:
         if self._weight(agreeing) < self.config.quorum:
             return
         cache.prepared = True
+        if self.cstore is not None and cache.block is not None:
+            # write-ahead of the COMMIT broadcast: after a crash this node
+            # can still prove (and re-offer) the prepared proposal
+            self.cstore.save_prepared(
+                number,
+                cache.pre_prepare.view,
+                cache.block.encode(),
+                [m.encode() for m in agreeing.values()],
+            )
         commit = PBFTMessage(
             packet_type=PacketType.COMMIT,
             view=self.view,
@@ -359,6 +484,13 @@ class PBFTEngine:
             stale = [n for n in self._caches if n <= msg.number]
             for n in stale:
                 self._caches.pop(n)
+            if self.cstore is not None:
+                self.cstore.prune_below(msg.number)
+            if (
+                self._recovered_prepared is not None
+                and self._recovered_prepared[0] <= msg.number
+            ):
+                self._recovered_prepared = None
             # committee may have changed at this block
             self.config.reload(self.ledger.consensus_nodes())
             _log.info(
@@ -396,6 +528,15 @@ class PBFTEngine:
                 for m in cache.prepares.values()
                 if m.proposal_hash == cache.pre_prepare.proposal_hash
             ]
+        elif (
+            self._recovered_prepared is not None
+            and self._recovered_prepared[0] == number
+        ):
+            # prepared before a crash (durable prepared record + its quorum
+            # certificate): re-offer it so the new leader can re-propose
+            _n, prepared_view, prepared_proposal, prepare_proof = (
+                self._recovered_prepared
+            )
         payload = ViewChangePayload(
             committed_number=self.committed_number,
             prepared_view=prepared_view,
@@ -544,6 +685,8 @@ class PBFTEngine:
         self.view = view
         self.to_view = view
         self.timeout_state = False
+        if self.cstore is not None:
+            self.cstore.save_view(view)
         # votes from older views are void; proposals re-run under the new view
         self._caches = {
             n: c for n, c in self._caches.items() if n > self.committed_number and c.stable

@@ -1,9 +1,11 @@
 """Sealer — packages pending txs into block proposals.
 
 Reference: bcos-sealer/Sealer.cpp:94-114 (worker loop: fetch → generate →
-submit to consensus) + SealingManager.cpp:140/230. Proposals here carry full
-txs (see engine.py docstring); the tx-count limit comes from the ledger's
-governed config.
+submit to consensus) + SealingManager.cpp:140/230. Proposals carry tx-hash
+*metadata* only (SealingManager::generateProposal ships TransactionMetaData;
+replicas fill from their pool and fetch stragglers via tx-sync) — pre-prepare
+size is independent of tx payload size. The tx-count limit comes from the
+ledger's governed config.
 """
 
 from __future__ import annotations
@@ -55,7 +57,8 @@ class Sealer:
             sealer_list=[n.node_id for n in self.config.nodes],
             consensus_weights=[n.weight for n in self.config.nodes],
         )
-        block = Block(header=header, transactions=txs)
+        hashes = [t.hash(suite) for t in txs]
+        block = Block(header=header, tx_metadata=hashes)
         header.txs_root = block.calculate_txs_root(suite)
         header.clear_hash_cache()
         return block
@@ -69,11 +72,11 @@ class Sealer:
         ok = self.engine.submit_proposal(block)
         if not ok:
             # give the txs back — not our turn / wrong number
-            self.txpool.unseal([t.hash(self.config.suite) for t in block.transactions])
+            self.txpool.unseal(list(block.tx_metadata))
         else:
             _log.info(
                 "proposed block %d with %d txs",
                 block.header.number,
-                len(block.transactions),
+                len(block.tx_metadata),
             )
         return ok

@@ -1,0 +1,99 @@
+"""Symmetric encryption — AES-256-CBC and SM4-CBC.
+
+Reference: bcos-crypto/encrypt/{AESCrypto.cpp, SM4Crypto.cpp} (wedpr FFI),
+consumed by bcos-security/DataEncryption.cpp.  Wire format here (and for the
+DataEncryption consumer): ``iv(16) ‖ ciphertext`` with PKCS7 padding —
+self-contained ciphertexts, fresh IV per encryption.
+
+AES rides the baked-in ``cryptography`` package (OpenSSL-backed, like the
+reference); SM4 uses the pure-Python block cipher in crypto/ref/sm4.py
+(no tassl in this image — the host cost is per-value at rest, not on the
+consensus hot path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from .ref import sm4 as ref_sm4
+
+
+class SymmetricEncryption:
+    """bcos-framework SymmetricEncryption interface analog."""
+
+    name = ""
+    key_len = 32
+
+    def __init__(self, key: bytes):
+        if len(key) != self.key_len:
+            # the reference derives fixed-size dataKeys by hashing the
+            # configured passphrase (DataEncryption.cpp init)
+            key = hashlib.sha256(key).digest()[: self.key_len]
+        self.key = key
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decrypt(self, ciphertext: bytes) -> bytes:
+        raise NotImplementedError
+
+
+class AESEncryption(SymmetricEncryption):
+    """AES-256-CBC with PKCS7 (AESCrypto.cpp analog)."""
+
+    name = "aes-256-cbc"
+    key_len = 32
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+        from cryptography.hazmat.primitives.padding import PKCS7
+
+        iv = os.urandom(16)
+        padder = PKCS7(128).padder()
+        data = padder.update(plaintext) + padder.finalize()
+        enc = Cipher(algorithms.AES(self.key), modes.CBC(iv)).encryptor()
+        return iv + enc.update(data) + enc.finalize()
+
+    def decrypt(self, ciphertext: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+        from cryptography.hazmat.primitives.padding import PKCS7
+
+        iv, body = ciphertext[:16], ciphertext[16:]
+        dec = Cipher(algorithms.AES(self.key), modes.CBC(iv)).decryptor()
+        data = dec.update(body) + dec.finalize()
+        unpadder = PKCS7(128).unpadder()
+        return unpadder.update(data) + unpadder.finalize()
+
+
+class SM4Encryption(SymmetricEncryption):
+    """SM4-CBC with PKCS7 (SM4Crypto.cpp analog; national-secret mode).
+    Native C blocks when available (native_bind); pure-Python fallback."""
+
+    name = "sm4-cbc"
+    key_len = 16
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        from .. import native_bind
+
+        iv = os.urandom(16)
+        padded = ref_sm4._pad(plaintext)
+        out = native_bind.sm4_cbc(self.key, iv, padded, decrypt=False)
+        if out is None:
+            out = ref_sm4.cbc_encrypt(self.key, iv, plaintext)
+        return iv + out
+
+    def decrypt(self, ciphertext: bytes) -> bytes:
+        from .. import native_bind
+
+        iv, body = ciphertext[:16], ciphertext[16:]
+        out = native_bind.sm4_cbc(self.key, iv, body, decrypt=True)
+        if out is None:
+            return ref_sm4.cbc_decrypt(self.key, iv, body)
+        return ref_sm4._unpad(out)
+
+
+def make_encryption(key: bytes, sm_crypto: bool = False) -> SymmetricEncryption:
+    """Suite selection mirrors ProtocolInitializer.cpp:51-99: sm_crypto
+    deployments pair SM3/SM2 with SM4; standard with AES."""
+    return SM4Encryption(key) if sm_crypto else AESEncryption(key)

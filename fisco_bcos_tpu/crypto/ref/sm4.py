@@ -1,0 +1,118 @@
+"""SM4 block cipher (GB/T 32907-2016) — pure-Python reference.
+
+Reference role: bcos-crypto/encrypt/SM4Crypto.cpp (via wedpr FFI), consumed
+by bcos-security's DataEncryption for national-secret deployments.  The
+S-box and system parameters FK/CK are the published standard constants.
+32-round unbalanced Feistel over 128-bit blocks; CBC + PKCS7 helpers at the
+bottom match the reference's cipher mode.
+"""
+
+from __future__ import annotations
+
+_SBOX = bytes.fromhex(
+    "d690e9fecce13db716b614c228fb2c05"
+    "2b679a762abe04c3aa44132649860699"
+    "9c4250f491ef987a33540b43edcfac62"
+    "e4b31ca9c908e89580df94fa758f3fa6"
+    "4707a7fcf37317ba83593c19e6854fa8"
+    "686b81b27164da8bf8eb0f4b70569d35"
+    "1e240e5e6358d1a225227c3b01217887"
+    "d40046579fd327524c3602e7a0c4c89e"
+    "eabf8ad240c738b5a3f7f2cef96115a1"
+    "e0ae5da49b341a55ad933230f58cb1e3"
+    "1df6e22e8266ca60c02923ab0d534e6f"
+    "d5db3745defd8e2f03ff6a726d6c5b51"
+    "8d1baf92bbddbc7f11d95c411f105ad8"
+    "0ac13188a5cd7bbd2d74d012b8e5b4b0"
+    "8969974a0c96777e65b9f109c56ec684"
+    "18f07dec3adc4d2079ee5f3ed7cb3948"
+)
+_FK = (0xA3B1BAC6, 0x56AA3350, 0x677D9197, 0xB27022DC)
+_CK = tuple(
+    sum(((4 * i + j) * 7 % 256) << (24 - 8 * j) for j in range(4)) for i in range(32)
+)
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+
+def _tau(a: int) -> int:
+    return int.from_bytes(bytes(_SBOX[b] for b in a.to_bytes(4, "big")), "big")
+
+
+def _t(a: int) -> int:  # round transform
+    b = _tau(a)
+    return b ^ _rotl(b, 2) ^ _rotl(b, 10) ^ _rotl(b, 18) ^ _rotl(b, 24)
+
+
+def _t_prime(a: int) -> int:  # key-schedule transform
+    b = _tau(a)
+    return b ^ _rotl(b, 13) ^ _rotl(b, 23)
+
+
+def expand_key(key: bytes) -> list[int]:
+    if len(key) != 16:
+        raise ValueError("SM4 key must be 16 bytes")
+    mk = [int.from_bytes(key[i : i + 4], "big") for i in range(0, 16, 4)]
+    k = [mk[i] ^ _FK[i] for i in range(4)]
+    rk = []
+    for i in range(32):
+        k.append(k[i] ^ _t_prime(k[i + 1] ^ k[i + 2] ^ k[i + 3] ^ _CK[i]))
+        rk.append(k[-1])
+    return rk
+
+
+def _crypt_block(rk: list[int], block: bytes) -> bytes:
+    x = [int.from_bytes(block[i : i + 4], "big") for i in range(0, 16, 4)]
+    for i in range(32):
+        x.append(x[i] ^ _t(x[i + 1] ^ x[i + 2] ^ x[i + 3] ^ rk[i]))
+    return b"".join(v.to_bytes(4, "big") for v in reversed(x[32:36]))
+
+
+def encrypt_block(key: bytes, block: bytes) -> bytes:
+    return _crypt_block(expand_key(key), block)
+
+
+def decrypt_block(key: bytes, block: bytes) -> bytes:
+    return _crypt_block(list(reversed(expand_key(key))), block)
+
+
+# ---------------------------------------------------------------------------
+# CBC mode + PKCS7 (the reference's SM4 CBC usage)
+# ---------------------------------------------------------------------------
+
+
+def _pad(data: bytes) -> bytes:
+    n = 16 - len(data) % 16
+    return data + bytes([n]) * n
+
+
+def _unpad(data: bytes) -> bytes:
+    if not data or len(data) % 16:
+        raise ValueError("bad padded length")
+    n = data[-1]
+    if not 1 <= n <= 16 or data[-n:] != bytes([n]) * n:
+        raise ValueError("bad padding")
+    return data[:-n]
+
+
+def cbc_encrypt(key: bytes, iv: bytes, plaintext: bytes) -> bytes:
+    rk = expand_key(key)
+    out, prev = [], iv
+    data = _pad(plaintext)
+    for i in range(0, len(data), 16):
+        block = bytes(a ^ b for a, b in zip(data[i : i + 16], prev))
+        prev = _crypt_block(rk, block)
+        out.append(prev)
+    return b"".join(out)
+
+
+def cbc_decrypt(key: bytes, iv: bytes, ciphertext: bytes) -> bytes:
+    rk = list(reversed(expand_key(key)))
+    out, prev = [], iv
+    for i in range(0, len(ciphertext), 16):
+        block = ciphertext[i : i + 16]
+        out.append(bytes(a ^ b for a, b in zip(_crypt_block(rk, block), prev)))
+        prev = block
+    return _unpad(b"".join(out))

@@ -52,10 +52,15 @@ class HashImpl:
 
 
 class Keccak256(HashImpl):
+    """Single-item host path: native C core when available (native_bind —
+    the wedpr/EVP analog), pure-Python reference otherwise; batch path: TPU."""
+
     name = "keccak256"
 
     def hash(self, data: bytes) -> bytes:
-        return ref_keccak256(data)
+        from .. import native_bind
+
+        return native_bind.keccak256(data) or ref_keccak256(data)
 
     def hash_batch(self, msgs) -> np.ndarray:
         return keccak_ops.keccak256_batch(msgs)
@@ -65,7 +70,9 @@ class SM3(HashImpl):
     name = "sm3"
 
     def hash(self, data: bytes) -> bytes:
-        return ref_sm3(data)
+        from .. import native_bind
+
+        return native_bind.sm3(data) or ref_sm3(data)
 
     def hash_batch(self, msgs) -> np.ndarray:
         return sm3_ops.sm3_batch(msgs)
@@ -75,7 +82,9 @@ class Sha256(HashImpl):
     name = "sha256"
 
     def hash(self, data: bytes) -> bytes:
-        return ref_sha256(data)
+        from .. import native_bind
+
+        return native_bind.sha256(data) or ref_sha256(data)
 
     def hash_batch(self, msgs) -> np.ndarray:
         return sha256_ops.sha256_batch(msgs)

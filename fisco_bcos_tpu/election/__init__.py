@@ -1,0 +1,9 @@
+"""Leader election — master failover coordination.
+
+Reference: bcos-leader-election (ElectionConfig.h:26-47, LeaderElection.cpp:
+etcd campaign + lease keepalive + watcher).
+"""
+
+from .leader_election import LeaderElection
+
+__all__ = ["LeaderElection"]

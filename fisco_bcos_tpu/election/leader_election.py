@@ -1,0 +1,146 @@
+"""Lease-based leader election over a shared SQLite coordination file.
+
+Reference: bcos-leader-election/src/LeaderElection.cpp — etcd campaign with
+a TTL lease, keepalive renewals, and a watcher that fires on leadership
+change (Max-mode SchedulerManager/ExecutorManager failover).  No etcd exists
+in this image; a shared SQLite file gives the same primitives to co-located
+processes (BEGIN IMMEDIATE = the atomic compare-and-swap), and this module
+is the seam where an etcd/consul client would plug in for multi-host.
+
+Semantics preserved from the reference:
+- `campaign()` claims the key iff it is unowned or its lease expired;
+- a keepalive thread renews at ttl/3 (CampaignConfig keep-alive);
+- losing the lease (e.g. the process stalls past the TTL) demotes the node
+  and fires `on_change(False)`; a new leader fires its own `on_change(True)`;
+- `resign()` releases immediately (LeaderElection::deregister).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+from typing import Callable
+
+from ..utils.log import get_logger
+
+_log = get_logger("election")
+
+
+class LeaderElection:
+    def __init__(
+        self,
+        path: str,
+        key: str,
+        member_id: str,
+        lease_ttl: float = 3.0,
+    ):
+        self.path = path
+        self.key = key
+        self.member_id = member_id
+        self.lease_ttl = lease_ttl
+        self._conn = sqlite3.connect(path, check_same_thread=False, timeout=10)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS election ("
+            " k TEXT PRIMARY KEY, leader TEXT NOT NULL, expiry REAL NOT NULL)"
+        )
+        self._conn.commit()
+        self._lock = threading.RLock()
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.on_change: Callable[[bool], None] | None = None
+
+    # -- campaign --------------------------------------------------------------
+
+    def _try_claim(self) -> bool:
+        now = time.time()
+        with self._lock:
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+                row = self._conn.execute(
+                    "SELECT leader, expiry FROM election WHERE k=?", (self.key,)
+                ).fetchone()
+                if row is None or row[1] < now or row[0] == self.member_id:
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO election (k, leader, expiry)"
+                        " VALUES (?, ?, ?)",
+                        (self.key, self.member_id, now + self.lease_ttl),
+                    )
+                    self._conn.commit()
+                    return True
+                self._conn.commit()
+                return False
+            except sqlite3.OperationalError:
+                try:
+                    self._conn.rollback()
+                except sqlite3.Error:
+                    pass
+                return self._leader  # contention: keep current belief
+
+    def campaign(self) -> bool:
+        """Start campaigning; returns current leadership immediately and
+        keeps renewing/retrying on the keepalive thread."""
+        self._set_leader(self._try_claim())
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._keepalive, name=f"election-{self.key}", daemon=True
+            )
+            self._thread.start()
+        return self._leader
+
+    def _keepalive(self) -> None:
+        interval = max(0.05, self.lease_ttl / 3)
+        while not self._stop.wait(interval):
+            self._set_leader(self._try_claim())
+
+    def _set_leader(self, now_leader: bool) -> None:
+        with self._lock:
+            changed = now_leader != self._leader
+            self._leader = now_leader
+        if changed:
+            _log.info(
+                "%s %s leadership of %s",
+                self.member_id,
+                "acquired" if now_leader else "lost",
+                self.key,
+            )
+            if self.on_change is not None:
+                try:
+                    self.on_change(now_leader)
+                except Exception:
+                    _log.exception("leadership-change callback failed")
+
+    # -- queries / teardown ----------------------------------------------------
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._leader
+
+    def current_leader(self) -> str | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT leader, expiry FROM election WHERE k=?", (self.key,)
+            ).fetchone()
+        if row is None or row[1] < time.time():
+            return None
+        return row[0]
+
+    def resign(self) -> None:
+        with self._lock:
+            if self._leader:
+                self._conn.execute(
+                    "DELETE FROM election WHERE k=? AND leader=?",
+                    (self.key, self.member_id),
+                )
+                self._conn.commit()
+        self._set_leader(False)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.resign()
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,124 @@
+"""AMOP — Advanced Messages Onchain Protocol (client pub/sub via the chain's
+P2P network).
+
+Reference: bcos-gateway/libamop/{AMOPImpl.cpp (573), TopicManager.cpp} +
+bcos-rpc/amop/AMOPClient.cpp: SDK clients subscribe to topics over ws; nodes
+gossip their local topic sets; a publish is routed to a node whose clients
+subscribe (unicast: first match; broadcast: all matches) and delivered to
+that node's ws sessions.
+
+Wire messages ride ModuleID.AMOP through the front/gateway:
+    TOPIC_ANNOUNCE: this node's topic set (gossiped on change + on request)
+    MESSAGE: (topic, payload) — deliver to local subscribers
+    REQUEST_TOPICS: ask a peer to re-announce (on connect)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from enum import IntEnum
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..front.front import FrontService, ModuleID
+from ..utils.log import get_logger
+
+_log = get_logger("amop")
+
+
+class AmopPacket(IntEnum):
+    TOPIC_ANNOUNCE = 0
+    MESSAGE = 1
+    REQUEST_TOPICS = 2
+
+
+class AMOPService:
+    def __init__(self, front: FrontService):
+        self.front = front
+        self.ws = None  # WsService (attach_ws)
+        # peer node id -> topic set (TopicManager's m_topicsInfo)
+        self._peer_topics: dict[bytes, set[str]] = {}
+        self._lock = threading.RLock()
+        front.register_module(ModuleID.AMOP, self._on_message)
+
+    def attach_ws(self, ws) -> None:
+        self.ws = ws
+
+    # -- topic registry sync (TopicManager) -----------------------------------
+
+    def on_local_topics_changed(self) -> None:
+        self.announce()
+
+    def announce(self) -> None:
+        topics = sorted(self.ws.local_topics()) if self.ws is not None else []
+        w = FlatWriter()
+        w.u8(int(AmopPacket.TOPIC_ANNOUNCE))
+        w.str_(json.dumps(topics))
+        self.front.broadcast(ModuleID.AMOP, w.out())
+
+    def request_topics(self) -> None:
+        w = FlatWriter()
+        w.u8(int(AmopPacket.REQUEST_TOPICS))
+        self.front.broadcast(ModuleID.AMOP, w.out())
+
+    # -- publish --------------------------------------------------------------
+
+    def _encode_message(self, topic: str, data_hex: str) -> bytes:
+        w = FlatWriter()
+        w.u8(int(AmopPacket.MESSAGE))
+        w.str_(topic)
+        w.str_(data_hex)
+        return w.out()
+
+    def publish(self, topic: str, data_hex: str) -> int:
+        """Unicast (AMOPImpl::asyncSendMessageByTopic): local subscribers
+        first, else the first peer advertising the topic. Returns deliveries
+        initiated."""
+        if self.ws is not None and topic in self.ws.local_topics():
+            return self.ws.local_amop_push(topic, data_hex, "")
+        with self._lock:
+            target = next(
+                (nid for nid, ts in self._peer_topics.items() if topic in ts), None
+            )
+        if target is None:
+            return 0
+        self.front.send_message(
+            ModuleID.AMOP, target, self._encode_message(topic, data_hex)
+        )
+        return 1
+
+    def broadcast(self, topic: str, data_hex: str) -> int:
+        """Broadcast (asyncSendBroadcastMessageByTopic): every node with the
+        topic, local subscribers included."""
+        n = 0
+        if self.ws is not None and topic in self.ws.local_topics():
+            n += self.ws.local_amop_push(topic, data_hex, "")
+        msg = self._encode_message(topic, data_hex)
+        with self._lock:
+            targets = [nid for nid, ts in self._peer_topics.items() if topic in ts]
+        for nid in targets:
+            self.front.send_message(ModuleID.AMOP, nid, msg)
+            n += 1
+        return n
+
+    # -- inbound --------------------------------------------------------------
+
+    def _on_message(self, src: bytes, payload: bytes) -> None:
+        try:
+            r = FlatReader(payload)
+            pkt = AmopPacket(r.u8())
+            if pkt == AmopPacket.TOPIC_ANNOUNCE:
+                topics = set(json.loads(r.str_()))
+                r.done()
+                with self._lock:
+                    self._peer_topics[src] = topics
+            elif pkt == AmopPacket.MESSAGE:
+                topic = r.str_()
+                data_hex = r.str_()
+                r.done()
+                if self.ws is not None:
+                    self.ws.local_amop_push(topic, data_hex, src.hex()[:16])
+            elif pkt == AmopPacket.REQUEST_TOPICS:
+                self.announce()
+        except Exception as e:
+            _log.warning("bad amop message from %s: %s", src.hex()[:8], e)

@@ -1,0 +1,95 @@
+"""Multi-group gateway mux — one transport, many chain groups.
+
+Reference: the multi-group architecture (bcos-framework/multigroup/*,
+bcos-gateway/gateway/GatewayNodeManager.cpp group registry,
+bcos-front per-group instances): one P2P host carries every group's
+traffic, each group running its own ledger + consensus; frames route by
+(groupID, moduleID, dst).
+
+`GroupGateway` sits between one transport (TcpGateway / InprocGateway) and
+N group-scoped FrontServices.  To the transport it looks like a front
+(node_id + on_receive); to each group's front it hands out a
+GatewayInterface facade that prefixes payloads with the group id.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..front.front import FrontService, GatewayInterface
+from ..utils.log import get_logger
+
+_log = get_logger("group-gw")
+
+
+def _wrap(group_id: str, payload: bytes) -> bytes:
+    g = group_id.encode()
+    if len(g) > 255:
+        raise ValueError("group id too long")
+    return bytes([len(g)]) + g + payload
+
+
+def _unwrap(payload: bytes) -> tuple[str, bytes]:
+    n = payload[0]
+    return payload[1 : 1 + n].decode(), payload[1 + n :]
+
+
+class _GroupFacade(GatewayInterface):
+    def __init__(self, mux: "GroupGateway", group_id: str):
+        self.mux = mux
+        self.group_id = group_id
+
+    def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+        gw = self.mux.transport
+        if gw is not None:
+            gw.send(module_id, src, dst, _wrap(self.group_id, payload))
+
+    def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+        gw = self.mux.transport
+        if gw is not None:
+            gw.broadcast(module_id, src, _wrap(self.group_id, payload))
+
+
+class GroupGateway:
+    """node_id comes from the host key (one identity across groups, like the
+    reference's P2P node id)."""
+
+    def __init__(self, node_id: bytes):
+        self.node_id = node_id
+        self.transport = None  # the real gateway (set by its connect())
+        self._fronts: dict[str, FrontService] = {}
+        self._lock = threading.RLock()
+
+    # -- the transport treats us as its front --------------------------------
+
+    def set_gateway(self, gw) -> None:
+        self.transport = gw
+
+    def on_receive(self, module_id: int, src: bytes, payload: bytes) -> None:
+        try:
+            group_id, inner = _unwrap(payload)
+        except (IndexError, UnicodeDecodeError):
+            _log.warning("undecodable group frame from %s", src.hex()[:8])
+            return
+        with self._lock:
+            front = self._fronts.get(group_id)
+        if front is None:
+            _log.debug("no local group %s", group_id)
+            return
+        front.on_receive(module_id, src, inner)
+
+    # -- group side -----------------------------------------------------------
+
+    def register_group(self, group_id: str) -> FrontService:
+        """Create (or return) the group's front, wired through this mux."""
+        with self._lock:
+            front = self._fronts.get(group_id)
+            if front is None:
+                front = FrontService(self.node_id)
+                front.set_gateway(_GroupFacade(self, group_id))
+                self._fronts[group_id] = front
+            return front
+
+    def groups(self) -> list[str]:
+        with self._lock:
+            return sorted(self._fronts)

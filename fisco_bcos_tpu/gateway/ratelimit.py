@@ -1,0 +1,78 @@
+"""Bandwidth policing — token buckets per connection/module/group.
+
+Reference: bcos-gateway/libratelimit/{TokenBucketRateLimiter.cpp,
+RateLimiterManager.cpp, GatewayRateLimiter.cpp} (outbound bandwidth caps per
+group / per module, total-outgoing cap; the redis-backed
+DistributedRateLimiter is a deployment variant of the same interface and is
+out of scope with no redis in the image — this manager is the seam).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucketRateLimiter:
+    """Classic token bucket: `rate` tokens/sec, burst up to `burst` tokens.
+    `try_acquire(n)` is non-blocking (gateway drops/queues on failure, it
+    never stalls a reader thread)."""
+
+    def __init__(self, rate: float, burst: float | None = None):
+        if rate <= 0:
+            raise ValueError("rate must be positive")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else rate)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def try_acquire(self, tokens: float = 1.0) -> bool:
+        with self._lock:
+            self._refill()
+            if tokens <= self._tokens:
+                self._tokens -= tokens
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            self._refill()
+            return self._tokens
+
+
+class RateLimiterManager:
+    """Per-module and total outbound budgets (RateLimiterManager.cpp keyed
+    policies). `check(module_id, nbytes)` returns False when the frame should
+    be dropped; stats track drops for the metrics surface."""
+
+    def __init__(
+        self,
+        total_rate_bytes: float | None = None,
+        module_rates: dict[int, float] | None = None,
+    ):
+        self.total = (
+            TokenBucketRateLimiter(total_rate_bytes) if total_rate_bytes else None
+        )
+        self.by_module = {
+            m: TokenBucketRateLimiter(r) for m, r in (module_rates or {}).items()
+        }
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    def check(self, module_id: int, nbytes: int) -> bool:
+        lim = self.by_module.get(int(module_id))
+        if lim is not None and not lim.try_acquire(nbytes):
+            with self._lock:
+                self.dropped += 1
+            return False
+        if self.total is not None and not self.total.try_acquire(nbytes):
+            with self._lock:
+                self.dropped += 1
+            return False
+        return True

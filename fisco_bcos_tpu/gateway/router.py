@@ -1,0 +1,115 @@
+"""Distance-vector routing table — multi-hop P2P delivery.
+
+Reference: bcos-gateway/libp2p/router/RouterTableImpl.cpp (ServiceV2's
+distance-vector table: per-destination {distance, next-hop}, updated from
+peers' advertised tables, bounded hop count) — lets a directed message reach
+a node that is not a direct neighbour (partial-mesh deployments).
+
+Event-driven DV: a gateway advertises its table on handshake and whenever an
+update changes it; entries expire with their next-hop peer.  Unreachable =
+distance > MAX_DISTANCE (RouterTableImpl's m_unreachableDistance analog).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..codec.flat import FlatReader, FlatWriter
+
+MAX_DISTANCE = 8
+
+
+class RouterTable:
+    def __init__(self, self_id: bytes):
+        self.self_id = self_id
+        # dst -> (distance, next_hop direct-peer id)
+        self._routes: dict[bytes, tuple[int, bytes]] = {}
+        self._lock = threading.Lock()
+
+    # -- updates --------------------------------------------------------------
+
+    def peer_connected(self, peer_id: bytes) -> bool:
+        """Direct neighbour: distance 1. Returns True if the table changed."""
+        with self._lock:
+            cur = self._routes.get(peer_id)
+            if cur is not None and cur[0] <= 1:
+                return False
+            self._routes[peer_id] = (1, peer_id)
+            return True
+
+    def peer_disconnected(self, peer_id: bytes) -> bool:
+        """Drop the neighbour and every route through it."""
+        with self._lock:
+            before = len(self._routes)
+            self._routes = {
+                dst: (d, hop)
+                for dst, (d, hop) in self._routes.items()
+                if hop != peer_id and dst != peer_id
+            }
+            return len(self._routes) != before
+
+    def update_from(self, peer_id: bytes, entries: list[tuple[bytes, int]]) -> bool:
+        """Merge a neighbour's advertised table (distance-vector relaxation
+        with poisoned-route replacement for paths through that neighbour)."""
+        changed = False
+        with self._lock:
+            if self._routes.get(peer_id, (99, b""))[0] != 1:
+                # adverts only count from direct neighbours
+                return False
+            advertised = {dst: d for dst, d in entries}
+            for dst, d in advertised.items():
+                if dst == self.self_id:
+                    continue
+                cand = d + 1
+                cur = self._routes.get(dst)
+                if cand > MAX_DISTANCE:
+                    # neighbour lost it; if our route went through them, drop
+                    if cur is not None and cur[1] == peer_id and dst != peer_id:
+                        del self._routes[dst]
+                        changed = True
+                    continue
+                if cur is None or cand < cur[0] or (cur[1] == peer_id and cand != cur[0]):
+                    self._routes[dst] = (cand, peer_id)
+                    changed = True
+            # routes through this neighbour it no longer advertises are stale
+            for dst in list(self._routes):
+                d, hop = self._routes[dst]
+                if hop == peer_id and dst != peer_id and dst not in advertised:
+                    del self._routes[dst]
+                    changed = True
+        return changed
+
+    # -- queries --------------------------------------------------------------
+
+    def next_hop(self, dst: bytes) -> bytes | None:
+        with self._lock:
+            r = self._routes.get(dst)
+            return None if r is None else r[1]
+
+    def distance(self, dst: bytes) -> int | None:
+        with self._lock:
+            r = self._routes.get(dst)
+            return None if r is None else r[0]
+
+    def reachable(self) -> list[bytes]:
+        with self._lock:
+            return list(self._routes)
+
+    def entries(self) -> list[tuple[bytes, int]]:
+        with self._lock:
+            return [(dst, d) for dst, (d, _) in self._routes.items()]
+
+    # -- wire format ----------------------------------------------------------
+
+    @staticmethod
+    def encode_entries(entries: list[tuple[bytes, int]]) -> bytes:
+        w = FlatWriter()
+        w.seq(entries, lambda w2, e: (w2.fixed(e[0], 64), w2.u8(min(e[1], 255))))
+        return w.out()
+
+    @staticmethod
+    def decode_entries(buf: bytes) -> list[tuple[bytes, int]]:
+        r = FlatReader(buf)
+        out = r.seq(lambda r2: (r2.fixed(64), r2.u8()))
+        r.done()
+        return out

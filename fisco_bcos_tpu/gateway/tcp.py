@@ -8,19 +8,21 @@ node moves from test fixture to real network without code changes.
 
 Frame layout (all little-endian):
     u32 frame_len  (bytes after this field)
-    u8  kind       (0 = data, 1 = handshake)
+    u8  kind       (0 = data, 1 = handshake, 2 = router advert)
     u32 module_id
     u8  flags      (bit 0: payload is zlib-compressed)
-    64B src node id
-    64B dst node id (zeros for handshake)
+    u8  ttl        (remaining forward hops for routed delivery)
+    64B src node id (the ORIGIN — preserved across forwards)
+    64B dst node id (zeros for handshake/broadcast/advert)
     payload
 
 Handshake: on connect, both sides send their node id; frames route by the
-peer registry. Compression: payloads over 1 KiB are zlib-deflated (the
-reference uses zstd via c_compress_threshold — zlib is the stdlib-available
-equivalent; the wire flag keeps the seam for a native zstd codec). TLS is a
-documented gap vs the reference's boostssl (SM2 national TLS) — the framing
-carries no secrets beyond what consensus already signs.
+peer registry. Directed sends to non-neighbours forward hop-by-hop along the
+distance-vector router table (gateway/router.py; reference ServiceV2 +
+RouterTableImpl), decrementing ttl. Compression: payloads over 1 KiB are
+zlib-deflated (the reference uses zstd via c_compress_threshold — zlib is
+the stdlib-available equivalent; the wire flag keeps the seam for a native
+zstd codec). TLS rides gateway/tls.py contexts (boostssl analog).
 """
 
 from __future__ import annotations
@@ -32,6 +34,7 @@ import zlib
 
 from ..front.front import FrontService, GatewayInterface
 from ..utils.log import get_logger
+from .router import MAX_DISTANCE, RouterTable
 
 _log = get_logger("gateway")
 
@@ -39,11 +42,24 @@ _COMPRESS_THRESHOLD = 1024
 _MAX_FRAME = 128 * 1024 * 1024
 _KIND_DATA = 0
 _KIND_HANDSHAKE = 1
+_KIND_ROUTE = 2
 _FLAG_COMPRESSED = 1
+_FLAG_BROADCAST = 2  # dst[:4] carries the origin's sequence number
+_HDR = "<BIBB"  # kind, module_id, flags, ttl
+_HDR_LEN = 7
+_SEEN_CAP = 4096  # per-origin broadcast dedup window
 
 
-def _pack_frame(kind: int, module_id: int, flags: int, src: bytes, dst: bytes, payload: bytes) -> bytes:
-    body = struct.pack("<BIB", kind, module_id, flags) + src + dst + payload
+def _pack_frame(
+    kind: int,
+    module_id: int,
+    flags: int,
+    src: bytes,
+    dst: bytes,
+    payload: bytes,
+    ttl: int = 0,
+) -> bytes:
+    body = struct.pack(_HDR, kind, module_id, flags, ttl) + src + dst + payload
     return struct.pack("<I", len(body)) + body
 
 
@@ -64,8 +80,31 @@ class _Peer:
 
 
 class TcpGateway(GatewayInterface):
-    def __init__(self, node_id: bytes, host: str = "127.0.0.1", port: int = 0):
+    """`ssl_context`/`client_ssl_context` (from gateway.tls) upgrade every
+    connection to mutual TLS — the bcos-boostssl deployment model; a peer
+    without a chain-CA cert fails the handshake and never reaches framing."""
+
+    def __init__(
+        self,
+        node_id: bytes,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl_context=None,
+        client_ssl_context=None,
+        rate_limiter=None,
+    ):
         self.node_id = node_id
+        self._ssl = ssl_context
+        self._cli_ssl = client_ssl_context
+        # outbound bandwidth policing (gateway/ratelimit.py; libratelimit)
+        self._limiter = rate_limiter
+        # multi-hop routing (gateway/router.py; libp2p RouterTableImpl)
+        self.router = RouterTable(node_id)
+        # broadcast relay state: our outgoing sequence + per-origin dedup
+        # (broadcasts flood hop-by-hop so partial meshes converge, like the
+        # reference's group-wide asyncSendBroadcastMessage over routing)
+        self._bcast_seq = 0
+        self._seen_bcast: dict[bytes, set[int]] = {}
         self._front: FrontService | None = None
         self._peers: dict[bytes, _Peer] = {}
         self._lock = threading.RLock()
@@ -108,8 +147,10 @@ class TcpGateway(GatewayInterface):
         """Dial a peer (the static nodes list of config.ini [p2p])."""
         try:
             sock = socket.create_connection((host, port), timeout=5)
+            if self._cli_ssl is not None:
+                sock = self._cli_ssl.wrap_socket(sock)  # mutual-TLS handshake
             sock.settimeout(None)  # timeout applies to the dial only, not reads
-        except OSError as e:
+        except (OSError, ValueError) as e:
             _log.warning("dial %s:%d failed: %s", host, port, e)
             return False
         peer = _Peer(sock, (host, port))
@@ -127,26 +168,93 @@ class TcpGateway(GatewayInterface):
 
     # -- GatewayInterface ----------------------------------------------------
 
-    def _frame_for(self, module_id: int, dst: bytes, payload: bytes) -> bytes:
+    def _frame_for(
+        self, module_id: int, dst: bytes, payload: bytes, ttl: int = 0
+    ) -> bytes:
         flags = 0
         if len(payload) >= _COMPRESS_THRESHOLD:
             flags = _FLAG_COMPRESSED
             payload = zlib.compress(payload, 6)
-        return _pack_frame(_KIND_DATA, module_id, flags, self.node_id, dst, payload)
+        return _pack_frame(
+            _KIND_DATA, module_id, flags, self.node_id, dst, payload, ttl=ttl
+        )
 
     def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+        if self._limiter is not None and not self._limiter.check(
+            module_id, len(payload)
+        ):
+            _log.warning("rate limit dropped send to %s", dst.hex()[:8])
+            return
+        frame = self._frame_for(module_id, dst, payload, ttl=MAX_DISTANCE)
+        self._send_routed(frame, dst)
+
+    def _send_routed(self, frame: bytes, dst: bytes) -> None:
+        """Deliver to a direct peer, else to the router's next hop."""
         with self._lock:
             peer = self._peers.get(dst)
         if peer is None:
+            hop = self.router.next_hop(dst)
+            if hop is not None:
+                with self._lock:
+                    peer = self._peers.get(hop)
+        if peer is None:
             _log.debug("no route to %s", dst.hex()[:8])
             return
-        if not peer.send(self._frame_for(module_id, dst, payload)):
+        if not peer.send(frame):
             self._drop(peer)
 
     def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
-        # one frame for everyone: receivers never read dst, and compressing
-        # the payload once beats once-per-peer
-        frame = self._frame_for(module_id, b"\x00" * 64, payload)
+        if self._limiter is not None and not self._limiter.check(
+            module_id, len(payload)
+        ):
+            _log.warning("rate limit dropped broadcast")
+            return
+        with self._lock:
+            self._bcast_seq = (self._bcast_seq + 1) & 0xFFFFFFFF
+            seq = self._bcast_seq
+        # dst[:4] = origin sequence; relayed hop-by-hop with (origin, seq)
+        # dedup so partial meshes converge without loops
+        dst = struct.pack("<I", seq) + b"\x00" * 60
+        flags = _FLAG_BROADCAST
+        if len(payload) >= _COMPRESS_THRESHOLD:
+            flags |= _FLAG_COMPRESSED
+            payload = zlib.compress(payload, 6)
+        frame = _pack_frame(
+            _KIND_DATA, module_id, flags, self.node_id, dst, payload,
+            ttl=MAX_DISTANCE,
+        )
+        self._fanout(frame, exclude=None)
+
+    def _fanout(self, frame: bytes, exclude: bytes | None) -> None:
+        with self._lock:
+            peers = [
+                p for p in self._peers.values() if p.node_id != exclude
+            ]
+        for peer in peers:
+            if not peer.send(frame):
+                self._drop(peer)
+
+    def _bcast_is_new(self, origin: bytes, seq: int) -> bool:
+        with self._lock:
+            seen = self._seen_bcast.setdefault(origin, set())
+            if seq in seen:
+                return False
+            seen.add(seq)
+            if len(seen) > _SEEN_CAP:
+                # drop the oldest half (sequences are monotonic per origin)
+                keep = sorted(seen)[_SEEN_CAP // 2 :]
+                self._seen_bcast[origin] = set(keep)
+            return True
+
+    # -- router adverts -------------------------------------------------------
+
+    def _advertise_routes(self) -> None:
+        """Push our distance-vector table to every direct neighbour
+        (ServiceV2's asyncBroadcastRouterEntries)."""
+        payload = RouterTable.encode_entries(self.router.entries())
+        frame = _pack_frame(
+            _KIND_ROUTE, 0, 0, self.node_id, b"\x00" * 64, payload
+        )
         with self._lock:
             peers = list(self._peers.values())
         for peer in peers:
@@ -161,15 +269,30 @@ class TcpGateway(GatewayInterface):
                 sock, addr = self._listener.accept()
             except OSError:
                 return
-            peer = _Peer(sock, addr)
-            peer.send(
-                _pack_frame(_KIND_HANDSHAKE, 0, 0, self.node_id, b"\x00" * 64, b"")
-            )
+            # TLS handshake + framing run in the per-connection thread so a
+            # stalled (or wrong-CA) dialer cannot block the accept loop
             t = threading.Thread(
-                target=self._read_loop, args=(peer,), name="gw-peer", daemon=True
+                target=self._serve_conn, args=(sock, addr), name="gw-peer", daemon=True
             )
             t.start()
             self._threads.append(t)
+
+    def _serve_conn(self, sock: socket.socket, addr) -> None:
+        if self._ssl is not None:
+            try:
+                sock.settimeout(10)
+                sock = self._ssl.wrap_socket(sock, server_side=True)
+                sock.settimeout(None)
+            except (OSError, ValueError) as e:
+                _log.warning("TLS accept from %s:%s failed: %s", addr[0], addr[1], e)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+        peer = _Peer(sock, addr)
+        peer.send(_pack_frame(_KIND_HANDSHAKE, 0, 0, self.node_id, b"\x00" * 64, b""))
+        self._read_loop(peer)
 
     def _recv_exact(self, sock: socket.socket, n: int) -> bytes | None:
         buf = b""
@@ -192,16 +315,53 @@ class TcpGateway(GatewayInterface):
             if not 0 < length <= _MAX_FRAME:
                 break
             body = self._recv_exact(peer.sock, length)
-            if body is None or len(body) < 6 + 128:
+            if body is None or len(body) < _HDR_LEN + 128:
                 break
-            kind, module_id, flags = struct.unpack("<BIB", body[:6])
-            src = body[6:70]
-            payload = body[134:]
+            kind, module_id, flags, ttl = struct.unpack(_HDR, body[:_HDR_LEN])
+            src = body[_HDR_LEN : _HDR_LEN + 64]
+            dst = body[_HDR_LEN + 64 : _HDR_LEN + 128]
+            payload = body[_HDR_LEN + 128 :]
             if kind == _KIND_HANDSHAKE:
                 peer.node_id = src
                 with self._lock:
                     self._peers[src] = peer
                 _log.info("peer %s connected (%s:%s)", src.hex()[:8], *peer.addr)
+                self.router.peer_connected(src)
+                self._advertise_routes()
+                continue
+            if kind == _KIND_ROUTE:
+                if peer.node_id is None:
+                    continue
+                try:
+                    entries = RouterTable.decode_entries(payload)
+                except Exception:
+                    _log.warning("bad router advert from %s", src.hex()[:8])
+                    continue
+                if self.router.update_from(peer.node_id, entries):
+                    self._advertise_routes()
+                continue
+            if kind == _KIND_DATA and flags & _FLAG_BROADCAST:
+                (seq,) = struct.unpack("<I", dst[:4])
+                if src == self.node_id or not self._bcast_is_new(src, seq):
+                    continue
+                if ttl > 0:
+                    # flood onward (minus the arrival edge) before delivering
+                    fwd = (
+                        struct.pack(_HDR, kind, module_id, flags, ttl - 1)
+                        + body[_HDR_LEN:]
+                    )
+                    self._fanout(
+                        struct.pack("<I", len(fwd)) + fwd, exclude=peer.node_id
+                    )
+                # fall through to local delivery
+            elif kind == _KIND_DATA and dst != b"\x00" * 64 and dst != self.node_id:
+                # directed transit frame: forward along the table (ServiceV2)
+                if ttl > 0:
+                    fwd = (
+                        struct.pack(_HDR, kind, module_id, flags, ttl - 1)
+                        + body[_HDR_LEN:]
+                    )
+                    self._send_routed(struct.pack("<I", len(fwd)) + fwd, dst)
                 continue
             if flags & _FLAG_COMPRESSED:
                 try:
@@ -223,12 +383,16 @@ class TcpGateway(GatewayInterface):
         self._drop(peer)
 
     def _drop(self, peer: _Peer) -> None:
+        dropped = False
         with self._lock:
             if peer.node_id and self._peers.get(peer.node_id) is peer:
                 del self._peers[peer.node_id]
+                dropped = True
         try:
             peer.sock.close()
         except OSError:
             pass
-        if peer.node_id:
+        if peer.node_id and dropped:
             _log.info("peer %s disconnected", peer.node_id.hex()[:8])
+            if self.router.peer_disconnected(peer.node_id):
+                self._advertise_routes()

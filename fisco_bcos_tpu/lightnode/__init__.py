@@ -1,0 +1,9 @@
+"""Light client node — header-verified chain access without full state.
+
+Reference: lightnode/{bcos-lightnode/rpc/LightNodeRPC.h,
+ledger/LedgerImpl.h, client/P2PClientImpl.h} + fisco-bcos-lightnode/main.cpp.
+"""
+
+from .lightnode import LightNode, LightNodeService
+
+__all__ = ["LightNode", "LightNodeService"]

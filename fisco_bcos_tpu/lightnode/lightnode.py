@@ -1,0 +1,318 @@
+"""LightNode — headers + QC verification locally, data served by full nodes.
+
+Reference: lightnode/bcos-lightnode/rpc/LightNodeRPC.h (`call:91`,
+`sendTransaction:128`, `getBlockByNumber:257` — each verified locally
+against synced headers) and the LIGHTNODE_* ModuleIDs
+(bcos-framework/protocol/Protocol.h:67-87) that full nodes answer on.
+
+Trust model (the reference's, stated explicitly): the light client starts
+from the genesis committee, verifies every header's QC against the
+*current* committee (device-batch signature check via BlockValidator), and
+only then adopts that header's sealer list as the next committee — a
+committee change is valid only if the previous committee signed it.  Bodies,
+transactions, and receipts fetched from full nodes are accepted only when
+their merkle proofs land on the verified header's roots.
+
+Request/response over the one-way front: every request carries a u64
+request-id; responses echo it (the P2PClientImpl sendMessageByNodeID
+pattern).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..consensus.block_validator import BlockValidator
+from ..front.front import FrontService, ModuleID
+from ..ledger.ledger import ConsensusNode
+from ..ops.merkle import MerkleProofItem, MerkleTree
+from ..protocol.block import Block
+from ..protocol.block_header import BlockHeader
+from ..protocol.receipt import TransactionReceipt
+from ..protocol.transaction import Transaction
+from ..utils.log import get_logger
+
+_log = get_logger("lightnode")
+
+_REQ_MODULES = (
+    ModuleID.LIGHTNODE_GET_BLOCK,
+    ModuleID.LIGHTNODE_GET_TRANSACTIONS,
+    ModuleID.LIGHTNODE_GET_RECEIPTS,
+    ModuleID.LIGHTNODE_GET_STATUS,
+    ModuleID.LIGHTNODE_SEND_TRANSACTION,
+    ModuleID.LIGHTNODE_CALL,
+)
+
+
+# ---------------------------------------------------------------------------
+# Full-node side: serve light clients
+# ---------------------------------------------------------------------------
+
+
+class LightNodeService:
+    """Answers LIGHTNODE_* requests from the node's ledger/txpool/scheduler
+    (the full-node half the reference wires in LightNodeInitializer)."""
+
+    def __init__(self, node):
+        self.node = node
+        for module in _REQ_MODULES:
+            node.front.register_module(
+                module, lambda src, payload, m=module: self._serve(m, src, payload)
+            )
+
+    def _serve(self, module: int, src: bytes, payload: bytes) -> None:
+        r = FlatReader(payload)
+        req_id = r.u64()
+        is_response = r.u8()
+        if is_response:
+            return  # we serve requests; a stray response is not ours
+        w = FlatWriter()
+        w.u64(req_id)
+        w.u8(1)
+        try:
+            self._fill_response(module, r, w)
+            w_ok = True
+        except Exception as e:  # malformed request / missing data
+            _log.info("lightnode request failed: %s", e)
+            w_ok = False
+        if w_ok:
+            self.node.front.send_message(module, src, w.out())
+
+    def _fill_response(self, module: int, r: FlatReader, w: FlatWriter) -> None:
+        node = self.node
+        if module == ModuleID.LIGHTNODE_GET_STATUS:
+            r.done()
+            w.u64(node.ledger.block_number())
+        elif module == ModuleID.LIGHTNODE_GET_BLOCK:
+            number = r.u64()
+            with_body = r.u8()
+            r.done()
+            blk = node.ledger.block_by_number(number, with_txs=bool(with_body))
+            if blk is None:
+                raise ValueError(f"no block {number}")
+            if not with_body:
+                blk = Block(header=blk.header, tx_metadata=blk.tx_metadata)
+            w.bytes_(blk.encode())
+        elif module == ModuleID.LIGHTNODE_GET_TRANSACTIONS:
+            hashes = r.seq(lambda r2: r2.fixed(32))
+            r.done()
+            txs = [node.ledger.tx_by_hash(h) for h in hashes]
+            w.seq([t for t in txs if t is not None], lambda w2, t: w2.bytes_(t.encode()))
+        elif module == ModuleID.LIGHTNODE_GET_RECEIPTS:
+            hashes = r.seq(lambda r2: r2.fixed(32))
+            r.done()
+            out = []
+            for h in hashes:
+                rc = node.ledger.receipt_by_hash(h)
+                if rc is None:
+                    continue
+                proof = node.ledger.receipt_proof(h)
+                pw = FlatWriter()
+                pw.bytes_(rc.encode())
+                _write_proof(pw, proof)
+                out.append(pw.out())
+            w.seq(out, lambda w2, b: w2.bytes_(b))
+        elif module == ModuleID.LIGHTNODE_SEND_TRANSACTION:
+            raw = r.bytes_()
+            r.done()
+            tx = Transaction.decode(raw)
+            res = node.txpool.submit(tx)
+            w.u64(int(res.status))
+            w.fixed(res.tx_hash.ljust(32, b"\x00")[:32], 32)
+        elif module == ModuleID.LIGHTNODE_CALL:
+            raw = r.bytes_()
+            r.done()
+            rc = node.scheduler.call(Transaction.decode(raw))
+            w.bytes_(rc.encode())
+        else:
+            raise ValueError(f"unknown lightnode module {module}")
+
+
+def _write_proof(w: FlatWriter, proof) -> None:
+    if proof is None:
+        w.u8(0)
+        return
+    items, idx, count = proof
+    w.u8(1)
+    w.u64(idx)
+    w.u64(count)
+    w.seq(
+        items,
+        lambda w2, it: (
+            w2.seq(list(it.group), lambda w3, g: w3.fixed(g, 32)),
+            w2.u64(it.index),
+        ),
+    )
+
+
+def _read_proof(r: FlatReader):
+    if not r.u8():
+        return None
+    idx = r.u64()
+    count = r.u64()
+    items = r.seq(
+        lambda r2: MerkleProofItem(
+            group=tuple(r2.seq(lambda r3: r3.fixed(32))), index=r2.u64()
+        )
+    )
+    return items, idx, count
+
+
+# ---------------------------------------------------------------------------
+# Light-client side
+# ---------------------------------------------------------------------------
+
+
+class LightNode:
+    def __init__(self, front: FrontService, suite, genesis_committee: list[ConsensusNode]):
+        self.front = front
+        self.suite = suite
+        self.validator = BlockValidator(suite)
+        self.committee = list(genesis_committee)
+        self.headers: dict[int, BlockHeader] = {}
+        self.head = 0
+        self._pending: dict[int, Any] = {}
+        self._ids = itertools.count(1)
+        self._cv = threading.Condition()
+        self.full_node: bytes | None = None  # peer to query
+        for module in _REQ_MODULES:
+            front.register_module(
+                module, lambda src, payload, m=module: self._on_response(payload)
+            )
+
+    # -- transport ------------------------------------------------------------
+
+    def _on_response(self, payload: bytes) -> None:
+        r = FlatReader(payload)
+        req_id = r.u64()
+        if not r.u8():
+            return  # a request (we are not serving)
+        with self._cv:
+            if req_id in self._pending:
+                self._pending[req_id] = r
+                self._cv.notify_all()
+
+    def _request(self, module: int, build, timeout: float = 5.0) -> FlatReader:
+        if self.full_node is None:
+            raise RuntimeError("no full node attached")
+        req_id = next(self._ids)
+        w = FlatWriter()
+        w.u64(req_id)
+        w.u8(0)
+        build(w)
+        with self._cv:
+            self._pending[req_id] = None
+        self.front.send_message(module, self.full_node, w.out())
+        with self._cv:
+            self._cv.wait_for(lambda: self._pending[req_id] is not None, timeout)
+            r = self._pending.pop(req_id)
+        if r is None:
+            raise TimeoutError(f"lightnode request {module} timed out")
+        return r
+
+    # -- header sync (LedgerImpl.h getBlockHeader + QC verify) ----------------
+
+    def remote_head(self) -> int:
+        r = self._request(ModuleID.LIGHTNODE_GET_STATUS, lambda w: None)
+        n = r.u64()
+        r.done()
+        return n
+
+    def sync_headers(self, to: int | None = None) -> int:
+        """Verify + adopt headers (head, to]; returns the new head."""
+        target = self.remote_head() if to is None else to
+        for n in range(self.head + 1, target + 1):
+            r = self._request(
+                ModuleID.LIGHTNODE_GET_BLOCK,
+                lambda w, n=n: (w.u64(n), w.u8(0)),
+            )
+            blk = Block.decode(r.bytes_())
+            r.done()
+            header = blk.header
+            if header.number != n:
+                raise ValueError(f"full node returned header {header.number} != {n}")
+            if n > 1 and header.parent_info:
+                parent = self.headers.get(n - 1)
+                if parent is not None and header.parent_info[0].block_hash != parent.hash(
+                    self.suite
+                ):
+                    raise ValueError(f"header {n} breaks the hash chain")
+            if not self.validator.check_block(header, self.committee):
+                raise ValueError(f"header {n} fails QC verification")
+            self.headers[n] = header
+            self.head = n
+            # committee handoff: the verified header defines the next epoch
+            weights = header.consensus_weights or [1] * len(header.sealer_list)
+            self.committee = [
+                ConsensusNode(nid, weight=wt)
+                for nid, wt in zip(header.sealer_list, weights)
+            ]
+        return self.head
+
+    # -- verified reads (LightNodeRPC.h) --------------------------------------
+
+    def get_block_by_number(self, number: int) -> Block:
+        """Full block, txs-root-verified against the locally-held header."""
+        if number not in self.headers:
+            raise ValueError(f"header {number} not synced")
+        r = self._request(
+            ModuleID.LIGHTNODE_GET_BLOCK, lambda w: (w.u64(number), w.u8(1))
+        )
+        blk = Block.decode(r.bytes_())
+        r.done()
+        local = self.headers[number]
+        if blk.header.hash(self.suite) != local.hash(self.suite):
+            raise ValueError("full node returned a different header")
+        if blk.calculate_txs_root(self.suite) != local.txs_root:
+            raise ValueError("block body does not match the verified txs root")
+        return blk
+
+    def get_receipt(self, tx_hash: bytes) -> TransactionReceipt:
+        """Receipt with merkle proof verified against the synced header."""
+        r = self._request(
+            ModuleID.LIGHTNODE_GET_RECEIPTS,
+            lambda w: w.seq([tx_hash], lambda w2, h: w2.fixed(h, 32)),
+        )
+        entries = r.seq(lambda r2: r2.bytes_())
+        r.done()
+        if not entries:
+            raise ValueError("receipt not found")
+        pr = FlatReader(entries[0])
+        rc = TransactionReceipt.decode(pr.bytes_())
+        proof = _read_proof(pr)
+        pr.done()
+        header = self.headers.get(rc.block_number)
+        if header is None:
+            raise ValueError(f"header {rc.block_number} not synced")
+        if proof is None:
+            raise ValueError("full node sent no proof")
+        items, idx, count = proof
+        if not MerkleTree.verify_proof(
+            rc.hash(self.suite),
+            idx,
+            count,
+            items,
+            header.receipts_root,
+            hasher=self.suite.hash_impl.name,
+        ):
+            raise ValueError("receipt proof fails against the verified root")
+        return rc
+
+    def send_transaction(self, tx: Transaction) -> tuple[int, bytes]:
+        r = self._request(
+            ModuleID.LIGHTNODE_SEND_TRANSACTION,
+            lambda w: w.bytes_(tx.encode()),
+        )
+        status = r.u64()
+        tx_hash = r.fixed(32)
+        r.done()
+        return status, tx_hash
+
+    def call(self, tx: Transaction) -> TransactionReceipt:
+        r = self._request(ModuleID.LIGHTNODE_CALL, lambda w: w.bytes_(tx.encode()))
+        rc = TransactionReceipt.decode(r.bytes_())
+        r.done()
+        return rc

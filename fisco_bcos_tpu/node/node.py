@@ -11,6 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..consensus import BlockValidator, PBFTConfig, PBFTEngine, Sealer
+from ..consensus.storage import ConsensusStorage
 from ..crypto.suite import CryptoSuite, KeyPair, ecdsa_suite, sm_suite
 from ..executor import TransactionExecutor
 from ..front import FrontService
@@ -35,11 +36,19 @@ class NodeConfig:
     db_path: str = ":memory:"  # sqlite path; ":memory:"/"" -> MemoryStorage
     block_limit: int = 600
     pool_limit: int = 15000 * 9
+    # storage_security (bcos-security DataEncryption): non-empty -> every
+    # stored value is encrypted at rest with this key
+    data_key: bytes = b""
     genesis: GenesisConfig = field(default_factory=GenesisConfig)
 
 
 class Node:
-    def __init__(self, config: NodeConfig, keypair: KeyPair | None = None):
+    def __init__(
+        self,
+        config: NodeConfig,
+        keypair: KeyPair | None = None,
+        front: FrontService | None = None,
+    ):
         self.config = config
         self.suite: CryptoSuite = sm_suite() if config.sm_crypto else ecdsa_suite()
         self.keypair = keypair or self.suite.signature_impl.generate_keypair()
@@ -48,10 +57,17 @@ class Node:
             if config.db_path in ("", ":memory:")
             else SQLiteStorage(config.db_path)
         )
+        if config.data_key:
+            from ..security import DataEncryption, EncryptedStorage
+
+            self.storage = EncryptedStorage(
+                self.storage, DataEncryption(config.data_key, config.sm_crypto)
+            )
         config.genesis.chain_id = config.chain_id
         config.genesis.group_id = config.group_id
         self.ledger = Ledger(self.storage, self.suite)
         self.ledger.build_genesis(config.genesis)
+        durable = config.db_path not in ("", ":memory:")
         self.txpool = TxPool(
             self.suite,
             self.ledger,
@@ -59,12 +75,15 @@ class Node:
             group_id=config.group_id,
             pool_limit=config.pool_limit,
             block_limit=config.block_limit,
+            persistent_store=self.storage if durable else None,
         )
         self.executor = TransactionExecutor(self.storage, self.suite)
         self.scheduler = Scheduler(
             self.executor, self.ledger, self.storage, self.suite, self.txpool
         )
-        self.front = FrontService(self.keypair.pub)
+        # injected front = multi-group hosting (gateway/group.py GroupGateway
+        # hands each group its own front over one shared transport)
+        self.front = front if front is not None else FrontService(self.keypair.pub)
         ledger_cfg = self.ledger.ledger_config()
         self.pbft_config = PBFTConfig(
             suite=self.suite,
@@ -73,7 +92,12 @@ class Node:
             leader_period=ledger_cfg.leader_period,
         )
         self.engine = PBFTEngine(
-            self.pbft_config, self.scheduler, self.txpool, self.ledger, self.front
+            self.pbft_config,
+            self.scheduler,
+            self.txpool,
+            self.ledger,
+            self.front,
+            consensus_storage=ConsensusStorage(self.storage) if durable else None,
         )
         self.sealer = Sealer(self.pbft_config, self.txpool, self.ledger, self.engine)
         self.block_validator = BlockValidator(self.suite)
@@ -85,6 +109,16 @@ class Node:
             validator=self.block_validator,
         )
         self.tx_sync = TransactionSync(self.txpool, self.front)
+        # proposal straggler fetch (asyncVerifyBlock's fetch-missing hook)
+        self.engine.fetch_missing_fn = self.tx_sync.fetch_missing
+        # AMOP topic routing (bcos-gateway/libamop); ws sessions attach later
+        from ..gateway.amop import AMOPService
+
+        self.amop = AMOPService(self.front)
+        if durable:
+            # restart path: re-admit durably-stored pool txs (signatures
+            # re-verified on device; Initializer.cpp:188-195 analog)
+            self.txpool.reload_persisted()
 
     def warmup(self, batch_sizes: tuple[int, ...] = (8,)) -> None:
         """Pre-compile the batch admission kernels for the given bucket

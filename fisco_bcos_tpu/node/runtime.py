@@ -38,6 +38,10 @@ class NodeRuntime:
         self._last_sync = 0.0
 
     def start(self) -> None:
+        # live nodes process consensus messages on the engine's own worker
+        # (the reference's single PBFTEngine thread) so blocking tx fetches
+        # in proposal verification never stall gateway readers
+        self.node.engine.start_worker()
         self._thread = threading.Thread(target=self._run, name="node-runtime", daemon=True)
         self._thread.start()
 
@@ -45,6 +49,7 @@ class NodeRuntime:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        self.node.engine.stop_worker()
 
     def _run(self) -> None:
         _log.info("runtime started (node %s)", self.node.node_id.hex()[:8])

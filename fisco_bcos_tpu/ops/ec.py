@@ -198,7 +198,7 @@ def jac_infinity(like: jax.Array):
     """Point at infinity: (1, 1, 0) in any domain-encoding (Z=0 is the flag;
     X/Y values are never read for infinity lanes)."""
     z = jnp.zeros_like(like)
-    one = jnp.zeros_like(like).at[0].set(1)
+    one = jnp.concatenate([jnp.ones_like(like[:1]), z[1:]], axis=0)
     return one, one, z
 
 
@@ -275,17 +275,47 @@ def g_comb_table(name: str) -> np.ndarray:
     return tab
 
 
+LIMBS_PER_SCALAR = 16
+
+
+def window_at(k: jax.Array, wi: jax.Array) -> jax.Array:
+    """4-bit window ``wi`` (traced scalar, 0 = LSB) of [16, T] plain limbs ->
+    [T] uint32 in 0..15.
+
+    Row fetch is a 16-way masked chain on the static limb index and the
+    sub-limb shift is by a traced broadcast scalar — no gather, no
+    dynamic_slice, so the same code lowers under Mosaic (Pallas TPU), where
+    ``lax.scan`` over a precomputed [64, T] window array would not (its xs
+    slicing needs dynamic_slice)."""
+    li = wi // (16 // WINDOW)  # limb index 0..15
+    sh = (wi % (16 // WINDOW)).astype(jnp.uint32) * WINDOW
+    r = limb.row(k, 0)
+    for j in range(1, LIMBS_PER_SCALAR):
+        r = jnp.where(li == j, limb.row(k, j), r)
+    return (r >> sh) & np.uint32(0xF)
+
+
 def scalar_windows(k: jax.Array) -> jax.Array:
-    """[16, T] plain limbs -> [64, T] 4-bit windows, LSB-first order."""
+    """[16, T] plain limbs -> [64, T] 4-bit windows, LSB-first order (the
+    scan-shape window precompute; plain-XLA path only)."""
     rep = jnp.repeat(k, 16 // WINDOW, axis=0)  # [64, T]
     shifts = limb.dev_vec((np.arange(N_WINDOWS) % (16 // WINDOW)) * WINDOW)
-    return (rep >> shifts[:, None]) & jnp.uint32(0xF)
+    return (rep >> shifts[:, None]) & np.uint32(0xF)
 
 
-def _point_table(t1, C: CurveOps):
-    """Window table [15, 16, T] x/y/z of k*P for k = 1..15, built with a
-    scan of 14 uniform additions (a uniform body keeps the traced program
-    small; compile time matters on both the XLA-CPU and Mosaic paths)."""
+def _point_table_list(t1, C: CurveOps):
+    """Window table of k*P for k = 1..15 as a 15-entry Python list of
+    (x, y, z) tuples — 14 unrolled additions (Mosaic shape: no scan-stacking,
+    Pallas TPU has no dynamic_update_slice for scan ys outputs)."""
+    tab = [t1]
+    for _ in range(14):
+        tab.append(jac_add(tab[-1], t1, C))
+    return tab
+
+
+def _point_table_scan(t1, C: CurveOps):
+    """Same table as three stacked [15, 16, T] arrays via a 14-step scan —
+    the compact HLO shape for plain XLA (fast CPU compiles)."""
 
     def step(prev, _):
         nxt = jac_add(prev, t1, C)
@@ -298,9 +328,10 @@ def _point_table(t1, C: CurveOps):
     return tq_x, tq_y, tq_z
 
 
-def _select15(tab: jax.Array, w: jax.Array):
-    """tab [15, ..., T], w [T] in 0..15 -> tab[w-1] (w==0 lanes get tab[0],
-    callers must mask). 15-way masked chain — branch-free."""
+def _select15(tab, w: jax.Array):
+    """tab: 15 entries (list of arrays/tuples, or a [15, ..., T] stacked
+    array), w [T] in 0..15 -> tab[w-1] (w==0 lanes get tab[0], callers must
+    mask). 15-way masked chain — branch-free."""
     sel = tab[0]
     for c in range(2, 16):
         sel = select(w == c, tab[c - 1], sel)
@@ -314,37 +345,66 @@ def dual_mul_windowed(k1, k2, Q, C: CurveOps, g_table: jax.Array):
     (not infinity; garbage lanes are fine — callers mask validity).
     g_table: device copy of :func:`g_comb_table` ([30, 16]).
 
-    Schedule: 64 scan steps, each 4 doublings + one full addition (Q table)
-    + one mixed addition (G table), all lane-uniform.
+    Schedule: 64 window steps, each 4 doublings + one full addition (runtime
+    Q table) + one mixed addition (affine G table), all lane-uniform. The
+    loop/table trace shape follows :func:`limb.is_mosaic_trace` (fori +
+    where-chains under Pallas, compact scans under plain XLA) — outputs are
+    bit-identical either way.
     """
     F = C.F
     one = F.one(k1)
     t1 = (Q[0], Q[1], one)
-    tq_x, tq_y, tq_z = _point_table(t1, C)
+    acc0 = jac_infinity(k1)
 
+    if limb.is_mosaic_trace():
+        tq = _point_table_list(t1, C)
+        # G table as 15-entry lists of [16, 1] columns (affine x, y) —
+        # static slices + reshape, not g_table[c] (no dynamic_slice in Mosaic)
+        tg_x = [
+            lax.slice_in_dim(g_table, c, c + 1, axis=0).reshape(16, 1)
+            for c in range(15)
+        ]
+        tg_y = [
+            lax.slice_in_dim(g_table, 15 + c, 16 + c, axis=0).reshape(16, 1)
+            for c in range(15)
+        ]
+
+        def step(i, acc):
+            wi = 63 - i  # MSB-first
+            w1_i = window_at(k1, wi)
+            w2_i = window_at(k2, wi)
+            for _ in range(WINDOW):
+                acc = jac_double(acc, C)
+            qx, qy, qz = _select15(tq, w2_i)
+            added = jac_add(acc, (qx, qy, qz), C)
+            acc = select(w2_i == 0, acc, added)
+            gx = _select15(tg_x, w1_i)  # [16, T]
+            gy = _select15(tg_y, w1_i)
+            madded = jac_add_mixed(acc, (gx, gy), C)
+            acc = select(w1_i == 0, acc, madded)
+            return acc
+
+        return lax.fori_loop(0, N_WINDOWS, step, acc0)
+
+    tq_x, tq_y, tq_z = _point_table_scan(t1, C)
     w1 = scalar_windows(k1)[::-1]  # MSB-first [64, T]
     w2 = scalar_windows(k2)[::-1]
 
-    acc0 = jac_infinity(k1)
-
-    def step(acc, xs):
+    def sstep(acc, xs):
         w1_i, w2_i = xs
         for _ in range(WINDOW):
             acc = jac_double(acc, C)
-        # Q term (full Jacobian addition)
-        qx = _select15(tq_x, w2_i)
-        qy = _select15(tq_y, w2_i)
-        qz = _select15(tq_z, w2_i)
-        added = jac_add(acc, (qx, qy, qz), C)
+        added = jac_add(
+            acc, (_select15(tq_x, w2_i), _select15(tq_y, w2_i), _select15(tq_z, w2_i)), C
+        )
         acc = select(w2_i == 0, acc, added)
-        # G term (mixed addition against the affine constant table)
         gx = _select15(g_table[:15][:, :, None], w1_i)  # [16, T]
         gy = _select15(g_table[15:][:, :, None], w1_i)
         madded = jac_add_mixed(acc, (gx, gy), C)
         acc = select(w1_i == 0, acc, madded)
         return acc, None
 
-    acc, _ = lax.scan(step, acc0, (w1, w2))
+    acc, _ = lax.scan(sstep, acc0, (w1, w2))
     return acc
 
 
@@ -356,10 +416,23 @@ def scalar_mul(k, P, C: CurveOps):
     F = C.F
     one = F.one(k)
     t1 = (P[0], P[1], one)
-    tq_x, tq_y, tq_z = _point_table(t1, C)
+
+    if limb.is_mosaic_trace():
+        tq = _point_table_list(t1, C)
+
+        def step(i, acc):
+            w_i = window_at(k, 63 - i)
+            for _ in range(WINDOW):
+                acc = jac_double(acc, C)
+            added = jac_add(acc, _select15(tq, w_i), C)
+            return select(w_i == 0, acc, added)
+
+        return lax.fori_loop(0, N_WINDOWS, step, jac_infinity(k))
+
+    tq_x, tq_y, tq_z = _point_table_scan(t1, C)
     w = scalar_windows(k)[::-1]
 
-    def step(acc, w_i):
+    def sstep(acc, w_i):
         for _ in range(WINDOW):
             acc = jac_double(acc, C)
         added = jac_add(
@@ -367,7 +440,7 @@ def scalar_mul(k, P, C: CurveOps):
         )
         return select(w_i == 0, acc, added), None
 
-    acc, _ = lax.scan(step, jac_infinity(k), w)
+    acc, _ = lax.scan(sstep, jac_infinity(k), w)
     return acc
 
 
@@ -394,7 +467,7 @@ __all__ = [
     "reduce_mod_n",
     "add_mod_n",
     "g_comb_table",
-    "scalar_windows",
+    "window_at",
     "dual_mul_windowed",
     "scalar_mul",
     "generator_affine",

@@ -39,7 +39,10 @@ from jax import lax
 
 LIMBS = 16
 LIMB_BITS = 16
-_MASK = jnp.uint32(0xFFFF)
+# numpy scalar, not jnp: a module-level jax.Array would be a captured
+# constant inside Pallas kernel traces (Mosaic rejects those); np scalars
+# stay jaxpr literals.
+_MASK = np.uint32(0xFFFF)
 _R = 1 << 256
 
 
@@ -71,12 +74,17 @@ def dev_vec(arr, dtype=jnp.uint32) -> jax.Array:
 
 
 def const_rows(limbs_np: np.ndarray, t: int | jax.Array) -> jax.Array:
-    """[L] host constant -> [L, T] broadcast (T from an int or a like-array)."""
+    """[L] host constant -> [L, T] broadcast (T from an int or a like-array).
+
+    Plain XLA: one embedded constant + one broadcast. Mosaic trace: built
+    from scalar literals (Pallas kernels may not capture array constants) —
+    L fulls + a stack, which Mosaic constant-folds."""
     if not isinstance(t, int):
         t = t.shape[-1]
-    return jnp.stack(
-        [jnp.full((t,), int(v), jnp.uint32) for v in limbs_np]
-    )
+    if is_mosaic_trace():
+        return jnp.stack([jnp.full((t,), int(v), jnp.uint32) for v in limbs_np])
+    arr = np.asarray(limbs_np, dtype=np.uint32)
+    return jnp.broadcast_to(jnp.asarray(arr)[:, None], (arr.shape[0], t))
 
 
 # ---------------------------------------------------------------------------
@@ -95,12 +103,37 @@ def _shift_up(x: jax.Array) -> jax.Array:
     return jnp.concatenate([jnp.zeros_like(x[:1]), x[:-1]], axis=0)
 
 
+def row(x: jax.Array, i: int) -> jax.Array:
+    """Static row i of [L, T] -> [T] via a static slice + squeeze.
+
+    NEVER ``x[i]``: jnp integer indexing lowers through dynamic_slice even
+    for constant indices, and Mosaic (Pallas TPU) has no dynamic_slice."""
+    return jnp.squeeze(lax.slice_in_dim(x, i, i + 1, axis=0), axis=0)
+
+
 def _carry_in(g: jax.Array, p: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Per-position carry/borrow-in from generate/propagate; also returns the
-    final carry-out row (both bool [T])."""
-    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=0)
+    final carry-out row (both bool [T]).
+
+    Explicit Kogge–Stone doubling loop rather than ``lax.associative_scan``:
+    the scan's recursive odd/even decomposition emits zero-length slices,
+    which Mosaic (Pallas TPU) rejects as 0-sized vectors; this loop is the
+    same log₂-depth circuit with every slice non-empty. Bits ride int32
+    lanes, not bool — Mosaic cannot concatenate i1 (mask-register) vectors
+    ("Invalid vector register cast")."""
+    G = g.astype(jnp.int32)
+    P = p.astype(jnp.int32)
+    shift = 1
+    n = g.shape[0]
+    while shift < n:
+        # segment ending at i-shift, shifted into position i; out-of-range
+        # rows get the combine identity (g=0, p=1)
+        Gs = jnp.concatenate([jnp.zeros_like(G[:shift]), G[:-shift]], axis=0)
+        Ps = jnp.concatenate([jnp.ones_like(P[:shift]), P[:-shift]], axis=0)
+        G, P = _gp_combine((Gs, Ps), (G, P))
+        shift *= 2
     cin = jnp.concatenate([jnp.zeros_like(G[:1]), G[:-1]], axis=0)
-    return cin, G[-1]
+    return cin != 0, row(G, n - 1) != 0
 
 
 def carry_norm(cols: jax.Array) -> jax.Array:
@@ -124,12 +157,25 @@ def sub_borrow(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
     return diff, bout
 
 
+def _or_fold(x: jax.Array) -> jax.Array:
+    """Bitwise-OR all rows of [L, T] -> [T] via a log-depth halving tree
+    (no jnp.all/jnp.any: Mosaic lacks those reductions for integer input,
+    and this shape serves both backends identically)."""
+    while x.shape[0] > 1:
+        half = x.shape[0] // 2
+        rest = x[2 * half :]  # odd leftover row, if any
+        x = x[:half] | x[half : 2 * half]
+        if rest.shape[0]:
+            x = jnp.concatenate([x[:1] | rest, x[1:]], axis=0)
+    return row(x, 0)
+
+
 def is_zero(a: jax.Array) -> jax.Array:
-    return jnp.all(a == 0, axis=0)
+    return _or_fold(a) == 0
 
 
 def eq(a: jax.Array, b: jax.Array) -> jax.Array:
-    return jnp.all(a == b, axis=0)
+    return _or_fold(a ^ b) == 0
 
 
 def geq(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -155,25 +201,52 @@ def select(cond: jax.Array, a, b):
 # ---------------------------------------------------------------------------
 
 
+def _placed(x: jax.Array, offset: int, out: int) -> jax.Array:
+    """[n, T] rows placed at row `offset` of an [out, T] zero canvas —
+    zeros‖x‖zeros concat (2 broadcasts + 1 concat). NEVER `.at[...].add`:
+    a static-slice scatter is the single most expensive op for XLA to
+    compile (round-2 lesson: ~11k scatters made one EC program a >10-minute
+    CPU compile), and Mosaic cannot lower scatter at all."""
+    n = min(x.shape[0], out - offset)
+    if n <= 0:
+        return jnp.zeros((out, x.shape[1]), x.dtype)
+    parts = []
+    if offset:
+        parts.append(jnp.zeros((offset, x.shape[1]), x.dtype))
+    parts.append(x[:n])
+    if offset + n < out:
+        parts.append(jnp.zeros((out - offset - n, x.shape[1]), x.dtype))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+def _sum_terms(terms: list[jax.Array]) -> jax.Array:
+    """Balanced tree-add of equal-shape u32 arrays.
+
+    Mosaic has no unsigned reductions, so no stack+jnp.sum; a log-depth add
+    tree is equally fusable under XLA and trivially lowerable under Mosaic."""
+    while len(terms) > 1:
+        nxt = [
+            terms[i] + terms[i + 1] if i + 1 < len(terms) else terms[i]
+            for i in range(0, len(terms), 2)
+        ]
+        terms = nxt
+    return terms[0]
+
+
 def mul_cols(a: jax.Array, b: jax.Array, out: int = 2 * LIMBS) -> jax.Array:
     """Column sums of a*b: [16, T] x [16, T] -> [out, T] raw columns.
 
     Column k collects lo16(a_i*b_j) for i+j == k and hi16 for i+j == k-1;
-    every column sum is < 32 * 2^16 < 2^22, inside uint32.
+    every column sum is < 32 * 2^16 < 2^22, inside uint32. The 32 shifted
+    row groups are summed with one stacked reduction (scatter-free).
     """
-    t = a.shape[1]
-    acc = jnp.zeros((out, t), jnp.uint32)
+    terms = []
     for i in range(LIMBS):
-        prod = a[i][None, :] * b  # [16, T], each element < 2^32
-        lo = prod & _MASK
-        hi = prod >> LIMB_BITS
-        n_lo = min(LIMBS, out - i)
-        if n_lo > 0:
-            acc = acc.at[i : i + n_lo].add(lo[:n_lo])
-        n_hi = min(LIMBS, out - i - 1)
-        if n_hi > 0:
-            acc = acc.at[i + 1 : i + 1 + n_hi].add(hi[:n_hi])
-    return acc
+        # static slice, not a[i]: integer indexing lowers via dynamic_slice
+        prod = lax.slice_in_dim(a, i, i + 1, axis=0) * b  # [16, T], < 2^32
+        terms.append(_placed(prod & _MASK, i, out))
+        terms.append(_placed(prod >> LIMB_BITS, i + 1, out))
+    return _sum_terms(terms)
 
 
 def mul_const_cols(
@@ -181,23 +254,15 @@ def mul_const_cols(
 ) -> jax.Array:
     """Column sums of hi * c for a small host constant c: [H, T] x [C] ->
     [out, T] raw columns (same lo/hi splitting as :func:`mul_cols`)."""
-    t = hi.shape[1]
-    h = hi.shape[0]
-    acc = jnp.zeros((out, t), jnp.uint32)
+    terms = [jnp.zeros((out, hi.shape[1]), jnp.uint32)]
     for k, cval in enumerate(np.asarray(c_limbs, dtype=np.uint64)):
         cval = int(cval)
         if cval == 0:
             continue
-        prod = hi * jnp.uint32(cval)  # < 2^32
-        lo = prod & _MASK
-        hi16 = prod >> LIMB_BITS
-        n_lo = min(h, out - k)
-        if n_lo > 0:
-            acc = acc.at[k : k + n_lo].add(lo[:n_lo])
-        n_hi = min(h, out - k - 1)
-        if n_hi > 0:
-            acc = acc.at[k + 1 : k + 1 + n_hi].add(hi16[:n_hi])
-    return acc
+        prod = hi * np.uint32(cval)  # < 2^32
+        terms.append(_placed(prod & _MASK, k, out))
+        terms.append(_placed(prod >> LIMB_BITS, k + 1, out))
+    return _sum_terms(terms)
 
 
 def add_widen(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -283,7 +348,7 @@ class FoldField:
             bound = (_R - 1) + hi_max * c_int + 1
             width = max((bound - 1).bit_length() + 15, 17 * 16) // 16
             cols = mul_const_cols(hi, self.c_limbs, width)
-            cols = cols.at[:LIMBS].add(lo)
+            cols = cols + _placed(lo, 0, width)
             x = carry_norm(cols)[:width]
         return cond_sub(x, self.m_limbs)
 
@@ -428,25 +493,86 @@ def _exp_windows(e: int) -> np.ndarray:
     )
 
 
+# When set, shared field/EC code traces in its Mosaic-safe shape (fori
+# loops, masked where-chains, unrolled tables — no scan xs/ys, whose
+# dynamic_slice/dynamic_update_slice lowering Pallas TPU lacks). Otherwise
+# (plain XLA: CPU tests, virtual meshes, fallback), the same math traces as
+# compact lax.scan programs — ~15x smaller HLO, which is the difference
+# between seconds and tens of minutes of XLA-CPU compile on a 1-core host.
+# Integer semantics are identical element-for-element, so both shapes are
+# bit-identical in output — the consensus requirement.
+# A ContextVar, not a module global: a Pallas kernel trace on one thread
+# must not leak the Mosaic shape into a concurrent plain-XLA trace.
+import contextvars as _contextvars
+
+_MOSAIC_TRACE: _contextvars.ContextVar[bool] = _contextvars.ContextVar(
+    "mosaic_trace", default=False
+)
+
+
+def is_mosaic_trace() -> bool:
+    return _MOSAIC_TRACE.get()
+
+
+class mosaic_trace:
+    """Context manager scoping the Mosaic trace shape to this thread."""
+
+    def __enter__(self):
+        self._token = _MOSAIC_TRACE.set(True)
+
+    def __exit__(self, *exc):
+        _MOSAIC_TRACE.reset(self._token)
+
+
+def static_lookup(vals: np.ndarray, i: jax.Array) -> jax.Array:
+    """vals[i] for a static host table and a traced scalar index — a masked
+    where-chain (no gather/dynamic_slice; Mosaic supports neither)."""
+    out = jnp.full((), int(vals[0]), jnp.int32)
+    for j in range(1, len(vals)):
+        out = jnp.where(i == j, np.int32(int(vals[j])), out)
+    return out
+
+
 def pow_static(F, a: jax.Array, e: int) -> jax.Array:
     """a^e in field F for a fixed Python-int exponent.
 
     4-bit windows, MSB first: per window 4 squarings + one table multiply
-    selected branch-free from the 15 precomputed odd/even powers. The loop is
-    a ``fori_loop`` so the compiled program stays small; the table select is
-    a 15-way masked chain (lane-uniform schedule, data only in selects).
+    selected branch-free from the 15 precomputed powers; the loop/table
+    shape follows :func:`is_mosaic_trace` (see its comment).
     """
     wins = _exp_windows(e)
 
-    # table[c-1] = a^c for c in 1..15, built as a scan (14 sequential muls
-    # with a uniform body keep the traced program small — compile time
-    # matters on both the XLA-CPU and Mosaic paths)
+    if is_mosaic_trace():
+        # table[c-1] = a^c for c in 1..15 — 14 unrolled sequential muls
+        tab = [a]
+        for _ in range(14):
+            tab.append(F.mul(tab[-1], a))
+        first = int(wins[0])
+        assert first != 0
+        acc0 = tab[first - 1]
+        if len(wins) == 1:
+            return acc0
+        rest = wins[1:]
+
+        def body(i, acc):
+            c = static_lookup(rest, i)
+            for _ in range(_POW_W):
+                acc = F.sqr(acc)
+            sel = tab[0]
+            for k in range(2, 16):
+                sel = jnp.where(c == k, tab[k - 1], sel)
+            with_mul = F.mul(acc, sel)
+            return jnp.where(c == 0, acc, with_mul)
+
+        return lax.fori_loop(0, len(rest), body, acc0)
+
+    # compact scan shape (plain XLA)
     def _tab_step(prev, _):
         nxt = F.mul(prev, a)
         return nxt, nxt
 
-    _, rest = lax.scan(_tab_step, a, None, length=14)
-    tab = jnp.concatenate([a[None], rest], axis=0)  # [15, 16, T]
+    _, rest_tab = lax.scan(_tab_step, a, None, length=14)
+    tab = jnp.concatenate([a[None], rest_tab], axis=0)  # [15, 16, T]
 
     first = int(wins[0])
     assert first != 0

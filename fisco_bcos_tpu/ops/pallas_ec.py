@@ -26,7 +26,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-MAX_TILE = 512
+# 256 lanes/program: at 512 the ladder's live set overflows the 16 MiB
+# scoped-VMEM stack limit by ~4% (measured on v5e); 256 leaves headroom
+MAX_TILE = 256
 MIN_TILE = 128
 
 # Test hook: run the kernels through the Pallas interpreter (CPU) so kernel
@@ -36,7 +38,7 @@ INTERPRET = False
 
 
 def _tile(b: int) -> int:
-    for t in (MAX_TILE, 256, MIN_TILE):
+    for t in (MAX_TILE, MIN_TILE):
         if b % t == 0:
             return t
     raise ValueError(f"pallas EC batch must be a multiple of {MIN_TILE}, got {b}")
@@ -49,12 +51,16 @@ def _pad_lanes(x: jnp.ndarray, b_pad: int) -> jnp.ndarray:
     return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, b_pad - x.shape[-1])])
 
 
+from .limb import mosaic_trace as _mosaic_trace
+
+
 def _recover_kernel(z_ref, r_ref, s_ref, v_ref, gt_ref, qx_ref, qy_ref, ok_ref):
     from .secp256k1 import recover_core
 
-    qx, qy, ok = recover_core(
-        z_ref[:], r_ref[:], s_ref[:], v_ref[0], gt_ref[:]
-    )
+    with _mosaic_trace():
+        qx, qy, ok = recover_core(
+            z_ref[:], r_ref[:], s_ref[:], v_ref[0], gt_ref[:]
+        )
     qx_ref[:] = qx
     qy_ref[:] = qy
     ok_ref[0] = ok.astype(jnp.int32)
@@ -63,9 +69,10 @@ def _recover_kernel(z_ref, r_ref, s_ref, v_ref, gt_ref, qx_ref, qy_ref, ok_ref):
 def _verify_kernel(z_ref, r_ref, s_ref, qx_ref, qy_ref, gt_ref, ok_ref):
     from .secp256k1 import verify_core
 
-    ok = verify_core(
-        z_ref[:], r_ref[:], s_ref[:], qx_ref[:], qy_ref[:], gt_ref[:]
-    )
+    with _mosaic_trace():
+        ok = verify_core(
+            z_ref[:], r_ref[:], s_ref[:], qx_ref[:], qy_ref[:], gt_ref[:]
+        )
     ok_ref[0] = ok.astype(jnp.int32)
 
 

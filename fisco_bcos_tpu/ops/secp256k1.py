@@ -99,14 +99,14 @@ def recover_core(z, r, s, v, g_table):
         (v & 2) != 0, const_rows(C.n_limbs, r), jnp.zeros_like(r)
     )
     x17 = limb.add_widen(r, n_or_0)  # [17, T]
-    overflow = x17[16] != 0
+    overflow = limb.row(x17, 16) != 0
     x = x17[:16]
     valid &= ~overflow & lt(x, const_rows(C.p_limbs, r))
     # y from the curve equation y^2 = x^3 + b (a = 0); p ≡ 3 (mod 4)
     y2 = F.add(F.mul(F.sqr(x), x), const_rows(C.b_enc, x))
     y = F.sqrt(y2)
     valid &= eq(F.sqr(y), y2)  # x^3 + b must be a quadratic residue
-    flip = (y[0] & 1).astype(jnp.int32) != (v & 1)  # plain-domain parity
+    flip = (limb.row(y, 0) & 1).astype(jnp.int32) != (v & 1)  # plain parity
     y = select(flip, F.neg(y), y)
     # Q = r^-1 * (s*R - z*G)
     rinv = Fn.inv(r)

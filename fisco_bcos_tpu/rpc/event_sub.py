@@ -1,0 +1,172 @@
+"""Event-log subscription engine.
+
+Reference: bcos-rpc/event/{EventSub.cpp, EventSubMatcher.cpp,
+EventSubTask.cpp} — clients register a filter (block range, addresses,
+topics), the node replays the historical range from the ledger and then
+pushes matched logs from every newly committed block.
+
+Filter semantics match the reference/Ethereum style: `addresses` OR-match
+the log address; `topics[i]` is a list OR-matched against the log's i-th
+topic (empty list = wildcard).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..utils.bytesutil import from_hex, to_hex
+from ..utils.log import get_logger
+
+_log = get_logger("event-sub")
+
+
+@dataclass
+class EventFilter:
+    from_block: int = -1  # -1: start at current head (live only)
+    to_block: int = -1  # -1: unbounded
+    addresses: list[bytes] = field(default_factory=list)
+    topics: list[list[bytes]] = field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "EventFilter":
+        return cls(
+            from_block=int(obj.get("fromBlock", -1)),
+            to_block=int(obj.get("toBlock", -1)),
+            addresses=[from_hex(a) for a in obj.get("addresses", [])],
+            topics=[
+                [from_hex(t) for t in (ts if isinstance(ts, list) else [ts])]
+                for ts in obj.get("topics", [])
+            ],
+        )
+
+    def matches(self, address: bytes, topics: list[bytes]) -> bool:
+        if self.addresses and address not in self.addresses:
+            return False
+        for i, wanted in enumerate(self.topics):
+            if not wanted:
+                continue  # wildcard position
+            if i >= len(topics) or topics[i] not in wanted:
+                return False
+        return True
+
+
+def _log_json(number: int, tx_hash: bytes, log_index: int, entry) -> dict:
+    return {
+        "blockNumber": number,
+        "transactionHash": to_hex(tx_hash),
+        "logIndex": log_index,
+        "address": to_hex(entry.address),
+        "topics": [to_hex(t) for t in entry.topics],
+        "data": to_hex(entry.data),
+    }
+
+
+@dataclass
+class _Subscription:
+    sub_id: str
+    filt: EventFilter
+    push: Callable[[dict], None]  # delivery hook (ws session send)
+
+
+class EventSubEngine:
+    """Register with `scheduler.on_committed` for live pushes; `subscribe`
+    replays any historical range from the ledger first (EventSubTask
+    semantics: history, then live)."""
+
+    def __init__(self, ledger, suite):
+        self.ledger = ledger
+        self.suite = suite
+        self._subs: dict[str, _Subscription] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.RLock()
+
+    # -- wiring ---------------------------------------------------------------
+
+    def on_block_committed(self, number: int, block) -> None:
+        with self._lock:
+            subs = list(self._subs.values())
+        if not subs:
+            return
+        events = self._collect(number, block)
+        if not events:
+            return
+        for sub in subs:
+            if sub.filt.to_block != -1 and number > sub.filt.to_block:
+                self.unsubscribe(sub.sub_id)
+                continue
+            matched = [
+                e
+                for e, (addr, topics) in events
+                if sub.filt.matches(addr, topics)
+            ]
+            if matched:
+                self._push(sub, number, matched)
+
+    def _collect(self, number: int, block):
+        """[(log_json, (address, topics))] for one committed block."""
+        out = []
+        txs = block.transactions
+        receipts = block.receipts
+        for i, rc in enumerate(receipts):
+            tx_hash = txs[i].hash(self.suite) if i < len(txs) else b""
+            for j, entry in enumerate(rc.log_entries):
+                out.append(
+                    (_log_json(number, tx_hash, j, entry), (entry.address, entry.topics))
+                )
+        return out
+
+    def _push(self, sub: _Subscription, number: int, logs: list[dict]) -> None:
+        try:
+            sub.push(
+                {
+                    "method": "eventLogPush",
+                    "params": {"id": sub.sub_id, "blockNumber": number, "logs": logs},
+                }
+            )
+        except Exception:
+            _log.info("push failed; dropping subscription %s", sub.sub_id)
+            self.unsubscribe(sub.sub_id)
+
+    # -- api ------------------------------------------------------------------
+
+    def subscribe(self, filt: EventFilter, push: Callable[[dict], None]) -> str:
+        sub_id = f"sub-{next(self._ids)}"
+        sub = _Subscription(sub_id, filt, push)
+        head = self.ledger.block_number()
+        # historical replay (EventSubTask): blocks [from, min(head, to)]
+        if 0 <= filt.from_block <= head:
+            end = head if filt.to_block == -1 else min(head, filt.to_block)
+            for n in range(filt.from_block, end + 1):
+                block = self.ledger.block_by_number(
+                    n, with_txs=True, with_receipts=True
+                )
+                if block is None:
+                    continue
+                events = self._collect(n, block)
+                matched = [
+                    e for e, (addr, topics) in events if filt.matches(addr, topics)
+                ]
+                if matched:
+                    self._push(sub, n, matched)
+        with self._lock:
+            self._subs[sub_id] = sub
+        return sub_id
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        with self._lock:
+            return self._subs.pop(sub_id, None) is not None
+
+    def drop_by_push_owner(self, owner) -> None:
+        """Remove every subscription whose push hook belongs to `owner`
+        (a closed ws session)."""
+        with self._lock:
+            dead = [
+                s.sub_id
+                for s in self._subs.values()
+                if getattr(s.push, "__self__", None) is owner
+            ]
+            for sid in dead:
+                self._subs.pop(sid, None)

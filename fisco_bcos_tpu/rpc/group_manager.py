@@ -1,0 +1,100 @@
+"""Group manager + multi-group JSON-RPC routing.
+
+Reference: bcos-rpc/groupmgr/{GroupManager, AirGroupManager, NodeService}
+— the RPC layer holds one NodeService per (group, node) and routes each
+request by its group parameter; group listing/info methods aggregate over
+the registry.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .jsonrpc import JsonRpcImpl
+
+
+class GroupManager:
+    def __init__(self):
+        self._impls: dict[str, JsonRpcImpl] = {}
+        self._lock = threading.RLock()
+
+    def add_node(self, node) -> JsonRpcImpl:
+        impl = JsonRpcImpl(node)
+        with self._lock:
+            self._impls[node.config.group_id] = impl
+        return impl
+
+    def impl_for(self, group: str) -> JsonRpcImpl | None:
+        with self._lock:
+            return self._impls.get(group)
+
+    def groups(self) -> list[str]:
+        with self._lock:
+            return sorted(self._impls)
+
+    def impls(self) -> list[JsonRpcImpl]:
+        with self._lock:
+            return [self._impls[g] for g in sorted(self._impls)]
+
+
+class MultiGroupRpc:
+    """Drop-in for JsonRpcImpl.handle: routes by the request's group param
+    (first positional param of every grouped method), aggregates the
+    group-listing surface."""
+
+    def __init__(self, manager: GroupManager, default_group: str):
+        self.manager = manager
+        self.default_group = default_group
+
+    def _default(self) -> JsonRpcImpl:
+        impl = self.manager.impl_for(self.default_group)
+        if impl is None:
+            impls = self.manager.impls()
+            if not impls:
+                raise RuntimeError("no groups registered")
+            impl = impls[0]
+        return impl
+
+    def handle(self, request: dict) -> dict:
+        method = request.get("method", "")
+        params = request.get("params", [])
+        if method == "getGroupList":
+            return self._ok(request, {"groupList": self.manager.groups()})
+        if method == "getGroupInfoList":
+            return self._ok(
+                request,
+                [impl.get_group_info() for impl in self.manager.impls()],
+            )
+        impl = self._default()
+        if (
+            params
+            and isinstance(params[0], str)
+            and self.manager.impl_for(params[0]) is not None
+        ):
+            impl = self.manager.impl_for(params[0])
+        elif params and isinstance(params[0], str) and params[0]:
+            # an explicit unknown group is an error, not a silent default
+            # (only for methods whose first param is a group name)
+            if params[0] not in ("",) and self._looks_like_group(method):
+                return {
+                    "jsonrpc": "2.0",
+                    "id": request.get("id"),
+                    "error": {"code": -32602, "message": f"unknown group: {params[0]}"},
+                }
+        return impl.handle(request)
+
+    @staticmethod
+    def _looks_like_group(method: str) -> bool:
+        return method in {
+            "call", "sendTransaction", "getTransaction", "getTransactionReceipt",
+            "getBlockByHash", "getBlockByNumber", "getBlockHashByNumber",
+            "getCode", "getABI", "getSealerList", "getObserverList",
+            "getPbftView", "getPendingTxSize", "getSyncStatus",
+            "getConsensusStatus", "getSystemConfigByKey",
+            "getTotalTransactionCount", "getGroupPeers", "getGroupInfo",
+            "getGroupNodeInfo",
+        }
+
+    @staticmethod
+    def _ok(request: dict, result) -> dict:
+        return {"jsonrpc": "2.0", "id": request.get("id"), "result": result}

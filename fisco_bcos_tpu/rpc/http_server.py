@@ -18,8 +18,19 @@ _log = get_logger("rpc-http")
 
 
 class RpcHttpServer:
-    def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1", port: int = 20200):
+    """`ssl_context` (gateway.tls.make_server_context) upgrades to HTTPS —
+    the reference's boostssl TLS RPC channel."""
+
+    def __init__(
+        self,
+        impl: JsonRpcImpl,
+        host: str = "127.0.0.1",
+        port: int = 20200,
+        ssl_context=None,
+        metrics=None,
+    ):
         self.impl = impl
+        self.metrics = metrics
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -48,10 +59,26 @@ class RpcHttpServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def do_GET(self) -> None:  # noqa: N802 — Prometheus scrape
+                if self.path != "/metrics" or outer.metrics is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = outer.metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def log_message(self, fmt, *args):  # quiet
                 pass
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            self._server.socket = ssl_context.wrap_socket(
+                self._server.socket, server_side=True
+            )
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
 

@@ -1,0 +1,371 @@
+"""WebSocket service — RFC 6455 server carrying JSON-RPC plus push channels.
+
+Reference: bcos-boostssl/websocket/{WsService, WsSession, WsMessage} and the
+bcos-rpc ws endpoint: the same JSON-RPC 2.0 method table as HTTP, plus the
+push-capable channels that HTTP cannot carry — event-log subscription
+(bcos-rpc/event/EventSub*.cpp), AMOP (amop/AMOPClient.cpp), and block-number
+notify.  Implemented on stdlib sockets: handshake = HTTP Upgrade with the
+Sec-WebSocket-Accept digest; frames = client-masked, server-unmasked;
+ping/pong + close handled in-session.
+
+Service-level methods (consumed by sdk.WsClient):
+    subscribeEvent(filterJson) -> subId         eventLogPush notifications
+    unsubscribeEvent(subId)
+    subscribeBlockNumber() -> ok                blockNumberPush notifications
+    amopSubscribe(topic...)                     amopPush notifications
+    amopPublish(topic, dataHex)
+    amopBroadcast(topic, dataHex)
+Everything else dispatches to the JsonRpcImpl method table.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+
+from ..utils.log import get_logger
+from .event_sub import EventFilter
+
+_log = get_logger("ws")
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_BIN = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+class WsSession:
+    def __init__(self, sock: socket.socket, addr, service: "WsService"):
+        self.sock = sock
+        self.addr = addr
+        self.service = service
+        self.wlock = threading.Lock()
+        self.open = True
+        self.topics: set[str] = set()  # AMOP subscriptions
+
+    # -- frame io ------------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def recv_frame(self) -> tuple[int, bytes] | None:
+        head = self._recv_exact(2)
+        if head is None:
+            return None
+        fin_op, mask_len = head
+        opcode = fin_op & 0x0F
+        masked = mask_len & 0x80
+        length = mask_len & 0x7F
+        if length == 126:
+            ext = self._recv_exact(2)
+            if ext is None:
+                return None
+            (length,) = struct.unpack(">H", ext)
+        elif length == 127:
+            ext = self._recv_exact(8)
+            if ext is None:
+                return None
+            (length,) = struct.unpack(">Q", ext)
+        if length > 64 * 1024 * 1024:
+            return None
+        mask = b"\x00" * 4
+        if masked:
+            mask = self._recv_exact(4)
+            if mask is None:
+                return None
+        payload = self._recv_exact(length) if length else b""
+        if payload is None:
+            return None
+        if masked:
+            payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        return opcode, payload
+
+    def send_frame(self, opcode: int, payload: bytes) -> bool:
+        head = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            head += bytes([n])
+        elif n < 1 << 16:
+            head += bytes([126]) + struct.pack(">H", n)
+        else:
+            head += bytes([127]) + struct.pack(">Q", n)
+        try:
+            with self.wlock:
+                self.sock.sendall(head + payload)
+            return True
+        except OSError:
+            self.open = False
+            return False
+
+    def send_json(self, obj: dict) -> bool:
+        return self.send_frame(OP_TEXT, json.dumps(obj).encode())
+
+    def close(self) -> None:
+        self.open = False
+        try:
+            self.send_frame(OP_CLOSE, b"")
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class WsService:
+    def __init__(
+        self,
+        impl,
+        event_engine=None,
+        amop=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl_context=None,
+    ):
+        self.impl = impl  # JsonRpcImpl (or None)
+        self.events = event_engine  # EventSubEngine
+        self.amop = amop  # AMOPService
+        self._ssl = ssl_context
+        self._sessions: set[WsSession] = set()
+        self._block_subs: set[WsSession] = set()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        if amop is not None:
+            amop.attach_ws(self)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        threading.Thread(target=self._accept_loop, name="ws-accept", daemon=True).start()
+        _log.info("websocket listening on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            sessions = list(self._sessions)
+            self._sessions.clear()
+        for s in sessions:
+            s.close()
+
+    # -- push channels --------------------------------------------------------
+
+    def on_block_committed(self, number: int, block) -> None:
+        """Block notify push (reference asyncNotifyBlockNumber → ws)."""
+        if self.events is not None:
+            self.events.on_block_committed(number, block)
+        with self._lock:
+            subs = list(self._block_subs)
+        for s in subs:
+            if not s.send_json(
+                {"method": "blockNumberPush", "params": {"blockNumber": number}}
+            ):
+                self._drop(s)
+
+    def local_amop_push(self, topic: str, data_hex: str, from_node: str) -> int:
+        """Deliver an AMOP message to local subscribers; returns count."""
+        with self._lock:
+            targets = [s for s in self._sessions if topic in s.topics]
+        delivered = 0
+        for s in targets:
+            if s.send_json(
+                {
+                    "method": "amopPush",
+                    "params": {"topic": topic, "data": data_hex, "from": from_node},
+                }
+            ):
+                delivered += 1
+            else:
+                self._drop(s)
+        return delivered
+
+    def local_topics(self) -> set[str]:
+        with self._lock:
+            out: set[str] = set()
+            for s in self._sessions:
+                out |= s.topics
+            return out
+
+    # -- internals ------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(sock, addr), name="ws-conn", daemon=True
+            ).start()
+
+    def _handshake(self, sock: socket.socket) -> bool:
+        sock.settimeout(10)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = sock.recv(4096)
+            if not chunk or len(data) > 64 * 1024:
+                return False
+            data += chunk
+        headers = {}
+        for line in data.split(b"\r\n")[1:]:
+            if b":" in line:
+                k, v = line.split(b":", 1)
+                headers[k.strip().lower()] = v.strip()
+        key = headers.get(b"sec-websocket-key")
+        if key is None or b"websocket" not in headers.get(b"upgrade", b"").lower():
+            sock.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+            return False
+        accept = base64.b64encode(
+            hashlib.sha1(key + _GUID.encode()).digest()
+        ).decode()
+        sock.sendall(
+            (
+                "HTTP/1.1 101 Switching Protocols\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Accept: {accept}\r\n\r\n"
+            ).encode()
+        )
+        sock.settimeout(None)
+        return True
+
+    def _serve(self, sock: socket.socket, addr) -> None:
+        if self._ssl is not None:
+            try:
+                sock = self._ssl.wrap_socket(sock, server_side=True)
+            except (OSError, ValueError):
+                return
+        try:
+            if not self._handshake(sock):
+                sock.close()
+                return
+        except OSError:
+            return
+        session = WsSession(sock, addr, self)
+        with self._lock:
+            self._sessions.add(session)
+        while not self._stop.is_set() and session.open:
+            frame = session.recv_frame()
+            if frame is None:
+                break
+            opcode, payload = frame
+            if opcode == OP_CLOSE:
+                break
+            if opcode == OP_PING:
+                session.send_frame(OP_PONG, payload)
+                continue
+            if opcode in (OP_TEXT, OP_BIN):
+                self._dispatch(session, payload)
+        self._drop(session)
+
+    def _drop(self, session: WsSession) -> None:
+        with self._lock:
+            self._sessions.discard(session)
+            self._block_subs.discard(session)
+        if self.events is not None:
+            self.events.drop_by_push_owner(session)
+        if self.amop is not None and session.topics:
+            self.amop.on_local_topics_changed()
+        session.close()
+
+    def _dispatch(self, session: WsSession, payload: bytes) -> None:
+        try:
+            req = json.loads(payload)
+        except ValueError:
+            session.send_json(
+                {"jsonrpc": "2.0", "id": None,
+                 "error": {"code": -32700, "message": "parse error"}}
+            )
+            return
+        method = req.get("method", "")
+        rid = req.get("id")
+        params = req.get("params", [])
+        handler = {
+            "subscribeEvent": self._m_subscribe_event,
+            "unsubscribeEvent": self._m_unsubscribe_event,
+            "subscribeBlockNumber": self._m_subscribe_block,
+            "amopSubscribe": self._m_amop_subscribe,
+            "amopUnsubscribe": self._m_amop_unsubscribe,
+            "amopPublish": self._m_amop_publish,
+            "amopBroadcast": self._m_amop_broadcast,
+        }.get(method)
+        if handler is not None:
+            try:
+                result = handler(session, *params)
+                session.send_json({"jsonrpc": "2.0", "id": rid, "result": result})
+            except Exception as e:
+                session.send_json(
+                    {"jsonrpc": "2.0", "id": rid,
+                     "error": {"code": -32602, "message": str(e)}}
+                )
+            return
+        if self.impl is not None:
+            session.send_json(self.impl.handle(req))
+        else:
+            session.send_json(
+                {"jsonrpc": "2.0", "id": rid,
+                 "error": {"code": -32601, "message": f"method not found: {method}"}}
+            )
+
+    # -- service methods -------------------------------------------------------
+
+    def _m_subscribe_event(self, session: WsSession, filter_obj) -> str:
+        if self.events is None:
+            raise ValueError("event subscription unavailable")
+        if isinstance(filter_obj, str):
+            filter_obj = json.loads(filter_obj)
+        return self.events.subscribe(
+            EventFilter.from_json(filter_obj), session.send_json
+        )
+
+    def _m_unsubscribe_event(self, session: WsSession, sub_id: str) -> bool:
+        if self.events is None:
+            raise ValueError("event subscription unavailable")
+        return self.events.unsubscribe(sub_id)
+
+    def _m_subscribe_block(self, session: WsSession) -> bool:
+        with self._lock:
+            self._block_subs.add(session)
+        return True
+
+    def _m_amop_subscribe(self, session: WsSession, *topics: str) -> bool:
+        session.topics.update(topics)
+        if self.amop is not None:
+            self.amop.on_local_topics_changed()
+        return True
+
+    def _m_amop_unsubscribe(self, session: WsSession, *topics: str) -> bool:
+        session.topics.difference_update(topics)
+        if self.amop is not None:
+            self.amop.on_local_topics_changed()
+        return True
+
+    def _m_amop_publish(self, session: WsSession, topic: str, data_hex: str) -> int:
+        if self.amop is None:
+            return self.local_amop_push(topic, data_hex, "")
+        return self.amop.publish(topic, data_hex)
+
+    def _m_amop_broadcast(self, session: WsSession, topic: str, data_hex: str) -> int:
+        if self.amop is None:
+            return self.local_amop_push(topic, data_hex, "")
+        return self.amop.broadcast(topic, data_hex)

@@ -60,6 +60,8 @@ class Scheduler:
         self.suite = suite
         self.txpool = txpool
         self._executed: dict[int, ExecutedBlock] = {}
+        # block-commit listeners: cb(number, committed Block-with-receipts)
+        self.on_committed: list = []
         self._lock = threading.RLock()
 
     # -- executeBlock:150 ----------------------------------------------------
@@ -152,7 +154,16 @@ class Scheduler:
 
     def commit_block(self, header: BlockHeader) -> None:
         with self._lock:
-            self._commit_block_locked(header)
+            committed = self._commit_block_locked(header)
+        # listeners run OUTSIDE the lock: a slow push (ws block notify,
+        # event subscription to a stalled client) must not stall consensus
+        if committed is not None:
+            number, block = committed
+            for cb in list(self.on_committed):
+                try:
+                    cb(number, block)
+                except Exception:
+                    _log.exception("block-commit listener failed at %d", number)
 
     def _commit_block_locked(self, header: BlockHeader) -> None:
         number = header.number
@@ -185,6 +196,7 @@ class Scheduler:
             self.txpool.on_block_committed(
                 number, [t.hash(self.suite) for t in cached.block.transactions]
             )
+        return number, cached.block
 
     # -- call:621 ------------------------------------------------------------
 

@@ -1,0 +1,11 @@
+"""Client SDK — the bcos-sdk (bcos-cpp-sdk) analog in Python.
+
+Reference: bcos-sdk/bcos-cpp-sdk/{rpc/JsonRpcImpl.cpp, SdkFactory.cpp} plus
+the event/amop client channels.  `Client` speaks JSON-RPC over HTTP(S);
+`Account` signs transactions; `Contract` wraps ABI encode/decode around
+deploy/send/call.
+"""
+
+from .client import Account, Client, Contract, ReceiptTimeout
+
+__all__ = ["Account", "Client", "Contract", "ReceiptTimeout"]

@@ -1,0 +1,250 @@
+"""JSON-RPC client, account signing, and contract helper.
+
+Reference surface: bcos-cpp-sdk/rpc/JsonRpcImpl.cpp (the method wrappers),
+bcos-cpp-sdk/SdkFactory.cpp (client assembly), DuplicateTransactionFactory
+(the TPS-flood helper, bcos-rpc/jsonrpc/DupTestTxJsonRpcImpl_2_0.h) —
+`Account.duplicate_signed` serves that role for benchmarks.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import ssl
+import time
+import urllib.request
+
+from ..codec.abi import ABICodec
+from ..crypto.suite import CryptoSuite, KeyPair, ecdsa_suite, sm_suite
+from ..protocol.transaction import Transaction, TransactionFactory
+from ..utils.bytesutil import from_hex, to_hex
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"rpc error {code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class ReceiptTimeout(Exception):
+    pass
+
+
+class Client:
+    """JSON-RPC 2.0 over HTTP(S).  `ca_cert` verifies a TLS node endpoint
+    (build_chain --ssl deployments)."""
+
+    def __init__(
+        self,
+        url: str,
+        group: str = "group0",
+        node: str = "",
+        timeout: float = 15.0,
+        ca_cert: str | None = None,
+    ):
+        self.url = url
+        self.group = group
+        self.node = node
+        self.timeout = timeout
+        self._ids = itertools.count(1)
+        self._ssl_ctx: ssl.SSLContext | None = None
+        if url.startswith("https"):
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
+            self._ssl_ctx.check_hostname = False
+
+    # -- transport -----------------------------------------------------------
+
+    def request(self, method: str, *params):
+        body = {
+            "jsonrpc": "2.0",
+            "id": next(self._ids),
+            "method": method,
+            "params": list(params),
+        }
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(
+            req, timeout=self.timeout, context=self._ssl_ctx
+        )
+        out = json.loads(resp.read())
+        if "error" in out:
+            raise RpcError(out["error"].get("code", -1), out["error"].get("message", ""))
+        return out["result"]
+
+    def _grouped(self, method: str, *params):
+        return self.request(method, self.group, self.node, *params)
+
+    # -- chain reads (JsonRpcInterface.cpp:16-65 surface) ---------------------
+
+    def get_block_number(self) -> int:
+        return self.request("getBlockNumber")
+
+    def get_block_by_number(self, number: int, with_txs: bool = False) -> dict:
+        return self._grouped("getBlockByNumber", number, with_txs)
+
+    def get_block_by_hash(self, block_hash: str, with_txs: bool = False) -> dict:
+        return self._grouped("getBlockByHash", block_hash, with_txs)
+
+    def get_block_hash_by_number(self, number: int) -> str:
+        return self._grouped("getBlockHashByNumber", number)
+
+    def get_transaction(self, tx_hash: str, with_proof: bool = True) -> dict:
+        return self._grouped("getTransaction", tx_hash, with_proof)
+
+    def get_transaction_receipt(self, tx_hash: str, with_proof: bool = True) -> dict:
+        return self._grouped("getTransactionReceipt", tx_hash, with_proof)
+
+    def get_code(self, address: str) -> str:
+        return self._grouped("getCode", address)
+
+    def get_abi(self, address: str) -> str:
+        return self._grouped("getABI", address)
+
+    def get_sealer_list(self) -> list:
+        return self.request("getSealerList")
+
+    def get_observer_list(self) -> list:
+        return self.request("getObserverList")
+
+    def get_pbft_view(self) -> int:
+        return self.request("getPbftView")
+
+    def get_pending_tx_size(self) -> int:
+        return self.request("getPendingTxSize")
+
+    def get_sync_status(self) -> dict:
+        return self.request("getSyncStatus")
+
+    def get_consensus_status(self) -> dict:
+        return self.request("getConsensusStatus")
+
+    def get_system_config_by_key(self, key: str) -> dict:
+        return self._grouped("getSystemConfigByKey", key)
+
+    def get_total_transaction_count(self) -> dict:
+        return self.request("getTotalTransactionCount")
+
+    def get_peers(self) -> dict:
+        return self.request("getPeers")
+
+    def get_group_list(self) -> list:
+        return self.request("getGroupList")
+
+    def get_group_info(self) -> dict:
+        return self.request("getGroupInfo", self.group)
+
+    # -- writes ---------------------------------------------------------------
+
+    def send_raw_transaction(self, tx: Transaction) -> dict:
+        return self._grouped("sendTransaction", to_hex(tx.encode()))
+
+    def call(self, to: bytes | str, data: bytes) -> dict:
+        to_h = to if isinstance(to, str) else to_hex(to)
+        return self._grouped("call", to_h, to_hex(data))
+
+    def wait_for_receipt(
+        self, tx_hash: str, timeout: float = 30.0, interval: float = 0.1
+    ) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                return self.get_transaction_receipt(tx_hash)
+            except RpcError:
+                time.sleep(interval)
+        raise ReceiptTimeout(tx_hash)
+
+
+class Account:
+    """Key management + transaction building (bcos-cpp-sdk TransactionBuilder)."""
+
+    def __init__(
+        self,
+        suite: CryptoSuite | None = None,
+        keypair: KeyPair | None = None,
+        sm_crypto: bool = False,
+        chain_id: str = "chain0",
+        group_id: str = "group0",
+    ):
+        self.suite = suite or (sm_suite() if sm_crypto else ecdsa_suite())
+        self.keypair = keypair or self.suite.signature_impl.generate_keypair()
+        self.factory = TransactionFactory(self.suite)
+        self.chain_id = chain_id
+        self.group_id = group_id
+        self._nonce = itertools.count(int(time.time() * 1000))
+
+    @property
+    def address(self) -> bytes:
+        return self.suite.calculate_address(self.keypair.pub)
+
+    def sign_tx(
+        self,
+        to: bytes = b"",
+        data: bytes = b"",
+        block_limit: int = 500,
+        nonce: str | None = None,
+        abi: str = "",
+    ) -> Transaction:
+        return self.factory.create_signed(
+            self.keypair,
+            chain_id=self.chain_id,
+            group_id=self.group_id,
+            block_limit=block_limit,
+            nonce=nonce if nonce is not None else f"sdk-{next(self._nonce)}",
+            to=to,
+            input=data,
+            abi=abi,
+        )
+
+    def duplicate_signed(self, tx: Transaction, count: int) -> list[Transaction]:
+        """N re-signed copies with fresh nonces — the reference's TPS-flood
+        helper (DuplicateTransactionFactory.cpp duplicates a signed tx for
+        load tests)."""
+        return [
+            self.sign_tx(
+                to=tx.to, data=tx.input, block_limit=tx.block_limit, abi=tx.abi
+            )
+            for _ in range(count)
+        ]
+
+
+class Contract:
+    """ABI-aware deploy/send/call wrapper (bcos-cpp-sdk TransactionManager +
+    ContractABICodec glue)."""
+
+    def __init__(self, client: Client, account: Account, address: bytes = b""):
+        self.client = client
+        self.account = account
+        self.address = address
+        self.codec = ABICodec(account.suite.hash)
+
+    def deploy(self, bytecode: bytes, abi: str = "", timeout: float = 30.0):
+        """Deploy `bytecode` (CREATE); returns (contract_address, receipt)."""
+        tx = self.account.sign_tx(to=b"", data=bytecode, abi=abi)
+        block_limit = self.client.get_block_number() + 500
+        tx.block_limit = max(tx.block_limit, block_limit)
+        res = self.client.send_raw_transaction(tx)
+        rc = self.client.wait_for_receipt(res["transactionHash"], timeout=timeout)
+        if rc.get("status") != 0:
+            raise RpcError(rc.get("status", -1), f"deploy reverted: {rc}")
+        self.address = from_hex(rc["contractAddress"])
+        return self.address, rc
+
+    def send(self, signature: str, *args, timeout: float = 30.0) -> dict:
+        """State-changing call: sign, submit, wait for the receipt."""
+        data = self.codec.encode_call(signature, *args)
+        tx = self.account.sign_tx(to=self.address, data=data)
+        res = self.client.send_raw_transaction(tx)
+        return self.client.wait_for_receipt(res["transactionHash"], timeout=timeout)
+
+    def call(self, signature: str, out_types: list[str], *args):
+        """Read-only call; decodes the output tuple."""
+        data = self.codec.encode_call(signature, *args)
+        out = self.client.call(self.address, data)
+        raw = from_hex(out.get("output", "0x"))
+        if not out_types:
+            return ()
+        return self.codec.decode_output(out_types, raw)

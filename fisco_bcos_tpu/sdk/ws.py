@@ -1,0 +1,190 @@
+"""SDK WebSocket client — push-capable channel (events, AMOP, block notify).
+
+Reference: bcos-cpp-sdk/ws/Service.cpp + event/amop client wrappers. Minimal
+RFC 6455 client on stdlib sockets: masked frames out, notification dispatch
+on a reader thread, request/response correlation by JSON-RPC id.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Callable
+
+
+class WsClient:
+    def __init__(self, host: str, port: int, timeout: float = 15.0):
+        self.timeout = timeout
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (
+                f"GET / HTTP/1.1\r\nHost: {host}:{port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("ws handshake failed")
+            data += chunk
+        if b"101" not in data.split(b"\r\n", 1)[0]:
+            raise ConnectionError(f"ws handshake rejected: {data[:100]!r}")
+        self.sock.settimeout(None)
+        self._ids = itertools.count(1)
+        self._pending: dict[int, dict | None] = {}
+        self._cv = threading.Condition()
+        self.notifications: list[dict] = []  # push messages, in arrival order
+        self.on_notify: Callable[[dict], None] | None = None
+        self._open = True
+        threading.Thread(target=self._reader, name="ws-client", daemon=True).start()
+
+    # -- frames ---------------------------------------------------------------
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        head = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            head += bytes([0x80 | n])
+        elif n < 1 << 16:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            head += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        mask = os.urandom(4)
+        body = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + body)
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _reader(self) -> None:
+        while self._open:
+            head = self._recv_exact(2)
+            if head is None:
+                break
+            opcode = head[0] & 0x0F
+            length = head[1] & 0x7F
+            if length == 126:
+                ext = self._recv_exact(2)
+                if ext is None:
+                    break
+                (length,) = struct.unpack(">H", ext)
+            elif length == 127:
+                ext = self._recv_exact(8)
+                if ext is None:
+                    break
+                (length,) = struct.unpack(">Q", ext)
+            payload = self._recv_exact(length) if length else b""
+            if payload is None:
+                break
+            if opcode == 0x9:  # ping
+                self._send_frame(0xA, payload)
+                continue
+            if opcode == 0x8:  # close
+                break
+            try:
+                msg = json.loads(payload)
+            except ValueError:
+                continue
+            with self._cv:
+                if "id" in msg and msg["id"] in self._pending:
+                    self._pending[msg["id"]] = msg
+                    self._cv.notify_all()
+                else:
+                    self.notifications.append(msg)
+                    self._cv.notify_all()
+            if "id" not in msg and self.on_notify is not None:
+                try:
+                    self.on_notify(msg)
+                except Exception:
+                    pass
+        self._open = False
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- rpc ------------------------------------------------------------------
+
+    def request(self, method: str, *params):
+        rid = next(self._ids)
+        with self._cv:
+            self._pending[rid] = None
+        self._send_frame(
+            0x1,
+            json.dumps(
+                {"jsonrpc": "2.0", "id": rid, "method": method, "params": list(params)}
+            ).encode(),
+        )
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._pending[rid] is not None or not self._open,
+                timeout=self.timeout,
+            )
+            resp = self._pending.pop(rid)
+        if resp is None:
+            raise TimeoutError(f"ws request {method} timed out")
+        if "error" in resp:
+            raise RuntimeError(f"rpc error: {resp['error']}")
+        return resp["result"]
+
+    def wait_notification(self, predicate=None, timeout: float = 15.0) -> dict | None:
+        """Pop the first (matching) push notification, waiting if needed."""
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+
+        def find():
+            for i, m in enumerate(self.notifications):
+                if predicate is None or predicate(m):
+                    return i
+            return None
+
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: find() is not None or not self._open, timeout=deadline
+            )
+            idx = find()
+            if not ok or idx is None:
+                return None
+            return self.notifications.pop(idx)
+
+    # -- channels -------------------------------------------------------------
+
+    def subscribe_event(self, filter_obj: dict) -> str:
+        return self.request("subscribeEvent", filter_obj)
+
+    def unsubscribe_event(self, sub_id: str) -> bool:
+        return self.request("unsubscribeEvent", sub_id)
+
+    def subscribe_block_number(self) -> bool:
+        return self.request("subscribeBlockNumber")
+
+    def amop_subscribe(self, *topics: str) -> bool:
+        return self.request("amopSubscribe", *topics)
+
+    def amop_publish(self, topic: str, data: bytes) -> int:
+        return self.request("amopPublish", topic, data.hex())
+
+    def amop_broadcast(self, topic: str, data: bytes) -> int:
+        return self.request("amopBroadcast", topic, data.hex())
+
+    def close(self) -> None:
+        self._open = False
+        try:
+            self._send_frame(0x8, b"")
+            self.sock.close()
+        except OSError:
+            pass

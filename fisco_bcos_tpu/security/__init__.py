@@ -1,0 +1,8 @@
+"""Storage security — transparent at-rest encryption.
+
+Reference: bcos-security/{DataEncryption.cpp, KeyCenter.cpp}.
+"""
+
+from .data_encryption import DataEncryption, EncryptedStorage
+
+__all__ = ["DataEncryption", "EncryptedStorage"]

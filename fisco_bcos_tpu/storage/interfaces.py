@@ -34,6 +34,12 @@ class StorageInterface:
     def set_row(self, table: str, key: bytes, entry: Entry) -> None:
         raise NotImplementedError
 
+    def set_rows(self, table: str, items: list[tuple[bytes, Entry]]) -> None:
+        """Bulk write; durable backends commit all rows in one transaction
+        (hot paths like pool persistence write thousands of rows per block)."""
+        for key, entry in items:
+            self.set_row(table, key, entry)
+
     def get_primary_keys(self, table: str) -> list[bytes]:
         raise NotImplementedError
 

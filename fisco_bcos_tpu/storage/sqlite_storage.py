@@ -50,6 +50,14 @@ class SQLiteStorage(TransactionalStorage):
             )
             self._conn.commit()
 
+    def set_rows(self, table: str, items) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO kv (tbl, k, v) VALUES (?, ?, ?)",
+                [(table, bytes(k), e.encode()) for k, e in items],
+            )
+            self._conn.commit()
+
     def get_primary_keys(self, table: str) -> list[bytes]:
         with self._lock:
             rows = self._conn.execute(

@@ -1,0 +1,9 @@
+"""Boot/ops tooling — config loading and chain deployment.
+
+Reference: bcos-tool (NodeConfig.cpp INI loading) + tools/BcosAirBuilder
+(build_chain.sh deployment generator).
+"""
+
+from .config import ChainOptions, load_chain_options, load_genesis, load_keypair
+
+__all__ = ["ChainOptions", "load_chain_options", "load_genesis", "load_keypair"]

@@ -1,0 +1,223 @@
+"""Chain deployment generator — the build_chain.sh analog.
+
+Reference: tools/BcosAirBuilder/build_chain.sh (1,962 lines: chain CA + node
+certs, node keys, config.ini/config.genesis per node, start/stop scripts).
+Usage::
+
+    python -m fisco_bcos_tpu.tool.build_chain -l 127.0.0.1:4 -o nodes \
+        [--sm] [--ssl] [-p 30300,20200]
+
+emits::
+
+    nodes/ca/{ca.crt,ca.key}                (with --ssl)
+    nodes/node<i>/config.ini
+    nodes/node<i>/config.genesis
+    nodes/node<i>/conf/{node.key,node.nodeid[,ssl.crt,ssl.key,ca.crt]}
+    nodes/node<i>/start.sh  nodes/{start_all,stop_all}.sh
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import stat
+import sys
+
+
+def _genesis_text(nodeids: list[str], chain_id: str, group_id: str) -> str:
+    nodes = "\n".join(
+        f"    node.{i}={nid}:1" for i, nid in enumerate(nodeids)
+    )
+    return f"""[chain]
+    chain_id={chain_id}
+    group_id={group_id}
+
+[consensus]
+    consensus_type=pbft
+    block_tx_count_limit=1000
+    leader_period=1
+{nodes}
+
+[tx]
+    gas_limit=3000000000
+
+[version]
+    compatibility_version=1
+"""
+
+
+def _config_text(
+    host: str,
+    p2p_port: int,
+    rpc_port: int,
+    ws_port: int,
+    peers: list[tuple[str, int]],
+    sm: bool,
+    ssl: bool,
+) -> str:
+    peer_lines = "\n".join(
+        f"    node.{i}={h}:{p}" for i, (h, p) in enumerate(peers)
+    )
+    return f"""[chain]
+    sm_crypto={'true' if sm else 'false'}
+
+[security]
+    private_key_path=conf/node.key
+
+[cert]
+    enable_ssl={'true' if ssl else 'false'}
+    ca_cert=conf/ca.crt
+    node_cert=conf/ssl.crt
+    node_key=conf/ssl.key
+
+[rpc]
+    listen_ip={host}
+    listen_port={rpc_port}
+    ws_port={ws_port}
+
+[p2p]
+    listen_ip={host}
+    listen_port={p2p_port}
+{peer_lines}
+
+[consensus]
+    consensus_timeout=3.0
+    sealer_interval=0.05
+
+[sync]
+    sync_interval=0.5
+
+[storage]
+    data_path=data
+
+[txpool]
+    limit=135000
+    block_limit=600
+
+[log]
+    level=info
+"""
+
+
+_START_SH = """#!/bin/bash
+cd "$(dirname "$0")"
+nohup {python} -m fisco_bcos_tpu -c config.ini -g config.genesis \\
+    >> node.log 2>&1 &
+echo $! > node.pid
+echo "started node (pid $(cat node.pid))"
+"""
+
+_STOP_SH = """#!/bin/bash
+cd "$(dirname "$0")"
+[ -f node.pid ] && kill "$(cat node.pid)" 2>/dev/null && rm -f node.pid
+"""
+
+
+def _write_exec(path: str, text: str) -> None:
+    with open(path, "w") as f:
+        f.write(text)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR | stat.S_IXGRP)
+
+
+def build_chain(
+    out_dir: str,
+    count: int,
+    host: str = "127.0.0.1",
+    p2p_base: int = 30300,
+    rpc_base: int = 20200,
+    sm: bool = False,
+    ssl: bool = False,
+    chain_id: str = "chain0",
+    group_id: str = "group0",
+    ports: list[tuple[int, int]] | None = None,
+) -> list[str]:
+    """Generate `count` node directories under out_dir; returns their paths.
+    `ports` overrides the (p2p, rpc) pair per node (tests pick free ports)."""
+    from ..crypto.suite import ecdsa_suite, sm_suite
+
+    from .config import save_keypair
+
+    suite = sm_suite() if sm else ecdsa_suite()
+    os.makedirs(out_dir, exist_ok=True)
+
+    if ports is None:
+        # third member = websocket channel (event-sub/AMOP push)
+        ports = [(p2p_base + i, rpc_base + i, rpc_base + 500 + i) for i in range(count)]
+    ports = [p if len(p) == 3 else (p[0], p[1], p[1] + 500) for p in ports]
+    keypairs = [suite.signature_impl.generate_keypair() for _ in range(count)]
+    nodeids = [kp.pub.hex() for kp in keypairs]
+    genesis = _genesis_text(nodeids, chain_id, group_id)
+    peers = [(host, p[0]) for p in ports]
+
+    ca_crt = ca_key = None
+    if ssl:
+        from ..gateway.tls import generate_chain_ca
+
+        ca_crt, ca_key = generate_chain_ca(os.path.join(out_dir, "ca"))
+
+    node_dirs = []
+    for i in range(count):
+        ndir = os.path.join(out_dir, f"node{i}")
+        conf = os.path.join(ndir, "conf")
+        os.makedirs(conf, exist_ok=True)
+        p2p_port, rpc_port, ws_port = ports[i]
+        with open(os.path.join(ndir, "config.genesis"), "w") as f:
+            f.write(genesis)
+        with open(os.path.join(ndir, "config.ini"), "w") as f:
+            f.write(_config_text(host, p2p_port, rpc_port, ws_port, peers, sm, ssl))
+        save_keypair(os.path.join(conf, "node.key"), keypairs[i])
+        if ssl:
+            from ..gateway.tls import issue_node_cert
+
+            issue_node_cert(ca_crt, ca_key, conf, f"node{i}", hosts=[host])
+            shutil.copy(ca_crt, os.path.join(conf, "ca.crt"))
+        _write_exec(
+            os.path.join(ndir, "start.sh"), _START_SH.format(python=sys.executable)
+        )
+        _write_exec(os.path.join(ndir, "stop.sh"), _STOP_SH)
+        node_dirs.append(ndir)
+
+    _write_exec(
+        os.path.join(out_dir, "start_all.sh"),
+        "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n"
+        + "".join(f"./node{i}/start.sh\n" for i in range(count)),
+    )
+    _write_exec(
+        os.path.join(out_dir, "stop_all.sh"),
+        "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n"
+        + "".join(f"./node{i}/stop.sh\n" for i in range(count)),
+    )
+    return node_dirs
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="build_chain", description=__doc__)
+    ap.add_argument("-l", "--listen", default="127.0.0.1:4", help="host:count")
+    ap.add_argument("-o", "--output", default="nodes")
+    ap.add_argument("-p", "--ports", default="30300,20200", help="p2p_base,rpc_base")
+    ap.add_argument("--sm", action="store_true", help="SM2/SM3 national crypto")
+    ap.add_argument("--ssl", action="store_true", help="mutual TLS on P2P + RPC")
+    ap.add_argument("--chain-id", default="chain0")
+    ap.add_argument("--group-id", default="group0")
+    args = ap.parse_args(argv)
+
+    host, count = args.listen.rsplit(":", 1)
+    p2p_base, rpc_base = (int(x) for x in args.ports.split(","))
+    dirs = build_chain(
+        args.output,
+        int(count),
+        host=host,
+        p2p_base=p2p_base,
+        rpc_base=rpc_base,
+        sm=args.sm,
+        ssl=args.ssl,
+        chain_id=args.chain_id,
+        group_id=args.group_id,
+    )
+    print(f"generated {len(dirs)} nodes under {args.output}/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

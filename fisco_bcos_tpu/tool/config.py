@@ -1,0 +1,200 @@
+"""INI config loading — the bcos-tool/NodeConfig analog.
+
+Reference: bcos-tool/src/NodeConfig.cpp:58-93 (`loadConfig` dispatching to
+loadRpcConfig / loadGatewayConfig / loadTxPoolConfig / loadChainConfig /
+loadSealerConfig / loadStorageConfig / loadConsensusConfig, then
+`loadGenesisConfig` for the ledger/executor sections), using
+boost::property_tree INI files.  This loader reads the same two files
+(``config.ini`` + ``config.genesis``) with the same section/key naming and
+produces the framework's dataclass configs.
+
+The genesis file is consensus-critical: every node must derive the identical
+genesis block from it (reference: Ledger::buildGenesisBlock), so parsing here
+is strict — unknown consensus node lines are errors, not warnings.
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+from dataclasses import dataclass, field
+
+from ..ledger import ConsensusNode, GenesisConfig
+from ..node.node import NodeConfig
+
+
+@dataclass
+class P2PPeer:
+    host: str
+    port: int
+
+
+@dataclass
+class ChainOptions:
+    """Everything main() needs beyond NodeConfig: transports + key paths.
+
+    Mirrors the [rpc]/[p2p]/[cert]/[security] sections of the reference
+    config.ini (NodeConfig.cpp loadRpcConfig/loadGatewayConfig +
+    GatewayConfig.cpp cert paths).
+    """
+
+    node: NodeConfig = field(default_factory=NodeConfig)
+    # [rpc]
+    rpc_listen_ip: str = "127.0.0.1"
+    rpc_listen_port: int = 20200
+    ws_listen_port: int = 0  # 0 -> websocket channel disabled
+    # [p2p]
+    p2p_listen_ip: str = "127.0.0.1"
+    p2p_listen_port: int = 30300
+    peers: list[P2PPeer] = field(default_factory=list)
+    # [security]
+    private_key_path: str = "conf/node.key"
+    # [cert] — mutual TLS for P2P + RPC (bcos-boostssl/context)
+    enable_ssl: bool = False
+    ca_cert: str = "conf/ca.crt"
+    node_cert: str = "conf/ssl.crt"
+    node_key: str = "conf/ssl.key"
+    # [consensus] runtime knobs (engine limits come from genesis)
+    consensus_timeout: float = 3.0
+    sealer_interval: float = 0.05
+    sync_interval: float = 0.5
+    # [log]
+    log_level: str = "info"
+
+
+def _parser(path: str) -> configparser.ConfigParser:
+    cp = configparser.ConfigParser(inline_comment_prefixes=(";", "#"))
+    with open(path) as f:
+        cp.read_file(f)
+    return cp
+
+
+def load_genesis(path: str) -> GenesisConfig:
+    """Parse config.genesis (reference: NodeConfig::loadGenesisConfig —
+    [chain]/[consensus]/[tx]/[version]/[executor] sections; consensus node
+    lines are ``node.N=<128-hex-pubkey>:<weight>``)."""
+    cp = _parser(path)
+    g = GenesisConfig()
+    if cp.has_section("chain"):
+        g.chain_id = cp.get("chain", "chain_id", fallback=g.chain_id)
+        g.group_id = cp.get("chain", "group_id", fallback=g.group_id)
+    if cp.has_section("consensus"):
+        g.leader_period = cp.getint(
+            "consensus", "leader_period", fallback=g.leader_period
+        )
+        g.tx_count_limit = cp.getint(
+            "consensus", "block_tx_count_limit", fallback=g.tx_count_limit
+        )
+        for key, val in cp.items("consensus"):
+            if not key.startswith("node."):
+                continue
+            try:
+                pub_hex, weight = val.rsplit(":", 1)
+                pub = bytes.fromhex(pub_hex)
+                if len(pub) != 64:
+                    raise ValueError("node id must be 64 bytes")
+                g.consensus_nodes.append(ConsensusNode(pub, weight=int(weight)))
+            except ValueError as e:
+                raise ValueError(f"bad consensus node line {key}={val}: {e}") from e
+    if cp.has_section("tx"):
+        g.gas_limit = cp.getint("tx", "gas_limit", fallback=g.gas_limit)
+    if cp.has_section("version"):
+        g.version = cp.getint("version", "compatibility_version", fallback=g.version)
+    return g
+
+
+def load_chain_options(config_path: str, genesis_path: str) -> ChainOptions:
+    """Parse config.ini + config.genesis into ChainOptions.
+
+    Relative paths inside config.ini resolve against the config file's
+    directory (the reference resolves against the node dir the same way).
+    """
+    base = os.path.dirname(os.path.abspath(config_path))
+    cp = _parser(config_path)
+    opts = ChainOptions()
+    opts.node.genesis = load_genesis(genesis_path)
+    opts.node.chain_id = opts.node.genesis.chain_id
+    opts.node.group_id = opts.node.genesis.group_id
+
+    def respath(p: str) -> str:
+        return p if os.path.isabs(p) else os.path.join(base, p)
+
+    if cp.has_section("chain"):
+        opts.node.sm_crypto = cp.getboolean("chain", "sm_crypto", fallback=False)
+    if cp.has_section("security"):
+        opts.private_key_path = respath(
+            cp.get("security", "private_key_path", fallback=opts.private_key_path)
+        )
+    if cp.has_section("storage"):
+        data_path = cp.get("storage", "data_path", fallback="data")
+        if data_path in ("", ":memory:"):
+            opts.node.db_path = ":memory:"
+        else:
+            d = respath(data_path)
+            os.makedirs(d, exist_ok=True)
+            opts.node.db_path = os.path.join(d, "state.db")
+    if cp.has_section("storage_security"):
+        # bcos-security DataEncryption: [storage_security] enable/data_key
+        if cp.getboolean("storage_security", "enable", fallback=False):
+            opts.node.data_key = cp.get(
+                "storage_security", "data_key", fallback=""
+            ).encode()
+    if cp.has_section("txpool"):
+        opts.node.pool_limit = cp.getint(
+            "txpool", "limit", fallback=opts.node.pool_limit
+        )
+        opts.node.block_limit = cp.getint(
+            "txpool", "block_limit", fallback=opts.node.block_limit
+        )
+    if cp.has_section("rpc"):
+        opts.rpc_listen_ip = cp.get("rpc", "listen_ip", fallback=opts.rpc_listen_ip)
+        opts.rpc_listen_port = cp.getint(
+            "rpc", "listen_port", fallback=opts.rpc_listen_port
+        )
+        opts.ws_listen_port = cp.getint("rpc", "ws_port", fallback=0)
+    if cp.has_section("p2p"):
+        opts.p2p_listen_ip = cp.get("p2p", "listen_ip", fallback=opts.p2p_listen_ip)
+        opts.p2p_listen_port = cp.getint(
+            "p2p", "listen_port", fallback=opts.p2p_listen_port
+        )
+        for key, val in cp.items("p2p"):
+            if key.startswith("node."):
+                host, port = val.rsplit(":", 1)
+                opts.peers.append(P2PPeer(host, int(port)))
+    if cp.has_section("cert"):
+        opts.enable_ssl = cp.getboolean("cert", "enable_ssl", fallback=False)
+        opts.ca_cert = respath(cp.get("cert", "ca_cert", fallback=opts.ca_cert))
+        opts.node_cert = respath(cp.get("cert", "node_cert", fallback=opts.node_cert))
+        opts.node_key = respath(cp.get("cert", "node_key", fallback=opts.node_key))
+    if cp.has_section("consensus"):
+        opts.consensus_timeout = cp.getfloat(
+            "consensus", "consensus_timeout", fallback=opts.consensus_timeout
+        )
+        opts.sealer_interval = cp.getfloat(
+            "consensus", "sealer_interval", fallback=opts.sealer_interval
+        )
+    if cp.has_section("sync"):
+        opts.sync_interval = cp.getfloat(
+            "sync", "sync_interval", fallback=opts.sync_interval
+        )
+    if cp.has_section("log"):
+        opts.log_level = cp.get("log", "level", fallback=opts.log_level)
+    return opts
+
+
+def load_keypair(path: str, suite):
+    """node.key: hex-encoded secret scalar (one line).  The reference stores
+    a PEM EC key (NodeConfig loadSecurityConfig); a bare scalar carries the
+    same entropy without an ASN.1 dependency."""
+    with open(path) as f:
+        secret = int(f.read().strip(), 16)
+    return suite.signature_impl.generate_keypair(secret=secret)
+
+
+def save_keypair(path: str, kp) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(f"{kp.secret:064x}\n")
+    os.chmod(path, 0o600)
+    with open(os.path.join(os.path.dirname(path), "node.nodeid"), "w") as f:
+        f.write(kp.pub.hex() + "\n")

@@ -36,6 +36,8 @@ class TxSubmitResult:
 
 
 class TxPool:
+    PERSIST_TABLE = "s_txpool_data"
+
     def __init__(
         self,
         suite: CryptoSuite,
@@ -44,10 +46,14 @@ class TxPool:
         group_id: str = "group0",
         pool_limit: int = 15000 * 9,
         block_limit: int = 600,
+        persistent_store=None,
     ):
         self.suite = suite
         self.ledger = ledger
         self.pool_limit = pool_limit
+        # durable pool (reference: Initializer.cpp:188-195 re-imports pool
+        # txs on boot); None -> memory-only pool
+        self.pstore = persistent_store
         self._txs: dict[bytes, Transaction] = {}
         self._sealed: set[bytes] = set()
         self._lock = threading.RLock()
@@ -106,20 +112,57 @@ class TxPool:
             to_verify.append(i)
         if to_verify:
             ok = batch_admit([txs[i] for i in to_verify], self.suite)
+            persisted: list[tuple[bytes, "Entry"]] = []
             for j, i in enumerate(to_verify):
                 if ok[j]:
-                    self._insert(txs[i], hashes[i])
+                    self._insert(txs[i], hashes[i], persist=False)
+                    persisted.append((hashes[i], txs[i]))
                     results[i] = TxSubmitResult(
                         hashes[i], ErrorCode.SUCCESS, txs[i].sender
                     )
                 else:
                     results[i] = TxSubmitResult(hashes[i], ErrorCode.INVALID_SIGNATURE)
+            if self.pstore is not None and persisted:
+                from ..storage.entry import Entry
+
+                # one transaction for the whole batch — per-row sqlite
+                # commits would fsync thousands of times per block
+                self.pstore.set_rows(
+                    self.PERSIST_TABLE,
+                    [(h, Entry({"value": t.encode()})) for h, t in persisted],
+                )
         return results  # type: ignore[return-value]
 
-    def _insert(self, tx: Transaction, h: bytes) -> None:
+    def _insert(self, tx: Transaction, h: bytes, persist: bool = True) -> None:
         with self._lock:
             self._txs[h] = tx
         self.pool_nonces.insert(tx.nonce)
+        if persist and self.pstore is not None:
+            from ..storage.entry import Entry
+
+            self.pstore.set_row(self.PERSIST_TABLE, h, Entry({"value": tx.encode()}))
+
+    def reload_persisted(self) -> int:
+        """Re-import durably-stored pool txs after a restart (signatures
+        re-verified in one device batch; committed nonces rejected by the
+        primed ledger window). Returns the number re-admitted."""
+        if self.pstore is None:
+            return 0
+        txs = []
+        for key in self.pstore.get_primary_keys(self.PERSIST_TABLE):
+            e = self.pstore.get_row(self.PERSIST_TABLE, key)
+            if e is None or not e.get():
+                continue
+            try:
+                txs.append(Transaction.decode(e.get()))
+            except Exception:
+                continue
+        if not txs:
+            return 0
+        results = self.submit_batch(txs)
+        ok = sum(1 for r in results if r.status == ErrorCode.SUCCESS)
+        _log.info("re-imported %d/%d persisted pool txs", ok, len(txs))
+        return ok
 
     # -- queries -------------------------------------------------------------
 
@@ -208,5 +251,12 @@ class TxPool:
                 if tx is not None:
                     nonces.append(tx.nonce)
                     self.pool_nonces.remove(tx.nonce)
+        if self.pstore is not None and tx_hashes:
+            from ..storage.entry import Entry, EntryStatus
+
+            self.pstore.set_rows(
+                self.PERSIST_TABLE,
+                [(h, Entry(status=EntryStatus.DELETED)) for h in tx_hashes],
+            )
         self.ledger_nonces.commit_block(number, nonces)
         _log.info("block %d committed: dropped %d txs", number, len(tx_hashes))

@@ -1,0 +1,94 @@
+"""Metrics registry with Prometheus text exposition.
+
+Reference: the reference exports node metrics by tailing METRIC log lines
+with mtail into Prometheus (tools/BcosAirBuilder/build_chain.sh:891-946
+generates the mtail config).  Here the same signals are first-class: modules
+register counters/gauges, and the RPC HTTP server exposes ``GET /metrics``
+in Prometheus text format — no sidecar required (the mtail-compatible METRIC
+log lines from utils/log.py remain for log-based pipelines).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, Callable[[], float] | float] = {}
+        self._help: dict[str, str] = {}
+
+    def counter_add(self, name: str, value: float = 1.0, help: str = "") -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+            if help:
+                self._help.setdefault(name, help)
+
+    def gauge_set(self, name: str, value: float, help: str = "") -> None:
+        with self._lock:
+            self._gauges[name] = value
+            if help:
+                self._help.setdefault(name, help)
+
+    def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "") -> None:
+        """Register a pull-time gauge (evaluated at scrape)."""
+        with self._lock:
+            self._gauges[name] = fn
+            if help:
+                self._help.setdefault(name, help)
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            helps = dict(self._help)
+        for name, val in sorted(counters.items()):
+            base = name.split("{")[0]
+            if base in helps:
+                lines.append(f"# HELP {base} {helps[base]}")
+            lines.append(f"# TYPE {base} counter")
+            lines.append(f"{name} {val:g}")
+        for name, val in sorted(gauges.items()):
+            base = name.split("{")[0]
+            if callable(val):
+                try:
+                    val = float(val())
+                except Exception:
+                    continue
+            if base in helps:
+                lines.append(f"# HELP {base} {helps[base]}")
+            lines.append(f"# TYPE {base} gauge")
+            lines.append(f"{name} {val:g}")
+        return "\n".join(lines) + "\n"
+
+
+# process-wide default registry (modules import and use directly)
+REGISTRY = MetricsRegistry()
+
+
+def bind_node_metrics(node, registry: MetricsRegistry | None = None) -> MetricsRegistry:
+    """Register the standard node gauges (block height, pool size, view —
+    the signals the reference's mtail config extracts)."""
+    reg = registry or REGISTRY
+    reg.gauge_fn(
+        "fisco_block_number", lambda: float(node.block_number()),
+        help="committed chain head",
+    )
+    reg.gauge_fn(
+        "fisco_txpool_pending", lambda: float(node.txpool.pending_count()),
+        help="pending pool transactions",
+    )
+    reg.gauge_fn(
+        "fisco_pbft_view", lambda: float(node.engine.view), help="current PBFT view"
+    )
+    reg.gauge_fn(
+        "fisco_committee_size",
+        lambda: float(node.pbft_config.committee_size),
+        help="consensus committee size",
+    )
+    return reg

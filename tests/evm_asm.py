@@ -129,3 +129,12 @@ def pingpong_runtime() -> bytes:
         ("label", "done"), "STOP",
         ("label", "end"), "STOP",
     )
+
+
+def logger_runtime() -> bytes:
+    """Emits LOG1(topic=0xfeed, data=calldata word 0) — the event-sub fixture."""
+    return asm(
+        ("PUSH", 0), "CALLDATALOAD", ("PUSH", 0), "MSTORE",
+        ("PUSH", 0xFEED), ("PUSH", 32), ("PUSH", 0), "LOG1",
+        "STOP",
+    )

@@ -1,0 +1,102 @@
+"""Leader election (lease campaign) + LRU cache storage layer.
+
+References: bcos-leader-election/src/LeaderElection.cpp,
+bcos-table/src/CacheStorageFactory.cpp.
+"""
+
+import time
+
+from fisco_bcos_tpu.election import LeaderElection
+from fisco_bcos_tpu.storage import MemoryStorage
+from fisco_bcos_tpu.storage.cache import CacheStorage
+from fisco_bcos_tpu.storage.entry import Entry, EntryStatus
+from fisco_bcos_tpu.storage.interfaces import TwoPCParams
+
+
+def test_leader_election_campaign_and_failover(tmp_path):
+    db = str(tmp_path / "election.db")
+    a = LeaderElection(db, "scheduler", "node-a", lease_ttl=0.4)
+    b = LeaderElection(db, "scheduler", "node-b", lease_ttl=0.4)
+    events_b = []
+    b.on_change = events_b.append
+    try:
+        assert a.campaign() is True
+        assert b.campaign() is False
+        assert a.is_leader() and not b.is_leader()
+        assert b.current_leader() == "node-a"
+
+        # leader resigns -> follower takes over within a lease period
+        a.stop()
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and not b.is_leader():
+            time.sleep(0.05)
+        assert b.is_leader()
+        assert events_b and events_b[-1] is True
+        assert b.current_leader() == "node-b"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_leader_lease_expires_without_keepalive(tmp_path):
+    db = str(tmp_path / "election.db")
+    a = LeaderElection(db, "exec", "node-a", lease_ttl=0.3)
+    assert a._try_claim()  # claim once, NO keepalive thread
+    b = LeaderElection(db, "exec", "node-b", lease_ttl=0.3)
+    try:
+        assert not b._try_claim()  # lease still live
+        time.sleep(0.4)
+        assert b._try_claim()  # expired lease is claimable
+        assert b.current_leader() == "node-b"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_different_keys_are_independent(tmp_path):
+    db = str(tmp_path / "election.db")
+    a = LeaderElection(db, "scheduler", "node-a", lease_ttl=1.0)
+    b = LeaderElection(db, "executor", "node-b", lease_ttl=1.0)
+    try:
+        assert a.campaign() and b.campaign()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_cache_storage_hits_writes_and_2pc_invalidation():
+    inner = MemoryStorage()
+    cache = CacheStorage(inner, capacity=2)
+    inner.set_row("t", b"k1", Entry({"value": b"v1"}))
+
+    assert cache.get_row("t", b"k1").get() == b"v1"  # miss -> fill
+    assert cache.get_row("t", b"k1").get() == b"v1"  # hit
+    assert cache.hits == 1 and cache.misses == 1
+
+    # negative caching
+    assert cache.get_row("t", b"nope") is None
+    assert cache.get_row("t", b"nope") is None
+    assert cache.hits == 2
+
+    # write-through
+    cache.set_row("t", b"k2", Entry({"value": b"v2"}))
+    assert inner.get_row("t", b"k2").get() == b"v2"
+    assert cache.get_row("t", b"k2").get() == b"v2"
+    assert cache.hits == 3
+
+    # capacity eviction (cap 2: k1 evicted by nope+k2)
+    assert len(cache._cache) <= 2
+
+    # 2PC commit invalidates staleness: stage a write behind the cache
+    writes = MemoryStorage()
+    writes.set_row("t", b"k2", Entry({"value": b"v2-new"}))
+    params = TwoPCParams(number=9)
+    cache.prepare(params, writes)
+    assert cache.get_row("t", b"k2").get() == b"v2"  # pre-commit: old value
+    cache.commit(params)
+    assert cache.get_row("t", b"k2").get() == b"v2-new"  # invalidated + refilled
+
+    # deletes propagate
+    cache.set_row("t", b"k2", Entry(status=EntryStatus.DELETED))
+    assert cache.get_row("t", b"k2") is None
+    assert inner.get_row("t", b"k2") is None

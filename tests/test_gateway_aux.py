@@ -1,0 +1,208 @@
+"""Gateway auxiliaries: distance-vector routing, rate limiting, metrics,
+worker/timer kit.
+
+References: bcos-gateway/libp2p/router/RouterTableImpl.cpp,
+libratelimit/TokenBucketRateLimiter.cpp, build_chain.sh mtail metrics
+(:891-946), bcos-utilities Worker.h/Timer.cpp.
+"""
+
+import json
+import time
+import urllib.request
+
+from fisco_bcos_tpu.front.front import FrontService
+from fisco_bcos_tpu.gateway import TcpGateway
+from fisco_bcos_tpu.gateway.ratelimit import RateLimiterManager, TokenBucketRateLimiter
+from fisco_bcos_tpu.gateway.router import RouterTable
+from fisco_bcos_tpu.rpc.http_server import RpcHttpServer
+from fisco_bcos_tpu.utils.metrics import MetricsRegistry
+from fisco_bcos_tpu.utils.worker import RepeatingTimer, ThreadPool, Worker
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# RouterTable unit
+# ---------------------------------------------------------------------------
+
+A, B, C, D = (bytes([i]) * 64 for i in (1, 2, 3, 4))
+
+
+def test_router_table_line_topology():
+    ra = RouterTable(A)
+    assert ra.peer_connected(B)
+    # B advertises its table: it can reach C at distance 1
+    assert ra.update_from(B, [(B, 0), (C, 1)])
+    assert ra.next_hop(C) == B and ra.distance(C) == 2
+    # C learns D; the advert propagates
+    assert ra.update_from(B, [(B, 0), (C, 1), (D, 2)])
+    assert ra.next_hop(D) == B and ra.distance(D) == 3
+    # B loses C: routes through B to C and D die with the advert
+    assert ra.update_from(B, [(B, 0)])
+    assert ra.next_hop(C) is None and ra.next_hop(D) is None
+    # dropping the neighbour removes everything through it
+    ra.update_from(B, [(C, 1)])
+    assert ra.peer_disconnected(B)
+    assert ra.next_hop(B) is None and ra.next_hop(C) is None
+
+
+def test_router_ignores_non_neighbour_adverts():
+    ra = RouterTable(A)
+    assert not ra.update_from(C, [(D, 1)])  # C is not a direct neighbour
+    assert ra.next_hop(D) is None
+
+
+def test_router_entries_roundtrip():
+    entries = [(B, 1), (C, 2)]
+    assert RouterTable.decode_entries(RouterTable.encode_entries(entries)) == entries
+
+
+# ---------------------------------------------------------------------------
+# Multi-hop delivery over real sockets (A - B - C line, no A-C link)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_hop_send_over_tcp_line():
+    ids = [bytes([0x10 + i]) * 64 for i in range(3)]
+    gws = [TcpGateway(i) for i in ids]
+    fronts = [FrontService(i) for i in ids]
+    got = []
+    fronts[2].register_module(7777, lambda src, payload: got.append((src, payload)))
+    try:
+        for gw, fr in zip(gws, fronts):
+            gw.connect(fr)
+            gw.start()
+        assert gws[0].connect_peer(gws[1].host, gws[1].port)
+        assert gws[1].connect_peer(gws[2].host, gws[2].port)
+        # A learns a route to C through B's adverts
+        assert wait_until(lambda: gws[0].router.next_hop(ids[2]) == ids[1], 10)
+        fronts[0].send_message(7777, ids[2], b"over-the-hill")
+        assert wait_until(lambda: got, 10)
+        assert got[0] == (ids[0], b"over-the-hill")
+    finally:
+        for gw in gws:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# Rate limiting
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_caps_and_refills():
+    tb = TokenBucketRateLimiter(rate=1000, burst=100)
+    assert tb.try_acquire(100)
+    assert not tb.try_acquire(50)  # bucket drained
+    time.sleep(0.06)
+    assert tb.try_acquire(50)  # ~60 tokens refilled
+
+
+def test_rate_limiter_manager_per_module():
+    mgr = RateLimiterManager(module_rates={1000: 100.0})
+    assert mgr.check(1000, 100)
+    assert not mgr.check(1000, 100)  # module budget exhausted
+    assert mgr.check(2001, 10_000)  # other modules unlimited
+    assert mgr.dropped == 1
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_render_and_http_scrape():
+    reg = MetricsRegistry()
+    reg.counter_add("fisco_test_total", 3, help="test counter")
+    reg.gauge_set("fisco_gauge", 1.5)
+    reg.gauge_fn("fisco_pull", lambda: 42.0)
+    text = reg.render()
+    assert "# TYPE fisco_test_total counter" in text
+    assert "fisco_test_total 3" in text
+    assert "fisco_gauge 1.5" in text and "fisco_pull 42" in text
+
+    server = RpcHttpServer(impl=None, port=0, metrics=reg)
+    server.start()
+    try:
+        out = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        )
+        assert out.headers["Content-Type"].startswith("text/plain")
+        assert b"fisco_test_total 3" in out.read()
+        # unknown path 404s
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=5
+            )
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Worker / ThreadPool / Timer
+# ---------------------------------------------------------------------------
+
+
+def test_worker_and_pool_drain_tasks():
+    w = Worker("t-worker")
+    seen = []
+    w.start()
+    for i in range(5):
+        w.post(lambda i=i: seen.append(i))
+    assert wait_until(lambda: len(seen) == 5, 5)
+    assert seen == [0, 1, 2, 3, 4]  # single worker preserves order
+    w.stop()
+
+    pool = ThreadPool(4, "t-pool")
+    pool.start()
+    done = []
+    for i in range(20):
+        pool.enqueue(lambda i=i: done.append(i))
+    assert wait_until(lambda: len(done) == 20, 5)
+    pool.stop()
+
+
+def test_repeating_timer_fires():
+    ticks = []
+    t = RepeatingTimer(0.02, lambda: ticks.append(time.monotonic()), "t-timer")
+    t.start()
+    assert wait_until(lambda: len(ticks) >= 3, 5)
+    t.stop()
+    n = len(ticks)
+    time.sleep(0.06)
+    assert len(ticks) == n  # stopped timers stop
+
+
+def test_broadcast_floods_across_hops():
+    """A's broadcast reaches C through B (partial mesh) exactly once —
+    hop-relay with (origin, seq) dedup."""
+    ids = [bytes([0x20 + i]) * 64 for i in range(3)]
+    gws = [TcpGateway(i) for i in ids]
+    fronts = [FrontService(i) for i in ids]
+    got_c, got_b = [], []
+    fronts[2].register_module(8888, lambda src, p: got_c.append((src, p)))
+    fronts[1].register_module(8888, lambda src, p: got_b.append((src, p)))
+    try:
+        for gw, fr in zip(gws, fronts):
+            gw.connect(fr)
+            gw.start()
+        assert gws[0].connect_peer(gws[1].host, gws[1].port)
+        assert gws[1].connect_peer(gws[2].host, gws[2].port)
+        assert wait_until(lambda: gws[0].router.next_hop(ids[2]) == ids[1], 10)
+        fronts[0].broadcast(8888, b"to-everyone")
+        assert wait_until(lambda: got_c and got_b, 10)
+        time.sleep(0.3)  # allow any (incorrect) duplicate relays to land
+        assert got_b == [(ids[0], b"to-everyone")]
+        assert got_c == [(ids[0], b"to-everyone")]
+    finally:
+        for gw in gws:
+            gw.stop()

@@ -1,0 +1,106 @@
+"""Lightnode: header sync with QC verification, proof-checked reads,
+forwarded writes/calls.
+
+Reference: lightnode/bcos-lightnode/rpc/LightNodeRPC.h + ledger/LedgerImpl.h.
+"""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+import pytest  # noqa: E402
+from test_pbft import leader_of, make_chain, submit_txs  # noqa: E402
+
+from fisco_bcos_tpu.codec.abi import ABICodec  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.front import FrontService  # noqa: E402
+from fisco_bcos_tpu.lightnode import LightNode, LightNodeService  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory  # noqa: E402
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+@pytest.fixture
+def chain_with_light():
+    nodes, gw = make_chain(4)
+    for n in nodes:
+        LightNodeService(n)
+    # two committed blocks with txs
+    for height in (1, 2):
+        leader = leader_of(nodes, height)
+        submit_txs(leader, 3, start=height * 10)
+        assert leader.sealer.seal_and_submit()
+    # light client joins the gateway with its own front
+    lkp = SUITE.signature_impl.generate_keypair(secret=0x11111)
+    front = FrontService(lkp.pub)
+    gw.connect(front)
+    light = LightNode(front, SUITE, nodes[0].ledger.consensus_nodes())
+    light.full_node = nodes[0].node_id
+    return nodes, light
+
+
+def test_lightnode_header_sync_and_verified_reads(chain_with_light):
+    nodes, light = chain_with_light
+    assert light.remote_head() == 2
+    assert light.sync_headers() == 2
+    assert set(light.headers) == {1, 2}
+
+    # verified full-block read
+    blk = light.get_block_by_number(2)
+    assert len(blk.transactions) == 3
+
+    # verified receipt read (merkle proof against the synced header root)
+    tx_hash = blk.transactions[0].hash(SUITE)
+    rc = light.get_receipt(tx_hash)
+    assert rc.status == 0 and rc.block_number == 2
+
+    # forwarded call sees committed state
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=0x7777)
+    call_tx = fac.create(
+        chain_id="chain0",
+        group_id="group0",
+        block_limit=500,
+        nonce="light-call",
+        to=DAG_TRANSFER_ADDRESS,
+        input=CODEC.encode_call("userBalance(string)", "u10"),
+    )
+    out = light.call(call_tx)
+    ok, bal = CODEC.decode_output(["uint256", "uint256"], out.output)
+    assert (ok, bal) == (0, 100)
+
+    # forwarded sendTransaction lands in the full node's pool and commits
+    tx = fac.create_signed(
+        kp,
+        chain_id="chain0",
+        group_id="group0",
+        block_limit=500,
+        nonce="light-send",
+        to=DAG_TRANSFER_ADDRESS,
+        input=CODEC.encode_call("userAdd(string,uint256)", "lightuser", 42),
+    )
+    status, h = light.send_transaction(tx)
+    assert status == 0
+    nodes[0].tx_sync.maintain()
+    leader = leader_of(nodes, 3)
+    assert leader.sealer.seal_and_submit()
+    assert light.sync_headers() == 3
+    rc2 = light.get_receipt(tx.hash(SUITE))
+    assert rc2.status == 0 and rc2.block_number == 3
+
+
+def test_lightnode_rejects_bad_qc(chain_with_light):
+    nodes, light = chain_with_light
+    # an attacker committee (wrong keys) must not be accepted
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+
+    fake = [
+        ConsensusNode(SUITE.signature_impl.generate_keypair(secret=900 + i).pub, 1)
+        for i in range(4)
+    ]
+    evil = LightNode(light.front, SUITE, fake)
+    evil.full_node = nodes[0].node_id
+    with pytest.raises(ValueError, match="QC|sealer|chain"):
+        evil.sync_headers(to=1)

@@ -1,0 +1,77 @@
+"""Native C crypto core vs the pure-Python references — bit-identical.
+
+Reference role: bcos-crypto's wedpr/OpenSSL FFI layer; here
+native/fisco_native.cpp bound via ctypes (fisco_bcos_tpu/native_bind.py).
+"""
+
+import os
+
+import pytest
+
+from fisco_bcos_tpu import native_bind
+from fisco_bcos_tpu.crypto.ref import sm4 as ref_sm4
+from fisco_bcos_tpu.crypto.ref.keccak import keccak256 as ref_keccak
+from fisco_bcos_tpu.crypto.ref.sha2 import sha256 as ref_sha256
+from fisco_bcos_tpu.crypto.ref.sm3 import sm3 as ref_sm3
+
+pytestmark = pytest.mark.skipif(
+    native_bind.load() is None, reason="native toolchain unavailable"
+)
+
+MSGS = [
+    b"",
+    b"abc",
+    b"fisco-bcos-tpu",
+    bytes(range(256)),
+    b"\xff" * 135,   # keccak rate boundary - 1
+    b"\x00" * 136,   # exactly one keccak block
+    b"x" * 137,
+    os.urandom(1000),
+    b"\x80" * 55,    # sha/sm3 single-block padding boundary
+    b"\x80" * 56,    # forces the two-block tail
+    b"q" * 64,
+]
+
+
+@pytest.mark.parametrize("i", range(len(MSGS)))
+def test_hashes_match_reference(i):
+    m = MSGS[i]
+    assert native_bind.keccak256(m) == ref_keccak(m)
+    assert native_bind.sha256(m) == ref_sha256(m)
+    assert native_bind.sm3(m) == ref_sm3(m)
+
+
+def test_sha256_against_hashlib():
+    import hashlib
+
+    for m in MSGS:
+        assert native_bind.sha256(m) == hashlib.sha256(m).digest()
+
+
+def test_sm4_cbc_matches_reference():
+    key = bytes.fromhex("0123456789abcdeffedcba9876543210")
+    iv = bytes(range(16))
+    for n in (16, 32, 160):
+        data = os.urandom(n)
+        native_ct = native_bind.sm4_cbc(key, iv, data, decrypt=False)
+        # reference cbc_encrypt pads; compare on the unpadded prefix by
+        # encrypting pre-padded data through the block API instead
+        ref_ct = ref_sm4.cbc_encrypt(key, iv, data)[: len(data)]
+        assert native_ct[: len(data)] != data  # sanity: actually encrypted
+        # decrypt roundtrip through native
+        assert native_bind.sm4_cbc(key, iv, native_ct, decrypt=True) == data
+        # cross-check: native decrypt of reference ciphertext
+        full_ref = ref_sm4.cbc_encrypt(key, iv, data)
+        opened = native_bind.sm4_cbc(key, iv, full_ref, decrypt=True)
+        assert ref_sm4._unpad(opened) == data
+        assert ref_ct == native_bind.sm4_cbc(
+            key, iv, ref_sm4._pad(data), decrypt=False
+        )[: len(data)]
+
+
+def test_suite_hash_uses_native_consistently():
+    from fisco_bcos_tpu.crypto.suite import Keccak256, Sha256, SM3
+
+    for impl, ref in ((Keccak256(), ref_keccak), (Sha256(), ref_sha256), (SM3(), ref_sm3)):
+        for m in MSGS[:4]:
+            assert impl.hash(m) == ref(m)

@@ -59,6 +59,10 @@ def submit_txs(node, count, start=0):
     ]
     results = node.txpool.submit_batch(txs)
     assert all(r.status == 0 for r in results)
+    # proposals carry hash metadata only — gossip the tx payloads so replicas
+    # can fill proposals from their own pools (inline under auto=True;
+    # auto=False tests drain the queue before sealing)
+    node.tx_sync.maintain()
     return txs
 
 
@@ -138,6 +142,7 @@ def test_view_change_preserves_prepared_proposal():
     nodes, gw = make_chain(4, auto=False)
     leader = leader_of(nodes, 1, view=0)
     submit_txs(leader, 4)
+    gw.deliver_all()  # tx gossip reaches every pool before the proposal
     assert leader.sealer.seal_and_submit()
     # deliver pre-prepare + prepares so the proposal reaches prepared state,
     # but drop all commits: block must NOT commit
@@ -194,3 +199,30 @@ def test_engine_ignores_forged_messages():
     before = len(victim.engine._caches)
     victim.engine.handle_message(forged)
     assert len(victim.engine._caches) == before
+
+
+def test_proposal_carries_metadata_not_payloads():
+    """Pre-prepare ships tx-hash metadata (SealingManager.cpp:140), so its
+    size is independent of tx payload size; replicas fill from their pools."""
+    nodes, gw = make_chain(4, auto=False)
+    leader = leader_of(nodes, 1)
+    txs = submit_txs(leader, 6)
+    gw.deliver_all()  # gossip payloads
+    assert leader.sealer.seal_and_submit()
+    from fisco_bcos_tpu.consensus.messages import PacketType, PBFTMessage
+    from fisco_bcos_tpu.protocol.block import Block
+
+    with gw._lock:
+        batch = list(gw._queue)
+    pre = next(
+        PBFTMessage.decode(p)
+        for m, s, d, p in batch
+        if PBFTMessage.decode(p).packet_type == PacketType.PRE_PREPARE
+    )
+    shipped = Block.decode(pre.proposal_data)
+    assert not shipped.transactions and len(shipped.tx_metadata) == 6
+    payload_bytes = sum(len(t.encode()) for t in txs)
+    assert len(pre.proposal_data) < payload_bytes
+    # consensus still commits (replicas fill from pools)
+    gw.deliver_all()
+    assert all(n.block_number() == 1 for n in nodes)

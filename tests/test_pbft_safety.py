@@ -18,6 +18,7 @@ def test_leader_equivocation_ignored():
     nodes, gw = make_chain(4, auto=False)
     leader = leader_of(nodes, 1)
     submit_txs(leader, 2)
+    gw.deliver_all()  # tx gossip reaches every pool before the proposal
     assert leader.sealer.seal_and_submit()
     # capture the real pre-prepare and forge a second one with a different hash
     from fisco_bcos_tpu.protocol.block import Block

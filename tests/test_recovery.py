@@ -1,0 +1,144 @@
+"""Crash recovery: durable consensus state + pool re-import + rejoin-and-sync.
+
+Reference: bcos-pbft/pbft/storage/LedgerStorage.cpp (persisted consensus
+state), libinitializer/Initializer.cpp:188-195 (pool re-import on boot).
+A node is "crashed" by dropping every in-memory object without any clean
+shutdown — only its sqlite file survives — then rebuilt from disk.
+"""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+from test_pbft import leader_of, submit_txs  # noqa: E402
+
+from fisco_bcos_tpu.consensus.storage import ConsensusStorage  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.front import InprocGateway  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig  # noqa: E402
+from fisco_bcos_tpu.node import Node, NodeConfig  # noqa: E402
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+
+SUITE = ecdsa_suite()
+
+
+def make_durable_chain(tmp_path, n_nodes=4):
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=42_000 + i)
+        for i in range(n_nodes)
+    ]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gw = InprocGateway(auto=True)
+    nodes = []
+    for i, kp in enumerate(keypairs):
+        cfg = NodeConfig(
+            db_path=str(tmp_path / f"node{i}.db"),
+            genesis=GenesisConfig(consensus_nodes=list(committee)),
+        )
+        node = Node(cfg, keypair=kp)
+        gw.connect(node.front)
+        nodes.append(node)
+    return nodes, gw, keypairs, committee
+
+
+def restart_node(tmp_path, gw, keypairs, committee, i):
+    cfg = NodeConfig(
+        db_path=str(tmp_path / f"node{i}.db"),
+        genesis=GenesisConfig(consensus_nodes=list(committee)),
+    )
+    node = Node(cfg, keypair=keypairs[i])
+    gw.connect(node.front)
+    return node
+
+
+def test_crash_rejoin_catchup_and_pool_reimport(tmp_path):
+    nodes, gw, keypairs, committee = make_durable_chain(tmp_path)
+
+    # block 1 commits everywhere
+    leader1 = leader_of(nodes, 1)
+    submit_txs(leader1, 3)
+    assert leader1.sealer.seal_and_submit()
+    assert all(n.block_number() == 1 for n in nodes)
+
+    # a tx submitted ONLY to the victim (no gossip) must survive its crash
+    victim_idx = next(
+        i for i, n in enumerate(nodes) if n is not leader_of(nodes, 2)
+    )
+    victim = nodes[victim_idx]
+    solo_txs = submit_txs(victim, 1, start=900)
+    solo_hash = solo_txs[0].hash(SUITE)
+    # undo the helper's gossip on the OTHER pools so the tx exists only in
+    # the victim's pool + its durable table (simulates a pre-gossip crash)
+    for n in nodes:
+        if n is not victim:
+            n.txpool._txs.pop(solo_hash, None)
+            n.txpool._sealed.discard(solo_hash)
+
+    # crash: drop the object without shutdown; only node<i>.db survives
+    gw.disconnect(victim.node_id)
+    del victim
+    alive = [n for i, n in enumerate(nodes) if i != victim_idx]
+
+    # chain advances one block without it (victim was chosen ≠ leader of 2)
+    leader2 = leader_of(nodes, 2)
+    submit_txs(leader2, 2, start=100)
+    assert leader2.sealer.seal_and_submit()
+    height = 2
+    assert all(n.block_number() == height for n in alive)
+
+    # restart from disk: ledger primed, pool re-imported, then sync catch-up
+    reborn = restart_node(tmp_path, gw, keypairs, committee, victim_idx)
+    assert reborn.block_number() == 1  # committed state survived
+    assert reborn.txpool.get(solo_hash) is not None, "pool re-import lost the tx"
+
+    alive[0].block_sync.broadcast_status()
+    reborn.block_sync.maintain()
+    assert reborn.block_number() == height
+    assert (
+        reborn.ledger.header_by_number(height).state_root
+        == alive[0].ledger.header_by_number(height).state_root
+    )
+
+    # committed txs must NOT resurrect via the persisted pool (deleted rows)
+    committed_tx_hashes = alive[0].ledger.block_by_number(1, with_txs=True)
+    for t in committed_tx_hashes.transactions:
+        assert reborn.txpool.get(t.hash(SUITE)) is None
+
+    # and the reborn node participates in the next block
+    nodes[victim_idx] = reborn
+    nxt = leader_of(nodes, height + 1)
+    if nxt.engine.view != reborn.engine.view:
+        reborn.engine.request_recover()
+    submit_txs(nxt, 2, start=700)
+    if nxt.sealer.seal_and_submit():
+        assert reborn.block_number() == height + 1
+
+
+def test_view_and_vote_survive_restart(tmp_path):
+    nodes, gw, keypairs, committee = make_durable_chain(tmp_path)
+    # force everyone into view 2
+    for n in nodes:
+        n.engine.on_timeout()
+        n.engine.on_timeout()
+    views = [n.engine.view for n in nodes]
+    assert max(views) >= 1
+
+    idx = 0
+    persisted_view = nodes[idx].engine.view
+    gw.disconnect(nodes[idx].node_id)
+    reborn = restart_node(tmp_path, gw, keypairs, committee, idx)
+    assert reborn.engine.view == persisted_view, "view regressed after restart"
+
+
+def test_consensus_storage_roundtrip():
+    cs = ConsensusStorage(MemoryStorage())
+    assert cs.load_view() == 0 and cs.load_prepared() is None
+    cs.save_view(7)
+    cs.save_vote(3, 1, b"\xaa" * 32)
+    cs.save_prepared(3, 1, b"blockdata", [b"p1", b"p2", b"p3"])
+    assert cs.load_view() == 7
+    assert cs.load_vote(3) == (1, b"\xaa" * 32)
+    assert cs.load_prepared() == (3, 1, b"blockdata", [b"p1", b"p2", b"p3"])
+    cs.prune_below(3)
+    assert cs.load_vote(3) is None and cs.load_prepared() is None
+    assert cs.load_view() == 7  # view survives pruning

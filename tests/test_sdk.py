@@ -1,0 +1,86 @@
+"""Client SDK end-to-end against a live solo node over real HTTP.
+
+Reference: bcos-sdk/bcos-cpp-sdk (rpc wrappers + TransactionBuilder) and the
+DuplicateTransactionFactory TPS helper.
+"""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+import pytest  # noqa: E402
+from evm_asm import _deployer, counter_runtime  # noqa: E402
+
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig  # noqa: E402
+from fisco_bcos_tpu.node import Node, NodeConfig  # noqa: E402
+from fisco_bcos_tpu.node.runtime import NodeRuntime  # noqa: E402
+from fisco_bcos_tpu.rpc import JsonRpcImpl, RpcHttpServer  # noqa: E402
+from fisco_bcos_tpu.sdk import Account, Client, Contract  # noqa: E402
+
+SUITE = ecdsa_suite()
+
+
+@pytest.fixture
+def live_node():
+    kp = SUITE.signature_impl.generate_keypair(secret=0x5DC)
+    cfg = NodeConfig(
+        genesis=GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub, weight=1)])
+    )
+    node = Node(cfg, keypair=kp)
+    runtime = NodeRuntime(node, sealer_interval=0.02)
+    server = RpcHttpServer(JsonRpcImpl(node), port=0)
+    runtime.start()
+    server.start()
+    yield node, server.port
+    server.stop()
+    runtime.stop()
+
+
+def test_sdk_full_surface(live_node):
+    node, port = live_node
+    client = Client(f"http://127.0.0.1:{port}")
+    account = Account(suite=SUITE)
+
+    assert client.get_block_number() == 0
+    assert client.get_sealer_list()
+    assert client.get_consensus_status()["committeeSize"] == 1
+
+    # precompile write through the SDK contract helper
+    dag = Contract(client, account, address=DAG_TRANSFER_ADDRESS)
+    rc = dag.send("userAdd(string,uint256)", "sdkuser", 250)
+    assert rc["status"] == 0 and rc["blockNumber"] >= 1
+    ok, bal = dag.call("userBalance(string)", ["uint256", "uint256"], "sdkuser")
+    assert (ok, bal) == (0, 250)
+
+    # EVM deploy + interact (counter contract: inc() / get())
+    counter = Contract(client, account)
+    codec = counter.codec
+    addr, rc = counter.deploy(_deployer(counter_runtime(codec)))
+    assert len(addr) == 20 and rc["status"] == 0
+    assert client.get_code(rc["contractAddress"]) not in ("", "0x")
+    rc2 = counter.send("inc()")
+    assert rc2["status"] == 0
+    (value,) = counter.call("get()", ["uint256"])
+    assert value == 1
+
+    # tx + proof surface
+    got = client.get_transaction(rc2["transactionHash"])
+    assert got["hash"] == rc2["transactionHash"] and "txProof" in got
+    blk = client.get_block_by_number(rc2["blockNumber"], with_txs=True)
+    assert any(t["hash"] == rc2["transactionHash"] for t in blk["transactions"])
+
+    # flood helper (DuplicateTransactionFactory analog)
+    base = account.sign_tx(
+        to=DAG_TRANSFER_ADDRESS,
+        data=codec.encode_call("userAdd(string,uint256)", "flood", 1),
+    )
+    dups = account.duplicate_signed(base, 5)
+    assert len({t.nonce for t in dups}) == 5
+    results = [client.send_raw_transaction(t) for t in dups]
+    for r in results:
+        rc = client.wait_for_receipt(r["transactionHash"], timeout=30)
+        assert rc["status"] == 0
+    totals = client.get_total_transaction_count()
+    assert totals["transactionCount"] >= 7

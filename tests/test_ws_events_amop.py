@@ -1,0 +1,207 @@
+"""WebSocket channel, event-log subscription, AMOP pub/sub.
+
+References: bcos-boostssl/websocket (WsService/WsSession),
+bcos-rpc/event/EventSub*.cpp (filtered log push + historical replay),
+bcos-gateway/libamop/AMOPImpl.cpp + TopicManager.cpp (topic routing).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "tests")
+
+import pytest  # noqa: E402
+from evm_asm import _deployer, logger_runtime  # noqa: E402
+
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.front import InprocGateway  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig  # noqa: E402
+from fisco_bcos_tpu.node import Node, NodeConfig  # noqa: E402
+from fisco_bcos_tpu.node.runtime import NodeRuntime  # noqa: E402
+from fisco_bcos_tpu.rpc import JsonRpcImpl  # noqa: E402
+from fisco_bcos_tpu.rpc.event_sub import EventSubEngine  # noqa: E402
+from fisco_bcos_tpu.rpc.ws_server import WsService  # noqa: E402
+from fisco_bcos_tpu.sdk.ws import WsClient  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory  # noqa: E402
+from fisco_bcos_tpu.utils.bytesutil import to_hex  # noqa: E402
+
+SUITE = ecdsa_suite()
+TOPIC_FEED = "0x" + (0xFEED).to_bytes(32, "big").hex()
+
+
+def _ws_for(node, impl=True):
+    ws = WsService(
+        JsonRpcImpl(node) if impl else None,
+        event_engine=EventSubEngine(node.ledger, node.suite),
+        amop=node.amop,
+    )
+    node.scheduler.on_committed.append(ws.on_block_committed)
+    ws.start()
+    return ws
+
+
+@pytest.fixture
+def live():
+    kp = SUITE.signature_impl.generate_keypair(secret=0x115)
+    cfg = NodeConfig(
+        genesis=GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub, weight=1)])
+    )
+    node = Node(cfg, keypair=kp)
+    ws = _ws_for(node)
+    runtime = NodeRuntime(node, sealer_interval=0.02)
+    runtime.start()
+    yield node, ws
+    runtime.stop()
+    ws.stop()
+
+
+def _send_tx(client, node, to=b"", data=b""):
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=0xAB5)
+    tx = fac.create_signed(
+        kp,
+        chain_id="chain0",
+        group_id="group0",
+        block_limit=node.block_number() + 500,
+        nonce=f"ws-{time.monotonic_ns()}",
+        to=to,
+        input=data,
+    )
+    return client.request("sendTransaction", "group0", "", to_hex(tx.encode()))
+
+
+def _wait_receipt(client, tx_hash, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return client.request("getTransactionReceipt", "group0", "", tx_hash)
+        except RuntimeError:
+            time.sleep(0.05)
+    raise TimeoutError(tx_hash)
+
+
+def test_ws_rpc_events_and_block_push(live):
+    node, ws = live
+    c = WsClient(ws.host, ws.port)
+    try:
+        # plain JSON-RPC over ws
+        assert c.request("getBlockNumber") == 0
+        assert c.subscribe_block_number()
+
+        # deploy the log-emitting contract
+        res = _send_tx(c, node, to=b"", data=_deployer(logger_runtime()))
+        rc = _wait_receipt(c, res["transactionHash"])
+        assert rc["status"] == 0
+        addr = rc["contractAddress"]
+
+        # block push arrived for the deploy block
+        assert c.wait_notification(
+            lambda m: m.get("method") == "blockNumberPush", timeout=15
+        )
+
+        # live subscription: filter by address + topic
+        sub = c.subscribe_event(
+            {"fromBlock": -1, "addresses": [addr], "topics": [[TOPIC_FEED]]}
+        )
+        payload = (0xABCD).to_bytes(32, "big")
+        res2 = _send_tx(c, node, to=bytes.fromhex(addr[2:]), data=payload)
+        rc2 = _wait_receipt(c, res2["transactionHash"])
+        assert rc2["status"] == 0
+        push = c.wait_notification(
+            lambda m: m.get("method") == "eventLogPush"
+            and m["params"]["id"] == sub,
+            timeout=15,
+        )
+        assert push is not None, "no event push received"
+        logs = push["params"]["logs"]
+        assert logs[0]["topics"] == [TOPIC_FEED]
+        assert logs[0]["data"] == "0x" + payload.hex()
+        assert logs[0]["address"] == addr
+
+        # historical replay: a fresh subscription from block 0 re-delivers it
+        c2 = WsClient(ws.host, ws.port)
+        try:
+            sub2 = c2.subscribe_event(
+                {"fromBlock": 0, "addresses": [addr], "topics": [[TOPIC_FEED]]}
+            )
+            replay = c2.wait_notification(
+                lambda m: m.get("method") == "eventLogPush"
+                and m["params"]["id"] == sub2,
+                timeout=15,
+            )
+            assert replay is not None and replay["params"]["logs"]
+        finally:
+            c2.close()
+
+        # filters actually filter: wrong topic -> no push
+        sub3 = c.subscribe_event(
+            {"addresses": [addr], "topics": [["0x" + "11" * 32]]}
+        )
+        res3 = _send_tx(c, node, to=bytes.fromhex(addr[2:]), data=payload)
+        _wait_receipt(c, res3["transactionHash"])
+        assert (
+            c.wait_notification(
+                lambda m: m.get("method") == "eventLogPush"
+                and m["params"]["id"] == sub3,
+                timeout=2,
+            )
+            is None
+        )
+        assert c.unsubscribe_event(sub)
+    finally:
+        c.close()
+
+
+def test_amop_local_pubsub(live):
+    node, ws = live
+    sub = WsClient(ws.host, ws.port)
+    pub = WsClient(ws.host, ws.port)
+    try:
+        assert sub.amop_subscribe("orders")
+        assert pub.amop_publish("orders", b"hello-amop") == 1
+        got = sub.wait_notification(
+            lambda m: m.get("method") == "amopPush", timeout=10
+        )
+        assert got is not None
+        assert got["params"]["topic"] == "orders"
+        assert bytes.fromhex(got["params"]["data"]) == b"hello-amop"
+        # no subscriber for an unknown topic
+        assert pub.amop_publish("void-topic", b"x") == 0
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_amop_routes_across_nodes():
+    """Topic gossip + cross-node unicast through the (in-process) gateway."""
+    kps = [SUITE.signature_impl.generate_keypair(secret=0x200 + i) for i in range(2)]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in kps]
+    gw = InprocGateway(auto=True)
+    nodes, wss = [], []
+    for kp in kps:
+        cfg = NodeConfig(genesis=GenesisConfig(consensus_nodes=list(committee)))
+        node = Node(cfg, keypair=kp)
+        gw.connect(node.front)
+        nodes.append(node)
+        wss.append(_ws_for(node))
+    sub = WsClient(wss[0].host, wss[0].port)
+    pub = WsClient(wss[1].host, wss[1].port)
+    try:
+        assert sub.amop_subscribe("cross")  # announces topics to peers
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if nodes[1].amop._peer_topics.get(nodes[0].node_id):
+                break
+            time.sleep(0.05)
+        assert pub.amop_publish("cross", b"over-the-wire") == 1
+        got = sub.wait_notification(
+            lambda m: m.get("method") == "amopPush", timeout=10
+        )
+        assert got is not None
+        assert bytes.fromhex(got["params"]["data"]) == b"over-the-wire"
+        assert got["params"]["from"], "cross-node push must carry the origin"
+    finally:
+        sub.close()
+        pub.close()
+        for ws in wss:
+            ws.stop()
