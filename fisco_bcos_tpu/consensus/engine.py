@@ -26,7 +26,6 @@ Differences kept deliberate and documented:
 
 from __future__ import annotations
 
-import queue
 import threading
 from dataclasses import dataclass, field
 
@@ -39,6 +38,7 @@ from ..txpool import TxPool
 from ..txpool.validator import batch_admit
 from ..utils.error import ErrorCode
 from ..utils.log import get_logger
+from ..utils.worker import Worker
 from .config import PBFTConfig
 from .messages import (
     NewViewPayload,
@@ -56,6 +56,10 @@ class ProposalCache:
 
     pre_prepare: PBFTMessage | None = None
     block: Block | None = None
+    # immutable accept-time encoding of the FILLED block — the bytes that
+    # certificates persist and view changes re-offer; never re-encoded from
+    # the live object (pre-execution mutates header/receipts concurrently)
+    block_data: bytes = b""
     prepares: dict[int, PBFTMessage] = field(default_factory=dict)
     commits: dict[int, PBFTMessage] = field(default_factory=dict)
     checkpoints: dict[int, PBFTMessage] = field(default_factory=dict)
@@ -109,8 +113,7 @@ class PBFTEngine:
         # thread (the reference's single PBFTEngine worker, PBFTEngine.cpp:40)
         # so a blocking tx fetch can't stall the gateway reader that must
         # deliver the fetch response; deterministic tests dispatch inline.
-        self._worker_queue: "queue.SimpleQueue | None" = None
-        self._worker: threading.Thread | None = None
+        self._worker: Worker | None = None
         front.register_module(ModuleID.PBFT, self._on_front_message)
 
     # ----------------------------------------------------------------- worker
@@ -118,31 +121,13 @@ class PBFTEngine:
     def start_worker(self) -> None:
         if self._worker is not None:
             return
-        self._worker_queue = queue.SimpleQueue()
-        self._worker = threading.Thread(
-            target=self._worker_loop, name="pbft-worker", daemon=True
-        )
+        self._worker = Worker("pbft-worker")
         self._worker.start()
 
     def stop_worker(self) -> None:
-        q = self._worker_queue
-        if q is not None:
-            q.put(None)
         if self._worker is not None:
-            self._worker.join(timeout=5)
+            self._worker.stop()
         self._worker = None
-        self._worker_queue = None
-
-    def _worker_loop(self) -> None:
-        q = self._worker_queue
-        while True:
-            msg = q.get()
-            if msg is None:
-                return
-            try:
-                self.handle_message(msg)
-            except Exception:
-                _log.exception("pbft worker failed on %s", msg.packet_type.name)
 
     # ------------------------------------------------------------------ utils
 
@@ -200,9 +185,9 @@ class PBFTEngine:
         except Exception:
             _log.warning("undecodable pbft message from %s", src.hex()[:8])
             return
-        q = self._worker_queue
-        if q is not None:
-            q.put(msg)
+        w = self._worker
+        if w is not None:
+            w.post(lambda: self.handle_message(msg))
         else:
             self.handle_message(msg)
 
@@ -306,6 +291,7 @@ class PBFTEngine:
             cache = self._cache(msg.number)
             cache.pre_prepare = msg
             cache.block = block
+            cache.block_data = block.encode()  # accept-time snapshot
             prepare = PBFTMessage(
                 packet_type=PacketType.PREPARE,
                 view=self.view,
@@ -319,6 +305,20 @@ class PBFTEngine:
             # delivery / network reordering — the reference caches them too)
             self._check_prepared_quorum(msg.number, cache)
             self._check_commit_quorum(msg.number, cache)
+            already_executed = cache.executed_header is not None
+            pre_data = cache.block_data
+        if not already_executed:
+            # block pipeline (StateMachine::asyncPreApply): execute while the
+            # vote round-trips are in flight; the commit-quorum handler then
+            # hits the scheduler's proposal-identity cache. Outside the
+            # engine lock — execution takes block-time, votes must flow.
+            # A DECODED COPY runs, never cache.block: execution fills
+            # header roots/receipts in place, and the certificate path
+            # serializes cache state concurrently.
+            try:
+                self.scheduler.execute_block(Block.decode(pre_data))
+            except SchedulerError as e:
+                _log.debug("pre-execute %d skipped: %s", msg.number, e)
 
     def _verify_and_fill(
         self, block: Block, leader_id: bytes | None, from_self: bool
@@ -389,13 +389,14 @@ class PBFTEngine:
         if self._weight(agreeing) < self.config.quorum:
             return
         cache.prepared = True
-        if self.cstore is not None and cache.block is not None:
+        if self.cstore is not None and cache.block_data:
             # write-ahead of the COMMIT broadcast: after a crash this node
-            # can still prove (and re-offer) the prepared proposal
+            # can still prove (and re-offer) the prepared proposal — from
+            # the accept-time snapshot, not the (possibly executing) object
             self.cstore.save_prepared(
                 number,
                 cache.pre_prepare.view,
-                cache.block.encode(),
+                cache.block_data,
                 [m.encode() for m in agreeing.values()],
             )
         commit = PBFTMessage(
@@ -518,10 +519,10 @@ class PBFTEngine:
         if (
             cache is not None
             and cache.prepared
-            and cache.block is not None
+            and cache.block_data
             and cache.pre_prepare is not None
         ):
-            prepared_proposal = cache.block.encode()
+            prepared_proposal = cache.block_data
             prepared_view = cache.pre_prepare.view
             prepare_proof = [
                 m.encode()

@@ -29,6 +29,7 @@ from ..ops import sm2 as sm2_ops
 from ..ops import sm3 as sm3_ops
 from ..utils.bytesutil import right160
 from .ref import ecdsa as ref_ecdsa
+from .ref import ed25519 as ref_ed25519
 from .ref.keccak import keccak256 as ref_keccak256
 from .ref.sha2 import sha256 as ref_sha256
 from .ref.sm3 import sm3 as ref_sm3
@@ -155,6 +156,64 @@ class SignatureCrypto:
         self, msg_hashes: np.ndarray, sigs: np.ndarray
     ) -> tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
+
+
+class Ed25519Crypto(SignatureCrypto):
+    """Ed25519 (reference: signature/ed25519/Ed25519Crypto.cpp via wedpr).
+
+    Host-side suite: 96-byte signatures R‖S‖pubkey32 — like the reference's
+    SM2 scheme, "recover" parses the appended key then verifies
+    (SM2Crypto.cpp:81-91 pattern); ed25519 has no algebraic recovery. The
+    secret scalar is the 32-byte seed (little-endian int). Batch calls loop
+    on the host: the device batch plane covers the two tx-signing curves
+    (secp256k1/SM2); this suite exists for signature-surface parity.
+    """
+
+    name = "ed25519"
+    sig_len = 96
+
+    def generate_keypair(self, secret: int | None = None) -> KeyPair:
+        if secret is None:
+            secret = int.from_bytes(secrets.token_bytes(32), "little")
+        seed = (secret % (1 << 256)).to_bytes(32, "little")
+        return KeyPair(
+            int.from_bytes(seed, "little"), ref_ed25519.seed_to_pubkey(seed)
+        )
+
+    @staticmethod
+    def _seed(kp: KeyPair) -> bytes:
+        return (kp.secret % (1 << 256)).to_bytes(32, "little")
+
+    def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
+        return ref_ed25519.sign(self._seed(kp), msg_hash) + kp.pub
+
+    def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        return ref_ed25519.verify(pub[:32], msg_hash, sig[:64])
+
+    def recover(self, msg_hash: bytes, sig: bytes) -> bytes:
+        pub = sig[64:96]
+        if not ref_ed25519.verify(pub, msg_hash, sig[:64]):
+            raise ValueError("ed25519 signature does not verify")
+        return pub
+
+    def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
+        return np.array(
+            [
+                self.verify(bytes(p), bytes(h), bytes(s))
+                for h, p, s in zip(msg_hashes, pubs, sigs)
+            ]
+        )
+
+    def batch_recover(self, msg_hashes, sigs):
+        pubs, ok = [], []
+        for h, s in zip(msg_hashes, sigs):
+            try:
+                pubs.append(self.recover(bytes(h), bytes(s)))
+                ok.append(True)
+            except ValueError:
+                pubs.append(b"\x00" * 32)
+                ok.append(False)
+        return np.frombuffer(b"".join(pubs), np.uint8).reshape(-1, 32), np.array(ok)
 
 
 class Secp256k1Crypto(SignatureCrypto):

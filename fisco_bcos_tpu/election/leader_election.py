@@ -47,6 +47,7 @@ class LeaderElection:
         self._conn.commit()
         self._lock = threading.RLock()
         self._leader = False
+        self._lease_expiry = 0.0  # last CONFIRMED lease expiry
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.on_change: Callable[[bool], None] | None = None
@@ -68,6 +69,7 @@ class LeaderElection:
                         (self.key, self.member_id, now + self.lease_ttl),
                     )
                     self._conn.commit()
+                    self._lease_expiry = now + self.lease_ttl
                     return True
                 self._conn.commit()
                 return False
@@ -76,7 +78,10 @@ class LeaderElection:
                     self._conn.rollback()
                 except sqlite3.Error:
                     pass
-                return self._leader  # contention: keep current belief
+                # renewal unconfirmed: leadership only holds while the LAST
+                # CONFIRMED lease is still live — acting on stale belief past
+                # the TTL is split-brain (another member may have claimed)
+                return self._leader and time.time() < self._lease_expiry
 
     def campaign(self) -> bool:
         """Start campaigning; returns current leadership immediately and

@@ -4,6 +4,7 @@ Addresses mirror the reference's map
 (bcos-framework/executor/PrecompiledTypeDef.h:57-116).
 """
 
+from .bfs import BFSPrecompiled
 from .base import (  # noqa: F401
     Precompiled,
     PrecompiledCallContext,
@@ -29,6 +30,7 @@ TABLE_MANAGER_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001002"
 CONSENSUS_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001003")
 KV_TABLE_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001009")
 CRYPTO_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100a")
+BFS_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100e")
 DAG_TRANSFER_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100c")
 # PrecompiledTypeDef.h:112/116 — benchmark families start at fixed bases
 CPU_HEAVY_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005200")
@@ -42,6 +44,7 @@ def default_registry() -> dict[bytes, Precompiled]:
         CONSENSUS_ADDRESS: ConsensusPrecompiled(),
         KV_TABLE_ADDRESS: KVTablePrecompiled(),
         CRYPTO_ADDRESS: CryptoPrecompiled(),
+        BFS_ADDRESS: BFSPrecompiled(),
         DAG_TRANSFER_ADDRESS: DagTransferPrecompiled(),
         CPU_HEAVY_ADDRESS: CpuHeavyPrecompiled(),
         SMALLBANK_ADDRESS: SmallBankPrecompiled(),
@@ -52,6 +55,7 @@ PRECOMPILED_ADDRESSES = {
     "sys_config": SYS_CONFIG_ADDRESS,
     "table_manager": TABLE_MANAGER_ADDRESS,
     "consensus": CONSENSUS_ADDRESS,
+    "bfs": BFS_ADDRESS,
     "kv_table": KV_TABLE_ADDRESS,
     "crypto": CRYPTO_ADDRESS,
     "dag_transfer": DAG_TRANSFER_ADDRESS,

@@ -1,0 +1,176 @@
+"""BFS — the blockchain file system precompile.
+
+Reference: bcos-executor/src/precompiled/BFSPrecompiled.cpp (+
+bcos-tool/BfsFileFactory.cpp): a directory tree over state tables rooted at
+/apps /tables /usr /sys, with `mkdir`/`list`/`link`/`readlink`/`touch` —
+the namespace the reference's console and deploy tooling navigate, and the
+home of versioned contract links (/apps/<name>/<version> -> address).
+
+Storage: one ``s_file_system`` table row per absolute path; fields:
+``type`` (directory|link|contract), ``address``/``abi`` for links.
+Deviation (documented): ``list`` returns its entries as a JSON string —
+this framework's ABI codec carries no tuple-array encoding, and the Python
+SDK consumes JSON directly.
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+
+from ...storage.entry import Entry
+from .base import (
+    Precompiled,
+    PrecompiledCallContext,
+    PrecompiledError,
+    PrecompiledResult,
+)
+
+FS_TABLE = "s_file_system"
+ROOT_DIRS = ("/", "/apps", "/tables", "/usr", "/sys")
+
+TYPE_DIR = b"directory"
+TYPE_LINK = b"link"
+TYPE_CONTRACT = b"contract"
+
+
+def _norm(path: str) -> str:
+    if not path.startswith("/"):
+        raise PrecompiledError(f"BFS path must be absolute: {path!r}")
+    p = posixpath.normpath(path)
+    if ".." in p.split("/"):
+        raise PrecompiledError(f"invalid BFS path: {path!r}")
+    return p
+
+
+def ensure_root(storage) -> None:
+    """Seed the standard directory skeleton (BfsFileFactory::buildDir)."""
+    for d in ROOT_DIRS:
+        if storage.get_row(FS_TABLE, d.encode()) is None:
+            storage.set_row(FS_TABLE, d.encode(), Entry({"type": TYPE_DIR}))
+
+
+class BFSPrecompiled(Precompiled):
+    def setup(self, codec):
+        self.register(codec, "mkdir(string)", self._mkdir)
+        self.register(codec, "list(string)", self._list)
+        self.register(codec, "link(string,string,string,string)", self._link)
+        self.register(codec, "readlink(string)", self._readlink)
+        self.register(codec, "touch(string,string)", self._touch)
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _get(ctx, path: str) -> Entry | None:
+        return ctx.storage.get_row(FS_TABLE, path.encode())
+
+    def _require_parent_dir(self, ctx, path: str) -> None:
+        parent = posixpath.dirname(path)
+        e = self._get(ctx, parent)
+        if e is None or e.fields.get("type") != TYPE_DIR:
+            raise PrecompiledError(f"parent is not a directory: {parent}")
+
+    def _mk_parents(self, ctx, path: str) -> None:
+        """Create missing ancestor directories (BfsFileFactory recursive)."""
+        parts = path.strip("/").split("/")
+        cur = ""
+        for part in parts[:-1]:
+            cur += "/" + part
+            e = self._get(ctx, cur)
+            if e is None:
+                ctx.storage.set_row(FS_TABLE, cur.encode(), Entry({"type": TYPE_DIR}))
+            elif e.fields.get("type") != TYPE_DIR:
+                raise PrecompiledError(f"path component is a file: {cur}")
+
+    # -- methods ---------------------------------------------------------------
+
+    def _mkdir(self, ctx: PrecompiledCallContext, path: str):
+        ensure_root(ctx.storage)
+        path = _norm(path)
+        if self._get(ctx, path) is not None:
+            raise PrecompiledError(f"file exists: {path}")
+        self._mk_parents(ctx, path)
+        ctx.storage.set_row(FS_TABLE, path.encode(), Entry({"type": TYPE_DIR}))
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+    def _list(self, ctx: PrecompiledCallContext, path: str):
+        ensure_root(ctx.storage)
+        path = _norm(path)
+        e = self._get(ctx, path)
+        if e is None:
+            raise PrecompiledError(f"no such file: {path}")
+        if e.fields.get("type") != TYPE_DIR:
+            info = [self._info(path, e)]
+        else:
+            prefix = path.rstrip("/") + "/"
+            if path == "/":
+                prefix = "/"
+            info = []
+            for key in ctx.storage.get_primary_keys(FS_TABLE):
+                ks = key.decode()
+                if not ks.startswith(prefix) or ks == path:
+                    continue
+                if "/" in ks[len(prefix) :]:
+                    continue  # direct children only
+                child = self._get(ctx, ks)
+                if child is not None:
+                    info.append(self._info(ks, child))
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(
+                ["int256", "string"], 0, json.dumps(sorted(info, key=lambda x: x["name"]))
+            )
+        )
+
+    @staticmethod
+    def _info(path: str, e: Entry) -> dict:
+        out = {
+            "name": posixpath.basename(path) or "/",
+            "type": e.fields.get("type", b"").decode(),
+        }
+        if e.fields.get("address"):
+            out["address"] = "0x" + e.fields["address"].hex()
+        return out
+
+    def _link(
+        self, ctx: PrecompiledCallContext, name: str, version: str, address: str, abi: str
+    ):
+        ensure_root(ctx.storage)
+        if not name or "/" in name or (version and "/" in version):
+            raise PrecompiledError("invalid link name/version")
+        path = f"/apps/{name}/{version}" if version else f"/apps/{name}"
+        path = _norm(path)
+        addr = bytes.fromhex(address[2:] if address.startswith("0x") else address)
+        if len(addr) != 20:
+            raise PrecompiledError(f"bad address for link: {address!r}")
+        self._mk_parents(ctx, path)
+        existing = self._get(ctx, path)
+        if existing is not None and existing.fields.get("type") == TYPE_DIR:
+            raise PrecompiledError(f"directory exists at link path: {path}")
+        ctx.storage.set_row(
+            FS_TABLE,
+            path.encode(),
+            Entry({"type": TYPE_LINK, "address": addr, "abi": abi.encode()}),
+        )
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
+
+    def _readlink(self, ctx: PrecompiledCallContext, path: str):
+        e = self._get(ctx, _norm(path))
+        if e is None or e.fields.get("type") != TYPE_LINK:
+            raise PrecompiledError(f"not a link: {path}")
+        addr = e.fields.get("address", b"\x00" * 20)
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["address"], addr)
+        )
+
+    def _touch(self, ctx: PrecompiledCallContext, path: str, file_type: str):
+        ensure_root(ctx.storage)
+        path = _norm(path)
+        if file_type not in ("directory", "link", "contract"):
+            raise PrecompiledError(f"bad file type {file_type!r}")
+        if self._get(ctx, path) is not None:
+            raise PrecompiledError(f"file exists: {path}")
+        self._mk_parents(ctx, path)
+        ctx.storage.set_row(
+            FS_TABLE, path.encode(), Entry({"type": file_type.encode()})
+        )
+        return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))

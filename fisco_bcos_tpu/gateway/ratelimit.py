@@ -66,12 +66,15 @@ class RateLimiterManager:
         self._lock = threading.Lock()
 
     def check(self, module_id: int, nbytes: int) -> bool:
-        lim = self.by_module.get(int(module_id))
-        if lim is not None and not lim.try_acquire(nbytes):
+        # charge the TOTAL budget first: if it rejects, the module budget is
+        # untouched (charging module-then-total double-charged dropped frames
+        # against the module, throttling it below its configured rate)
+        if self.total is not None and not self.total.try_acquire(nbytes):
             with self._lock:
                 self.dropped += 1
             return False
-        if self.total is not None and not self.total.try_acquire(nbytes):
+        lim = self.by_module.get(int(module_id))
+        if lim is not None and not lim.try_acquire(nbytes):
             with self._lock:
                 self.dropped += 1
             return False

@@ -236,7 +236,7 @@ class LightNode:
                 raise ValueError(f"full node returned header {header.number} != {n}")
             if n > 1 and header.parent_info:
                 parent = self.headers.get(n - 1)
-                if parent is not None and header.parent_info[0].block_hash != parent.hash(
+                if parent is not None and header.parent_info[0].hash != parent.hash(
                     self.suite
                 ):
                     raise ValueError(f"header {n} breaks the hash chain")

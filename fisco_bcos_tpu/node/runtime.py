@@ -92,3 +92,7 @@ class NodeRuntime:
             self._last_sync = now
             node.tx_sync.maintain()
             node.block_sync.maintain()
+            gw = node.front._gateway
+            if gw is not None and hasattr(gw, "peers"):
+                # drop sync/clock state for disconnected peers
+                node.block_sync.prune_peers(set(gw.peers()))

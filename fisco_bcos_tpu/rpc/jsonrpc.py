@@ -244,16 +244,20 @@ class JsonRpcImpl:
     # -- contract/code -------------------------------------------------------
 
     def get_code(self, group: str = "", node_name: str = "", address: str = "") -> str:
-        from ..ledger.ledger import SYS_CODE_BINARY
+        # contract accounts live in /apps/<addr> rows (executor/evm.py
+        # contract_table; TransactionExecutor::getCode:1881 reads the same)
+        from ..executor.evm import F_CODE, contract_table
 
-        e = self.node.storage.get_row(SYS_CODE_BINARY, from_hex(address))
-        return to_hex(e.get()) if e is not None else "0x"
+        e = self.node.storage.get_row(contract_table(from_hex(address)), b"#account")
+        code = e.fields.get(F_CODE, b"") if e is not None else b""
+        return to_hex(code) if code else "0x"
 
     def get_abi(self, group: str = "", node_name: str = "", address: str = "") -> str:
-        from ..ledger.ledger import SYS_CONTRACT_ABI
+        from ..executor.evm import F_ABI, contract_table
 
-        e = self.node.storage.get_row(SYS_CONTRACT_ABI, from_hex(address))
-        return e.get().decode() if e is not None else ""
+        e = self.node.storage.get_row(contract_table(from_hex(address)), b"#account")
+        abi = e.fields.get(F_ABI, b"") if e is not None else b""
+        return abi.decode(errors="replace")
 
     # -- status methods ------------------------------------------------------
 

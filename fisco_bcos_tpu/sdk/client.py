@@ -84,10 +84,11 @@ class Client:
         return self.request("getBlockNumber")
 
     def get_block_by_number(self, number: int, with_txs: bool = False) -> dict:
-        return self._grouped("getBlockByNumber", number, with_txs)
+        # reference param order: (group, node, number, onlyHeader, onlyTxHash)
+        return self._grouped("getBlockByNumber", number, False, not with_txs)
 
     def get_block_by_hash(self, block_hash: str, with_txs: bool = False) -> dict:
-        return self._grouped("getBlockByHash", block_hash, with_txs)
+        return self._grouped("getBlockByHash", block_hash, False, not with_txs)
 
     def get_block_hash_by_number(self, number: int) -> str:
         return self._grouped("getBlockHashByNumber", number)

@@ -29,6 +29,7 @@ class CacheStorage(TransactionalStorage):
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self._gen = 0  # bumped on every commit (miss-fill TOCTOU guard)
         # staged write-sets by 2PC batch, to invalidate on commit
         self._staged_keys: dict[int, list[tuple[str, bytes]]] = {}
 
@@ -43,11 +44,17 @@ class CacheStorage(TransactionalStorage):
                 e = self._cache[k]
                 return None if e is None else e.copy()
             self.misses += 1
+            gen = self._gen
         e = self.inner.get_row(table, key)
         with self._lock:
-            self._cache[k] = None if e is None else e.copy()
-            while len(self._cache) > self.capacity:
-                self._cache.popitem(last=False)
+            # TOCTOU guard: a commit() invalidation between the backend read
+            # and this fill means `e` may be a pre-commit value — caching it
+            # would serve stale state indefinitely. The generation counter
+            # bumps on every commit; only same-generation reads may fill.
+            if gen == self._gen:
+                self._cache[k] = None if e is None else e.copy()
+                while len(self._cache) > self.capacity:
+                    self._cache.popitem(last=False)
         return e
 
     def get_primary_keys(self, table: str) -> list[bytes]:
@@ -89,6 +96,7 @@ class CacheStorage(TransactionalStorage):
     def commit(self, params: TwoPCParams) -> None:
         self.inner.commit(params)
         with self._lock:
+            self._gen += 1
             for k in self._staged_keys.pop(params.number, []):
                 self._cache.pop(k, None)
 

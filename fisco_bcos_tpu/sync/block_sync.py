@@ -42,6 +42,8 @@ class SyncStatus:
     number: int
     block_hash: bytes
     genesis_hash: bytes
+    # sender's UTC clock (ms) — feeds NodeTimeMaintenance's median offset
+    utc_ms: int = 0
 
 
 def _encode_status(s: SyncStatus) -> bytes:
@@ -50,6 +52,7 @@ def _encode_status(s: SyncStatus) -> bytes:
     w.i64(s.number)
     w.fixed(s.block_hash, 32)
     w.fixed(s.genesis_hash, 32)
+    w.i64(s.utc_ms)
     return w.out()
 
 
@@ -87,6 +90,10 @@ class BlockSync:
         self._requested_to: int = 0
         self._requested_at: float = 0.0
         self.request_timeout: float = 10.0
+        # median peer clock tracking (bcos-tool NodeTimeMaintenance)
+        from ..utils.time_sync import NodeTimeMaintenance
+
+        self.time_maintenance = NodeTimeMaintenance()
         self._lock = threading.RLock()
         self._genesis_hash = ledger.block_hash_by_number(0) or b"\x00" * 32
         front.register_module(ModuleID.BLOCK_SYNC, self._on_message)
@@ -102,11 +109,14 @@ class BlockSync:
     # -- outbound ------------------------------------------------------------
 
     def broadcast_status(self) -> None:
+        from ..utils.time_sync import utc_ms
+
         num = self.ledger.block_number()
         st = SyncStatus(
             number=num,
             block_hash=self.ledger.block_hash_by_number(num) or b"\x00" * 32,
             genesis_hash=self._genesis_hash,
+            utc_ms=utc_ms(),
         )
         self.front.broadcast(ModuleID.BLOCK_SYNC, _encode_status(st))
 
@@ -149,7 +159,7 @@ class BlockSync:
             r = FlatReader(payload)
             pkt = SyncPacket(r.u8())
             if pkt == SyncPacket.STATUS:
-                st = SyncStatus(r.i64(), r.fixed(32), r.fixed(32))
+                st = SyncStatus(r.i64(), r.fixed(32), r.fixed(32), r.i64())
                 r.done()
                 self._on_status(src, st)
             elif pkt == SyncPacket.REQUEST:
@@ -163,9 +173,22 @@ class BlockSync:
         except Exception as e:
             _log.warning("bad sync message from %s: %s", src.hex()[:8], e)
 
+    def prune_peers(self, live: set[bytes]) -> None:
+        """Drop sync/clock state for departed peers (the runtime feeds the
+        gateway's live-peer set; a dead node's stale clock sample must not
+        skew the NodeTimeMaintenance median forever)."""
+        with self._lock:
+            dead = [nid for nid in self._peers if nid not in live]
+            for nid in dead:
+                del self._peers[nid]
+        for nid in dead:
+            self.time_maintenance.remove_peer(nid)
+
     def _on_status(self, src: bytes, st: SyncStatus) -> None:
         with self._lock:
             self._peers[src] = st
+        if self.time_maintenance is not None:
+            self.time_maintenance.on_peer_time(src, st.utc_ms)
         if st.number > self.ledger.block_number():
             self._request_missing()
 

@@ -1,0 +1,65 @@
+"""NodeTimeMaintenance — median peer clock-offset tracking.
+
+Reference: bcos-tool/src/NodeTimeMaintenance.cpp: every peer status message
+carries the sender's UTC time; the node keeps per-peer offsets, uses the
+median as the chain-aligned clock, and logs a warning when local time
+drifts beyond the tolerance (the reference's MAX_OFFSET, 30 min) — a
+skewed clock makes a node reject honest proposals by timestamp.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+
+from .log import get_logger
+
+_log = get_logger("time-sync")
+
+MAX_OFFSET_MS = 30 * 60 * 1000  # reference MAX_OFFSET
+
+
+def utc_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class NodeTimeMaintenance:
+    def __init__(self, max_peers: int = 128):
+        self._offsets: dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        self.max_peers = max_peers
+        self._warned = False
+
+    def on_peer_time(self, peer: bytes, peer_utc_ms: int) -> None:
+        """Record a peer-reported clock sample (NodeTimeMaintenance::
+        tryToUpdatePeerTimeInfo)."""
+        if peer_utc_ms <= 0:
+            return
+        offset = peer_utc_ms - utc_ms()
+        with self._lock:
+            if peer not in self._offsets and len(self._offsets) >= self.max_peers:
+                return
+            self._offsets[peer] = offset
+            median = int(statistics.median(self._offsets.values()))
+        if abs(median) > MAX_OFFSET_MS and not self._warned:
+            self._warned = True
+            _log.warning(
+                "local clock is %d ms off the peer median — fix NTP "
+                "(consensus timestamps will look invalid to peers)",
+                median,
+            )
+
+    def remove_peer(self, peer: bytes) -> None:
+        with self._lock:
+            self._offsets.pop(peer, None)
+
+    def median_offset_ms(self) -> int:
+        with self._lock:
+            if not self._offsets:
+                return 0
+            return int(statistics.median(self._offsets.values()))
+
+    def aligned_time_ms(self) -> int:
+        """Network-aligned clock (getAlignedTime): local + median offset."""
+        return utc_ms() + self.median_offset_ms()

@@ -1,0 +1,78 @@
+"""BFS precompile: directory tree, links, listing.
+
+Reference: bcos-executor/src/precompiled/BFSPrecompiled.cpp.
+"""
+
+import json
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor import TransactionExecutor  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import BFS_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.protocol.block_header import BlockHeader  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import Transaction  # noqa: E402
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+
+SUITE = ecdsa_suite()
+
+
+def make_executor():
+    ex = TransactionExecutor(MemoryStorage(), SUITE)
+    ex.next_block_header(BlockHeader(number=1, timestamp=1_700_000_000))
+    return ex
+
+
+def call(ex, sig, *args, sender=b"\x31" * 20):
+    tx = Transaction(
+        to=BFS_ADDRESS, input=ex.codec.encode_call(sig, *args), sender=sender
+    )
+    return ex.execute_transactions([tx])[0]
+
+
+def test_bfs_mkdir_list_touch():
+    ex = make_executor()
+    rc = call(ex, "mkdir(string)", "/apps/dex/v1")
+    assert rc.status == 0
+    rc = call(ex, "list(string)", "/apps/dex")
+    assert rc.status == 0
+    code, blob = ex.codec.decode_output(["int256", "string"], rc.output)
+    assert code == 0
+    entries = json.loads(blob)
+    assert [e["name"] for e in entries] == ["v1"]
+    assert entries[0]["type"] == "directory"
+
+    # root listing shows the standard skeleton
+    rc = call(ex, "list(string)", "/")
+    _, blob = ex.codec.decode_output(["int256", "string"], rc.output)
+    names = {e["name"] for e in json.loads(blob)}
+    assert {"apps", "tables", "usr", "sys"} <= names
+
+    # duplicate mkdir fails
+    assert call(ex, "mkdir(string)", "/apps/dex/v1").status != 0
+    # touch a contract node
+    assert call(ex, "touch(string,string)", "/sys/thing", "contract").status == 0
+    # relative paths rejected
+    assert call(ex, "mkdir(string)", "oops").status != 0
+
+
+def test_bfs_link_and_readlink():
+    ex = make_executor()
+    addr = "0x" + "ab" * 20
+    rc = call(
+        ex, "link(string,string,string,string)", "dex", "1.0", addr, '[{"abi":1}]'
+    )
+    assert rc.status == 0
+    rc = call(ex, "readlink(string)", "/apps/dex/1.0")
+    assert rc.status == 0
+    (got,) = ex.codec.decode_output(["address"], rc.output)
+    assert got == bytes.fromhex("ab" * 20)
+    # listing the version dir shows the link with its address
+    rc = call(ex, "list(string)", "/apps/dex")
+    _, blob = ex.codec.decode_output(["int256", "string"], rc.output)
+    (entry,) = json.loads(blob)
+    assert entry["type"] == "link" and entry["address"] == addr
+    # readlink on a directory fails
+    assert call(ex, "readlink(string)", "/apps").status != 0

@@ -206,3 +206,21 @@ def test_broadcast_floods_across_hops():
     finally:
         for gw in gws:
             gw.stop()
+
+
+def test_node_time_maintenance_median_offset():
+    """bcos-tool NodeTimeMaintenance: median peer offset + aligned clock."""
+    from fisco_bcos_tpu.utils.time_sync import NodeTimeMaintenance, utc_ms
+
+    tm = NodeTimeMaintenance()
+    now = utc_ms()
+    tm.on_peer_time(b"p1" * 32, now + 1000)
+    tm.on_peer_time(b"p2" * 32, now + 2000)
+    tm.on_peer_time(b"p3" * 32, now - 500)
+    off = tm.median_offset_ms()
+    assert 900 <= off <= 1100, off  # median of (+1000, +2000, -500)
+    assert abs(tm.aligned_time_ms() - (utc_ms() + off)) < 100
+    tm.remove_peer(b"p2" * 32)
+    assert tm.median_offset_ms() < 500  # median of (+1000, -500)
+    tm.on_peer_time(b"p4" * 32, 0)  # zero timestamps are ignored
+    assert len(tm._offsets) == 2

@@ -1,0 +1,83 @@
+"""KeyPageStorage: page packing, splits, 2PC repacking.
+
+Reference: bcos-table/src/KeyPageStorage.cpp.
+"""
+
+import random
+
+from fisco_bcos_tpu.storage import MemoryStorage
+from fisco_bcos_tpu.storage.keypage import PAGE_TABLE, KeyPageStorage
+from fisco_bcos_tpu.storage.entry import Entry, EntryStatus
+from fisco_bcos_tpu.storage.interfaces import TwoPCParams
+
+
+def test_basic_rw_and_delete():
+    kp = KeyPageStorage(MemoryStorage(), page_size=4)
+    assert kp.get_row("t", b"missing") is None
+    kp.set_row("t", b"k1", Entry({"value": b"v1"}))
+    kp.set_row("t", b"k2", Entry({"value": b"v2"}))
+    assert kp.get_row("t", b"k1").get() == b"v1"
+    assert kp.get_row("t", b"k2").get() == b"v2"
+    kp.set_row("t", b"k1", Entry({"value": b"v1b"}))  # overwrite
+    assert kp.get_row("t", b"k1").get() == b"v1b"
+    kp.set_row("t", b"k1", Entry(status=EntryStatus.DELETED))
+    assert kp.get_row("t", b"k1") is None
+    assert kp.get_primary_keys("t") == [b"k2"]
+
+
+def test_pages_split_and_stay_sorted():
+    inner = MemoryStorage()
+    kp = KeyPageStorage(inner, page_size=8)
+    keys = [f"key{i:04d}".encode() for i in range(100)]
+    shuffled = keys[:]
+    random.Random(7).shuffle(shuffled)
+    for k in shuffled:
+        kp.set_row("acct", k, Entry({"value": b"v" + k}))
+    assert kp.get_primary_keys("acct") == sorted(keys)
+    for k in keys:
+        assert kp.get_row("acct", k).get() == b"v" + k
+    # actually paged: far fewer backend rows than keys
+    n_pages = len(inner.get_primary_keys(PAGE_TABLE))
+    assert 100 / 8 <= n_pages < 100 / 2, n_pages
+
+
+def test_tables_are_isolated():
+    kp = KeyPageStorage(MemoryStorage(), page_size=4)
+    kp.set_row("a", b"k", Entry({"value": b"in-a"}))
+    kp.set_row("b", b"k", Entry({"value": b"in-b"}))
+    assert kp.get_row("a", b"k").get() == b"in-a"
+    assert kp.get_row("b", b"k").get() == b"in-b"
+    assert kp.get_primary_keys("a") == [b"k"]
+
+
+def test_2pc_repacks_rows_into_pages():
+    kp = KeyPageStorage(MemoryStorage(), page_size=16)
+    kp.set_row("s", b"pre", Entry({"value": b"old"}))
+    writes = MemoryStorage()
+    for i in range(40):
+        writes.set_row("s", f"w{i:03d}".encode(), Entry({"value": b"x%d" % i}))
+    writes.set_row("s", b"pre", Entry({"value": b"new"}))
+    params = TwoPCParams(number=3)
+    kp.prepare(params, writes)
+    assert kp.get_row("s", b"pre").get() == b"old"  # not visible pre-commit
+    kp.commit(params)
+    assert kp.get_row("s", b"pre").get() == b"new"
+    for i in range(40):
+        assert kp.get_row("s", f"w{i:03d}".encode()).get() == b"x%d" % i
+    assert len(kp.get_primary_keys("s")) == 41
+
+    # rollback drops the staged write-set
+    writes2 = MemoryStorage()
+    writes2.set_row("s", b"pre", Entry({"value": b"never"}))
+    params2 = TwoPCParams(number=4)
+    kp.prepare(params2, writes2)
+    kp.rollback(params2)
+    assert kp.get_row("s", b"pre").get() == b"new"
+
+
+def test_traverse_unpacks_pages():
+    kp = KeyPageStorage(MemoryStorage(), page_size=4)
+    for i in range(10):
+        kp.set_row("t", b"k%d" % i, Entry({"value": b"v%d" % i}))
+    seen = {(t, k): e.get() for t, k, e in kp.traverse()}
+    assert seen[("t", b"k3")] == b"v3" and len(seen) == 10

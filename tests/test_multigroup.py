@@ -8,9 +8,10 @@ import sys
 
 sys.path.insert(0, "tests")
 
-from test_pbft import submit_txs  # noqa: E402
-
+from fisco_bcos_tpu.codec.abi import ABICodec  # noqa: E402
 from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory  # noqa: E402
 from fisco_bcos_tpu.front import InprocGateway  # noqa: E402
 from fisco_bcos_tpu.gateway.group import GroupGateway  # noqa: E402
 from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig  # noqa: E402
@@ -18,8 +19,33 @@ from fisco_bcos_tpu.node import Node, NodeConfig  # noqa: E402
 from fisco_bcos_tpu.rpc.group_manager import GroupManager, MultiGroupRpc  # noqa: E402
 
 SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
 N_HOSTS = 4
 GROUPS = ("group0", "group1")
+
+
+def submit_txs(node, count, start=0):
+    """Group-aware tx submission (the validator rejects foreign group ids)."""
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=777)
+    txs = [
+        fac.create_signed(
+            kp,
+            chain_id=node.config.chain_id,
+            group_id=node.config.group_id,
+            block_limit=500,
+            nonce=f"mg-{node.config.group_id}-{start + i}",
+            to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call(
+                "userAdd(string,uint256)", f"u{start + i}", 100
+            ),
+        )
+        for i in range(count)
+    ]
+    results = node.txpool.submit_batch(txs)
+    assert all(r.status == 0 for r in results), [r.status for r in results]
+    node.tx_sync.maintain()
+    return txs
 
 
 def make_multigroup_chain():

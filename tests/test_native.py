@@ -75,3 +75,46 @@ def test_suite_hash_uses_native_consistently():
     for impl, ref in ((Keccak256(), ref_keccak), (Sha256(), ref_sha256), (SM3(), ref_sm3)):
         for m in MSGS[:4]:
             assert impl.hash(m) == ref(m)
+
+
+def test_ed25519_suite_rfc8032_and_recover():
+    """Ed25519 suite (Ed25519Crypto.cpp analog): RFC 8032 vectors + the
+    SM2-style parse-then-verify recovery."""
+    from fisco_bcos_tpu.crypto.ref import ed25519 as ed
+    from fisco_bcos_tpu.crypto.suite import Ed25519Crypto
+
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    impl = Ed25519Crypto()
+    kp = impl.generate_keypair(secret=int.from_bytes(seed, "little"))
+    assert kp.pub == bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    sig = impl.sign(kp, b"")
+    assert len(sig) == impl.sig_len == 96
+    assert sig[:64] == bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert impl.verify(kp.pub, b"", sig)
+    assert impl.recover(b"", sig) == kp.pub
+    # tampered signature neither verifies nor recovers
+    bad = sig[:-33] + bytes([sig[-33] ^ 1]) + sig[-32:]
+    assert not impl.verify(kp.pub, b"", bad[:96])
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        impl.recover(b"x", sig)
+    # batch wrappers
+    import numpy as np
+
+    msgs = [b"m%d" % i for i in range(4)]
+    kps = [impl.generate_keypair(secret=100 + i) for i in range(4)]
+    sigs = [impl.sign(k, m) for k, m in zip(kps, msgs)]
+    ok = impl.batch_verify(
+        [m for m in msgs], [k.pub for k in kps], sigs
+    )
+    assert ok.all()
+    pubs, okr = impl.batch_recover(msgs, sigs)
+    assert okr.all() and bytes(pubs[2]) == kps[2].pub
