@@ -18,7 +18,7 @@ import time
 from .gateway import TcpGateway
 from .node import Node
 from .node.runtime import NodeRuntime
-from .rpc import JsonRpcImpl, RpcHttpServer
+from .rpc import RpcHttpServer
 from .tool.config import ChainOptions, load_chain_options, load_keypair
 from .utils.log import get_logger
 
@@ -66,10 +66,15 @@ def build_node(opts: ChainOptions):
         client_ssl_context=cli_ssl,
     )
     gw.connect(node.front)
+    from .rpc.group_manager import GroupManager, MultiGroupRpc
     from .utils.metrics import bind_node_metrics
 
+    # group-managed RPC surface (bcos-rpc groupmgr): one group today, but
+    # getGroupList/getGroupInfoList aggregate and requests route by group
+    manager = GroupManager()
+    impl = manager.add_node(node)
     server = RpcHttpServer(
-        JsonRpcImpl(node),
+        MultiGroupRpc(manager, default_group=opts.node.group_id),
         host=opts.rpc_listen_ip,
         port=opts.rpc_listen_port,
         ssl_context=rpc_ssl,
@@ -81,7 +86,7 @@ def build_node(opts: ChainOptions):
         from .rpc.ws_server import WsService
 
         ws = WsService(
-            JsonRpcImpl(node),
+            impl,
             event_engine=EventSubEngine(node.ledger, node.suite),
             amop=node.amop,
             host=opts.rpc_listen_ip,

@@ -72,9 +72,20 @@ class PBFTConfig:
     def is_leader(self, number: int, view: int) -> bool:
         return self.my_index == self.leader_index(number, view)
 
-    def reload(self, nodes: list[ConsensusNode]) -> None:
-        """Committee change from an s_consensus update (dynamic membership)."""
+    def reload(self, nodes: list[ConsensusNode], active_at: int | None = None) -> None:
+        """Committee change from an s_consensus update (dynamic membership).
+
+        `active_at`: the block number the committee serves (committed + 1).
+        A member joined via ConsensusPrecompiled carries enable_number =
+        write-block + 1 (ConsensusPrecompiled.cpp semantics) and must not
+        vote before it — every replica filters on the same boundary, so the
+        committee (and header sealer lists) stay deterministic."""
         self.nodes = sorted(
-            (n for n in nodes if n.node_type == "consensus_sealer"),
+            (
+                n
+                for n in nodes
+                if n.node_type == "consensus_sealer"
+                and (active_at is None or n.enable_number <= active_at)
+            ),
             key=lambda n: n.node_id,
         )

@@ -492,8 +492,11 @@ class PBFTEngine:
                 and self._recovered_prepared[0] <= msg.number
             ):
                 self._recovered_prepared = None
-            # committee may have changed at this block
-            self.config.reload(self.ledger.consensus_nodes())
+            # committee may have changed at this block; members activate at
+            # their enable_number (block N+1 for a change written at N)
+            self.config.reload(
+                self.ledger.consensus_nodes(), active_at=msg.number + 1
+            )
             _log.info(
                 "block %d stable-committed, view=%d, committee=%d",
                 msg.number,
@@ -728,7 +731,9 @@ class PBFTEngine:
             stale = [n for n in self._caches if n <= number]
             for n in stale:
                 self._caches.pop(n)
-            self.config.reload(self.ledger.consensus_nodes())
+            self.config.reload(
+                self.ledger.consensus_nodes(), active_at=number + 1
+            )
 
     # ---------------------------------------------------------------- recover
 

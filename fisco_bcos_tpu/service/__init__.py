@@ -1,0 +1,24 @@
+"""Service RPC — the Pro/Max microservice split.
+
+Reference: bcos-tars-protocol (service IDLs + async clients) and
+fisco-bcos-tars-service (per-service servants): in Pro/Max deployments the
+executor and storage run as separate OS processes behind service RPC.
+Here the same seam is a length-framed flat-codec RPC over TCP
+(service/rpc.py), with servers/clients for the executor
+(ExecutorService/RemoteExecutor — ExecutorServiceServer.cpp analog) and
+the storage backend (StorageService/RemoteStorage — StorageService
+servant analog).
+"""
+
+from .executor_service import ExecutorService, RemoteExecutor
+from .rpc import ServiceClient, ServiceServer
+from .storage_service import RemoteStorage, StorageService
+
+__all__ = [
+    "ExecutorService",
+    "RemoteExecutor",
+    "RemoteStorage",
+    "ServiceClient",
+    "ServiceServer",
+    "StorageService",
+]

@@ -1,0 +1,172 @@
+"""Length-framed service RPC over TCP — the tars-RPC transport analog.
+
+Reference: bcos-tars-protocol's service clients ride tars RPC between
+microservices; this transport carries the same request/response shape with
+the framework's flat codec:
+
+    frame   = u32 len ‖ body
+    request = u64 id ‖ str method ‖ bytes payload
+    reply   = u64 id ‖ u8 ok ‖ bytes payload-or-error
+
+Servers dispatch method -> handler(payload bytes) -> payload bytes; the
+client is synchronous (one in-flight pipeline per connection, matching how
+the scheduler drives an executor).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+from typing import Callable
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..utils.log import get_logger
+
+_log = get_logger("service-rpc")
+
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _recv_frame(sock: socket.socket) -> bytes | None:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack("<I", head)
+    if not 0 < n <= _MAX_FRAME:
+        return None
+    return _recv_exact(sock, n)
+
+
+class ServiceServer:
+    """Hosts named methods for one service (a tars servant analog)."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0):
+        self.name = name
+        self._methods: dict[str, Callable[[bytes], bytes]] = {}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self.host, self.port = self._listener.getsockname()
+        self._stop = threading.Event()
+        # one lock: service handlers mutate shared state (executor block
+        # context, storage), and tars servants are effectively serialized too
+        self._dispatch_lock = threading.Lock()
+
+    def register(self, method: str, fn: Callable[[bytes], bytes]) -> None:
+        self._methods[method] = fn
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self._accept_loop, name=f"svc-{self.name}", daemon=True
+        ).start()
+        _log.info("service %s listening on %s:%d", self.name, self.host, self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(sock,), name=f"svc-{self.name}-conn",
+                daemon=True,
+            ).start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        while not self._stop.is_set():
+            body = _recv_frame(sock)
+            if body is None:
+                break
+            r = FlatReader(body)
+            req_id = r.u64()
+            method = r.str_()
+            payload = r.bytes_()
+            r.done()
+            w = FlatWriter()
+            w.u64(req_id)
+            fn = self._methods.get(method)
+            try:
+                if fn is None:
+                    raise ValueError(f"unknown method {method}")
+                with self._dispatch_lock:
+                    out = fn(payload)
+                w.u8(1)
+                w.bytes_(out)
+            except Exception as e:  # error crosses the wire, not the stack
+                _log.info("service %s.%s failed: %s", self.name, method, e)
+                w.u8(0)
+                w.bytes_(str(e).encode())
+            try:
+                _send_frame(sock, w.out())
+            except OSError:
+                break
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class ServiceRemoteError(RuntimeError):
+    pass
+
+
+class ServiceClient:
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def call(self, method: str, payload: bytes = b"") -> bytes:
+        with self._lock:
+            req_id = next(self._ids)
+            w = FlatWriter()
+            w.u64(req_id)
+            w.str_(method)
+            w.bytes_(payload)
+            _send_frame(self.sock, w.out())
+            body = _recv_frame(self.sock)
+        if body is None:
+            raise ServiceRemoteError(f"{method}: connection lost")
+        r = FlatReader(body)
+        got_id = r.u64()
+        ok = r.u8()
+        out = r.bytes_()
+        r.done()
+        if got_id != req_id:
+            raise ServiceRemoteError(f"{method}: response id mismatch")
+        if not ok:
+            raise ServiceRemoteError(f"{method}: {out.decode(errors='replace')}")
+        return out
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,179 @@
+"""Storage service — the backend KV store as its own process.
+
+Reference: the Pro/Max StorageService servant (fisco-bcos-tars-service) over
+bcos-storage: other services reach durable state through service RPC.
+`StorageService` exposes a TransactionalStorage over service/rpc.py;
+`RemoteStorage` implements the same interface as a client, so a node (or a
+remote executor) can mount a storage process exactly where it would mount
+sqlite.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..storage.entry import Entry
+from ..storage.interfaces import (
+    TransactionalStorage,
+    TraversableStorage,
+    TwoPCParams,
+)
+from .rpc import ServiceClient, ServiceServer
+
+
+class StorageService:
+    def __init__(self, backend: TransactionalStorage, host: str = "127.0.0.1", port: int = 0):
+        self.backend = backend
+        self.server = ServiceServer("storage", host, port)
+        s = self.server
+        s.register("get_row", self._get_row)
+        s.register("set_row", self._set_row)
+        s.register("set_rows", self._set_rows)
+        s.register("get_primary_keys", self._get_primary_keys)
+        s.register("prepare", self._prepare)
+        s.register("commit", self._commit)
+        s.register("rollback", self._rollback)
+        self.host, self.port = s.host, s.port
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    # -- handlers -------------------------------------------------------------
+
+    def _get_row(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        table, key = r.str_(), r.bytes_()
+        r.done()
+        e = self.backend.get_row(table, key)
+        w = FlatWriter()
+        w.u8(0 if e is None else 1)
+        if e is not None:
+            w.bytes_(e.encode())
+        return w.out()
+
+    def _set_row(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        table, key, data = r.str_(), r.bytes_(), r.bytes_()
+        r.done()
+        self.backend.set_row(table, key, Entry.decode(data))
+        return b""
+
+    def _set_rows(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        table = r.str_()
+        items = r.seq(lambda r2: (r2.bytes_(), Entry.decode(r2.bytes_())))
+        r.done()
+        self.backend.set_rows(table, items)
+        return b""
+
+    def _get_primary_keys(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        table = r.str_()
+        r.done()
+        w = FlatWriter()
+        w.seq(self.backend.get_primary_keys(table), lambda w2, k: w2.bytes_(k))
+        return w.out()
+
+    def _prepare(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        number = r.u64()
+        rows = r.seq(
+            lambda r2: (r2.str_(), r2.bytes_(), Entry.decode(r2.bytes_()))
+        )
+        r.done()
+
+        class _View(TraversableStorage):
+            def traverse(self) -> Iterator:
+                yield from rows
+
+        self.backend.prepare(TwoPCParams(number=number), _View())
+        return b""
+
+    def _commit(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        number = r.u64()
+        r.done()
+        self.backend.commit(TwoPCParams(number=number))
+        return b""
+
+    def _rollback(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        number = r.u64()
+        r.done()
+        self.backend.rollback(TwoPCParams(number=number))
+        return b""
+
+
+class RemoteStorage(TransactionalStorage):
+    """TransactionalStorage client over a StorageService."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.client = ServiceClient(host, port, timeout)
+
+    def get_row(self, table: str, key: bytes) -> Entry | None:
+        w = FlatWriter()
+        w.str_(table)
+        w.bytes_(bytes(key))
+        out = self.client.call("get_row", w.out())
+        r = FlatReader(out)
+        if not r.u8():
+            r.done()
+            return None
+        e = Entry.decode(r.bytes_())
+        r.done()
+        return None if e.deleted else e
+
+    def set_row(self, table: str, key: bytes, entry: Entry) -> None:
+        w = FlatWriter()
+        w.str_(table)
+        w.bytes_(bytes(key))
+        w.bytes_(entry.encode())
+        self.client.call("set_row", w.out())
+
+    def set_rows(self, table: str, items) -> None:
+        w = FlatWriter()
+        w.str_(table)
+        w.seq(
+            list(items),
+            lambda w2, kv: (w2.bytes_(bytes(kv[0])), w2.bytes_(kv[1].encode())),
+        )
+        self.client.call("set_rows", w.out())
+
+    def get_primary_keys(self, table: str) -> list[bytes]:
+        w = FlatWriter()
+        w.str_(table)
+        out = self.client.call("get_primary_keys", w.out())
+        r = FlatReader(out)
+        keys = r.seq(lambda r2: r2.bytes_())
+        r.done()
+        return keys
+
+    def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
+        w = FlatWriter()
+        w.u64(params.number)
+        w.seq(
+            [(t, k, e) for t, k, e in writes.traverse()],
+            lambda w2, row: (
+                w2.str_(row[0]),
+                w2.bytes_(bytes(row[1])),
+                w2.bytes_(row[2].encode()),
+            ),
+        )
+        self.client.call("prepare", w.out())
+
+    def commit(self, params: TwoPCParams) -> None:
+        w = FlatWriter()
+        w.u64(params.number)
+        self.client.call("commit", w.out())
+
+    def rollback(self, params: TwoPCParams) -> None:
+        w = FlatWriter()
+        w.u64(params.number)
+        self.client.call("rollback", w.out())
+
+    def close(self) -> None:
+        self.client.close()

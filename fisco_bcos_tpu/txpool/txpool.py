@@ -187,16 +187,36 @@ class TxPool:
 
     def seal_txs(self, limit: int) -> list[Transaction]:
         """Pick ≤limit unsealed txs and mark them sealed
-        (asyncSealTxs → batchFetchTxs, MemoryStorage.cpp:619)."""
+        (asyncSealTxs → batchFetchTxs, MemoryStorage.cpp:619).
+
+        Round-robin across senders (arrival order within a sender): the
+        reference bounds per-traversal fetches so one flooding sender cannot
+        starve everyone else out of a block. The grouping scan is capped at
+        a multiple of `limit` so sealing stays O(limit), not O(pool) — txs
+        past the cap wait for the next round exactly as in the reference's
+        bounded traversal."""
+        from collections import deque
+
+        scan_cap = max(limit * 8, 4096)
         out: list[Transaction] = []
         with self._lock:
+            by_sender: dict[bytes, deque] = {}
+            scanned = 0
             for h, tx in self._txs.items():
                 if h in self._sealed:
                     continue
+                by_sender.setdefault(tx.sender, deque()).append((h, tx))
+                scanned += 1
+                if scanned >= scan_cap:
+                    break
+            queues = deque(by_sender.values())
+            while queues and len(out) < limit:
+                q = queues.popleft()
+                h, tx = q.popleft()
                 self._sealed.add(h)
                 out.append(tx)
-                if len(out) >= limit:
-                    break
+                if q:
+                    queues.append(q)
         return out
 
     def unseal(self, hashes: list[bytes]) -> None:

@@ -226,3 +226,24 @@ def test_proposal_carries_metadata_not_payloads():
     # consensus still commits (replicas fill from pools)
     gw.deliver_all()
     assert all(n.block_number() == 1 for n in nodes)
+
+
+def test_committee_reload_honors_enable_number():
+    """A member added via ConsensusPrecompiled activates at its
+    enable_number, not immediately (ConsensusPrecompiled.cpp semantics)."""
+    from fisco_bcos_tpu.consensus.config import PBFTConfig
+    from fisco_bcos_tpu.ledger import ConsensusNode
+
+    kps = [SUITE.signature_impl.generate_keypair(secret=60_000 + i) for i in range(4)]
+    base = [ConsensusNode(kp.pub, weight=1) for kp in kps[:3]]
+    cfg = PBFTConfig(suite=SUITE, keypair=kps[0], nodes=list(base))
+    newcomer = ConsensusNode(kps[3].pub, weight=1, enable_number=5)
+
+    cfg.reload(base + [newcomer], active_at=4)
+    assert len(cfg.nodes) == 3  # not yet active at block 4
+    cfg.reload(base + [newcomer], active_at=5)
+    assert len(cfg.nodes) == 4  # active from its enable_number
+    # observers never join regardless of enable_number
+    obs = ConsensusNode(kps[3].pub, weight=1, node_type="consensus_observer")
+    cfg.reload(base + [obs], active_at=99)
+    assert len(cfg.nodes) == 3

@@ -1,0 +1,146 @@
+"""Pro-mode service split: storage + executor as services over real sockets.
+
+Reference topology: fisco-bcos-tars-service {StorageService, ExecutorService}
+driven by the node's scheduler through service RPC
+(TarsRemoteExecutorManager). Here the full Pro wiring runs in one test:
+
+    [node side]  Ledger + Scheduler ──RemoteExecutor──▶ [executor service]
+                      │                                      │ RemoteStorage
+                      └────────────RemoteStorage─────────────▶ [storage service]
+"""
+
+import sys
+
+sys.path.insert(0, "tests")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from fisco_bcos_tpu.codec.abi import ABICodec  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor import TransactionExecutor  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig, Ledger  # noqa: E402
+from fisco_bcos_tpu.protocol.block import Block  # noqa: E402
+from fisco_bcos_tpu.protocol.block_header import BlockHeader, ParentInfo  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory  # noqa: E402
+from fisco_bcos_tpu.scheduler import Scheduler  # noqa: E402
+from fisco_bcos_tpu.service import (  # noqa: E402
+    ExecutorService,
+    RemoteExecutor,
+    RemoteStorage,
+    StorageService,
+)
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+def test_full_pro_split_executes_and_commits():
+    # storage process: the durable backend behind service RPC
+    backing = MemoryStorage()
+    storage_svc = StorageService(backing)
+    storage_svc.start()
+
+    # executor process: a real engine mounted on REMOTE storage
+    exec_storage = RemoteStorage(storage_svc.host, storage_svc.port)
+    executor = TransactionExecutor(exec_storage, SUITE)
+    exec_svc = ExecutorService(executor)
+    exec_svc.start()
+
+    try:
+        # node side: ledger over remote storage, scheduler over remote executor
+        node_storage = RemoteStorage(storage_svc.host, storage_svc.port)
+        kp = SUITE.signature_impl.generate_keypair(secret=0x590)
+        ledger = Ledger(node_storage, SUITE)
+        ledger.build_genesis(
+            GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub, weight=1)])
+        )
+        remote_exec = RemoteExecutor(exec_svc.host, exec_svc.port)
+        scheduler = Scheduler(remote_exec, ledger, node_storage, SUITE)
+
+        fac = TransactionFactory(SUITE)
+        sender = SUITE.signature_impl.generate_keypair(secret=0x591)
+        txs = [
+            fac.create_signed(
+                sender,
+                chain_id="chain0",
+                group_id="group0",
+                block_limit=500,
+                nonce=f"svc-{i}",
+                to=DAG_TRANSFER_ADDRESS,
+                input=CODEC.encode_call("userAdd(string,uint256)", f"svc{i}", 11),
+            )
+            for i in range(3)
+        ]
+        parent = ledger.ledger_config()
+        header = BlockHeader(
+            number=1,
+            parent_info=[ParentInfo(0, parent.block_hash)],
+            timestamp=1_700_000_000,
+            sealer_list=[kp.pub],
+            consensus_weights=[1],
+        )
+        block = Block(header=header, transactions=txs)
+        header.txs_root = block.calculate_txs_root(SUITE)
+        header.clear_hash_cache()
+
+        executed = scheduler.execute_block(block)
+        assert executed.state_root != b"\x00" * 32
+        assert all(rc.status == 0 for rc in block.receipts)
+
+        scheduler.commit_block(executed)
+        assert ledger.block_number() == 1
+
+        # committed state is visible through a read-only remote call
+        out = scheduler.call(
+            fac.create(
+                chain_id="chain0", group_id="group0", block_limit=500,
+                nonce="ro", to=DAG_TRANSFER_ADDRESS,
+                input=CODEC.encode_call("userBalance(string)", "svc1"),
+            )
+        )
+        ok, bal = CODEC.decode_output(["uint256", "uint256"], out.output)
+        assert (ok, bal) == (0, 11)
+
+        # remote code/abi surface answers (empty for a precompile, no error)
+        assert remote_exec.get_code(DAG_TRANSFER_ADDRESS) == b""
+    finally:
+        exec_svc.stop()
+        storage_svc.stop()
+
+
+def test_remote_storage_2pc_and_errors():
+    backing = MemoryStorage()
+    svc = StorageService(backing)
+    svc.start()
+    try:
+        from fisco_bcos_tpu.service.rpc import ServiceRemoteError
+        from fisco_bcos_tpu.storage.entry import Entry
+        from fisco_bcos_tpu.storage.interfaces import TwoPCParams
+
+        rs = RemoteStorage(svc.host, svc.port)
+        rs.set_row("t", b"k", Entry({"value": b"v"}))
+        assert rs.get_row("t", b"k").get() == b"v"
+        assert backing.get_row("t", b"k").get() == b"v"  # actually remote
+        rs.set_rows("t", [(b"a", Entry({"value": b"1"})), (b"b", Entry({"value": b"2"}))])
+        assert rs.get_primary_keys("t") == [b"a", b"b", b"k"]
+
+        writes = MemoryStorage()
+        writes.set_row("t", b"k", Entry({"value": b"v2"}))
+        rs.prepare(TwoPCParams(number=7), writes)
+        assert rs.get_row("t", b"k").get() == b"v"  # staged, not visible
+        rs.commit(TwoPCParams(number=7))
+        assert rs.get_row("t", b"k").get() == b"v2"
+
+        # remote errors surface as exceptions, not dead sockets
+        import pytest
+
+        with pytest.raises(ServiceRemoteError):
+            rs.client.call("no_such_method", b"")
+        # the connection survives the error
+        assert rs.get_row("t", b"k").get() == b"v2"
+    finally:
+        svc.stop()

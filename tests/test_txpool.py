@@ -138,3 +138,37 @@ def test_batch_submit_marks_invalid_signature():
     assert results[1].status == ErrorCode.INVALID_SIGNATURE
     assert results[2].status == ErrorCode.SUCCESS
     assert pool.pending_count() == 2
+
+
+def test_seal_fairness_round_robin():
+    """One flooding sender cannot starve others out of a block
+    (batchFetchTxs bounded-traversal semantics)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_pbft import CODEC, SUITE, make_chain
+
+    from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+    from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+
+    nodes, _ = make_chain(1)
+    node = nodes[0]
+    fac = TransactionFactory(SUITE)
+    flooder = SUITE.signature_impl.generate_keypair(secret=0xF10)
+    quiet = SUITE.signature_impl.generate_keypair(secret=0x901)
+
+    def tx(kp, nonce):
+        return fac.create_signed(
+            kp, chain_id="chain0", group_id="group0", block_limit=500,
+            nonce=nonce, to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call("userAdd(string,uint256)", nonce, 1),
+        )
+
+    txs = [tx(flooder, f"flood-{i}") for i in range(20)] + [tx(quiet, "quiet-1")]
+    res = node.txpool.submit_batch(txs)
+    assert all(r.status == 0 for r in res)
+    sealed = node.txpool.seal_txs(4)
+    senders = {t.sender for t in sealed}
+    assert len(sealed) == 4
+    # the quiet sender is in the batch despite the 20-tx flood ahead of it
+    assert SUITE.calculate_address(quiet.pub) in senders
