@@ -44,10 +44,13 @@ def _encode_static(typ: str, val: Any) -> bytes:
         if len(b) != 20:
             raise ValueError("address must be 20 bytes")
         return b"\x00" * 12 + b
-    if typ == "bytes32":
+    if typ.startswith("bytes") and typ != "bytes":
+        n = int(typ[5:])
+        if not 1 <= n <= 32:
+            raise ValueError(f"bad fixed-bytes width {typ}")
         b = bytes(val)
-        if len(b) > 32:
-            raise ValueError("bytes32 overflow")
+        if len(b) > n:
+            raise ValueError(f"{typ} overflow")
         return b.ljust(32, b"\x00")
     raise ValueError(f"unsupported static type {typ}")
 
@@ -92,8 +95,8 @@ def _decode_static(typ: str, word: bytes) -> Any:
         return int.from_bytes(word, "big", signed=True)
     if typ == "address":
         return word[12:]
-    if typ == "bytes32":
-        return word
+    if typ.startswith("bytes") and typ != "bytes":
+        return word[: int(typ[5:])]  # bytes32 -> the whole word
     raise ValueError(f"unsupported static type {typ}")
 
 

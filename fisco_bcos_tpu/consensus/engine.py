@@ -142,6 +142,12 @@ class PBFTEngine:
     def _cache(self, number: int) -> ProposalCache:
         return self._caches.setdefault(number, ProposalCache())
 
+    def has_in_flight(self, number: int) -> bool:
+        """A proposal at `number` has been accepted and is being voted on."""
+        with self._lock:
+            cache = self._caches.get(number)
+            return cache is not None and cache.pre_prepare is not None
+
     def _broadcast(self, msg: PBFTMessage) -> None:
         self.front.broadcast(ModuleID.PBFT, msg.encode())
 
@@ -165,6 +171,12 @@ class PBFTEngine:
                 return False
             if number != self.committed_number + 1:
                 return False
+            existing = self._caches.get(number)
+            if existing is not None and existing.pre_prepare is not None:
+                # we already proposed at this height/view: a second, different
+                # proposal would be self-equivocation (re-delivery is the
+                # rebroadcast path's job, not the sealer's)
+                return False
             msg = PBFTMessage(
                 packet_type=PacketType.PRE_PREPARE,
                 view=self.view,
@@ -176,6 +188,32 @@ class PBFTEngine:
             self._broadcast(msg)
             self._handle_pre_prepare(msg, from_self=True)
             return True
+
+    def rebroadcast_in_flight(self) -> None:
+        """Re-broadcast our pre-prepare and votes for the uncommitted head
+        proposal (runtime-timer driven). Transient peer loss (reconnects,
+        stalls) drops frames; PBFT is idempotent to re-delivery — the
+        equivocation guard accepts the same hash, votes overwrite
+        themselves — so periodic re-send restores liveness without waiting
+        out the full view-change timeout (the reference's resend via
+        checkPoint/timeout broadcasts)."""
+        with self._lock:
+            cache = self._caches.get(self.committed_number + 1)
+            if cache is None or cache.stable:
+                return
+            msgs: list[PBFTMessage] = []
+            if (
+                cache.pre_prepare is not None
+                and cache.pre_prepare.generated_from == self.config.my_index
+            ):
+                msgs.append(cache.pre_prepare)
+            my = self.config.my_index
+            if my is not None:
+                for votes in (cache.prepares, cache.commits, cache.checkpoints):
+                    if my in votes:
+                        msgs.append(votes[my])
+        for m in msgs:
+            self._broadcast(m)
 
     # -------------------------------------------------------------- dispatch
 

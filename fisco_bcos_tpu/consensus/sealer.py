@@ -43,6 +43,11 @@ class Sealer:
         number = cfg.block_number + 1
         if not self.config.is_leader(number, self.engine.view):
             return None
+        if self.engine.has_in_flight(number):
+            # a proposal is already being voted on: sealing (hashing +
+            # device merkle) every tick just to be rejected by the engine's
+            # self-equivocation guard is pure waste
+            return None
         txs = self.txpool.seal_txs(cfg.tx_count_limit)
         if len(txs) < self.min_seal_txs:
             return None

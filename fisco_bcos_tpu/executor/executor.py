@@ -228,6 +228,21 @@ class TransactionExecutor:
             rc.output = b"unknown contract address"
             rc.gas_used = BASE_GAS
             return rc
+        # auth governance (ContractAuthMgr enforcement): frozen contracts and
+        # method ACLs gate deployed-contract calls before a frame starts
+        if not is_create and tx.to not in self.registry:
+            from .precompiled.auth import acl_allows, is_frozen
+
+            if is_frozen(overlay, tx.to):
+                rc.status = int(TransactionStatus.CONTRACT_FROZEN)
+                rc.output = b"contract is frozen"
+                rc.gas_used = BASE_GAS
+                return rc
+            if not acl_allows(overlay, tx.to, tx.input[:4], tx.sender):
+                rc.status = int(TransactionStatus.PERMISSION_DENIED)
+                rc.output = b"method ACL denies sender"
+                rc.gas_used = BASE_GAS
+                return rc
         msg = EVMCall(
             kind="create" if is_create else "call",
             sender=tx.sender,
@@ -249,6 +264,12 @@ class TransactionExecutor:
         rc.log_entries = res.logs
         rc.contract_address = res.create_address
         if res.ok and not static_call:
+            if is_create and res.create_address:
+                # deploy-time admin binding (AuthManager: the deployer
+                # governs its contract's ACLs/freeze until handover)
+                from .precompiled.auth import bind_admin
+
+                bind_admin(overlay, res.create_address, tx.sender)
             overlay.merge_into_prev()
         return rc
 

@@ -4,6 +4,7 @@ Addresses mirror the reference's map
 (bcos-framework/executor/PrecompiledTypeDef.h:57-116).
 """
 
+from .auth import ContractAuthPrecompiled
 from .bfs import BFSPrecompiled
 from .base import (  # noqa: F401
     Precompiled,
@@ -31,6 +32,8 @@ CONSENSUS_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001003")
 KV_TABLE_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001009")
 CRYPTO_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100a")
 BFS_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100e")
+AUTH_MANAGER_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001005")
+CONTRACT_AUTH_MGR_ADDRESS = bytes.fromhex("0000000000000000000000000000000000010002")
 DAG_TRANSFER_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100c")
 # PrecompiledTypeDef.h:112/116 — benchmark families start at fixed bases
 CPU_HEAVY_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005200")
@@ -45,6 +48,8 @@ def default_registry() -> dict[bytes, Precompiled]:
         KV_TABLE_ADDRESS: KVTablePrecompiled(),
         CRYPTO_ADDRESS: CryptoPrecompiled(),
         BFS_ADDRESS: BFSPrecompiled(),
+        AUTH_MANAGER_ADDRESS: ContractAuthPrecompiled(),
+        CONTRACT_AUTH_MGR_ADDRESS: ContractAuthPrecompiled(),
         DAG_TRANSFER_ADDRESS: DagTransferPrecompiled(),
         CPU_HEAVY_ADDRESS: CpuHeavyPrecompiled(),
         SMALLBANK_ADDRESS: SmallBankPrecompiled(),
@@ -56,6 +61,8 @@ PRECOMPILED_ADDRESSES = {
     "table_manager": TABLE_MANAGER_ADDRESS,
     "consensus": CONSENSUS_ADDRESS,
     "bfs": BFS_ADDRESS,
+    "auth_manager": AUTH_MANAGER_ADDRESS,
+    "contract_auth": CONTRACT_AUTH_MGR_ADDRESS,
     "kv_table": KV_TABLE_ADDRESS,
     "crypto": CRYPTO_ADDRESS,
     "dag_transfer": DAG_TRANSFER_ADDRESS,

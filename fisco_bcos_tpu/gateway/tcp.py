@@ -30,6 +30,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 import zlib
 
 from ..front.front import FrontService, GatewayInterface
@@ -43,6 +44,8 @@ _MAX_FRAME = 128 * 1024 * 1024
 _KIND_DATA = 0
 _KIND_HANDSHAKE = 1
 _KIND_ROUTE = 2
+_KIND_PING = 3  # payload: sender's monotonic clock (echoed back verbatim)
+_KIND_PONG = 4
 _FLAG_COMPRESSED = 1
 _FLAG_BROADCAST = 2  # dst[:4] carries the origin's sequence number
 _HDR = "<BIBB"  # kind, module_id, flags, ttl
@@ -63,12 +66,30 @@ def _pack_frame(
     return struct.pack("<I", len(body)) + body
 
 
+_SEND_TIMEOUT_S = 20
+
+
 class _Peer:
     def __init__(self, sock: socket.socket, addr):
         self.sock = sock
         self.addr = addr
         self.node_id: bytes | None = None
         self.wlock = threading.Lock()
+        # failure detection (Service::heartBeat analog)
+        self.last_seen: float = 0.0
+        self.rtt_ms: float = -1.0
+        # bound sends, not reads: a peer that stopped reading fills the
+        # kernel send buffer and sendall would block forever — taking the
+        # heartbeat (or a broadcast) thread with it. SO_SNDTIMEO turns that
+        # into an OSError -> drop, without touching recv semantics.
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET,
+                socket.SO_SNDTIMEO,
+                struct.pack("ll", _SEND_TIMEOUT_S, 0),
+            )
+        except OSError:
+            pass
 
     def send(self, frame: bytes) -> bool:
         try:
@@ -92,8 +113,12 @@ class TcpGateway(GatewayInterface):
         ssl_context=None,
         client_ssl_context=None,
         rate_limiter=None,
+        heartbeat_interval: float = 10.0,
     ):
         self.node_id = node_id
+        # liveness probing (0 disables; tests drive heartbeats manually)
+        self.heartbeat_interval = heartbeat_interval
+        self._hb_timer = None
         self._ssl = ssl_context
         self._cli_ssl = client_ssl_context
         # outbound bandwidth policing (gateway/ratelimit.py; libratelimit)
@@ -126,10 +151,46 @@ class TcpGateway(GatewayInterface):
         t = threading.Thread(target=self._accept_loop, name="gw-accept", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.heartbeat_interval > 0:
+            from ..utils.worker import RepeatingTimer
+
+            self._hb_timer = RepeatingTimer(
+                self.heartbeat_interval, self._heartbeat, "gw-heartbeat"
+            )
+            self._hb_timer.start()
         _log.info("gateway listening on %s:%d", self.host, self.port)
+
+    def _heartbeat(self) -> None:
+        """Ping every peer; drop peers silent past the dead window — a hung
+        remote (no TCP close, no reads) otherwise looks connected forever
+        (reference: Service::heartBeat + session keep-alive)."""
+        now = time.monotonic()
+        payload = struct.pack("<d", now)
+        frame = _pack_frame(_KIND_PING, 0, 0, self.node_id, b"\x00" * 64, payload)
+        with self._lock:
+            peers = list(self._peers.values())
+        # generous window: a peer deep in a first-time XLA trace holds the
+        # GIL for MINUTES on a small host and cannot answer pings — that is
+        # a stall, not a death; dropping it loses in-flight consensus
+        # frames. The view-change path handles livelocked peers; heartbeat
+        # only reaps the truly-gone (kernel keepalive never fired).
+        dead_after = self.heartbeat_interval * 30
+        for peer in peers:
+            if peer.last_seen and now - peer.last_seen > dead_after:
+                _log.warning(
+                    "peer %s silent for %.1fs — dropping",
+                    (peer.node_id or b"?").hex()[:8],
+                    now - peer.last_seen,
+                )
+                self._drop(peer)
+                continue
+            if not peer.send(frame):
+                self._drop(peer)
 
     def stop(self) -> None:
         self._stop.set()
+        if self._hb_timer is not None:
+            self._hb_timer.stop()
         try:
             self._listener.close()
         except OSError:
@@ -321,6 +382,19 @@ class TcpGateway(GatewayInterface):
             src = body[_HDR_LEN : _HDR_LEN + 64]
             dst = body[_HDR_LEN + 64 : _HDR_LEN + 128]
             payload = body[_HDR_LEN + 128 :]
+            peer.last_seen = time.monotonic()
+            if kind == _KIND_PING:
+                peer.send(
+                    _pack_frame(
+                        _KIND_PONG, 0, 0, self.node_id, b"\x00" * 64, payload
+                    )
+                )
+                continue
+            if kind == _KIND_PONG:
+                if len(payload) == 8:
+                    (sent,) = struct.unpack("<d", payload)
+                    peer.rtt_ms = (time.monotonic() - sent) * 1000.0
+                continue
             if kind == _KIND_HANDSHAKE:
                 peer.node_id = src
                 with self._lock:

@@ -36,6 +36,7 @@ class NodeRuntime:
         self._last_progress = time.monotonic()
         self._last_height = node.block_number()
         self._last_sync = 0.0
+        self._last_rebroadcast = 0.0
 
     def start(self) -> None:
         # live nodes process consensus messages on the engine's own worker
@@ -96,3 +97,9 @@ class NodeRuntime:
             if gw is not None and hasattr(gw, "peers"):
                 # drop sync/clock state for disconnected peers
                 node.block_sync.prune_peers(set(gw.peers()))
+            # liveness: re-offer the in-flight proposal + votes (frames can
+            # be lost across reconnects/stalls; PBFT re-delivery is
+            # idempotent, waiting out the view-change timeout is not needed)
+            if now - self._last_rebroadcast > max(2.0, 4 * self.sync_interval):
+                self._last_rebroadcast = now
+                node.engine.rebroadcast_in_flight()

@@ -1,0 +1,153 @@
+"""Contract auth governance: method ACLs, admin checks, freezing.
+
+Reference: bcos-executor/src/precompiled/extension/
+{AuthManagerPrecompiled.cpp, ContractAuthMgrPrecompiled.cpp}.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor import TransactionExecutor  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import AUTH_MANAGER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.protocol.block_header import BlockHeader  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import Transaction  # noqa: E402
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+
+SUITE = ecdsa_suite()
+ADMIN = b"\x0a" * 20
+ALICE = b"\x0b" * 20
+MALLORY = b"\x0c" * 20
+TARGET = "0x" + "77" * 20
+SEL = bytes.fromhex("aabbccdd")
+
+
+def make_executor():
+    ex = TransactionExecutor(MemoryStorage(), SUITE)
+    ex.next_block_header(BlockHeader(number=1, timestamp=1_700_000_000))
+    return ex
+
+
+def call(ex, sig, *args, sender=ADMIN):
+    tx = Transaction(
+        to=AUTH_MANAGER_ADDRESS, input=ex.codec.encode_call(sig, *args), sender=sender
+    )
+    return ex.execute_transactions([tx])[0]
+
+
+def check(ex, account) -> bool:
+    rc = call(ex, "checkMethodAuth(string,bytes4,string)", TARGET, SEL,
+              "0x" + account.hex())
+    assert rc.status == 0
+    (ok,) = ex.codec.decode_output(["bool"], rc.output)
+    return ok
+
+
+def test_white_and_black_lists():
+    ex = make_executor()
+    assert call(ex, "initAdmin(string,string)", TARGET, "0x" + ADMIN.hex()).status == 0
+    # no ACL -> everyone allowed
+    assert check(ex, MALLORY)
+
+    # white list: only opened accounts pass
+    assert call(ex, "setMethodAuthType(string,bytes4,uint8)", TARGET, SEL, 1).status == 0
+    assert not check(ex, ALICE)
+    assert call(ex, "openMethodAuth(string,bytes4,string)", TARGET, SEL,
+                "0x" + ALICE.hex()).status == 0
+    assert check(ex, ALICE) and not check(ex, MALLORY)
+
+    # black list: listed accounts fail
+    assert call(ex, "setMethodAuthType(string,bytes4,uint8)", TARGET, SEL, 2).status == 0
+    assert call(ex, "openMethodAuth(string,bytes4,string)", TARGET, SEL,
+                "0x" + MALLORY.hex()).status == 0
+    assert check(ex, ALICE)  # not listed -> allowed under black list
+    assert not check(ex, MALLORY)  # listed on the black list -> denied
+
+    # close flips the entry back off the black list
+    assert call(ex, "closeMethodAuth(string,bytes4,string)", TARGET, SEL,
+                "0x" + MALLORY.hex()).status == 0
+    assert check(ex, MALLORY)
+
+
+def test_only_admin_mutates():
+    ex = make_executor()
+    assert call(ex, "initAdmin(string,string)", TARGET, "0x" + ADMIN.hex()).status == 0
+    rc = call(ex, "setMethodAuthType(string,bytes4,uint8)", TARGET, SEL, 1,
+              sender=MALLORY)
+    assert rc.status != 0  # not the admin
+    rc = call(ex, "resetAdmin(string,string)", TARGET, "0x" + MALLORY.hex(),
+              sender=MALLORY)
+    assert rc.status != 0
+    # admin hands over, new admin can govern
+    assert call(ex, "resetAdmin(string,string)", TARGET, "0x" + ALICE.hex()).status == 0
+    assert call(ex, "setMethodAuthType(string,bytes4,uint8)", TARGET, SEL, 1,
+                sender=ALICE).status == 0
+    # admin queryable
+    rc = call(ex, "getAdmin(string)", TARGET)
+    (admin,) = ex.codec.decode_output(["address"], rc.output)
+    assert admin == ALICE
+
+
+def test_freeze_and_available():
+    ex = make_executor()
+    assert call(ex, "initAdmin(string,string)", TARGET, "0x" + ADMIN.hex()).status == 0
+    rc = call(ex, "contractAvailable(string)", TARGET)
+    (ok,) = ex.codec.decode_output(["bool"], rc.output)
+    assert ok
+    assert call(ex, "setContractStatus(string,bool)", TARGET, True).status == 0
+    rc = call(ex, "contractAvailable(string)", TARGET)
+    (ok,) = ex.codec.decode_output(["bool"], rc.output)
+    assert not ok
+
+
+def test_auth_is_enforced_by_the_executor():
+    """Freeze + method ACLs gate real execution, and the deployer is bound
+    as admin at CREATE (TransactionExecutive enforcement semantics)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from evm_asm import _deployer, counter_runtime
+
+    ex = make_executor()
+    deployer = b"\xd0" * 20
+    rc = ex.execute_transactions(
+        [Transaction(to=b"", input=_deployer(counter_runtime(ex.codec)),
+                     sender=deployer)]
+    )[0]
+    assert rc.status == 0
+    caddr = rc.contract_address
+    chex = "0x" + caddr.hex()
+
+    # deployer was bound as admin automatically
+    rc = call(ex, "getAdmin(string)", chex)
+    (admin,) = ex.codec.decode_output(["address"], rc.output)
+    assert admin == deployer
+
+    inc = ex.codec.selector("inc()")
+
+    def inc_tx(sender):
+        return ex.execute_transactions(
+            [Transaction(to=caddr, input=inc, sender=sender)]
+        )[0]
+
+    assert inc_tx(ALICE).status == 0  # no ACL yet
+
+    # white-list the method to ADMIN only: ALICE is now denied pre-frame
+    assert call(ex, "setMethodAuthType(string,bytes4,uint8)", chex, inc, 1,
+                sender=deployer).status == 0
+    assert call(ex, "openMethodAuth(string,bytes4,string)", chex, inc,
+                "0x" + ADMIN.hex(), sender=deployer).status == 0
+    denied = inc_tx(ALICE)
+    assert denied.status == 18  # PERMISSION_DENIED
+    assert inc_tx(ADMIN).status == 0
+
+    # freeze stops everyone
+    assert call(ex, "setContractStatus(string,bool)", chex, True,
+                sender=deployer).status == 0
+    frozen = inc_tx(ADMIN)
+    assert frozen.status == 21  # CONTRACT_FROZEN
+    # unfreeze restores service
+    assert call(ex, "setContractStatus(string,bool)", chex, False,
+                sender=deployer).status == 0
+    assert inc_tx(ADMIN).status == 0

@@ -175,6 +175,14 @@ def test_four_process_chain(tmp_path):
     ports = free_ports(2 * n)
     pairs = [(ports[2 * i], ports[2 * i + 1]) for i in range(n)]
     dirs = build_chain(str(tmp_path / "nodes"), n, ports=pairs)
+    for d in dirs:
+        # first-compile stalls must not trigger view-change churn on this
+        # 1-core host; production keeps the tight default
+        cfg = os.path.join(d, "config.ini")
+        text = open(cfg).read().replace(
+            "consensus_timeout=3.0", "consensus_timeout=600.0"
+        )
+        open(cfg, "w").write(text)
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -182,20 +190,29 @@ def test_four_process_chain(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
     procs = []
-    try:
-        for d in dirs:
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, "-c", _BOOT],
-                    cwd=d,
-                    env=env,
-                    stdout=open(os.path.join(d, "node.log"), "w"),
-                    stderr=subprocess.STDOUT,
-                )
+
+    def spawn(d):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _BOOT],
+                cwd=d,
+                env=env,
+                stdout=open(os.path.join(d, "node.log"), "w"),
+                stderr=subprocess.STDOUT,
             )
+        )
+
+    try:
         rpc_ports = [rpc for _, rpc in pairs]
+        # stagger: node0 boots alone first so it fills the persistent XLA
+        # compile cache; the other three then load instead of re-compiling
+        # (4 concurrent compiles on a 1-core host blow every budget)
+        spawn(dirs[0])
+        assert wait_until(lambda: _rpc_up(rpc_ports[0]), 300), "node0 not up"
+        for d in dirs[1:]:
+            spawn(d)
         assert wait_until(
-            lambda: all(_rpc_up(p) for p in rpc_ports), 180
+            lambda: all(_rpc_up(p) for p in rpc_ports), 300
         ), "nodes did not serve RPC in time"
 
         fac = TransactionFactory(SUITE)
@@ -219,17 +236,23 @@ def test_four_process_chain(tmp_path):
             )
             assert "result" in resp, resp
 
-        def all_committed():
-            try:
-                return all(
-                    _rpc(p, "getBlockNumber")["result"] >= 1 for p in rpc_ports
-                )
-            except Exception:
-                return False
+        def heights():
+            out = []
+            for p in rpc_ports:
+                try:
+                    out.append(_rpc(p, "getBlockNumber")["result"])
+                except Exception:
+                    out.append(-1)
+            return out
 
-        assert wait_until(all_committed, 300), [
-            _rpc(p, "getBlockNumber") for p in rpc_ports if _rpc_up(p)
-        ]
+        # quorum first: consensus is live once 3 of 4 commit (a straggler
+        # still tracing XLA programs on this 1-core host is not a
+        # consensus failure)...
+        assert wait_until(
+            lambda: sum(1 for h in heights() if h >= 1) >= 3, 600
+        ), heights()
+        # ...and the straggler must catch up via block sync within grace
+        assert wait_until(lambda: all(h >= 1 for h in heights()), 420), heights()
         # same block hash everywhere (consensus, not 4 solo chains)
         h1 = [
             _rpc(p, "getBlockHashByNumber", "group0", "", 1)["result"]

@@ -224,3 +224,32 @@ def test_node_time_maintenance_median_offset():
     assert tm.median_offset_ms() < 500  # median of (+1000, -500)
     tm.on_peer_time(b"p4" * 32, 0)  # zero timestamps are ignored
     assert len(tm._offsets) == 2
+
+
+def test_heartbeat_ping_pong_and_hung_peer_drop():
+    """Liveness probing (Service::heartBeat): pings measure RTT; a hung peer
+    (silent, no TCP close) is dropped after the dead window."""
+    a = TcpGateway(bytes([0x41]) * 64, heartbeat_interval=0)  # manual driving
+    b = TcpGateway(bytes([0x42]) * 64, heartbeat_interval=0)
+    fa, fb = FrontService(a.node_id), FrontService(b.node_id)
+    try:
+        a.connect(fa)
+        b.connect(fb)
+        a.start()
+        b.start()
+        a.heartbeat_interval = 0.2  # window for the drop check below
+        assert a.connect_peer(b.host, b.port)
+        assert wait_until(lambda: len(a.peers()) == 1 and len(b.peers()) == 1, 5)
+
+        a._heartbeat()  # ping round
+        peer = next(iter(a._peers.values()))
+        assert wait_until(lambda: peer.rtt_ms >= 0, 5), "no pong received"
+
+        # simulate a hung peer: stop B's reader by closing its socket reads
+        # without A noticing (freeze last_seen in the past instead)
+        peer.last_seen -= 10.0
+        a._heartbeat()
+        assert wait_until(lambda: len(a.peers()) == 0, 5), "hung peer not dropped"
+    finally:
+        a.stop()
+        b.stop()
