@@ -261,7 +261,32 @@ def bench_flood() -> None:
     _emit("e2e_flood_tps", tps, "tx/s", tps / 10_000.0)  # vs README.md:10
 
 
+def _probe_backend(timeout_s: int = 240) -> bool:
+    """The axon TPU tunnel sometimes goes UNAVAILABLE and hangs even
+    `jax.devices()` indefinitely; probe in a killable subprocess so a dead
+    tunnel costs minutes, not the whole bench budget."""
+    import subprocess
+    import sys
+
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return res.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    if not _probe_backend():
+        print(
+            "# TPU backend unreachable (axon tunnel down) — aborting instead "
+            "of hanging; re-run when jax.devices() responds",
+            flush=True,
+        )
+        raise SystemExit(2)
     bench_admission()
     for fn in (bench_sm2, bench_merkle, bench_flood):
         try:
