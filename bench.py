@@ -44,19 +44,33 @@ BLOCK_TXS = 10_000
 UNIQUE = 64
 FLOOD_TXS = int(os.environ.get("FISCO_BENCH_FLOOD", "3000"))
 
+# single source of truth for every metric this harness owes the artifact:
+# (name, unit) — bench functions emit through these; _emit_missing emits
+# degraded placeholders for whichever never landed
+M_SECP = ("secp256k1_admission_verifies_per_s_10k_block", "tx/s")
+M_LATENCY = ("block_verify_latency_ms_10k", "ms")
+M_SM2 = ("sm2_batch_verify_per_s_10k", "sig/s")
+M_MERKLE = ("merkle_root_10k_leaves_ms", "ms")
+M_FLOOD = ("e2e_flood_tps", "tx/s")
+ALL_METRICS = [M_SECP, M_LATENCY, M_SM2, M_MERKLE, M_FLOOD]
 
-def _emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 2),
-                "unit": unit,
-                "vs_baseline": round(vs_baseline, 2),
-            }
-        ),
-        flush=True,
-    )
+
+_EMITTED: set[str] = set()
+
+
+def _emit(
+    metric: str, value: float, unit: str, vs_baseline: float, error: str | None = None
+) -> None:
+    rec = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 2),
+    }
+    if error:
+        rec["error"] = error[:400]
+    _EMITTED.add(metric)
+    print(json.dumps(rec), flush=True)
 
 
 def _cpu_secp_baseline_tps(digests, sigs65, pubs) -> float:
@@ -104,14 +118,18 @@ def bench_admission() -> None:
     bb = bucket_batch(BLOCK_TXS)
     args = tuple(pad_rows(a, bb) for a in (blocks, nblocks, r, s, v))
 
-    # correctness gate + jit warmup: device must match the CPU reference
+    # correctness gate + jit warmup: device must match the CPU reference.
+    # A mismatch degrades the metric (error field) instead of killing it.
+    err = None
     addr, ok, *_rest = admission_step(*args)
     addr, ok = np.asarray(addr), np.asarray(ok)
-    assert bool(ok[:BLOCK_TXS].all()), "device admission rejected valid signatures"
+    if not bool(ok[:BLOCK_TXS].all()):
+        err = "device admission rejected valid signatures"
     for j in (0, UNIQUE - 1):
         x, y = pubs[j]
         expect = keccak256(x.to_bytes(32, "big") + y.to_bytes(32, "big"))[12:]
-        assert bytes(addr[j].astype(np.uint8)) == expect, "sender address mismatch"
+        if bytes(addr[j].astype(np.uint8)) != expect:
+            err = err or "sender address mismatch"
 
     times = []
     for _ in range(3):
@@ -123,12 +141,14 @@ def bench_admission() -> None:
     tps = BLOCK_TXS / best
 
     cpu_tps = _cpu_secp_baseline_tps(digests, sigs, pubs)
-    _emit(
-        "secp256k1_admission_verifies_per_s_10k_block", tps, "tx/s", tps / cpu_tps
-    )
+    _emit(M_SECP[0], tps, M_SECP[1], tps / cpu_tps, error=err)
     cpu_block_ms = BLOCK_TXS / cpu_tps * 1000.0
     _emit(
-        "block_verify_latency_ms_10k", best * 1000.0, "ms", cpu_block_ms / (best * 1000.0)
+        M_LATENCY[0],
+        best * 1000.0,
+        M_LATENCY[1],
+        cpu_block_ms / (best * 1000.0),
+        error=err,
     )
 
 
@@ -164,7 +184,11 @@ def bench_sm2() -> None:
     )
 
     ok = verify_batch(hz, r_b, s_b, pub_b)
-    assert bool(np.asarray(ok)[:n].all()), "sm2 device verify rejected valid sigs"
+    err = (
+        None
+        if bool(np.asarray(ok)[:n].all())
+        else "sm2 device verify rejected valid sigs"
+    )
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -180,9 +204,10 @@ def bench_sm2() -> None:
     for i in range(iters):
         j = i % UNIQUE
         r, s = sigs[j]
-        assert ref.sm2_verify(msgs[j], r, s, pubs[j])
+        if not ref.sm2_verify(msgs[j], r, s, pubs[j]):
+            err = err or "cpu reference sm2 verify rejected its own signature"
     cpu_tps = iters / (time.perf_counter() - t0) * (os.cpu_count() or 1)
-    _emit("sm2_batch_verify_per_s_10k", tps, "sig/s", tps / cpu_tps)
+    _emit(M_SM2[0], tps, M_SM2[1], tps / cpu_tps, error=err)
 
 
 def bench_merkle() -> None:
@@ -213,7 +238,7 @@ def bench_merkle() -> None:
             hash_fn(b"".join(level[g : g + 16])) for g in range(0, len(level), 16)
         ]
     cpu_ms = (time.perf_counter() - t0) * 1000.0 / (os.cpu_count() or 1)
-    _emit("merkle_root_10k_leaves_ms", dev_ms, "ms", cpu_ms / dev_ms)
+    _emit(M_MERKLE[0], dev_ms, M_MERKLE[1], cpu_ms / dev_ms)
 
 
 def bench_flood() -> None:
@@ -248,17 +273,22 @@ def bench_flood() -> None:
         )
         for i in range(n)
     ]
+    err = None
     t0 = time.perf_counter()
     results = node.txpool.submit_batch(txs)
-    assert all(r.status == 0 for r in results)
-    while node.txpool.pending_count() > 0:
+    rejected = sum(1 for r in results if r.status != 0)
+    if rejected:
+        err = f"{rejected}/{n} txs rejected at admission"
+    stalls = 0
+    while node.txpool.pending_count() > 0 and stalls < 3:
         if not node.sealer.seal_and_submit():
-            break
+            stalls += 1  # report a degraded number instead of dying
     dt = time.perf_counter() - t0
     committed = node.ledger.total_transaction_count()
-    assert committed >= n, f"only {committed} txs committed"
-    tps = n / dt
-    _emit("e2e_flood_tps", tps, "tx/s", tps / 10_000.0)  # vs README.md:10
+    if committed < n:
+        err = err or f"only {committed}/{n} txs committed"
+    tps = committed / dt
+    _emit(M_FLOOD[0], tps, M_FLOOD[1], tps / 10_000.0, error=err)  # vs README.md:10
 
 
 def _probe_backend(timeout_s: int = 240) -> bool:
@@ -279,20 +309,28 @@ def _probe_backend(timeout_s: int = 240) -> bool:
         return False
 
 
+def _emit_missing(error: str) -> None:
+    for metric, unit in ALL_METRICS:
+        if metric not in _EMITTED:
+            _emit(metric, 0.0, unit, 0.0, error=error)
+
+
 def main() -> None:
     if not _probe_backend():
-        print(
-            "# TPU backend unreachable (axon tunnel down) — aborting instead "
-            "of hanging; re-run when jax.devices() responds",
-            flush=True,
-        )
+        # still publish all 5 lines (value 0 + error) so the artifact is
+        # parseable even when the axon tunnel is down
+        _emit_missing("TPU backend unreachable (axon tunnel down)")
         raise SystemExit(2)
-    bench_admission()
-    for fn in (bench_sm2, bench_merkle, bench_flood):
+    rc = 0
+    for fn in (bench_admission, bench_sm2, bench_merkle, bench_flood):
         try:
             fn()
-        except Exception as e:  # secondary metrics must not kill the headline
+        except Exception as e:  # a failed bench degrades its metrics, never dies
             print(f"# bench {fn.__name__} failed: {e}", flush=True)
+            rc = 1
+    _emit_missing("bench raised before measuring — see '#' comment lines")
+    if rc:
+        raise SystemExit(rc)
 
 
 if __name__ == "__main__":

@@ -1,42 +1,153 @@
-"""Minimal Solidity ABI codec.
+"""Solidity ABI codec — the full static/dynamic type algebra.
 
 Reference: bcos-codec/abi/ContractABICodec.* (used by every precompile for
 input parsing and output encoding, e.g.
 bcos-executor/src/precompiled/extension/DagTransferPrecompiled.cpp:44-64's
-name2Selector table). Supports the types the system/benchmark precompiles
-use: uint256/int256, address, bool, string, bytes, bytes32, and dynamic
-arrays of them. Function selector = first 4 bytes of hash("name(type,...)"),
-where the hash is the suite hash (keccak256, or SM3 on SM chains — matching
-the reference's getFuncSelector, common/Utilities.cpp).
+name2Selector table). Covers the reference codec's whole surface: elementary
+types (uintN/intN, address, bool, bytesN, bytes, string), fixed-size arrays
+``T[k]``, dynamic arrays ``T[]``, nested arrays, and tuples ``(T1,T2,...)``
+with arbitrary nesting — head/tail layout per the Solidity ABI spec, with
+strict decode (out-of-range offsets and truncated data raise, they don't
+yield empty values). Function selector = first 4 bytes of
+hash("name(type,...)"), where the hash is the suite hash (keccak256, or SM3
+on SM chains — matching the reference's getFuncSelector,
+precompiled/common/Utilities.cpp).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any
 
 _WORD = 32
 
 
-def _pad32(b: bytes, left: bool = True) -> bytes:
-    if len(b) % _WORD == 0 and b:
-        return b
-    pad = _WORD - (len(b) % _WORD or _WORD)
-    return (b"\x00" * pad + b) if left else (b + b"\x00" * pad)
+# ---------------------------------------------------------------------------
+# Type grammar
+# ---------------------------------------------------------------------------
 
 
-def _is_dynamic(typ: str) -> bool:
-    return typ in ("string", "bytes") or typ.endswith("[]")
+@dataclass(frozen=True)
+class AbiType:
+    """Parsed ABI type. `base` is one of uint/int/address/bool/fbytes/
+    bytes/string/array/tuple; `bits` holds the uint/int width or the
+    fixed-bytes byte count; arrays carry `elem` and `length` (-1 = dynamic);
+    tuples carry `components`."""
+
+    base: str
+    bits: int = 0
+    length: int = -1
+    elem: "AbiType | None" = None
+    components: tuple = ()
+
+    @property
+    def is_dynamic(self) -> bool:
+        if self.base in ("bytes", "string"):
+            return True
+        if self.base == "array":
+            return self.length < 0 or self.elem.is_dynamic
+        if self.base == "tuple":
+            return any(c.is_dynamic for c in self.components)
+        return False
+
+    @property
+    def head_words(self) -> int:
+        """Words this type occupies in its enclosing head block
+        (1 for any dynamic type: the offset word)."""
+        if self.is_dynamic:
+            return 1
+        if self.base == "array":
+            return self.length * self.elem.head_words
+        if self.base == "tuple":
+            return sum(c.head_words for c in self.components)
+        return 1
 
 
-def _encode_static(typ: str, val: Any) -> bytes:
-    if typ.startswith("uint") or typ == "bool":
+def split_toplevel(s: str, sep: str = ",") -> list[str]:
+    """Split on `sep` at bracket depth 0 (tuple/array aware)."""
+    parts: list[str] = []
+    depth = 0
+    cur: list[str] = []
+    for ch in s:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+            if depth < 0:
+                raise ValueError(f"unbalanced brackets in {s!r}")
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if depth != 0:
+        raise ValueError(f"unbalanced brackets in {s!r}")
+    parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def parse_type(s: str) -> AbiType:
+    s = s.strip()
+    if not s:
+        raise ValueError("empty type")
+    if s.endswith("]"):
+        i = s.rindex("[")
+        inner = s[i + 1 : -1].strip()
+        if inner:
+            k = int(inner)
+            if k < 0:
+                raise ValueError(f"negative array length in {s!r}")
+        else:
+            k = -1
+        return AbiType("array", length=k, elem=parse_type(s[:i]))
+    if s.startswith("(") and s.endswith(")"):
+        return AbiType(
+            "tuple", components=tuple(parse_type(p) for p in split_toplevel(s[1:-1]))
+        )
+    if s in ("string", "bytes", "address", "bool"):
+        return AbiType(s)
+    if s.startswith("uint"):
+        bits = int(s[4:]) if s[4:] else 256
+        if not 8 <= bits <= 256 or bits % 8:
+            raise ValueError(f"bad uint width {s!r}")
+        return AbiType("uint", bits=bits)
+    if s.startswith("int"):
+        bits = int(s[3:]) if s[3:] else 256
+        if not 8 <= bits <= 256 or bits % 8:
+            raise ValueError(f"bad int width {s!r}")
+        return AbiType("int", bits=bits)
+    if s.startswith("bytes"):
+        n = int(s[5:])
+        if not 1 <= n <= 32:
+            raise ValueError(f"bad fixed-bytes width {s!r}")
+        return AbiType("fbytes", bits=n)
+    raise ValueError(f"unsupported ABI type {s!r}")
+
+
+# ---------------------------------------------------------------------------
+# Encoding
+# ---------------------------------------------------------------------------
+
+
+def _pad_right(b: bytes) -> bytes:
+    r = len(b) % _WORD
+    return b + b"\x00" * (_WORD - r) if r else b
+
+
+def _encode_static_word(t: AbiType, val: Any) -> bytes:
+    if t.base == "uint" or t.base == "bool":
         v = int(val)
         if v < 0:
-            raise ValueError(f"{typ} cannot encode negative {v}")
+            raise ValueError(f"uint{t.bits or ''} cannot encode negative {v}")
+        if t.base == "uint" and v >> t.bits:
+            raise ValueError(f"uint{t.bits} overflow: {v}")
         return v.to_bytes(_WORD, "big")
-    if typ.startswith("int"):
-        return int(val).to_bytes(_WORD, "big", signed=True)
-    if typ == "address":
+    if t.base == "int":
+        v = int(val)
+        if not -(1 << (t.bits - 1)) <= v < (1 << (t.bits - 1)):
+            raise ValueError(f"int{t.bits} overflow: {v}")
+        return v.to_bytes(_WORD, "big", signed=True)
+    if t.base == "address":
         if isinstance(val, str):
             b = bytes.fromhex(val[2:] if val[:2] in ("0x", "0X") else val)
         else:
@@ -44,95 +155,136 @@ def _encode_static(typ: str, val: Any) -> bytes:
         if len(b) != 20:
             raise ValueError("address must be 20 bytes")
         return b"\x00" * 12 + b
-    if typ.startswith("bytes") and typ != "bytes":
-        n = int(typ[5:])
-        if not 1 <= n <= 32:
-            raise ValueError(f"bad fixed-bytes width {typ}")
+    if t.base == "fbytes":
         b = bytes(val)
-        if len(b) > n:
-            raise ValueError(f"{typ} overflow")
-        return b.ljust(32, b"\x00")
-    raise ValueError(f"unsupported static type {typ}")
+        if len(b) > t.bits:
+            raise ValueError(f"bytes{t.bits} overflow")
+        return b.ljust(_WORD, b"\x00")
+    raise ValueError(f"not a static word type: {t.base}")
 
 
-def _encode_one(typ: str, val: Any) -> bytes:
-    """Encoding of one value; for dynamic types this is the *tail* data."""
-    if typ == "string":
-        val = val.encode() if isinstance(val, str) else bytes(val)
-        return len(val).to_bytes(_WORD, "big") + _pad32(val, left=False)
-    if typ == "bytes":
-        val = bytes(val)
-        return len(val).to_bytes(_WORD, "big") + _pad32(val, left=False)
-    if typ.endswith("[]"):
-        elem = typ[:-2]
-        return len(val).to_bytes(_WORD, "big") + abi_encode([elem] * len(val), val)
-    return _encode_static(typ, val)
+def _encode_value(t: AbiType, val: Any) -> bytes:
+    """Full encoding of one value — for dynamic types this is the tail."""
+    if t.base == "string":
+        raw = val.encode() if isinstance(val, str) else bytes(val)
+        return len(raw).to_bytes(_WORD, "big") + _pad_right(raw)
+    if t.base == "bytes":
+        raw = bytes(val)
+        return len(raw).to_bytes(_WORD, "big") + _pad_right(raw)
+    if t.base == "array":
+        vals = list(val)
+        if t.length >= 0 and len(vals) != t.length:
+            raise ValueError(
+                f"fixed array expects {t.length} elements, got {len(vals)}"
+            )
+        body = _encode_sequence([t.elem] * len(vals), vals)
+        if t.length < 0:
+            return len(vals).to_bytes(_WORD, "big") + body
+        return body
+    if t.base == "tuple":
+        vals = list(val)
+        if len(vals) != len(t.components):
+            raise ValueError(
+                f"tuple expects {len(t.components)} fields, got {len(vals)}"
+            )
+        return _encode_sequence(list(t.components), vals)
+    return _encode_static_word(t, val)
+
+
+def _encode_sequence(types: list[AbiType], values: list[Any]) -> bytes:
+    """Head/tail layout of a value sequence (top-level args, tuple fields,
+    array elements all share this shape; offsets are relative to the
+    sequence start)."""
+    heads: list[bytes] = []
+    tails: list[bytes] = []
+    head_len = _WORD * sum(t.head_words for t in types)
+    for t, v in zip(types, values):
+        if t.is_dynamic:
+            offset = head_len + sum(len(x) for x in tails)
+            heads.append(offset.to_bytes(_WORD, "big"))
+            tails.append(_encode_value(t, v))
+        else:
+            heads.append(_encode_value(t, v))
+    return b"".join(heads) + b"".join(tails)
 
 
 def abi_encode(types: list[str], values: list[Any]) -> bytes:
     """Head/tail ABI encoding of a value tuple."""
     if len(types) != len(values):
         raise ValueError("types/values length mismatch")
-    heads: list[bytes] = []
-    tails: list[bytes] = []
-    head_len = _WORD * len(types)
-    for typ, val in zip(types, values):
-        if _is_dynamic(typ):
-            offset = head_len + sum(len(t) for t in tails)
-            heads.append(offset.to_bytes(_WORD, "big"))
-            tails.append(_encode_one(typ, val))
-        else:
-            heads.append(_encode_static(typ, val))
-    return b"".join(heads) + b"".join(tails)
+    return _encode_sequence([parse_type(t) for t in types], list(values))
 
 
-def _decode_static(typ: str, word: bytes) -> Any:
-    if typ.startswith("uint"):
+# ---------------------------------------------------------------------------
+# Decoding (strict: malformed offsets/lengths raise)
+# ---------------------------------------------------------------------------
+
+
+def _word_at(data: bytes, pos: int) -> bytes:
+    if pos < 0 or pos + _WORD > len(data):
+        raise ValueError("abi decode: word out of range")
+    return data[pos : pos + _WORD]
+
+
+def _decode_static_word(t: AbiType, word: bytes) -> Any:
+    if t.base == "uint":
         return int.from_bytes(word, "big")
-    if typ == "bool":
+    if t.base == "bool":
         return bool(int.from_bytes(word, "big"))
-    if typ.startswith("int"):
+    if t.base == "int":
         return int.from_bytes(word, "big", signed=True)
-    if typ == "address":
+    if t.base == "address":
         return word[12:]
-    if typ.startswith("bytes") and typ != "bytes":
-        return word[: int(typ[5:])]  # bytes32 -> the whole word
-    raise ValueError(f"unsupported static type {typ}")
+    if t.base == "fbytes":
+        return word[: t.bits]
+    raise ValueError(f"not a static word type: {t.base}")
 
 
-def _decode_one(typ: str, data: bytes, offset: int) -> Any:
-    # an offset whose length word lies outside the buffer is malformed, not
-    # an empty value (the reference ContractABICodec rejects it too)
-    if offset + _WORD > len(data):
-        raise ValueError("abi decode: dynamic offset out of range")
-    if typ == "string" or typ == "bytes":
-        n = int.from_bytes(data[offset : offset + _WORD], "big")
-        raw = data[offset + _WORD : offset + _WORD + n]
+def _decode_value(t: AbiType, data: bytes, pos: int) -> Any:
+    if t.base in ("string", "bytes"):
+        n = int.from_bytes(_word_at(data, pos), "big")
+        raw = data[pos + _WORD : pos + _WORD + n]
         if len(raw) != n:
             raise ValueError("abi decode: truncated dynamic data")
-        return raw.decode() if typ == "string" else raw
-    if typ.endswith("[]"):
-        elem = typ[:-2]
-        n = int.from_bytes(data[offset : offset + _WORD], "big")
-        # each element needs at least one head word: a declared length beyond
-        # that is malformed, not a multi-terabyte allocation
-        if n > (len(data) - offset - _WORD) // _WORD:
-            raise ValueError("abi decode: array length exceeds calldata")
-        return abi_decode([elem] * n, data[offset + _WORD :])
-    return _decode_static(typ, data[offset : offset + _WORD])
+        return raw.decode() if t.base == "string" else raw
+    if t.base == "array":
+        if t.length < 0:
+            n = int.from_bytes(_word_at(data, pos), "big")
+            # every element occupies ≥1 head word: a declared length beyond
+            # that is malformed, not a multi-terabyte allocation
+            need = n * (1 if t.elem.is_dynamic else t.elem.head_words)
+            if pos + _WORD + need * _WORD > len(data):
+                raise ValueError("abi decode: array length exceeds calldata")
+            return _decode_sequence([t.elem] * n, data, pos + _WORD)
+        return _decode_sequence([t.elem] * t.length, data, pos)
+    if t.base == "tuple":
+        return _decode_sequence(list(t.components), data, pos)
+    return _decode_static_word(t, _word_at(data, pos))
+
+
+def _decode_sequence(types: list[AbiType], data: bytes, base: int) -> list[Any]:
+    """Decode a head/tail sequence starting at `base`; dynamic offsets in
+    the heads are relative to `base` (the enclosing frame)."""
+    out: list[Any] = []
+    pos = base
+    for t in types:
+        if t.is_dynamic:
+            offset = int.from_bytes(_word_at(data, pos), "big")
+            out.append(_decode_value(t, data, base + offset))
+            pos += _WORD
+        else:
+            out.append(_decode_value(t, data, pos))
+            pos += _WORD * t.head_words
+    return out
 
 
 def abi_decode(types: list[str], data: bytes) -> list[Any]:
-    out: list[Any] = []
-    for i, typ in enumerate(types):
-        word = data[i * _WORD : (i + 1) * _WORD]
-        if len(word) != _WORD:
-            raise ValueError("abi decode: truncated head")
-        if _is_dynamic(typ):
-            out.append(_decode_one(typ, data, int.from_bytes(word, "big")))
-        else:
-            out.append(_decode_static(typ, word))
-    return out
+    return _decode_sequence([parse_type(t) for t in types], data, 0)
+
+
+# ---------------------------------------------------------------------------
+# Selector-aware codec
+# ---------------------------------------------------------------------------
 
 
 class ABICodec:
@@ -148,7 +300,7 @@ class ABICodec:
     @staticmethod
     def _sig_types(signature: str) -> list[str]:
         inner = signature[signature.index("(") + 1 : signature.rindex(")")]
-        return [t.strip() for t in inner.split(",") if t.strip()]
+        return split_toplevel(inner)
 
     def encode_call(self, signature: str, *values: Any) -> bytes:
         return self.selector(signature) + abi_encode(

@@ -20,11 +20,16 @@ class PBFTConfig:
     keypair: KeyPair
     nodes: list[ConsensusNode] = field(default_factory=list)  # sealers, sorted
     leader_period: int = 1
+    # ledger head at construction: the boot committee must apply the SAME
+    # enable_number filter that reload(active_at=committed+1) applies on
+    # every commit, or a restarted node computes different leader/quorum
+    # math than running replicas when an s_consensus row carries
+    # enable_number > head+1. None = no filter (static test committees).
+    head: int | None = None
 
     def __post_init__(self) -> None:
-        self.nodes = sorted(
-            (n for n in self.nodes if n.node_type == "consensus_sealer"),
-            key=lambda n: n.node_id,
+        self.reload(
+            self.nodes, active_at=None if self.head is None else self.head + 1
         )
 
     @property

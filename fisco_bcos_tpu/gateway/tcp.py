@@ -27,6 +27,7 @@ zstd codec). TLS rides gateway/tls.py contexts (boostssl analog).
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -36,6 +37,7 @@ import zlib
 from ..front.front import FrontService, GatewayInterface
 from ..utils.log import get_logger
 from .router import MAX_DISTANCE, RouterTable
+from .tls import NODE_ID_URI_SCHEME
 
 _log = get_logger("gateway")
 
@@ -67,6 +69,30 @@ def _pack_frame(
 
 
 _SEND_TIMEOUT_S = 20
+
+
+def _cert_node_id(sock) -> bytes | None:
+    """Node identity pinned in the peer's TLS certificate (tls.py SAN URI
+    ``fbtpu-node://<hex>``). None when TLS is off or the cert carries no pin
+    (pre-pinning certs stay connectable; they just get no identity proof)."""
+    getpeercert = getattr(sock, "getpeercert", None)
+    if getpeercert is None:
+        return None
+    try:
+        cert = getpeercert()
+    except (OSError, ValueError):
+        return None
+    if not cert:
+        return None
+    for typ, val in cert.get("subjectAltName", ()):
+        if typ == "URI" and val.startswith(NODE_ID_URI_SCHEME):
+            try:
+                nid = bytes.fromhex(val[len(NODE_ID_URI_SCHEME) :])
+            except ValueError:
+                return None
+            if len(nid) == 64:
+                return nid
+    return None
 
 
 class _Peer:
@@ -127,9 +153,15 @@ class TcpGateway(GatewayInterface):
         self.router = RouterTable(node_id)
         # broadcast relay state: our outgoing sequence + per-origin dedup
         # (broadcasts flood hop-by-hop so partial meshes converge, like the
-        # reference's group-wide asyncSendBroadcastMessage over routing)
+        # reference's group-wide asyncSendBroadcastMessage over routing).
+        # The boot epoch namespaces our sequences: a restarted node's counter
+        # resets to 0, and without the epoch every post-restart broadcast
+        # would collide with peers' already-seen sequences and be blackholed
+        # chain-wide until the counter passed its pre-restart high-water mark.
         self._bcast_seq = 0
-        self._seen_bcast: dict[bytes, set[int]] = {}
+        self._bcast_epoch = os.urandom(4)
+        # per-origin: insertion-ordered {epoch: seen seqs}, newest last
+        self._seen_bcast: dict[bytes, dict[bytes, set[int]]] = {}
         self._front: FrontService | None = None
         self._peers: dict[bytes, _Peer] = {}
         self._lock = threading.RLock()
@@ -273,9 +305,10 @@ class TcpGateway(GatewayInterface):
         with self._lock:
             self._bcast_seq = (self._bcast_seq + 1) & 0xFFFFFFFF
             seq = self._bcast_seq
-        # dst[:4] = origin sequence; relayed hop-by-hop with (origin, seq)
-        # dedup so partial meshes converge without loops
-        dst = struct.pack("<I", seq) + b"\x00" * 60
+        # dst[:4] = origin sequence, dst[4:8] = origin boot epoch; relayed
+        # hop-by-hop with (origin, epoch, seq) dedup so partial meshes
+        # converge without loops and restarts never reuse a dedup key
+        dst = struct.pack("<I", seq) + self._bcast_epoch + b"\x00" * 56
         flags = _FLAG_BROADCAST
         if len(payload) >= _COMPRESS_THRESHOLD:
             flags |= _FLAG_COMPRESSED
@@ -295,16 +328,25 @@ class TcpGateway(GatewayInterface):
             if not peer.send(frame):
                 self._drop(peer)
 
-    def _bcast_is_new(self, origin: bytes, seq: int) -> bool:
+    def _bcast_is_new(self, origin: bytes, epoch: bytes, seq: int) -> bool:
         with self._lock:
-            seen = self._seen_bcast.setdefault(origin, set())
+            epochs = self._seen_bcast.setdefault(origin, {})
+            seen = epochs.get(epoch)
+            if seen is None:
+                # a new boot epoch voids the origin's old sequence space —
+                # but keep the previous epoch's set too: relays of
+                # pre-restart frames still in flight must not flip-flop the
+                # state and get re-delivered (two epochs is enough; frames
+                # older than one restart ago have long exceeded their TTL)
+                seen = epochs[epoch] = set()
+                while len(epochs) > 2:
+                    epochs.pop(next(iter(epochs)))
             if seq in seen:
                 return False
             seen.add(seq)
             if len(seen) > _SEEN_CAP:
-                # drop the oldest half (sequences are monotonic per origin)
-                keep = sorted(seen)[_SEEN_CAP // 2 :]
-                self._seen_bcast[origin] = set(keep)
+                # drop the oldest half (sequences are monotonic per epoch)
+                epochs[epoch] = set(sorted(seen)[_SEEN_CAP // 2 :])
             return True
 
     # -- router adverts -------------------------------------------------------
@@ -396,8 +438,37 @@ class TcpGateway(GatewayInterface):
                     peer.rtt_ms = (time.monotonic() - sent) * 1000.0
                 continue
             if kind == _KIND_HANDSHAKE:
-                peer.node_id = src
+                # bind the claimed identity to the TLS certificate: any
+                # chain-CA cert holder could otherwise claim another node's
+                # ID, evict the real peer from the registry and hijack its
+                # directed frames (reference derives the ID from the cert —
+                # Host.cpp nodeIDFromCertificate)
+                cert_id = _cert_node_id(peer.sock)
+                if cert_id is not None and cert_id != src:
+                    _log.warning(
+                        "handshake from %s:%s claims id %s but certificate "
+                        "pins %s — closing",
+                        *peer.addr,
+                        src.hex()[:8],
+                        cert_id.hex()[:8],
+                    )
+                    break
                 with self._lock:
+                    existing = self._peers.get(src)
+                    if (
+                        cert_id is None
+                        and self._ssl is not None
+                        and existing is not None
+                        and existing is not peer
+                    ):
+                        # legacy cert without an identity pin displacing an
+                        # existing connection: allowed (the dual-dial mesh
+                        # depends on overwrite) but worth an audit trail
+                        _log.warning(
+                            "peer %s re-registered by an unpinned certificate",
+                            src.hex()[:8],
+                        )
+                    peer.node_id = src
                     self._peers[src] = peer
                 _log.info("peer %s connected (%s:%s)", src.hex()[:8], *peer.addr)
                 self.router.peer_connected(src)
@@ -416,7 +487,9 @@ class TcpGateway(GatewayInterface):
                 continue
             if kind == _KIND_DATA and flags & _FLAG_BROADCAST:
                 (seq,) = struct.unpack("<I", dst[:4])
-                if src == self.node_id or not self._bcast_is_new(src, seq):
+                if src == self.node_id or not self._bcast_is_new(
+                    src, dst[4:8], seq
+                ):
                     continue
                 if ttl > 0:
                     # flood onward (minus the arrival edge) before delivering

@@ -90,6 +90,7 @@ class Node:
             keypair=self.keypair,
             nodes=ledger_cfg.consensus_nodes,
             leader_period=ledger_cfg.leader_period,
+            head=self.ledger.block_number(),
         )
         self.engine = PBFTEngine(
             self.pbft_config,

@@ -51,6 +51,7 @@ class NodeRuntime:
         if self._thread:
             self._thread.join(timeout=5)
         self.node.engine.stop_worker()
+        self.node.scheduler.stop()  # drain pending commit notifications
 
     def _run(self) -> None:
         _log.info("runtime started (node %s)", self.node.node_id.hex()[:8])

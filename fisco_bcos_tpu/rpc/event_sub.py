@@ -69,6 +69,9 @@ class _Subscription:
     sub_id: str
     filt: EventFilter
     push: Callable[[dict], None]  # delivery hook (ws session send)
+    # while the historical replay runs, live commits buffer here instead of
+    # pushing (ordering); None once the subscription is live
+    buffer: list | None = None
 
 
 class EventSubEngine:
@@ -102,8 +105,15 @@ class EventSubEngine:
                 for e, (addr, topics) in events
                 if sub.filt.matches(addr, topics)
             ]
-            if matched:
-                self._push(sub, number, matched)
+            if not matched:
+                continue
+            with self._lock:
+                if sub.buffer is not None:
+                    # replay still running on the subscriber thread — hold
+                    # the push so history stays ahead of live events
+                    sub.buffer.append((number, matched))
+                    continue
+            self._push(sub, number, matched)
 
     def _collect(self, number: int, block):
         """[(log_json, (address, topics))] for one committed block."""
@@ -134,8 +144,15 @@ class EventSubEngine:
 
     def subscribe(self, filt: EventFilter, push: Callable[[dict], None]) -> str:
         sub_id = f"sub-{next(self._ids)}"
-        sub = _Subscription(sub_id, filt, push)
+        sub = _Subscription(sub_id, filt, push, buffer=[])
+        # register BEFORE reading head/replaying: a block committed between
+        # the head read and registration would otherwise be delivered by
+        # neither the replay nor the live path (silent event gap). Live
+        # pushes buffer until the replay finishes, then drain deduped.
+        with self._lock:
+            self._subs[sub_id] = sub
         head = self.ledger.block_number()
+        end = -1
         # historical replay (EventSubTask): blocks [from, min(head, to)]
         if 0 <= filt.from_block <= head:
             end = head if filt.to_block == -1 else min(head, filt.to_block)
@@ -151,8 +168,21 @@ class EventSubEngine:
                 ]
                 if matched:
                     self._push(sub, n, matched)
-        with self._lock:
-            self._subs[sub_id] = sub
+        # drain-until-empty, clearing the buffer flag only once it IS empty
+        # under the lock: clearing first and pushing outside would let a
+        # concurrent commit (on the notify worker) deliver block N+1 ahead
+        # of still-buffered block N
+        while True:
+            with self._lock:
+                buffered = sub.buffer or []
+                if not buffered:
+                    sub.buffer = None
+                    break
+                sub.buffer = []
+            for number, matched in buffered:
+                if number <= end:
+                    continue  # the replay already delivered this block
+                self._push(sub, number, matched)
         return sub_id
 
     def unsubscribe(self, sub_id: str) -> bool:

@@ -49,6 +49,15 @@ class WsSession:
         self.wlock = threading.Lock()
         self.open = True
         self.topics: set[str] = set()  # AMOP subscriptions
+        # bound sends: a client that stops reading fills its TCP buffer and
+        # sendall would otherwise block whichever thread is pushing (block
+        # notify / event logs) forever; timeout -> OSError -> session drop
+        try:
+            self.sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO, struct.pack("ll", 20, 0)
+            )
+        except OSError:
+            pass
 
     # -- frame io ------------------------------------------------------------
 

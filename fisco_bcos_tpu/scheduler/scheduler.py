@@ -28,6 +28,7 @@ from ..storage.interfaces import TransactionalStorage, TwoPCParams
 from ..storage.state_storage import StateStorage
 from ..utils.error import ErrorCode
 from ..utils.log import StageTimer, get_logger
+from ..utils.worker import Worker
 
 _log = get_logger("scheduler")
 
@@ -63,6 +64,18 @@ class Scheduler:
         # block-commit listeners: cb(number, committed Block-with-receipts)
         self.on_committed: list = []
         self._lock = threading.RLock()
+        # listeners drain on a dedicated thread: commit_block is called by the
+        # PBFT engine under ITS lock, and a listener doing network I/O (ws
+        # block notify to a stalled client) must never stall consensus.
+        # Started here — commit_block has two concurrent callers (engine,
+        # block sync) and Worker.start is not thread-safe
+        self._notify = Worker("commit-notify")
+        self._notify.start()
+
+    def stop(self) -> None:
+        """Drain + stop the notify worker (queued block notifications are
+        delivered first — Worker.stop posts a sentinel and joins)."""
+        self._notify.stop()
 
     # -- executeBlock:150 ----------------------------------------------------
 
@@ -155,15 +168,13 @@ class Scheduler:
     def commit_block(self, header: BlockHeader) -> None:
         with self._lock:
             committed = self._commit_block_locked(header)
-        # listeners run OUTSIDE the lock: a slow push (ws block notify,
-        # event subscription to a stalled client) must not stall consensus
+        # listeners run on the notify worker, never on the caller's thread:
+        # the caller is the PBFT engine holding its own RLock, so a blocking
+        # sendall to a stalled ws client here would freeze consensus
         if committed is not None:
             number, block = committed
             for cb in list(self.on_committed):
-                try:
-                    cb(number, block)
-                except Exception:
-                    _log.exception("block-commit listener failed at %d", number)
+                self._notify.post(lambda cb=cb: cb(number, block))
 
     def _commit_block_locked(self, header: BlockHeader) -> None:
         number = header.number

@@ -170,7 +170,10 @@ def build_chain(
         if ssl:
             from ..gateway.tls import issue_node_cert
 
-            issue_node_cert(ca_crt, ca_key, conf, f"node{i}", hosts=[host])
+            issue_node_cert(
+                ca_crt, ca_key, conf, f"node{i}", hosts=[host],
+                node_id=keypairs[i].pub,
+            )
             shutil.copy(ca_crt, os.path.join(conf, "ca.crt"))
         _write_exec(
             os.path.join(ndir, "start.sh"), _START_SH.format(python=sys.executable)

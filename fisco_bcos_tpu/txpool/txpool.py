@@ -56,6 +56,11 @@ class TxPool:
         self.pstore = persistent_store
         self._txs: dict[bytes, Transaction] = {}
         self._sealed: set[bytes] = set()
+        # sealing-scan rotation state (see seal_txs): the bounded traversal
+        # resumes where the last one stopped so every pooled tx is
+        # eventually scanned even when the pool far exceeds one window
+        self._seal_cursor = 0
+        self.seal_scan_cap = 4096
         self._lock = threading.RLock()
         self.pool_nonces = TxPoolNonceChecker()
         self.ledger_nonces = LedgerNonceChecker(block_limit)
@@ -192,23 +197,35 @@ class TxPool:
         Round-robin across senders (arrival order within a sender): the
         reference bounds per-traversal fetches so one flooding sender cannot
         starve everyone else out of a block. The grouping scan is capped at
-        a multiple of `limit` so sealing stays O(limit), not O(pool) — txs
-        past the cap wait for the next round exactly as in the reference's
-        bounded traversal."""
+        a multiple of `limit`, and the scan START rotates between calls
+        (the reference's traversal rotates likewise): a fixed start would
+        only ever consider the oldest scan-window entries of a full pool,
+        starving every sender who landed past it. Reaching the rotated
+        start skips `cursor` dict entries at C speed — O(pool) worst case,
+        ~ms at the 135k pool cap — but the Python-level grouping work stays
+        O(scan_cap)."""
         from collections import deque
+        from itertools import chain, islice
 
-        scan_cap = max(limit * 8, 4096)
+        scan_cap = max(limit * 8, self.seal_scan_cap)
         out: list[Transaction] = []
         with self._lock:
+            n = len(self._txs)
+            if n == 0:
+                return out
+            start = self._seal_cursor % n
             by_sender: dict[bytes, deque] = {}
-            scanned = 0
-            for h, tx in self._txs.items():
+            scanned = visited = 0
+            items = self._txs.items()
+            for h, tx in chain(islice(items, start, None), islice(items, start)):
+                visited += 1
                 if h in self._sealed:
                     continue
                 by_sender.setdefault(tx.sender, deque()).append((h, tx))
                 scanned += 1
                 if scanned >= scan_cap:
                     break
+            self._seal_cursor = (start + visited) % n
             queues = deque(by_sender.values())
             while queues and len(out) < limit:
                 q = queues.popleft()

@@ -94,10 +94,13 @@ def test_genesis_rejects_bad_node_line(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _tls_gateway(ca_dir, node_dir, cn, node_id):
+def _tls_gateway(ca_dir, node_dir, cn, node_id, cert_node_id=None):
     ca_crt = os.path.join(ca_dir, "ca.crt")
     ca_key = os.path.join(ca_dir, "ca.key")
-    crt, key = issue_node_cert(ca_crt, ca_key, node_dir, cn)
+    crt, key = issue_node_cert(
+        ca_crt, ca_key, node_dir, cn,
+        node_id=node_id if cert_node_id is None else cert_node_id,
+    )
     return TcpGateway(
         node_id,
         ssl_context=make_server_context(ca_crt, crt, key),
@@ -134,6 +137,44 @@ def test_tls_gateway_accepts_chain_ca_rejects_foreign(tmp_path):
     finally:
         for gw in (gw1, gw2, gw3):
             gw.stop()
+
+
+def test_tls_gateway_rejects_impersonated_node_id(tmp_path):
+    """A chain-CA cert holder claiming ANOTHER node's identity must not
+    enter the peer registry: the handshake id is checked against the
+    node-id pin the CA wrote into the certificate (ADVICE r2: id/cert
+    binding; reference Host.cpp derives the id from the cert)."""
+    ca = str(tmp_path / "ca")
+    generate_chain_ca(ca)
+    victim_id = b"\x11" * 64
+    gw1 = _tls_gateway(ca, str(tmp_path / "n1"), "n1", b"\x01" * 64)
+    # insider: valid chain-CA cert pinned to its OWN id, but the gateway
+    # claims the victim's id in its handshake frames
+    evil = _tls_gateway(
+        ca, str(tmp_path / "evil"), "evil", victim_id, cert_node_id=b"\x66" * 64
+    )
+    f1, fe = FrontService(gw1.node_id), FrontService(evil.node_id)
+    try:
+        gw1.connect(f1)
+        gw1.start()
+        evil.connect(fe)
+        evil.start()
+        evil.connect_peer(gw1.host, gw1.port)
+        time.sleep(0.5)
+        assert victim_id not in gw1.peers()
+        # an honest pinned peer with the same CA still connects
+        gw2 = _tls_gateway(ca, str(tmp_path / "n2"), "n2", b"\x22" * 64)
+        f2 = FrontService(gw2.node_id)
+        gw2.connect(f2)
+        gw2.start()
+        try:
+            assert gw2.connect_peer(gw1.host, gw1.port)
+            assert wait_until(lambda: gw2.node_id in gw1.peers(), 5)
+        finally:
+            gw2.stop()
+    finally:
+        gw1.stop()
+        evil.stop()
 
 
 # ---------------------------------------------------------------------------

