@@ -252,7 +252,7 @@ def _decode_value(t: AbiType, data: bytes, pos: int) -> Any:
             n = int.from_bytes(_word_at(data, pos), "big")
             # every element occupies ≥1 head word: a declared length beyond
             # that is malformed, not a multi-terabyte allocation
-            need = n * (1 if t.elem.is_dynamic else t.elem.head_words)
+            need = n * t.elem.head_words
             if pos + _WORD + need * _WORD > len(data):
                 raise ValueError("abi decode: array length exceeds calldata")
             return _decode_sequence([t.elem] * n, data, pos + _WORD)

@@ -19,7 +19,19 @@ Frame layout (all little-endian):
 Handshake: on connect, both sides send their node id; frames route by the
 peer registry. Directed sends to non-neighbours forward hop-by-hop along the
 distance-vector router table (gateway/router.py; reference ServiceV2 +
-RouterTableImpl), decrementing ttl. Compression: payloads over 1 KiB are
+RouterTableImpl), decrementing ttl.
+
+Trust model: the handshake id is bound to the TLS certificate's node-id pin
+(tls.py SAN URI), which stops a chain-CA insider from evicting another
+node's registry entry. The per-frame `src` field, however, is ROUTING
+metadata, not authentication — multi-hop relay requires transit and
+broadcast frames to carry the ORIGIN's id on a neighbour's connection, so
+it cannot be checked against the peer identity. Authenticity is the
+application layer's job, and every consumer enforces it: PBFT messages are
+individually signed and verified against the claimed sender's key, synced
+blocks carry quorum certificates, and transactions carry ECDSA/SM2
+signatures checked at admission (same layering as the reference, whose
+P2P also forwards origin-stamped frames). Compression: payloads over 1 KiB are
 zlib-deflated (the reference uses zstd via c_compress_threshold — zlib is
 the stdlib-available equivalent; the wire flag keeps the seam for a native
 zstd codec). TLS rides gateway/tls.py contexts (boostssl analog).

@@ -36,8 +36,9 @@ import hashlib
 from dataclasses import dataclass, field
 from enum import IntEnum
 
+from ..codec.flat import FlatReader, FlatWriter
 from ..executor.evm import EVMCall, EVMResult
-from ..protocol.receipt import TransactionReceipt, TransactionStatus
+from ..protocol.receipt import LogEntry, TransactionReceipt, TransactionStatus
 from ..protocol.transaction import Transaction
 from ..storage.entry import Entry
 from ..storage.state_storage import StateStorage
@@ -78,6 +79,71 @@ class ExecutionMessage:
     logs: list = field(default_factory=list)
     key_locks: list = field(default_factory=list)
     create_address: bytes = b""
+
+    def encode_into(self, w: FlatWriter) -> None:
+        """Wire form for cross-process DMC (the ExecutionMessage the
+        reference ships over Tars — bcos-tars-protocol ExecutionMessage.tars)."""
+        w.u8(int(self.type))
+        w.u64(self.context_id)
+        w.u64(self.seq)
+        w.bytes_(self.from_addr)
+        w.bytes_(self.to_addr)
+        w.bytes_(self.sender)
+        w.bytes_(self.origin)
+        w.bytes_(self.data)
+        w.u8(1 if self.static_call else 0)
+        w.u8(1 if self.create else 0)
+        w.str_(self.kind)
+        w.bytes_(self.storage_addr)
+        w.bytes_(self.value.to_bytes(32, "big"))
+        w.bytes_(self.abi)
+        w.u64(self.gas)
+        w.i64(self.status)
+        w.u64(self.gas_used)
+        w.seq(self.logs, lambda w2, e: e.encode_into(w2))
+        w.seq(
+            self.key_locks,
+            lambda w2, kl: (w2.str_(kl[0]), w2.bytes_(kl[1])),
+        )
+        w.bytes_(self.create_address)
+
+    @classmethod
+    def decode_from(cls, r: FlatReader) -> "ExecutionMessage":
+        return cls(
+            type=MsgType(r.u8()),
+            context_id=r.u64(),
+            seq=r.u64(),
+            from_addr=r.bytes_(),
+            to_addr=r.bytes_(),
+            sender=r.bytes_(),
+            origin=r.bytes_(),
+            data=r.bytes_(),
+            static_call=bool(r.u8()),
+            create=bool(r.u8()),
+            kind=r.str_(),
+            storage_addr=r.bytes_(),
+            value=int.from_bytes(r.bytes_(), "big"),
+            abi=r.bytes_(),
+            gas=r.u64(),
+            status=r.i64(),
+            gas_used=r.u64(),
+            logs=r.seq(LogEntry.decode_from),
+            key_locks=r.seq(lambda r2: (r2.str_(), r2.bytes_())),
+            create_address=r.bytes_(),
+        )
+
+
+def encode_messages(msgs: list[ExecutionMessage]) -> bytes:
+    w = FlatWriter()
+    w.seq(msgs, lambda w2, m: m.encode_into(w2))
+    return w.out()
+
+
+def decode_messages(buf: bytes) -> list[ExecutionMessage]:
+    r = FlatReader(buf)
+    out = r.seq(ExecutionMessage.decode_from)
+    r.done()
+    return out
 
 
 class DmcStepRecorder:
@@ -174,6 +240,17 @@ class ExecutorShard:
         self._next_seq[ctx] = n + 1
         return n
 
+    # context-id coordination (ChecksumAddress hashes the contextID, so ids
+    # must be block-unique ACROSS shards; the scheduler aligns every
+    # participant to one floor — serializable, unlike reaching into
+    # `executor._block` directly, so RemoteShard can forward it)
+    def ctx_floor(self) -> int:
+        block = self.executor._block
+        return block.next_ctx if block else 0
+
+    def align(self, upto: int) -> None:
+        self.executor.align_contexts(upto)
+
     def ctx_storage(self, ctx: int) -> TrackingStorage:
         st = self._ctx_storage.get(ctx)
         if st is None:
@@ -198,8 +275,14 @@ class ExecutorShard:
         self._next_seq.pop(ctx, None)
 
     def execute(
-        self, contract: bytes, msgs: list[ExecutionMessage], locks: GraphKeyLocks,
+        self, contract: bytes, msgs: list[ExecutionMessage]
     ) -> list[ExecutionMessage]:
+        """Run/resume executives for `contract`. Outgoing messages carry the
+        context's touched-row set in `key_locks`; the SCHEDULER claims them
+        against its lock graph (the reference ships key locks on
+        ExecutionMessages the same way — DmcExecutor.cpp; the shard itself
+        never sees the graph, which is what lets it live in another
+        process)."""
         out: list[ExecutionMessage] = []
         block = self.executor._block
         assert block is not None, "next_block_header first"
@@ -218,7 +301,7 @@ class ExecutorShard:
                 out.extend(
                     self._settle(
                         parked.start_msg, parked.storage, parked.executive,
-                        state, payload, locks,
+                        state, payload,
                     )
                 )
             else:
@@ -253,19 +336,16 @@ class ExecutorShard:
                     seq_start=m.seq, abi=m.abi, is_local=self.owns,
                 )
                 state, payload = ex.step(None)
-                out.extend(self._settle(m, storage, ex, state, payload, locks))
+                out.extend(self._settle(m, storage, ex, state, payload))
         return out
 
     def _settle(
         self, start: ExecutionMessage, storage: TrackingStorage, executive,
-        state: str, payload, locks: GraphKeyLocks,
+        state: str, payload,
     ) -> list[ExecutionMessage]:
         ctx = start.context_id
         if state == "external":
             req: EVMCall = payload
-            # claim the rows touched so far; a conflict aborts the context
-            if not self._claim(ctx, storage, locks):
-                return [ExecutionMessage(type=MsgType.TXHASH, context_id=ctx)]
             seq = self._alloc_seq(ctx)
             self.parked[(ctx, seq)] = _Parked(executive, storage, start, seq)
             return [
@@ -287,10 +367,9 @@ class ExecutorShard:
                 )
             ]
         # done (top-level or migrated sub-call); commit is the scheduler's
-        # job once the TOP frame settles — nothing merges here
+        # job once the TOP frame settles — nothing merges here. Successful
+        # frames ship their touched-row claims for the scheduler to acquire.
         res: EVMResult = payload
-        if res.ok and not self._claim(ctx, storage, locks):
-            return [ExecutionMessage(type=MsgType.TXHASH, context_id=ctx)]
         return [
             ExecutionMessage(
                 type=MsgType.FINISHED if res.ok else MsgType.REVERT,
@@ -308,20 +387,10 @@ class ExecutorShard:
                     0,
                 ),
                 logs=res.logs,
+                key_locks=sorted(storage.touched) if res.ok else [],
                 create_address=res.create_address,
             )
         ]
-
-    def _claim(self, ctx: int, storage: TrackingStorage, locks: GraphKeyLocks) -> bool:
-        """Claim every touched row. On conflict the context keeps the locks
-        it already holds (from pre-conflict progress) and `acquire` records
-        the wait-for edge — that is what lets genuine cross-shard lock cycles
-        form and reach the deadlock detector, exactly like the reference's
-        held-until-commit key locks (GraphKeyLocks.cpp)."""
-        for key in sorted(storage.touched):
-            if not locks.acquire(ctx, key):
-                return False
-        return True
 
 
 class DmcExecutor:
@@ -335,15 +404,16 @@ class DmcExecutor:
     def schedule_in(self, msg: ExecutionMessage) -> None:
         self.pool.append(msg)
 
-    def go(self, recorder: DmcStepRecorder, locks: GraphKeyLocks) -> list[ExecutionMessage]:
+    def go(self, recorder: DmcStepRecorder) -> list[ExecutionMessage]:
         """Execute everything pending for this contract; returns results
-        (FINISHED/REVERT), migrated requests (MESSAGE) and retries."""
+        (FINISHED/REVERT) and migrated requests (MESSAGE), each carrying its
+        context's key-lock claims for the scheduler to acquire."""
         msgs, self.pool = self.pool, []
         if not msgs:
             return []
         msgs.sort(key=lambda m: (m.context_id, m.seq))  # determinism
         recorder.record_send(msgs)
-        results = self.shard.execute(self.contract, msgs, locks)
+        results = self.shard.execute(self.contract, msgs)
         recorder.record_recv(results)
         return results
 
@@ -376,7 +446,7 @@ class DMCScheduler:
             if contract not in dmc:
                 shard = self.shard_of(contract)
                 self._shards.add(shard)
-                shard.executor.align_contexts(getattr(self, "_ctx_end", 0))
+                shard.align(getattr(self, "_ctx_end", 0))
                 dmc[contract] = DmcExecutor(contract, shard)
             return dmc[contract]
 
@@ -403,13 +473,11 @@ class DMCScheduler:
         self.key_locks = GraphKeyLocks()
         # context ids must be block-unique per executor (CREATE addresses
         # hash the contextID — ChecksumAddress.h:83-97): take the highest
-        # floor any participating executor has reached and align them all
-        executors = {self.shard_of(tx.to).executor for tx in txs}
-        base = max(
-            (ex._block.next_ctx if ex._block else 0) for ex in executors
-        )
-        for ex in executors:
-            ex.align_contexts(base + len(txs))
+        # floor any participating shard has reached and align them all
+        shards = {self.shard_of(tx.to) for tx in txs}
+        base = max(s.ctx_floor() for s in shards)
+        for s in shards:
+            s.align(base + len(txs))
         self._ctx_base = base
         self._ctx_end = base + len(txs)
         for i, tx in enumerate(txs):
@@ -434,18 +502,35 @@ class DMCScheduler:
             # genuine lock cycles to form instead of being serialized away
             round_results: list[ExecutionMessage] = []
             for d in sorted(pending, key=lambda d: d.contract):
-                round_results.extend(d.go(self.recorder, self.key_locks))
+                round_results.extend(d.go(self.recorder))
+            # phase 1 — claims. The scheduler owns the lock graph: every
+            # result (pause request or successful completion) carries the
+            # rows its shard reported touched; claim them ALL before any
+            # completion releases. Two contexts of the SAME round touching
+            # the same row must conflict here — claiming and releasing
+            # interleaved would let the later context commit a stale read
+            # (it executed before the earlier one's writes merged). A
+            # conflict restarts the whole context in a later round; the
+            # failed acquire records the wait-for edge feeding the deadlock
+            # detector. (Reference: key locks ship on ExecutionMessages and
+            # DmcExecutor validates them scheduler-side — DmcExecutor.cpp.)
+            conflicted: set[int] = set()
+            for res in round_results:
+                ctx = res.context_id
+                if ctx in reverted or ctx in conflicted:
+                    continue
+                if res.type in (MsgType.MESSAGE, MsgType.FINISHED) and not all(
+                    self.key_locks.acquire(ctx, tuple(k)) for k in res.key_locks
+                ):
+                    conflicted.add(ctx)
+                    self._cancel_everywhere(ctx, dmc)
+                    retry_ctxs.append(ctx)
+            # phase 2 — settle survivors
             for res in round_results:
                     ctx = res.context_id
-                    if ctx in reverted:
+                    if ctx in reverted or ctx in conflicted:
                         continue
-                    if res.type == MsgType.TXHASH:
-                        # lock conflict: whole-context restart in a later
-                        # round (waiting edge already recorded for deadlock
-                        # detection)
-                        self._cancel_everywhere(ctx, dmc)
-                        retry_ctxs.append(ctx)
-                    elif res.type in (MsgType.FINISHED, MsgType.REVERT):
+                    if res.type in (MsgType.FINISHED, MsgType.REVERT):
                         if res.to_addr == b"" and res.seq == 0:
                             # top-level settled: commit/discard atomically
                             # across every shard, then release locks

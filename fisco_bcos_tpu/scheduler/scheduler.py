@@ -168,13 +168,15 @@ class Scheduler:
     def commit_block(self, header: BlockHeader) -> None:
         with self._lock:
             committed = self._commit_block_locked(header)
-        # listeners run on the notify worker, never on the caller's thread:
-        # the caller is the PBFT engine holding its own RLock, so a blocking
-        # sendall to a stalled ws client here would freeze consensus
-        if committed is not None:
-            number, block = committed
-            for cb in list(self.on_committed):
-                self._notify.post(lambda cb=cb: cb(number, block))
+            # listeners run on the notify worker, never on the caller's
+            # thread: the caller is the PBFT engine holding its own RLock,
+            # so a blocking sendall to a stalled ws client here would freeze
+            # consensus. Posting stays INSIDE the lock (post never blocks)
+            # so two concurrent committers cannot enqueue out of order.
+            if committed is not None:
+                number, block = committed
+                for cb in list(self.on_committed):
+                    self._notify.post(lambda cb=cb: cb(number, block))
 
     def _commit_block_locked(self, header: BlockHeader) -> None:
         number = header.number

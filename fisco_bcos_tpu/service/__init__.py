@@ -10,13 +10,14 @@ the storage backend (StorageService/RemoteStorage — StorageService
 servant analog).
 """
 
-from .executor_service import ExecutorService, RemoteExecutor
+from .executor_service import ExecutorService, RemoteExecutor, RemoteShard
 from .rpc import ServiceClient, ServiceServer
 from .storage_service import RemoteStorage, StorageService
 
 __all__ = [
     "ExecutorService",
     "RemoteExecutor",
+    "RemoteShard",
     "RemoteStorage",
     "ServiceClient",
     "ServiceServer",

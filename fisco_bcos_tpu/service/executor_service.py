@@ -7,10 +7,13 @@ executor behind tars RPC; discovered/driven by TarsRemoteExecutorManager).
 next_block_header / execute_transactions / dag_execute_transactions /
 get_hash / call / 2PC all cross the wire as flat-coded protocol objects.
 
-Scope note (documented deviation): DMC cross-shard *message migration*
-stays in-process (scheduler/dmc.py); the service split covers the serial +
-DAG execution path — the reference's multi-machine DMC rides the same
-servant with ExecutionMessage IDLs.
+The same servant carries the DMC cross-shard protocol — the reference's
+multi-machine DMC ("DMC的多机拓展"): `dmc_execute` moves ExecutionMessage
+batches (bcos-scheduler/src/DmcExecutor.cpp:239 dmcExecuteTransactions over
+Tars), and `RemoteShard` is a drop-in for the DMCScheduler's shard seam, so
+cross-contract calls pause, migrate BETWEEN PROCESSES, and resume — with the
+scheduler-side lock graph and deadlock revert unchanged (key-lock claims
+ride the messages).
 """
 
 from __future__ import annotations
@@ -19,6 +22,7 @@ from ..codec.flat import FlatReader, FlatWriter
 from ..protocol.block_header import BlockHeader
 from ..protocol.receipt import TransactionReceipt
 from ..protocol.transaction import Transaction
+from ..scheduler.dmc import ExecutorShard, decode_messages, encode_messages
 from ..storage.entry import Entry
 from ..storage.interfaces import StorageInterface, TwoPCParams
 from .rpc import ServiceClient, ServiceServer
@@ -38,8 +42,11 @@ def _decode_receipts(buf: bytes) -> list[TransactionReceipt]:
 
 
 class ExecutorService:
-    def __init__(self, executor, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, executor, host: str = "127.0.0.1", port: int = 0, name: str = "executor0"
+    ):
         self.executor = executor
+        self.shard = ExecutorShard(executor, name)
         self.server = ServiceServer("executor", host, port)
         s = self.server
         s.register("next_block_header", self._next_block_header)
@@ -52,6 +59,14 @@ class ExecutorService:
         s.register("prepare", self._prepare)
         s.register("commit", self._commit)
         s.register("rollback", self._rollback)
+        # DMC cross-process protocol (DmcExecutor.cpp over the wire)
+        s.register("dmc_execute", self._dmc_execute)
+        s.register("dmc_cancel", self._dmc_cancel)
+        s.register("dmc_commit_ctx", self._dmc_commit_ctx)
+        s.register("dmc_set_ownership", self._dmc_set_ownership)
+        s.register("ctx_floor", self._ctx_floor)
+        s.register("align", self._align)
+        s.register("get_storage", self._get_storage)
         self.host, self.port = s.host, s.port
 
     def start(self) -> None:
@@ -136,6 +151,76 @@ class ExecutorService:
         self.executor.rollback(TwoPCParams(number=number))
         return b""
 
+    # -- DMC handlers ---------------------------------------------------------
+
+    def _dmc_execute(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        contract = r.bytes_()
+        msgs = decode_messages(r.bytes_())
+        r.done()
+        return encode_messages(self.shard.execute(contract, msgs))
+
+    def _dmc_cancel(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        ctx = r.u64()
+        r.done()
+        self.shard.cancel_context(ctx)
+        return b""
+
+    def _dmc_commit_ctx(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        ctx = r.u64()
+        r.done()
+        self.shard.commit_context(ctx)
+        return b""
+
+    def _dmc_set_ownership(self, payload: bytes) -> bytes:
+        """Configure which contracts live on this shard: mode 'only' (own
+        exactly these addresses) or 'except' (own everything else). The
+        reference derives this from the scheduler's contract->executor
+        registry (TarsRemoteExecutorManager); here the scheduler pushes it."""
+        r = FlatReader(payload)
+        mode = r.str_()
+        addrs = set(r.seq(lambda r2: r2.bytes_()))
+        r.done()
+        if mode == "only":
+            self.shard.owns = lambda c: c in addrs
+        elif mode == "except":
+            self.shard.owns = lambda c: c not in addrs
+        else:
+            raise ValueError(f"unknown ownership mode {mode!r}")
+        return b""
+
+    def _ctx_floor(self, payload: bytes) -> bytes:
+        w = FlatWriter()
+        w.u64(self.shard.ctx_floor())
+        return w.out()
+
+    def _align(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        upto = r.u64()
+        r.done()
+        self.shard.align(upto)
+        return b""
+
+    def _get_storage(self, payload: bytes) -> bytes:
+        """Read one row from the current block state (ops/debug surface —
+        the reference exposes the same via its storage service getRow)."""
+        r = FlatReader(payload)
+        table = r.str_()
+        key = r.bytes_()
+        r.done()
+        block = self.executor._block
+        store = block.storage if block is not None else self.executor.storage
+        entry = store.get_row(table, key)
+        w = FlatWriter()
+        if entry is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            w.bytes_(entry.encode())
+        return w.out()
+
 
 class RemoteExecutor:
     """The scheduler-facing executor seam, over the wire
@@ -197,6 +282,68 @@ class RemoteExecutor:
         w = FlatWriter()
         w.u64(params.number)
         self.client.call("rollback", w.out())
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class RemoteShard:
+    """DMCScheduler-facing shard seam over the wire: drop-in for
+    scheduler.dmc.ExecutorShard, so the round loop pauses/migrates/resumes
+    executives across OS processes (TarsRemoteExecutorManager +
+    DmcExecutor::go over Tars). One RemoteShard per remote executor
+    process; `set_ownership` pushes the contract->shard mapping down so the
+    remote EVM knows which callees are local (inline sub-call) vs foreign
+    (pause + migrate)."""
+
+    def __init__(self, host: str, port: int, name: str, timeout: float = 300.0):
+        self.name = name
+        self.client = ServiceClient(host, port, timeout)
+
+    def set_ownership(self, mode: str, addrs: list[bytes]) -> None:
+        w = FlatWriter()
+        w.str_(mode)
+        w.seq(addrs, lambda w2, a: w2.bytes_(a))
+        self.client.call("dmc_set_ownership", w.out())
+
+    def execute(self, contract: bytes, msgs: list) -> list:
+        w = FlatWriter()
+        w.bytes_(contract)
+        w.bytes_(encode_messages(msgs))
+        return decode_messages(self.client.call("dmc_execute", w.out()))
+
+    def cancel_context(self, ctx: int) -> None:
+        w = FlatWriter()
+        w.u64(ctx)
+        self.client.call("dmc_cancel", w.out())
+
+    def commit_context(self, ctx: int) -> None:
+        w = FlatWriter()
+        w.u64(ctx)
+        self.client.call("dmc_commit_ctx", w.out())
+
+    def ctx_floor(self) -> int:
+        r = FlatReader(self.client.call("ctx_floor"))
+        v = r.u64()
+        r.done()
+        return v
+
+    def align(self, upto: int) -> None:
+        w = FlatWriter()
+        w.u64(upto)
+        self.client.call("align", w.out())
+
+    def get_storage(self, table: str, key: bytes):
+        w = FlatWriter()
+        w.str_(table)
+        w.bytes_(key)
+        resp = FlatReader(self.client.call("get_storage", w.out()))
+        if not resp.u8():
+            resp.done()
+            return None
+        raw = resp.bytes_()
+        resp.done()
+        return Entry.decode(raw)
 
     def close(self) -> None:
         self.client.close()
