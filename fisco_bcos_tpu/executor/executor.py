@@ -54,6 +54,7 @@ from .precompiled.base import (
     PrecompiledCallContext,
     PrecompiledError,
 )
+from .wasm import WASM_MAGIC, wasm_deploy, wasm_interpret
 
 _log = get_logger("executor")
 
@@ -83,11 +84,16 @@ class TransactionExecutor:
         backend: TransactionalStorage,
         suite: CryptoSuite,
         registry: dict[bytes, Precompiled] | None = None,
+        is_wasm: bool = False,
     ):
         self.backend = backend
         self.suite = suite
         self.codec = ABICodec(suite.hash)
         self.registry = registry if registry is not None else default_registry()
+        # chain VM type from the genesis `is_wasm` flag (the reference gates
+        # its dual-VM per chain — TransactionExecutive blockContext().isWasm()):
+        # a wasm chain deploys only wasm modules, an EVM chain only EVM code
+        self.is_wasm = is_wasm
         self._block: BlockContext | None = None
 
     # -- block lifecycle (nextBlockHeader:334 / getHash:1017) ---------------
@@ -447,11 +453,26 @@ class Executive:
                 return EVMResult(
                     status=int(TransactionStatus.CONTRACT_ADDRESS_ALREADY_USED)
                 )
+            deploying_wasm = msg.data[:4] == WASM_MAGIC
+            if deploying_wasm != self.ex.is_wasm:
+                # the chain's VM type is a genesis-time decision; mixed
+                # deploys are rejected like the reference's isWasm gate
+                return EVMResult(
+                    status=int(TransactionStatus.WASM_VALIDATION_FAILURE),
+                    output=(
+                        b"wasm deploy on an EVM chain"
+                        if deploying_wasm
+                        else b"EVM deploy on a wasm chain"
+                    ),
+                )
             run_msg = EVMCall(
                 kind="call", sender=msg.sender, to=addr, code_address=addr,
                 data=b"", gas=msg.gas, value=msg.value, depth=msg.depth,
             )
-            gen = interpret(host, run_msg, msg.data)
+            if deploying_wasm:
+                gen = wasm_deploy(host, run_msg, msg.data)
+            else:
+                gen = interpret(host, run_msg, msg.data)
             self.frames.append(_ExecFrame(gen, overlay, msg, addr, abi))
             return None
         builtin = self.ex._builtin_precompile(msg)
@@ -470,7 +491,10 @@ class Executive:
             # call to codeless address succeeds with empty output (EVM rule);
             # top-level txs to unknown addresses are rejected by execute()
             return EVMResult(status=0, output=b"", gas_left=msg.gas)
-        gen = interpret(host, msg, code)
+        if code[:4] == WASM_MAGIC:
+            gen = wasm_interpret(host, msg, code)
+        else:
+            gen = interpret(host, msg, code)
         self.frames.append(_ExecFrame(gen, overlay, msg))
         return None
 

@@ -1,0 +1,859 @@
+"""WASM execution engine — the framework's second VM.
+
+Reference: the reference executor is dual-VM — EVM (evmone) plus BCOS-WASM
+"liquid" contracts (bcos-executor/src/vm/gas_meter/GasInjector.cpp bytecode
+gas metering, bcos-executor/src/executive/TransactionExecutive.cpp's
+`blockContext().isWasm()` chains, SCALE-parameterized entry points). This
+module is a deterministic WASM-MVP-subset interpreter with the same
+contract conventions:
+
+- a module exports ``deploy`` (constructor) and ``main`` (entry), plus its
+  linear ``memory``;
+- host functions import from module ``bcos`` (the reference's HostApi):
+  call-data access, byte-keyed contract storage, finish/revert, caller/
+  address introspection, cross-contract ``call`` (which pauses the
+  executive exactly like an EVM external call — wasm frames migrate across
+  DMC shards the same way), logging and explicit ``useGas``;
+- parameters are SCALE-coded (codec/scale.py) — fixed-width little-endian
+  ints, compact vectors — matching the reference's ScaleEncoderStream;
+- gas is metered deterministically at bytecode level from a per-opcode
+  schedule (the reference's GasInjector rewrites modules to insert
+  ``useGas`` at basic-block starts; an interpreter charges the identical
+  schedule at dispatch time, which is the same deterministic function of
+  the executed instruction trace — documented deviation: no module
+  rewriting pass).
+
+Scope (v0, documented): MVP integer subset — i32/i64 arithmetic, structured
+control flow (block/loop/if/br/br_if/return/call), linear memory with
+load/store and memory.size/grow, globals, data segments. No floats (the
+reference REJECTS float opcodes for determinism — GasInjector.cpp
+InvalidInstruction), no tables/call_indirect, no multi-value blocks.
+
+Storage model: byte-string keys in the same per-contract table the EVM uses
+for its 32-byte slots (executor/evm.py contract_table) — liquid contracts
+key storage by arbitrary strings, so the namespaces never collide.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from ..protocol.receipt import LogEntry, TransactionStatus
+from .evm import EVMCall, EVMResult, contract_table
+
+WASM_MAGIC = b"\x00asm"
+
+PAGE = 65536
+MAX_PAGES = 256  # 16 MiB linear-memory cap
+MAX_STACK = 4096
+MAX_FRAMES = 256
+
+
+class WasmError(Exception):
+    pass
+
+
+class _Trap(WasmError):
+    def __init__(self, status: TransactionStatus, msg: str = ""):
+        super().__init__(msg)
+        self.status = status
+
+
+class _Finish(Exception):
+    def __init__(self, output: bytes):
+        self.output = output
+
+
+class _Revert(Exception):
+    def __init__(self, output: bytes):
+        self.output = output
+
+
+# ---------------------------------------------------------------------------
+# Gas schedule — deterministic per-opcode costs (GasInjector.cpp's
+# InstructionTable shape: cheap ALU, pricier branches/calls/memory)
+# ---------------------------------------------------------------------------
+
+_GAS_DEFAULT = 1
+_GAS_TABLE = {
+    0x0C: 2, 0x0D: 2, 0x0E: 2, 0x0F: 2,  # br / br_if / br_table / return
+    0x10: 5,                              # call
+    0x28: 3, 0x29: 3, 0x2D: 3,            # loads
+    0x36: 3, 0x37: 3, 0x3A: 3,            # stores
+    0x3F: 2,                              # memory.size
+    0x40: 256,                            # memory.grow (per call, + pages)
+    0x6E: 4, 0x70: 4,                     # i32.div_u / rem_u
+    0x7F: 4, 0x81: 4,                     # i64.div_u / rem_u
+}
+# host-function costs (external API pricing, cf. the EVM-side schedule)
+GAS_STORAGE_SET = 5000
+GAS_STORAGE_GET = 200
+GAS_PER_BYTE = 3
+GAS_LOG = 375
+GAS_CALL = 2600
+
+
+# ---------------------------------------------------------------------------
+# Binary decoding
+# ---------------------------------------------------------------------------
+
+
+def _leb_u(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        if pos >= len(buf):
+            raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "truncated leb")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "leb overflow")
+
+
+def _leb_s(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        if pos >= len(buf):
+            raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "truncated leb")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            if shift < 64 and b & 0x40:
+                result |= -(1 << shift)
+            return result, pos
+        if shift > 63:
+            raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "leb overflow")
+
+
+@dataclass
+class _FuncType:
+    params: int
+    results: int
+
+
+@dataclass
+class _Function:
+    type_idx: int
+    locals_count: int = 0
+    code: list = field(default_factory=list)  # [(op, imm)]
+    ctrl: dict = field(default_factory=dict)  # idx of block/loop/if -> (end, else)
+
+
+# opcodes with a single u32-leb immediate
+_U32_IMM = {0x0C, 0x0D, 0x10, 0x20, 0x21, 0x22, 0x23, 0x24}
+_NO_IMM = {
+    0x00, 0x01, 0x05, 0x0B, 0x0F, 0x1A, 0x1B,
+    0x45, 0x46, 0x47, 0x48, 0x49, 0x4A, 0x4B, 0x4C, 0x4D, 0x4E, 0x4F,
+    0x50, 0x51, 0x52, 0x53, 0x54, 0x55, 0x56, 0x57, 0x58, 0x59, 0x5A,
+    0x67, 0x68, 0x69, 0x6A, 0x6B, 0x6C, 0x6D, 0x6E, 0x6F, 0x70, 0x71,
+    0x72, 0x73, 0x74, 0x75, 0x76, 0x77, 0x78,
+    0x7C, 0x7D, 0x7E, 0x7F, 0x80, 0x81, 0x82, 0x83, 0x84, 0x85, 0x86,
+    0x87, 0x88, 0x89, 0x8A,
+    0xA7, 0xAC, 0xAD,
+}
+
+
+def _decode_body(buf: bytes, pos: int, end: int) -> list:
+    """Decode one code body into [(op, imm)] (no floats — rejected like the
+    reference's GasInjector InvalidInstruction path)."""
+    out = []
+    while pos < end:
+        op = buf[pos]
+        pos += 1
+        if op in _NO_IMM:
+            out.append((op, None))
+        elif op in _U32_IMM:
+            v, pos = _leb_u(buf, pos)
+            out.append((op, v))
+        elif op in (0x02, 0x03, 0x04):  # block/loop/if: blocktype byte
+            bt, pos = _leb_s(buf, pos)
+            out.append((op, bt))
+        elif op == 0x0E:  # br_table
+            n, pos = _leb_u(buf, pos)
+            targets = []
+            for _ in range(n):
+                t, pos = _leb_u(buf, pos)
+                targets.append(t)
+            d, pos = _leb_u(buf, pos)
+            out.append((op, (targets, d)))
+        elif op == 0x41:  # i32.const
+            v, pos = _leb_s(buf, pos)
+            out.append((op, v & 0xFFFFFFFF))
+        elif op == 0x42:  # i64.const
+            v, pos = _leb_s(buf, pos)
+            out.append((op, v & 0xFFFFFFFFFFFFFFFF))
+        elif op in (0x28, 0x29, 0x2D, 0x36, 0x37, 0x3A):  # load/store: align+offset
+            _a, pos = _leb_u(buf, pos)
+            off, pos = _leb_u(buf, pos)
+            out.append((op, off))
+        elif op in (0x3F, 0x40):  # memory.size/grow: reserved byte
+            _r, pos = _leb_u(buf, pos)
+            out.append((op, None))
+        else:
+            raise _Trap(
+                TransactionStatus.WASM_VALIDATION_FAILURE,
+                f"unsupported opcode 0x{op:02x}",
+            )
+    return out
+
+
+def _match_ctrl(code: list) -> dict:
+    """idx of block/loop/if -> (end_idx, else_idx|None)."""
+    ctrl: dict = {}
+    stack: list[int] = []
+    elses: dict[int, int] = {}
+    for i, (op, _imm) in enumerate(code):
+        if op in (0x02, 0x03, 0x04):
+            stack.append(i)
+        elif op == 0x05:  # else
+            if not stack:
+                raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "stray else")
+            elses[stack[-1]] = i
+        elif op == 0x0B:  # end
+            if stack:
+                start = stack.pop()
+                ctrl[start] = (i, elses.get(start))
+    if stack:
+        raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "unbalanced blocks")
+    return ctrl
+
+
+class WasmModule:
+    """Parsed module: types, imports, functions, memory, globals, exports,
+    data segments."""
+
+    def __init__(self, binary: bytes):
+        if binary[:4] != WASM_MAGIC or binary[4:8] != b"\x01\x00\x00\x00":
+            raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "bad magic")
+        self.types: list[_FuncType] = []
+        self.imports: list[tuple[str, str, int]] = []  # (module, name, type_idx)
+        self.functions: list[_Function] = []
+        self.mem_min = 1
+        self.mem_max = MAX_PAGES
+        self.globals: list[int] = []
+        self.exports: dict[str, tuple[int, int]] = {}  # name -> (kind, idx)
+        self.data: list[tuple[int, bytes]] = []
+        pos = 8
+        func_types: list[int] = []
+        while pos < len(binary):
+            sec = binary[pos]
+            pos += 1
+            size, pos = _leb_u(binary, pos)
+            body_end = pos + size
+            if sec == 1:  # types
+                n, pos = _leb_u(binary, pos)
+                for _ in range(n):
+                    if binary[pos] != 0x60:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE, "bad functype"
+                        )
+                    pos += 1
+                    np, pos = _leb_u(binary, pos)
+                    pos += np  # param valtypes (ints only; widths unchecked)
+                    nr, pos = _leb_u(binary, pos)
+                    pos += nr
+                    if nr > 1:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "multi-value unsupported",
+                        )
+                    self.types.append(_FuncType(np, nr))
+            elif sec == 2:  # imports
+                n, pos = _leb_u(binary, pos)
+                for _ in range(n):
+                    ml, pos = _leb_u(binary, pos)
+                    mod = binary[pos : pos + ml].decode()
+                    pos += ml
+                    nl, pos = _leb_u(binary, pos)
+                    name = binary[pos : pos + nl].decode()
+                    pos += nl
+                    kind = binary[pos]
+                    pos += 1
+                    if kind != 0:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "only function imports supported",
+                        )
+                    ti, pos = _leb_u(binary, pos)
+                    self.imports.append((mod, name, ti))
+            elif sec == 3:  # function (type indexes)
+                n, pos = _leb_u(binary, pos)
+                for _ in range(n):
+                    ti, pos = _leb_u(binary, pos)
+                    func_types.append(ti)
+            elif sec == 5:  # memory
+                n, pos = _leb_u(binary, pos)
+                if n:
+                    flags, pos = _leb_u(binary, pos)
+                    self.mem_min, pos = _leb_u(binary, pos)
+                    if flags & 1:
+                        self.mem_max, pos = _leb_u(binary, pos)
+                    self.mem_max = min(self.mem_max, MAX_PAGES)
+                    self.mem_min = min(self.mem_min, self.mem_max)
+            elif sec == 6:  # globals — init expr must be a single const
+                n, pos = _leb_u(binary, pos)
+                for _ in range(n):
+                    pos += 2  # valtype + mutability
+                    if binary[pos] not in (0x41, 0x42):
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "global init must be const",
+                        )
+                    wide = binary[pos] == 0x42
+                    val, pos = _leb_s(binary, pos + 1)
+                    if binary[pos] != 0x0B:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "bad global init expr",
+                        )
+                    pos += 1
+                    self.globals.append(val & (_M64 if wide else _M32))
+            elif sec == 7:  # exports
+                n, pos = _leb_u(binary, pos)
+                for _ in range(n):
+                    nl, pos = _leb_u(binary, pos)
+                    name = binary[pos : pos + nl].decode()
+                    pos += nl
+                    kind = binary[pos]
+                    pos += 1
+                    idx, pos = _leb_u(binary, pos)
+                    self.exports[name] = (kind, idx)
+            elif sec == 10:  # code
+                n, pos = _leb_u(binary, pos)
+                for fi in range(n):
+                    sz, pos = _leb_u(binary, pos)
+                    fend = pos + sz
+                    nloc, pos = _leb_u(binary, pos)
+                    locals_count = 0
+                    for _ in range(nloc):
+                        cnt, pos = _leb_u(binary, pos)
+                        pos += 1  # valtype
+                        locals_count += cnt
+                    code = _decode_body(binary, pos, fend)
+                    fn = _Function(func_types[fi], locals_count, code)
+                    fn.ctrl = _match_ctrl(code)
+                    self.functions.append(fn)
+                    pos = fend
+            elif sec == 11:  # data
+                n, pos = _leb_u(binary, pos)
+                for _ in range(n):
+                    _mi, pos = _leb_u(binary, pos)
+                    # offset expr: i32.const N end
+                    if binary[pos] != 0x41:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "data offset must be i32.const",
+                        )
+                    off, pos = _leb_s(binary, pos + 1)
+                    if binary[pos] != 0x0B:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE, "bad data expr"
+                        )
+                    pos += 1
+                    ln, pos = _leb_u(binary, pos)
+                    self.data.append((off, binary[pos : pos + ln]))
+                    pos += ln
+            pos = body_end
+        self.n_imports = len(self.imports)
+
+    def func_type(self, func_idx: int) -> _FuncType:
+        if func_idx < self.n_imports:
+            return self.types[self.imports[func_idx][2]]
+        return self.types[self.functions[func_idx - self.n_imports].type_idx]
+
+
+_M32 = 0xFFFFFFFF
+_M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _s32(v: int) -> int:
+    return v - (1 << 32) if v & (1 << 31) else v
+
+
+def _s64(v: int) -> int:
+    return v - (1 << 64) if v & (1 << 63) else v
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """WASM signed division truncates toward zero (Python // floors)."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
+def _trunc_rem(a: int, b: int) -> int:
+    return a - _trunc_div(a, b) * b
+
+
+def _compare(rel: int, a: int, b: int, signed) -> bool:
+    """rel: eq ne lt_s lt_u gt_s gt_u le_s le_u ge_s ge_u (wasm order)."""
+    sa, sb = signed(a), signed(b)
+    return (
+        a == b, a != b, sa < sb, a < b, sa > sb,
+        a > b, sa <= sb, a <= b, sa >= sb, a >= b,
+    )[rel]
+
+
+def _binop(idx: int, a: int, b: int, bits: int, signed) -> int:
+    """idx: add sub mul div_s div_u rem_s rem_u and or xor shl shr_s shr_u
+    rotl rotr (the shared i32/i64 binary-op order)."""
+    if idx == 0:
+        return a + b
+    if idx == 1:
+        return a - b
+    if idx == 2:
+        return a * b
+    if idx in (3, 4, 5, 6):
+        if b == 0:
+            raise _Trap(TransactionStatus.WASM_TRAP, "div by zero")
+        if idx == 3:
+            if signed(a) == -(1 << (bits - 1)) and signed(b) == -1:
+                # INT_MIN / -1 traps per spec (reference engines agree)
+                raise _Trap(TransactionStatus.WASM_TRAP, "integer overflow")
+            return _trunc_div(signed(a), signed(b))
+        if idx == 4:
+            return a // b
+        if idx == 5:
+            return _trunc_rem(signed(a), signed(b))
+        return a % b
+    if idx == 7:
+        return a & b
+    if idx == 8:
+        return a | b
+    if idx == 9:
+        return a ^ b
+    s = b % bits
+    if idx == 10:
+        return a << s
+    if idx == 11:
+        return signed(a) >> s
+    if idx == 12:
+        return a >> s
+    if idx == 13:
+        return (a << s) | (a >> (bits - s)) if s else a
+    if idx == 14:
+        return (a >> s) | (a << (bits - s)) if s else a
+    raise _Trap(TransactionStatus.WASM_VALIDATION_FAILURE, "bad binop")
+
+
+class WasmInstance:
+    """One instantiated module: linear memory + globals + a gas meter.
+    ``invoke`` is a generator — host functions that reach outside the shard
+    (cross-contract call) yield an EVMCall and resume with the EVMResult,
+    the same pause protocol as the EVM interpreter."""
+
+    def __init__(self, module: WasmModule, host_funcs: dict, gas: int):
+        self.m = module
+        self.mem = bytearray(module.mem_min * PAGE)
+        for off, data in module.data:
+            if off + len(data) > len(self.mem):
+                raise _Trap(
+                    TransactionStatus.WASM_ARGUMENT_OUT_OF_RANGE, "data segment OOB"
+                )
+            self.mem[off : off + len(data)] = data
+        self.globals = list(module.globals)
+        self.host_funcs = host_funcs
+        self.gas = gas
+
+    # -- gas / memory ----------------------------------------------------
+
+    def use_gas(self, n: int) -> None:
+        self.gas -= n
+        if self.gas < 0:
+            raise _Trap(TransactionStatus.OUT_OF_GAS, "out of gas")
+
+    def mread(self, ptr: int, n: int) -> bytes:
+        if ptr < 0 or n < 0 or ptr + n > len(self.mem):
+            raise _Trap(TransactionStatus.WASM_ARGUMENT_OUT_OF_RANGE, "read OOB")
+        return bytes(self.mem[ptr : ptr + n])
+
+    def mwrite(self, ptr: int, data: bytes) -> None:
+        if ptr < 0 or ptr + len(data) > len(self.mem):
+            raise _Trap(TransactionStatus.WASM_ARGUMENT_OUT_OF_RANGE, "write OOB")
+        self.mem[ptr : ptr + len(data)] = data
+
+    # -- execution -------------------------------------------------------
+
+    def invoke(self, name: str, args: list[int]):
+        exp = self.m.exports.get(name)
+        if exp is None or exp[0] != 0:
+            raise _Trap(
+                TransactionStatus.WASM_VALIDATION_FAILURE, f"no export {name!r}"
+            )
+        return (yield from self._call_func(exp[1], args, depth=0))
+
+    def _call_func(self, func_idx: int, args: list[int], depth: int):
+        if depth > MAX_FRAMES:
+            raise _Trap(TransactionStatus.OUT_OF_STACK, "call depth")
+        if func_idx < self.m.n_imports:
+            mod, name, _ti = self.m.imports[func_idx]
+            fn = self.host_funcs.get(name)
+            if fn is None:
+                raise _Trap(
+                    TransactionStatus.WASM_VALIDATION_FAILURE,
+                    f"unknown import {mod}.{name}",
+                )
+            res = fn(*args)
+            if hasattr(res, "send"):  # generator host fn (external call)
+                res = yield from res
+            return res
+        fn = self.m.functions[func_idx - self.m.n_imports]
+        ftype = self.m.types[fn.type_idx]
+        locals_ = list(args) + [0] * fn.locals_count
+        stack: list[int] = []
+        ctrl: list[tuple[int, int]] = []  # (kind_op, start_idx)
+        code = fn.code
+        pc = 0
+
+        def branch(depth_: int) -> int | None:
+            """New pc for `br depth_`; None = branch to the implicit
+            function label (equivalent to return)."""
+            if depth_ == len(ctrl):
+                return None
+            if depth_ > len(ctrl):
+                raise _Trap(TransactionStatus.WASM_TRAP, "branch depth")
+            for _ in range(depth_):
+                ctrl.pop()
+            kind, start = ctrl[-1]
+            if kind == 0x03:  # loop: back to just after the loop opcode
+                return start + 1
+            end_idx, _e = fn.ctrl[start]
+            ctrl.pop()
+            return end_idx + 1
+
+        while pc < len(code):
+            op, imm = code[pc]
+            self.use_gas(_GAS_TABLE.get(op, _GAS_DEFAULT))
+            if len(stack) > MAX_STACK:
+                raise _Trap(TransactionStatus.OUT_OF_STACK, "value stack")
+            if op == 0x00:  # unreachable
+                raise _Trap(
+                    TransactionStatus.WASM_UNREACHABLE_INSTRUCTION, "unreachable"
+                )
+            elif op in (0x01,):  # nop
+                pass
+            elif op in (0x02, 0x03):  # block / loop
+                ctrl.append((op, pc))
+            elif op == 0x04:  # if
+                cond = stack.pop()
+                end_idx, else_idx = fn.ctrl[pc]
+                if cond:
+                    ctrl.append((op, pc))
+                elif else_idx is not None:
+                    ctrl.append((op, pc))
+                    pc = else_idx  # fall into else arm
+                else:
+                    pc = end_idx  # skip block; its end pops nothing
+            elif op == 0x05:  # else reached from the true arm: skip to end
+                end_idx, _e = fn.ctrl[ctrl[-1][1]]
+                ctrl.pop()
+                pc = end_idx
+            elif op == 0x0B:  # end
+                if ctrl:
+                    ctrl.pop()
+            elif op == 0x0C:  # br
+                pc = branch(imm)
+                if pc is None:
+                    return stack[-1] if ftype.results and stack else None
+                continue
+            elif op == 0x0D:  # br_if
+                if stack.pop():
+                    pc = branch(imm)
+                    if pc is None:
+                        return stack[-1] if ftype.results and stack else None
+                    continue
+            elif op == 0x0E:  # br_table
+                targets, default = imm
+                i = stack.pop()
+                pc = branch(targets[i] if i < len(targets) else default)
+                if pc is None:
+                    return stack[-1] if ftype.results and stack else None
+                continue
+            elif op == 0x0F:  # return
+                return stack[-1] if ftype.results and stack else None
+            elif op == 0x10:  # call
+                callee_t = self.m.func_type(imm)
+                if callee_t.params > len(stack):
+                    raise _Trap(TransactionStatus.STACK_UNDERFLOW, "call args")
+                cargs = stack[len(stack) - callee_t.params :]
+                del stack[len(stack) - callee_t.params :]
+                r = yield from self._call_func(imm, cargs, depth + 1)
+                if callee_t.results:
+                    stack.append((r or 0) & _M64)
+            elif op == 0x1A:  # drop
+                stack.pop()
+            elif op == 0x1B:  # select
+                c, b, a = stack.pop(), stack.pop(), stack.pop()
+                stack.append(a if c else b)
+            elif op == 0x20:  # local.get
+                stack.append(locals_[imm])
+            elif op == 0x21:  # local.set
+                locals_[imm] = stack.pop()
+            elif op == 0x22:  # local.tee
+                locals_[imm] = stack[-1]
+            elif op == 0x23:  # global.get
+                stack.append(self.globals[imm])
+            elif op == 0x24:  # global.set
+                self.globals[imm] = stack.pop()
+            elif op == 0x28:  # i32.load
+                ptr = stack.pop()
+                stack.append(
+                    struct.unpack("<I", self.mread((ptr + imm) & _M32, 4))[0]
+                )
+            elif op == 0x29:  # i64.load
+                ptr = stack.pop()
+                stack.append(
+                    struct.unpack("<Q", self.mread((ptr + imm) & _M32, 8))[0]
+                )
+            elif op == 0x2D:  # i32.load8_u
+                ptr = stack.pop()
+                stack.append(self.mread((ptr + imm) & _M32, 1)[0])
+            elif op == 0x36:  # i32.store
+                v, ptr = stack.pop(), stack.pop()
+                self.mwrite((ptr + imm) & _M32, struct.pack("<I", v & _M32))
+            elif op == 0x37:  # i64.store
+                v, ptr = stack.pop(), stack.pop()
+                self.mwrite((ptr + imm) & _M32, struct.pack("<Q", v & _M64))
+            elif op == 0x3A:  # i32.store8
+                v, ptr = stack.pop(), stack.pop()
+                self.mwrite((ptr + imm) & _M32, bytes([v & 0xFF]))
+            elif op == 0x3F:  # memory.size
+                stack.append(len(self.mem) // PAGE)
+            elif op == 0x40:  # memory.grow
+                want = stack.pop()
+                cur = len(self.mem) // PAGE
+                if want < 0 or cur + want > self.m.mem_max:
+                    stack.append(_M32)  # -1: grow failed
+                else:
+                    self.use_gas(64 * want)
+                    self.mem.extend(bytes(want * PAGE))
+                    stack.append(cur)
+            elif op == 0x41 or op == 0x42:  # i32/i64.const
+                stack.append(imm)
+            elif op == 0x45:  # i32.eqz
+                stack.append(1 if (stack.pop() & _M32) == 0 else 0)
+            elif 0x46 <= op <= 0x4F:  # i32 comparisons
+                b, a = stack.pop() & _M32, stack.pop() & _M32
+                stack.append(1 if _compare(op - 0x46, a, b, _s32) else 0)
+            elif op == 0x50:  # i64.eqz
+                stack.append(1 if (stack.pop() & _M64) == 0 else 0)
+            elif 0x51 <= op <= 0x5A:  # i64 comparisons
+                b, a = stack.pop() & _M64, stack.pop() & _M64
+                stack.append(1 if _compare(op - 0x51, a, b, _s64) else 0)
+            elif op in (0x67, 0x68, 0x69):  # i32 clz/ctz/popcnt
+                a = stack.pop() & _M32
+                if op == 0x67:
+                    stack.append(32 - a.bit_length() if a else 32)
+                elif op == 0x68:
+                    stack.append((a & -a).bit_length() - 1 if a else 32)
+                else:
+                    stack.append(bin(a).count("1"))
+            elif 0x6A <= op <= 0x78:  # i32 binary arithmetic
+                b, a = stack.pop() & _M32, stack.pop() & _M32
+                stack.append(_binop(op - 0x6A, a, b, 32, _s32) & _M32)
+            elif 0x7C <= op <= 0x8A:  # i64 binary arithmetic
+                b, a = stack.pop() & _M64, stack.pop() & _M64
+                stack.append(_binop(op - 0x7C, a, b, 64, _s64) & _M64)
+            elif op == 0xA7:  # i32.wrap_i64
+                stack.append(stack.pop() & _M32)
+            elif op == 0xAC:  # i64.extend_i32_s
+                stack.append(_s32(stack.pop() & _M32) & _M64)
+            elif op == 0xAD:  # i64.extend_i32_u
+                stack.append(stack.pop() & _M32)
+            else:
+                raise _Trap(
+                    TransactionStatus.WASM_VALIDATION_FAILURE,
+                    f"unhandled opcode 0x{op:02x}",
+                )
+            pc += 1
+        return stack[-1] if ftype.results and stack else None
+
+
+# ---------------------------------------------------------------------------
+# Host interface (the reference's HostApi / EEI surface for BCOS-WASM)
+# ---------------------------------------------------------------------------
+
+
+def _bcos_host(inst_ref: list, host, msg: EVMCall, logs: list, ret_data: list):
+    """Builds the ``bcos`` import table. `inst_ref[0]` is filled with the
+    WasmInstance after construction (host fns need its memory/gas)."""
+
+    def inst() -> WasmInstance:
+        return inst_ref[0]
+
+    def get_call_data_size() -> int:
+        return len(msg.data)
+
+    def get_call_data(ptr: int) -> None:
+        inst().use_gas(GAS_PER_BYTE * len(msg.data))
+        inst().mwrite(ptr, msg.data)
+
+    def set_storage(kp: int, kl: int, vp: int, vl: int) -> None:
+        if msg.static:
+            raise _Trap(TransactionStatus.PERMISSION_DENIED, "store in static call")
+        i = inst()
+        i.use_gas(GAS_STORAGE_SET + GAS_PER_BYTE * (kl + vl))
+        key, val = i.mread(kp, kl), i.mread(vp, vl)
+        from ..storage.entry import Entry
+
+        host.storage.set_row(contract_table(msg.to), key, Entry({"value": val}))
+
+    def get_storage_size(kp: int, kl: int) -> int:
+        i = inst()
+        i.use_gas(GAS_STORAGE_GET)
+        row = host.storage.get_row(contract_table(msg.to), i.mread(kp, kl))
+        return len(row.get()) if row is not None else 0
+
+    def get_storage(kp: int, kl: int, vp: int) -> int:
+        i = inst()
+        i.use_gas(GAS_STORAGE_GET)
+        row = host.storage.get_row(contract_table(msg.to), i.mread(kp, kl))
+        if row is None:
+            return 0
+        val = row.get()
+        i.use_gas(GAS_PER_BYTE * len(val))
+        i.mwrite(vp, val)
+        return len(val)
+
+    def finish(ptr: int, n: int) -> None:
+        raise _Finish(inst().mread(ptr, n))
+
+    def revert(ptr: int, n: int) -> None:
+        raise _Revert(inst().mread(ptr, n))
+
+    def get_caller(ptr: int) -> None:
+        inst().mwrite(ptr, msg.sender.rjust(20, b"\x00")[:20])
+
+    def get_address(ptr: int) -> None:
+        inst().mwrite(ptr, msg.to.rjust(20, b"\x00")[:20])
+
+    def use_gas(n: int) -> None:
+        # explicit metering hook — what GasInjector-instrumented modules
+        # call. Negative amounts would MINT gas and defeat the meter.
+        amount = _s64(n & _M64)
+        if amount < 0:
+            raise _Trap(TransactionStatus.WASM_ARGUMENT_OUT_OF_RANGE, "useGas < 0")
+        inst().use_gas(amount)
+
+    def log_event(dp: int, dl: int, tp: int, tn: int) -> None:
+        if msg.static:  # same read-only rule as the EVM's LOG-in-static
+            raise _Trap(TransactionStatus.PERMISSION_DENIED, "log in static call")
+        i = inst()
+        i.use_gas(GAS_LOG + GAS_PER_BYTE * dl)
+        topics = [i.mread(tp + 32 * k, 32) for k in range(min(tn, 4))]
+        logs.append(LogEntry(address=msg.to, topics=topics, data=i.mread(dp, dl)))
+
+    def call(ap: int, dp: int, dl: int):
+        """Cross-contract call: yields the request out of the interpreter —
+        the Executive parks the wasm frame exactly like an EVM sub-call
+        (DMC migration works unchanged)."""
+        i = inst()
+        i.use_gas(GAS_CALL + GAS_PER_BYTE * dl)
+        addr = i.mread(ap, 20)
+        data = i.mread(dp, dl)
+        # forward all-but-1/64th and charge it NOW; the callee's leftover is
+        # refunded on resume (the EVM interpreter's gas_pass/gas_left
+        # reconciliation) — without this, callee work would be free and a
+        # recursive contract could do depth x budget of metered work
+        gas_pass = i.gas - i.gas // 64
+        i.use_gas(gas_pass)
+        res: EVMResult = yield EVMCall(
+            kind="call",
+            sender=msg.to,
+            to=addr,
+            code_address=addr,
+            data=data,
+            gas=gas_pass,
+            static=msg.static,
+            depth=msg.depth + 1,
+        )
+        i.gas += max(min(res.gas_left, gas_pass), 0)
+        ret_data[0] = res.output
+        logs.extend(res.logs)
+        return 0 if res.ok else 1
+
+    def get_return_data_size() -> int:
+        return len(ret_data[0])
+
+    def get_return_data(ptr: int) -> None:
+        inst().mwrite(ptr, ret_data[0])
+
+    return {
+        "getCallDataSize": get_call_data_size,
+        "getCallData": get_call_data,
+        "setStorage": set_storage,
+        "getStorageSize": get_storage_size,
+        "getStorage": get_storage,
+        "finish": finish,
+        "revert": revert,
+        "getCaller": get_caller,
+        "getAddress": get_address,
+        "useGas": use_gas,
+        "logEvent": log_event,
+        "call": call,
+        "getReturnDataSize": get_return_data_size,
+        "getReturnData": get_return_data,
+    }
+
+
+def _run_export(host, msg: EVMCall, code: bytes, entry: str):
+    """Generator: run one exported entry point to an EVMResult (yielding
+    EVMCalls for cross-contract requests, like executor/evm.py interpret)."""
+    logs: list[LogEntry] = []
+    ret_data = [b""]
+    inst_ref: list = [None]
+    try:
+        module = WasmModule(code)
+        funcs = _bcos_host(inst_ref, host, msg, logs, ret_data)
+        inst = WasmInstance(module, funcs, msg.gas)
+        inst_ref[0] = inst
+        output = b""
+        try:
+            if entry in module.exports:
+                yield from inst.invoke(entry, [])
+        except _Finish as f:
+            output = f.output
+        except _Revert as r:
+            return EVMResult(
+                status=int(TransactionStatus.REVERT_INSTRUCTION),
+                output=r.output,
+                gas_left=inst.gas,
+            )
+        return EVMResult(status=0, output=output, gas_left=inst.gas, logs=logs)
+    except _Trap as t:
+        gas_left = inst_ref[0].gas if inst_ref[0] is not None else 0
+        if t.status == TransactionStatus.OUT_OF_GAS:
+            gas_left = 0
+        return EVMResult(
+            status=int(t.status), output=str(t).encode(), gas_left=gas_left
+        )
+    except Exception as e:  # malformed module internals (bad indexes, wrong
+        # import arity, truncated sections): a failed receipt, never a crash
+        # that aborts the whole block (EVM path maps these to _VMError too)
+        return EVMResult(
+            status=int(TransactionStatus.WASM_TRAP),
+            output=f"wasm fault: {type(e).__name__}: {e}".encode()[:200],
+            gas_left=0,
+        )
+
+
+def wasm_interpret(host, msg: EVMCall, code: bytes):
+    """Entry-point call: runs the module's ``main``."""
+    return (yield from _run_export(host, msg, code, "main"))
+
+
+def wasm_deploy(host, msg: EVMCall, module_bytes: bytes):
+    """Deploy: validates the module, runs its ``deploy`` constructor, and
+    returns the MODULE as the code to store (wasm stores the module itself,
+    unlike EVM init code returning runtime code)."""
+    res = yield from _run_export(host, msg, module_bytes, "deploy")
+    if not res.ok:
+        return res
+    return EVMResult(
+        status=0, output=module_bytes, gas_left=res.gas_left, logs=res.logs
+    )

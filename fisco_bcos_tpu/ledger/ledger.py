@@ -98,6 +98,9 @@ class GenesisConfig:
     gas_limit: int = 3_000_000_000
     version: int = 1
     timestamp: int = 0
+    # chain VM type (the reference genesis [executor] is_wasm flag): a wasm
+    # chain runs liquid/WASM contracts, an EVM chain Solidity bytecode
+    is_wasm: bool = False
 
 
 @dataclass

@@ -77,7 +77,9 @@ class Node:
             block_limit=config.block_limit,
             persistent_store=self.storage if durable else None,
         )
-        self.executor = TransactionExecutor(self.storage, self.suite)
+        self.executor = TransactionExecutor(
+            self.storage, self.suite, is_wasm=config.genesis.is_wasm
+        )
         self.scheduler = Scheduler(
             self.executor, self.ledger, self.storage, self.suite, self.txpool
         )

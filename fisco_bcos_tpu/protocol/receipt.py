@@ -36,6 +36,11 @@ class TransactionStatus(IntEnum):
     CONTRACT_FROZEN = 21
     ACCOUNT_FROZEN = 22
     ACCOUNT_ABOLISHED = 23
+    # WASM engine statuses (TransactionStatus.h:48-53)
+    WASM_VALIDATION_FAILURE = 32
+    WASM_ARGUMENT_OUT_OF_RANGE = 33
+    WASM_UNREACHABLE_INSTRUCTION = 34
+    WASM_TRAP = 35
     # txpool admission errors (TransactionStatus.h:54-63)
     NONCE_CHECK_FAIL = 10000
     BLOCK_LIMIT_CHECK_FAIL = 10001

@@ -42,6 +42,9 @@ def _genesis_text(nodeids: list[str], chain_id: str, group_id: str) -> str:
 [tx]
     gas_limit=3000000000
 
+[executor]
+    is_wasm=false
+
 [version]
     compatibility_version=1
 """

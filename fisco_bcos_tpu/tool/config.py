@@ -98,6 +98,8 @@ def load_genesis(path: str) -> GenesisConfig:
                 raise ValueError(f"bad consensus node line {key}={val}: {e}") from e
     if cp.has_section("tx"):
         g.gas_limit = cp.getint("tx", "gas_limit", fallback=g.gas_limit)
+    if cp.has_section("executor"):
+        g.is_wasm = cp.getboolean("executor", "is_wasm", fallback=g.is_wasm)
     if cp.has_section("version"):
         g.version = cp.getint("version", "compatibility_version", fallback=g.version)
     return g

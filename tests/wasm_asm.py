@@ -1,0 +1,217 @@
+"""Hand assembler for tiny WASM modules — test fixtures for the wasm VM
+(the liquid-contract analog of tests/evm_asm.py)."""
+
+I32, I64 = 0x7F, 0x7E
+
+
+def leb_u(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def leb_s(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if (n == 0 and not b & 0x40) or (n == -1 and b & 0x40):
+            return out + bytes([b])
+        out += bytes([b | 0x80])
+
+
+def _vec(items: list[bytes]) -> bytes:
+    return leb_u(len(items)) + b"".join(items)
+
+
+def _section(sid: int, body: bytes) -> bytes:
+    return bytes([sid]) + leb_u(len(body)) + body
+
+
+# -- instruction helpers -----------------------------------------------------
+
+def i32c(v: int) -> bytes:
+    return b"\x41" + leb_s(v)
+
+
+def i64c(v: int) -> bytes:
+    return b"\x42" + leb_s(v)
+
+
+def call(idx: int) -> bytes:
+    return b"\x10" + leb_u(idx)
+
+
+def local_get(i: int) -> bytes:
+    return b"\x20" + leb_u(i)
+
+
+def local_set(i: int) -> bytes:
+    return b"\x21" + leb_u(i)
+
+
+I64_LOAD = b"\x29\x03\x00"   # align=8, offset=0
+I64_STORE = b"\x37\x03\x00"
+I32_LOAD = b"\x28\x02\x00"
+I32_STORE = b"\x36\x02\x00"
+I64_ADD = b"\x7c"
+I32_ADD = b"\x6a"
+I32_SUB = b"\x6b"
+DROP = b"\x1a"
+END = b"\x0b"
+LOOP = b"\x03\x40"  # blocktype: empty
+BR0 = b"\x0c\x00"
+
+
+def module(
+    types: list[tuple[list[int], list[int]]],
+    imports: list[tuple[str, str, int]],
+    funcs: list[tuple[int, list[int], bytes]],
+    exports: list[tuple[str, int]],
+    data: bytes = b"",
+    mem_min: int = 1,
+) -> bytes:
+    out = b"\x00asm\x01\x00\x00\x00"
+    out += _section(
+        1,
+        _vec(
+            [
+                b"\x60" + _vec([bytes([t]) for t in p]) + _vec([bytes([t]) for t in r])
+                for p, r in types
+            ]
+        ),
+    )
+    if imports:
+        out += _section(
+            2,
+            _vec(
+                [
+                    leb_u(len(m)) + m.encode() + leb_u(len(n)) + n.encode()
+                    + b"\x00" + leb_u(ti)
+                    for m, n, ti in imports
+                ]
+            ),
+        )
+    out += _section(3, _vec([leb_u(ti) for ti, _l, _b in funcs]))
+    out += _section(5, _vec([b"\x00" + leb_u(mem_min)]))
+    out += _section(
+        7,
+        _vec(
+            [
+                leb_u(len(name)) + name.encode() + b"\x00" + leb_u(idx)
+                for name, idx in exports
+            ]
+        ),
+    )
+    bodies = []
+    for _ti, locals_, body in funcs:
+        decls = _vec([leb_u(1) + bytes([t]) for t in locals_])
+        code = decls + body + END
+        bodies.append(leb_u(len(code)) + code)
+    out += _section(10, _vec(bodies))
+    if data:
+        out += _section(11, _vec([b"\x00" + i32c(0) + END + leb_u(len(data)) + data]))
+    return out
+
+
+# -- the standard bcos import block (indexes fixed for fixtures) -------------
+# 0 getCallDataSize ()->i32          1 getCallData (i32)->()
+# 2 getStorage (i32,i32,i32)->i32    3 setStorage (i32,i32,i32,i32)->()
+# 4 finish (i32,i32)->()             5 revert (i32,i32)->()
+# 6 call (i32,i32,i32)->i32          7 getReturnDataSize ()->i32
+# 8 getReturnData (i32)->()
+
+TYPES = [
+    ([], []),                      # 0: ()->()
+    ([], [I32]),                   # 1: ()->i32
+    ([I32], []),                   # 2: (i32)->()
+    ([I32, I32], []),              # 3
+    ([I32, I32, I32], [I32]),      # 4
+    ([I32, I32, I32, I32], []),    # 5
+]
+
+IMPORTS = [
+    ("bcos", "getCallDataSize", 1),
+    ("bcos", "getCallData", 2),
+    ("bcos", "getStorage", 4),
+    ("bcos", "setStorage", 5),
+    ("bcos", "finish", 3),
+    ("bcos", "revert", 3),
+    ("bcos", "call", 4),
+    ("bcos", "getReturnDataSize", 1),
+    ("bcos", "getReturnData", 2),
+]
+N_IMPORTS = len(IMPORTS)
+(GET_CD_SIZE, GET_CD, GET_ST, SET_ST, FINISH, REVERT, CALL,
+ GET_RD_SIZE, GET_RD) = range(N_IMPORTS)
+
+
+def counter_module() -> bytes:
+    """Key "c" at mem[0], value (u64 LE = SCALE u64) at mem[8], calldata
+    (a SCALE u64 delta) at mem[16]. deploy: count = 0. main: count += delta,
+    finish(SCALE u64 count)."""
+    deploy = (
+        i32c(8) + i64c(0) + I64_STORE
+        + i32c(0) + i32c(1) + i32c(8) + i32c(8) + call(SET_ST)
+    )
+    main = (
+        i32c(0) + i32c(1) + i32c(8) + call(GET_ST) + DROP
+        + i32c(16) + call(GET_CD)
+        + i32c(8)
+        + i32c(8) + I64_LOAD
+        + i32c(16) + I64_LOAD
+        + I64_ADD + I64_STORE
+        + i32c(0) + i32c(1) + i32c(8) + i32c(8) + call(SET_ST)
+        + i32c(8) + i32c(8) + call(FINISH)
+    )
+    return module(
+        TYPES,
+        IMPORTS,
+        [(0, [], deploy), (0, [], main)],
+        [("deploy", N_IMPORTS), ("main", N_IMPORTS + 1)],
+        data=b"c",
+    )
+
+
+def caller_module() -> bytes:
+    """main: calldata = 20-byte target address ++ payload; forwards the
+    payload via bcos.call and finishes with the callee's return data."""
+    main = (
+        call(GET_CD_SIZE) + local_set(0)
+        + i32c(0) + call(GET_CD)
+        + i32c(0) + i32c(20) + local_get(0) + i32c(20) + I32_SUB + call(CALL)
+        + DROP
+        + call(GET_RD_SIZE) + local_set(1)
+        + i32c(64) + call(GET_RD)
+        + i32c(64) + local_get(1) + call(FINISH)
+    )
+    return module(
+        TYPES,
+        IMPORTS,
+        [(0, [], b""), (0, [I32, I32], main)],  # deploy = no-op
+        [("deploy", N_IMPORTS), ("main", N_IMPORTS + 1)],
+    )
+
+
+def spin_module() -> bytes:
+    """main: an infinite loop — the gas-metering fixture."""
+    main = LOOP + BR0 + END
+    return module(TYPES, IMPORTS, [(0, [], main)], [("main", N_IMPORTS)])
+
+
+def reverter_module() -> bytes:
+    """main: writes storage then reverts with "nope" — revert must discard
+    the write."""
+    main = (
+        i32c(8) + i64c(9) + I64_STORE
+        + i32c(0) + i32c(1) + i32c(8) + i32c(8) + call(SET_ST)
+        + i32c(0) + i32c(4) + call(REVERT)
+    )
+    return module(
+        TYPES, IMPORTS, [(0, [], main)], [("main", N_IMPORTS)], data=b"nope"
+    )
