@@ -491,7 +491,11 @@ class Executive:
             # call to codeless address succeeds with empty output (EVM rule);
             # top-level txs to unknown addresses are rejected by execute()
             return EVMResult(status=0, output=b"", gas_left=msg.gas)
-        if code[:4] == WASM_MAGIC:
+        # VM choice follows the CHAIN type, never the stored bytes: an EVM
+        # init code could RETURN wasm-magic-prefixed runtime code, and
+        # prefix dispatch would then run wasm on an EVM chain, bypassing
+        # the genesis gate the deploy path enforces
+        if self.ex.is_wasm:
             gen = wasm_interpret(host, msg, code)
         else:
             gen = interpret(host, msg, code)

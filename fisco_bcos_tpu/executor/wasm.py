@@ -45,9 +45,14 @@ from .evm import EVMCall, EVMResult, contract_table
 WASM_MAGIC = b"\x00asm"
 
 PAGE = 65536
-MAX_PAGES = 256  # 16 MiB linear-memory cap
+MAX_PAGES = 256  # 16 MiB linear-memory cap per instance
 MAX_STACK = 4096
 MAX_FRAMES = 256
+# cross-contract depth cap, tighter than the EVM's 1024: every parked wasm
+# frame keeps its whole linear memory alive, so depth bounds worst-case
+# resident memory (64 x 16 MiB = 1 GiB) — the superlinear memory.grow
+# pricing makes even that expensive
+MAX_XCALL_DEPTH = 64
 
 
 class WasmError(Exception):
@@ -506,9 +511,30 @@ class WasmInstance:
         ftype = self.m.types[fn.type_idx]
         locals_ = list(args) + [0] * fn.locals_count
         stack: list[int] = []
-        ctrl: list[tuple[int, int]] = []  # (kind_op, start_idx)
+        # (kind_op, start_idx, stack_base, result_arity) — base/arity drive
+        # the spec's operand-stack unwinding at end/br: a branch discards
+        # everything above the label's entry height except the carried
+        # results, or stack-polymorphic code would leak operands
+        ctrl: list[tuple[int, int, int, int]] = []
         code = fn.code
         pc = 0
+
+        def block_arity(bt: int) -> int:
+            if bt == -64:  # 0x40: empty blocktype
+                return 0
+            if bt < 0:  # single valtype result
+                return 1
+            raise _Trap(
+                TransactionStatus.WASM_VALIDATION_FAILURE,
+                "multi-value block types unsupported",
+            )
+
+        def unwind(base: int, arity: int) -> None:
+            if len(stack) - base < arity:
+                raise _Trap(TransactionStatus.STACK_UNDERFLOW, "block results")
+            results = stack[len(stack) - arity :] if arity else []
+            del stack[base:]
+            stack.extend(results)
 
         def branch(depth_: int) -> int | None:
             """New pc for `br depth_`; None = branch to the implicit
@@ -519,9 +545,11 @@ class WasmInstance:
                 raise _Trap(TransactionStatus.WASM_TRAP, "branch depth")
             for _ in range(depth_):
                 ctrl.pop()
-            kind, start = ctrl[-1]
-            if kind == 0x03:  # loop: back to just after the loop opcode
+            kind, start, base, arity = ctrl[-1]
+            if kind == 0x03:  # loop: label arity = param count = 0 (MVP)
+                unwind(base, 0)
                 return start + 1
+            unwind(base, arity)
             end_idx, _e = fn.ctrl[start]
             ctrl.pop()
             return end_idx + 1
@@ -538,24 +566,26 @@ class WasmInstance:
             elif op in (0x01,):  # nop
                 pass
             elif op in (0x02, 0x03):  # block / loop
-                ctrl.append((op, pc))
+                ctrl.append((op, pc, len(stack), block_arity(imm)))
             elif op == 0x04:  # if
                 cond = stack.pop()
                 end_idx, else_idx = fn.ctrl[pc]
                 if cond:
-                    ctrl.append((op, pc))
+                    ctrl.append((op, pc, len(stack), block_arity(imm)))
                 elif else_idx is not None:
-                    ctrl.append((op, pc))
+                    ctrl.append((op, pc, len(stack), block_arity(imm)))
                     pc = else_idx  # fall into else arm
                 else:
                     pc = end_idx  # skip block; its end pops nothing
             elif op == 0x05:  # else reached from the true arm: skip to end
-                end_idx, _e = fn.ctrl[ctrl[-1][1]]
-                ctrl.pop()
+                _k, start, base, arity = ctrl.pop()
+                unwind(base, arity)
+                end_idx, _e = fn.ctrl[start]
                 pc = end_idx
             elif op == 0x0B:  # end
                 if ctrl:
-                    ctrl.pop()
+                    _k, _s, base, arity = ctrl.pop()
+                    unwind(base, arity)
             elif op == 0x0C:  # br
                 pc = branch(imm)
                 if pc is None:
@@ -630,7 +660,12 @@ class WasmInstance:
                 if want < 0 or cur + want > self.m.mem_max:
                     stack.append(_M32)  # -1: grow failed
                 else:
-                    self.use_gas(64 * want)
+                    # superlinear pricing (the EVM's quadratic memory-cost
+                    # shape): large live memories must cost real gas or a
+                    # recursive caller could hold many 16 MiB instances
+                    # within one block's budget
+                    after = cur + want
+                    self.use_gas(2048 * want + 512 * (after * after - cur * cur))
                     self.mem.extend(bytes(want * PAGE))
                     stack.append(cur)
             elif op == 0x41 or op == 0x42:  # i32/i64.const
@@ -754,6 +789,9 @@ def _bcos_host(inst_ref: list, host, msg: EVMCall, logs: list, ret_data: list):
         (DMC migration works unchanged)."""
         i = inst()
         i.use_gas(GAS_CALL + GAS_PER_BYTE * dl)
+        if msg.depth + 1 > MAX_XCALL_DEPTH:
+            ret_data[0] = b""
+            return 1  # call failed (depth), like an EVM depth-limit CALL
         addr = i.mread(ap, 20)
         data = i.mread(dp, dl)
         # forward all-but-1/64th and charge it NOW; the callee's leftover is

@@ -11,14 +11,22 @@ servant analog).
 """
 
 from .executor_service import ExecutorService, RemoteExecutor, RemoteShard
+from .gateway_service import FrontEndpoint, GatewayService, RemoteGateway
+from .rpc_service import RemoteJsonRpc, RpcFacade, RpcService
 from .rpc import ServiceClient, ServiceServer
 from .storage_service import RemoteStorage, StorageService
 
 __all__ = [
     "ExecutorService",
+    "FrontEndpoint",
+    "GatewayService",
     "RemoteExecutor",
+    "RemoteGateway",
+    "RemoteJsonRpc",
     "RemoteShard",
     "RemoteStorage",
+    "RpcFacade",
+    "RpcService",
     "ServiceClient",
     "ServiceServer",
     "StorageService",

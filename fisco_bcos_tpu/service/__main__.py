@@ -1,0 +1,75 @@
+"""Service process entrypoints — the Pro-mode service binaries.
+
+Reference: fisco-bcos-tars-service/{GatewayService/GatewayServiceApp,
+RpcService/RpcServiceApp} — the gateway and RPC front door each run as
+their own OS process, serving node cores over service RPC.
+
+    python -m fisco_bcos_tpu.service gateway --node-id <hex> \
+        [--service-port N] [--p2p-port N] [--peers h:p,...]
+    python -m fisco_bcos_tpu.service rpc --facade h:p [--port N]
+
+Each prints one ``READY key=port ...`` line once listening (port 0 resolves
+to a kernel-assigned port), then serves until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+# these are pure-IO processes: pin jax to CPU before anything imports it,
+# or the axon sitecustomize would route the import through the TPU tunnel
+try:  # pragma: no cover - environment-dependent
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="fisco-bcos-tpu-service", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    g = sub.add_parser("gateway", help="P2P gateway process")
+    g.add_argument("--node-id", required=True, help="node id (hex, 64 bytes)")
+    g.add_argument("--service-port", type=int, default=0)
+    g.add_argument("--p2p-port", type=int, default=0)
+    g.add_argument("--peers", default="", help="comma-separated host:port dials")
+    r = sub.add_parser("rpc", help="JSON-RPC front-door process")
+    r.add_argument("--facade", required=True, help="node RpcFacade host:port")
+    r.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_a: stop.set())
+
+    if args.cmd == "gateway":
+        from ..gateway.tcp import TcpGateway
+        from .gateway_service import GatewayService
+
+        gw = TcpGateway(bytes.fromhex(args.node_id), port=args.p2p_port)
+        svc = GatewayService(gw, port=args.service_port)
+        svc.start()
+        for hp in filter(None, args.peers.split(",")):
+            host, port = hp.rsplit(":", 1)
+            gw.connect_peer(host, int(port))
+        print(f"READY service={svc.port} p2p={gw.port}", flush=True)
+        stop.wait()
+        svc.stop()
+    else:
+        from .rpc_service import RpcService
+
+        host, port = args.facade.rsplit(":", 1)
+        svc = RpcService(host, int(port), port=args.port)
+        svc.start()
+        print(f"READY service={svc.port}", flush=True)
+        stop.wait()
+        svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

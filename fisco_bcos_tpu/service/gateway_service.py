@@ -1,0 +1,220 @@
+"""Gateway service — the P2P gateway as its own process.
+
+Reference: fisco-bcos-tars-service/GatewayService (GatewayServiceServer.cpp)
+paired with FrontService: in the Pro topology the gateway — TCP listener,
+TLS, routing, broadcast relay — runs as one process per machine, and node
+processes reach it over service RPC. Inbound P2P frames flow BACK to the
+node over the same wire: the node hosts a `FrontEndpoint` server the
+gateway calls into (the reference's FrontService is itself a Tars servant
+the gateway invokes — FrontServiceClient in GatewayServiceApp).
+
+    [node process]                         [gateway process]
+    FrontService ── RemoteGateway ──RPC──▶ GatewayService ── TcpGateway ─▶ P2P
+        ▲                                        │
+        └────────── FrontEndpoint ◀──RPC─────────┘ (inbound frames)
+"""
+
+from __future__ import annotations
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..front.front import FrontService, GatewayInterface
+from ..utils.log import get_logger
+from ..utils.worker import Worker
+from .rpc import ServiceClient, ServiceServer
+
+_log = get_logger("gateway-svc")
+
+
+class FrontEndpoint:
+    """Node-side server the gateway process delivers inbound frames to
+    (the FrontService servant half).
+
+    Delivery is acked IMMEDIATELY and dispatched on a worker thread: a
+    module handler doing heavy work (a tx-sync push triggering a device
+    signature batch) must not hold the gateway's synchronous delivery
+    pipeline — one slow frame would queue every later frame, consensus
+    messages included, behind it. FIFO order is preserved (one worker)."""
+
+    def __init__(self, front: FrontService, host: str = "127.0.0.1", port: int = 0):
+        self.front = front
+        self.server = ServiceServer("front", host, port)
+        self.server.register("on_receive", self._on_receive)
+        self.host, self.port = self.server.host, self.server.port
+        self._worker = Worker("front-endpoint")
+
+    def start(self) -> None:
+        self._worker.start()
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        self._worker.stop()
+
+    def _on_receive(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        module_id = r.u32()
+        src = r.bytes_()
+        data = r.bytes_()
+        r.done()
+        self._worker.post(lambda: self.front.on_receive(module_id, src, data))
+        return b""
+
+
+class _ForwardingFront:
+    """Gateway-side stub standing in for the node's FrontService: relays
+    every delivered frame to the registered node endpoints over RPC.
+
+    Endpoints are keyed by (host, port): re-registration after a node
+    restart replaces the old client instead of accumulating duplicates,
+    and an endpoint whose delivery fails is dropped immediately — a dead
+    endpoint must not stall the gateway's receive path until its timeout
+    on every subsequent frame (the restarted node re-registers)."""
+
+    def __init__(self, node_id: bytes):
+        self.node_id = node_id
+        self._clients: dict[tuple[str, int], ServiceClient] = {}
+
+    def set_gateway(self, gw) -> None:  # FrontService duck-type
+        pass
+
+    def add_endpoint(self, host: str, port: int) -> None:
+        old = self._clients.pop((host, port), None)
+        if old is not None:
+            old.close()
+        self._clients[(host, port)] = ServiceClient(host, port, timeout=60.0)
+
+    def on_receive(self, module_id: int, src: bytes, payload: bytes) -> None:
+        w = FlatWriter()
+        w.u32(module_id)
+        w.bytes_(src)
+        w.bytes_(payload)
+        buf = w.out()
+        for key, c in list(self._clients.items()):
+            try:
+                c.call("on_receive", buf)
+            except Exception as e:
+                _log.warning(
+                    "front endpoint %s:%d dropped after failed delivery: %s",
+                    key[0], key[1], e,
+                )
+                if self._clients.get(key) is c:
+                    del self._clients[key]
+                c.close()
+
+
+class GatewayService:
+    """Hosts a TcpGateway behind service RPC (GatewayServiceServer)."""
+
+    def __init__(self, gateway, host: str = "127.0.0.1", port: int = 0):
+        self.gateway = gateway
+        self._front = _ForwardingFront(gateway.node_id)
+        gateway.connect(self._front)
+        self.server = ServiceServer("gateway", host, port)
+        s = self.server
+        s.register("send", self._send)
+        s.register("broadcast", self._broadcast)
+        s.register("peers", self._peers)
+        s.register("connect_peer", self._connect_peer)
+        s.register("register_front", self._register_front)
+        self.host, self.port = s.host, s.port
+
+    def start(self) -> None:
+        self.gateway.start()
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.gateway.stop()
+
+    # -- handlers -------------------------------------------------------------
+
+    def _send(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        module_id = r.u32()
+        src = r.bytes_()
+        dst = r.bytes_()
+        data = r.bytes_()
+        r.done()
+        self.gateway.send(module_id, src, dst, data)
+        return b""
+
+    def _broadcast(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        module_id = r.u32()
+        src = r.bytes_()
+        data = r.bytes_()
+        r.done()
+        self.gateway.broadcast(module_id, src, data)
+        return b""
+
+    def _peers(self, payload: bytes) -> bytes:
+        w = FlatWriter()
+        w.seq(self.gateway.peers(), lambda w2, p: w2.bytes_(p))
+        return w.out()
+
+    def _connect_peer(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        host = r.str_()
+        port = r.u32()
+        r.done()
+        ok = self.gateway.connect_peer(host, port)
+        w = FlatWriter()
+        w.u8(1 if ok else 0)
+        return w.out()
+
+    def _register_front(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        host = r.str_()
+        port = r.u32()
+        r.done()
+        self._front.add_endpoint(host, port)
+        return b""
+
+
+class RemoteGateway(GatewayInterface):
+    """Node-side GatewayInterface over the wire (what FrontService sends
+    through when the gateway lives in another process)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.client = ServiceClient(host, port, timeout)
+
+    def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+        w = FlatWriter()
+        w.u32(module_id)
+        w.bytes_(src)
+        w.bytes_(dst)
+        w.bytes_(payload)
+        self.client.call("send", w.out())
+
+    def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+        w = FlatWriter()
+        w.u32(module_id)
+        w.bytes_(src)
+        w.bytes_(payload)
+        self.client.call("broadcast", w.out())
+
+    def peers(self) -> list[bytes]:
+        r = FlatReader(self.client.call("peers"))
+        out = r.seq(lambda r2: r2.bytes_())
+        r.done()
+        return out
+
+    def connect_peer(self, host: str, port: int) -> bool:
+        w = FlatWriter()
+        w.str_(host)
+        w.u32(port)
+        r = FlatReader(self.client.call("connect_peer", w.out()))
+        ok = bool(r.u8())
+        r.done()
+        return ok
+
+    def register_front(self, host: str, port: int) -> None:
+        """Tell the gateway process where this node's FrontEndpoint
+        listens, so inbound frames flow back."""
+        w = FlatWriter()
+        w.str_(host)
+        w.u32(port)
+        self.client.call("register_front", w.out())
+
+    def close(self) -> None:
+        self.client.close()

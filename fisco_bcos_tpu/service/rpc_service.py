@@ -1,0 +1,96 @@
+"""RPC service — the JSON-RPC front door as its own process.
+
+Reference: fisco-bcos-tars-service/RpcService (RpcServiceServer.cpp): in the
+Pro topology the HTTP/WS listener runs as its own process and forwards every
+JSON-RPC request to the node core over service RPC. The node hosts an
+`RpcFacade` server wrapping its JsonRpcImpl; the RPC process runs the
+standard RpcHttpServer with a forwarding `handle` — transport parsing stays
+in the RPC process, chain logic stays in the node.
+
+    client ──HTTP──▶ [rpc process] RpcHttpServer(RemoteJsonRpc) ──RPC──▶
+                     [node process] RpcFacade(JsonRpcImpl.handle)
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..utils.log import get_logger
+from .rpc import ServiceClient, ServiceServer
+
+_log = get_logger("rpc-svc")
+
+
+class RpcFacade:
+    """Node-side server exposing JsonRpcImpl.handle over service RPC."""
+
+    def __init__(self, impl, host: str = "127.0.0.1", port: int = 0):
+        self.impl = impl
+        self.server = ServiceServer("rpc-facade", host, port)
+        self.server.register("handle", self._handle)
+        self.host, self.port = self.server.host, self.server.port
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def _handle(self, payload: bytes) -> bytes:
+        req = json.loads(payload)
+        return json.dumps(self.impl.handle(req)).encode()
+
+
+class RemoteJsonRpc:
+    """RPC-process-side `handle` that forwards requests to the node's
+    facade — a drop-in for JsonRpcImpl wherever a transport needs one
+    (RpcHttpServer, WsService request path)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self.client = ServiceClient(host, port, timeout)
+
+    def handle(self, request: dict) -> dict:
+        try:
+            resp = self.client.call("handle", json.dumps(request).encode())
+            return json.loads(resp)
+        except Exception as e:
+            _log.exception("facade call failed")
+            return {
+                "jsonrpc": "2.0",
+                "id": request.get("id"),
+                "error": {"code": -32603, "message": f"node unreachable: {e}"},
+            }
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class RpcService:
+    """The RPC process: HTTP JSON-RPC listener over a remote node facade
+    (RpcServiceServer's process shape)."""
+
+    def __init__(
+        self,
+        facade_host: str,
+        facade_port: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl_context=None,
+        metrics=None,
+    ):
+        from ..rpc.http_server import RpcHttpServer
+
+        self.remote = RemoteJsonRpc(facade_host, facade_port)
+        self.http = RpcHttpServer(
+            self.remote, host=host, port=port, ssl_context=ssl_context,
+            metrics=metrics,
+        )
+        self.port = self.http.port
+
+    def start(self) -> None:
+        self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+        self.remote.close()

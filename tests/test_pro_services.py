@@ -1,0 +1,174 @@
+"""Pro topology: gateway and RPC as REAL OS processes.
+
+Reference: fisco-bcos-tars-service/{GatewayService,RpcService} — the P2P
+gateway and the JSON-RPC front door each run as their own process; node
+cores reach them over service RPC, and inbound P2P frames flow back through
+the node's FrontEndpoint. This test boots a 2-node PBFT chain whose
+gateways AND rpc run out-of-process and commits blocks through the split.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from fisco_bcos_tpu.codec.abi import ABICodec  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig  # noqa: E402
+from fisco_bcos_tpu.node import Node, NodeConfig  # noqa: E402
+from fisco_bcos_tpu.node.runtime import NodeRuntime  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory  # noqa: E402
+from fisco_bcos_tpu.rpc import JsonRpcImpl  # noqa: E402
+from fisco_bcos_tpu.service import (  # noqa: E402
+    FrontEndpoint,
+    RemoteGateway,
+    RpcFacade,
+)
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+def wait_until(cond, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _spawn_service(args):
+    """Start a service process; returns (proc, {key: port})."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fisco_bcos_tpu.service", *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd="/root/repo",
+    )
+    deadline = time.monotonic() + 60
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            ports = dict(
+                kv.split("=") for kv in line.strip().split()[1:]
+            )
+            return proc, {k: int(v) for k, v in ports.items()}
+        if proc.poll() is not None:
+            break
+    raise AssertionError(f"service did not come up: {line!r}")
+
+
+def _stop(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.mark.slow
+def test_pro_split_two_node_chain_commits(tmp_path):
+    kps = [SUITE.signature_impl.generate_keypair(secret=0x7000 + i) for i in range(2)]
+    genesis = GenesisConfig(
+        consensus_nodes=[ConsensusNode(kp.pub, weight=1) for kp in kps],
+        tx_count_limit=100,
+    )
+    procs, runtimes, endpoints, gws = [], [], [], []
+    try:
+        # gateway processes first (node 1's dials node 0's p2p port)
+        p0, ports0 = _spawn_service(
+            ["gateway", "--node-id", kps[0].pub.hex()]
+        )
+        procs.append(p0)
+        p1, ports1 = _spawn_service(
+            [
+                "gateway", "--node-id", kps[1].pub.hex(),
+                "--peers", f"127.0.0.1:{ports0['p2p']}",
+            ]
+        )
+        procs.append(p1)
+
+        nodes = []
+        for kp, ports in zip(kps, (ports0, ports1)):
+            node = Node(NodeConfig(genesis=genesis), keypair=kp)
+            ep = FrontEndpoint(node.front)
+            ep.start()
+            endpoints.append(ep)
+            rgw = RemoteGateway("127.0.0.1", ports["service"])
+            gws.append(rgw)
+            node.front.set_gateway(rgw)
+            rgw.register_front(ep.host, ep.port)
+            nodes.append(node)
+        # pre-trace/compile the admission kernels (shared in-process): a
+        # cold trace inside a message handler stalls the front-endpoint
+        # worker for minutes on this 1-core host (what --warmup does for
+        # the air node)
+        nodes[0].warmup(batch_sizes=(int(__import__("os").environ.get("FISCO_TEST_BUCKET", "32")),))
+
+        # rpc process serving node0's facade
+        facade = RpcFacade(JsonRpcImpl(nodes[0]))
+        facade.start()
+        endpoints.append(facade)  # reuse stop() in teardown
+        rpc_proc, rpc_ports = _spawn_service(
+            ["rpc", "--facade", f"127.0.0.1:{facade.port}"]
+        )
+        procs.append(rpc_proc)
+
+        # both gateways see each other before consensus starts
+        assert wait_until(lambda: len(gws[0].peers()) >= 1, 30)
+
+        for node in nodes:
+            rt = NodeRuntime(node, sealer_interval=0.05)
+            rt.start()
+            runtimes.append(rt)
+
+        def rpc(method, *params):
+            req = {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)}
+            r = urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{rpc_ports['service']}",
+                    data=json.dumps(req).encode(),
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=20,
+            )
+            return json.loads(r.read())
+
+        assert rpc("getBlockNumber")["result"] == 0
+
+        fac = TransactionFactory(SUITE)
+        sender = SUITE.signature_impl.generate_keypair(secret=0x7EAD)
+        tx = fac.create_signed(
+            sender, chain_id="chain0", group_id="group0", block_limit=500,
+            nonce="pro-1", to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call("userAdd(string,uint256)", "pro", 9),
+        )
+        resp = rpc("sendTransaction", "group0", "", tx.encode().hex())
+        assert "error" not in resp, resp
+
+        # a 2-of-2 PBFT quorum committed the block THROUGH the split:
+        # proposal + votes crossed two gateway processes; the tx entered
+        # via the rpc process
+        assert wait_until(lambda: nodes[0].ledger.block_number() >= 1, 120), (
+            nodes[0].ledger.block_number()
+        )
+        assert wait_until(lambda: nodes[1].ledger.block_number() >= 1, 60)
+        assert rpc("getBlockNumber")["result"] >= 1
+    finally:
+        for rt in runtimes:
+            rt.stop()
+        for ep in endpoints:
+            ep.stop()
+        for proc in procs:
+            _stop(proc)
