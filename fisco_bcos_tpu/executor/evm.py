@@ -221,7 +221,12 @@ class _Frame:
         if self.gas < 0:
             raise _VMError(_OUT_OF_GAS)
 
-    # memory helpers (quadratic-free simple expansion cost)
+    # memory expansion: the EVM cost function Cmem(w) = 3w + w^2/512,
+    # charged on the delta (evmone's grow_memory) — the quadratic term is
+    # what makes big memories exponentially expensive; a flat per-word
+    # price would let one tx hold arbitrary host memory cheaply. The 2 MiB
+    # hard cap is belt-and-braces on top (a 2 MiB memory already costs
+    # ~8.6M gas).
     def mem_extend(self, offset: int, size: int) -> None:
         if size == 0:
             return
@@ -229,9 +234,13 @@ class _Frame:
             raise _VMError(_OUT_OF_GAS)
         need = offset + size
         if need > len(self.memory):
-            words = (need + 31) // 32 - (len(self.memory)) // 32
-            self.use_gas(G_MEMORY * words)
-            self.memory.extend(b"\x00" * ((need + 31) // 32 * 32 - len(self.memory)))
+            old_w = len(self.memory) // 32
+            new_w = (need + 31) // 32
+            self.use_gas(
+                G_MEMORY * (new_w - old_w)
+                + (new_w * new_w // 512 - old_w * old_w // 512)
+            )
+            self.memory.extend(b"\x00" * (new_w * 32 - len(self.memory)))
 
     def mread(self, offset: int, size: int) -> bytes:
         self.mem_extend(offset, size)

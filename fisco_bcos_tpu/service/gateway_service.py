@@ -178,20 +178,32 @@ class RemoteGateway(GatewayInterface):
     def __init__(self, host: str, port: int, timeout: float = 60.0):
         self.client = ServiceClient(host, port, timeout)
 
+    # send/broadcast keep the GatewayInterface best-effort contract
+    # (TcpGateway logs and drops; it never raises): consensus and sync
+    # threads call these, and a gateway-process bounce must cost dropped
+    # frames — which PBFT re-delivery tolerates — not dead node threads.
+    # The self-healing ServiceClient redials on the next call.
+
     def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
         w = FlatWriter()
         w.u32(module_id)
         w.bytes_(src)
         w.bytes_(dst)
         w.bytes_(payload)
-        self.client.call("send", w.out())
+        try:
+            self.client.call("send", w.out())
+        except Exception as e:
+            _log.warning("gateway send dropped (%s)", e)
 
     def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
         w = FlatWriter()
         w.u32(module_id)
         w.bytes_(src)
         w.bytes_(payload)
-        self.client.call("broadcast", w.out())
+        try:
+            self.client.call("broadcast", w.out())
+        except Exception as e:
+            _log.warning("gateway broadcast dropped (%s)", e)
 
     def peers(self) -> list[bytes]:
         r = FlatReader(self.client.call("peers"))

@@ -138,20 +138,49 @@ class ServiceRemoteError(RuntimeError):
 
 
 class ServiceClient:
+    """Self-healing: a transport failure poisons only the CURRENT call —
+    the broken socket is discarded and the next call redials, so a service
+    restart (gateway/rpc/executor process bounce) heals without restarting
+    every client process (tars proxies reconnect the same way)."""
+
     def __init__(self, host: str, port: int, timeout: float = 60.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._addr = (host, port)
+        self._timeout = timeout
+        self.sock: socket.socket | None = socket.create_connection(
+            (host, port), timeout=timeout
+        )
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
 
+    def _drop_sock(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
     def call(self, method: str, payload: bytes = b"") -> bytes:
         with self._lock:
+            if self.sock is None:
+                try:
+                    self.sock = socket.create_connection(
+                        self._addr, timeout=self._timeout
+                    )
+                except OSError as e:
+                    raise ServiceRemoteError(f"{method}: reconnect failed: {e}")
             req_id = next(self._ids)
             w = FlatWriter()
             w.u64(req_id)
             w.str_(method)
             w.bytes_(payload)
-            _send_frame(self.sock, w.out())
-            body = _recv_frame(self.sock)
+            try:
+                _send_frame(self.sock, w.out())
+                body = _recv_frame(self.sock)
+            except OSError:
+                body = None
+            if body is None:
+                self._drop_sock()
         if body is None:
             raise ServiceRemoteError(f"{method}: connection lost")
         r = FlatReader(body)
@@ -166,7 +195,5 @@ class ServiceClient:
         return out
 
     def close(self) -> None:
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_sock()

@@ -5,6 +5,7 @@ fixtures for bcos-executor's unit tests)."""
 
 OPS = {
     "STOP": 0x00, "ADD": 0x01, "MUL": 0x02, "SUB": 0x03, "DIV": 0x04,
+    "EXP": 0x0A, "INVALID": 0xFE,
     "LT": 0x10, "GT": 0x11, "EQ": 0x14, "ISZERO": 0x15, "AND": 0x16,
     "OR": 0x17, "NOT": 0x19, "SHL": 0x1B, "SHR": 0x1C,
     "SHA3": 0x20, "ADDRESS": 0x30, "CALLER": 0x33, "CALLVALUE": 0x34,

@@ -47,7 +47,12 @@ def wait_until(cond, timeout=60.0, interval=0.05):
 
 
 def _spawn_service(args):
-    """Start a service process; returns (proc, {key: port})."""
+    """Start a service process; returns (proc, {key: port}). Stdout is
+    drained on a thread for the process's whole life: a blocking readline
+    would defeat the deadline, and an undrained pipe would eventually
+    block the child's own logging."""
+    import threading
+
     proc = subprocess.Popen(
         [sys.executable, "-m", "fisco_bcos_tpu.service", *args],
         stdout=subprocess.PIPE,
@@ -55,18 +60,25 @@ def _spawn_service(args):
         text=True,
         cwd="/root/repo",
     )
+    ready: dict = {}
+
+    def drain():
+        for line in proc.stdout:
+            if line.startswith("READY"):
+                ready.update(
+                    (k, int(v))
+                    for k, v in (kv.split("=") for kv in line.strip().split()[1:])
+                )
+
+    threading.Thread(target=drain, daemon=True).start()
     deadline = time.monotonic() + 60
-    line = ""
     while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if line.startswith("READY"):
-            ports = dict(
-                kv.split("=") for kv in line.strip().split()[1:]
-            )
-            return proc, {k: int(v) for k, v in ports.items()}
+        if ready:
+            return proc, ready
         if proc.poll() is not None:
             break
-    raise AssertionError(f"service did not come up: {line!r}")
+        time.sleep(0.1)
+    raise AssertionError("service did not come up")
 
 
 def _stop(proc):
