@@ -1,0 +1,142 @@
+"""Curve25519 VRF (ECVRF-EDWARDS25519-SHA512-TAI, RFC 9381) — pure Python.
+
+Reference role: the wedpr curve25519 VRF behind CryptoPrecompiled's
+``curve25519VRFVerify`` (bcos-executor/src/precompiled/CryptoPrecompiled.cpp:117
+→ ``wedpr_curve25519_vrf_verify_utf8`` / ``wedpr_curve25519_vrf_proof_to_hash``)
+and the rPBFT VRF-based leader selection seam. wedpr-crypto implements the
+pre-RFC draft of the same ECVRF construction over curve25519; this module
+implements the published RFC 9381 ciphersuite 0x03 (TAI hash-to-curve,
+SHA-512, 16-byte challenges) — same proof shape (gamma ‖ c ‖ s, 80 bytes),
+same security contract, documented spec pin instead of an unversioned FFI.
+
+Host-side only: VRF verification is a per-proposal singleton (one proof per
+leader election round), not a batch plane — no device path is warranted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .ed25519 import (
+    BASE,
+    IDENT,
+    L,
+    P,
+    _add,
+    _compress,
+    _decompress,
+    _eq_points,
+    _mul,
+)
+
+SUITE = b"\x03"  # ECVRF-EDWARDS25519-SHA512-TAI
+PROOF_LEN = 80  # gamma(32) ‖ c(16) ‖ s(32)
+_CLEN = 16
+
+
+def _neg(p):
+    x, y, z, t = p
+    return (P - x) % P, y, z, (P - t) % P
+
+
+def _cofactor_clear(p):
+    return _mul(8, p)
+
+
+def _is_small_order(p) -> bool:
+    return _eq_points(_cofactor_clear(p), IDENT)
+
+
+def _hash_to_curve_tai(pub: bytes, alpha: bytes):
+    """Try-and-increment encode_to_curve (RFC 9381 §5.4.1.1)."""
+    for ctr in range(256):
+        h = hashlib.sha512(
+            SUITE + b"\x01" + pub + alpha + bytes([ctr]) + b"\x00"
+        ).digest()[:32]
+        pt = _decompress(h)
+        if pt is not None:
+            return _cofactor_clear(pt)  # never small-order after clearing
+    return None  # 2^-256-class improbability; callers treat as invalid
+
+
+def _challenge(points) -> int:
+    """RFC 9381 §5.4.3: c = first 16 bytes of SHA-512 over the point list."""
+    h = hashlib.sha512(
+        SUITE + b"\x02" + b"".join(_compress(p) for p in points) + b"\x00"
+    ).digest()[:_CLEN]
+    return int.from_bytes(h, "big")
+
+
+def is_valid_public_key(pub: bytes) -> bool:
+    """wedpr_curve25519_vrf_is_valid_public_key: on-curve and not small-order."""
+    pt = _decompress(pub)
+    return pt is not None and not _is_small_order(pt)
+
+
+def vrf_prove(secret: int, alpha: bytes) -> bytes:
+    """Proof pi = gamma ‖ c ‖ s for scalar secret key x (0 < x < L).
+
+    Takes the raw scalar (not an RFC 8032 seed): VRF keys here are standalone
+    scalars exactly like wedpr's curve25519 VRF keypairs.
+    """
+    x = secret % L
+    if x == 0:
+        raise ValueError("vrf secret must be nonzero mod L")
+    pub_pt = _mul(x, BASE)
+    pub = _compress(pub_pt)
+    h_pt = _hash_to_curve_tai(pub, alpha)
+    if h_pt is None:
+        raise ValueError("hash_to_curve failed")
+    gamma = _mul(x, h_pt)
+    # deterministic nonce (RFC 9381 §5.4.2.2 shape, keyed by the raw scalar)
+    k = (
+        int.from_bytes(
+            hashlib.sha512(
+                x.to_bytes(32, "little") + _compress(h_pt)
+            ).digest(),
+            "little",
+        )
+        % L
+    )
+    c = _challenge([pub_pt, h_pt, gamma, _mul(k, BASE), _mul(k, h_pt)])
+    s = (k + c * x) % L
+    return (
+        _compress(gamma)
+        + c.to_bytes(_CLEN, "big")
+        + s.to_bytes(32, "little")
+    )
+
+
+def vrf_verify(pub: bytes, alpha: bytes, pi: bytes) -> bool:
+    """RFC 9381 §5.3 verify: U = s*B - c*Y, V = s*H - c*Gamma, c' == c."""
+    if len(pi) != PROOF_LEN or len(pub) != 32:
+        return False
+    y_pt = _decompress(pub)
+    if y_pt is None or _is_small_order(y_pt):
+        return False
+    gamma = _decompress(pi[:32])
+    if gamma is None:
+        return False
+    c = int.from_bytes(pi[32 : 32 + _CLEN], "big")
+    s = int.from_bytes(pi[32 + _CLEN :], "little")
+    if s >= L:
+        return False
+    h_pt = _hash_to_curve_tai(pub, alpha)
+    if h_pt is None:
+        return False
+    u = _add(_mul(s, BASE), _mul(c, _neg(y_pt)))
+    v = _add(_mul(s, h_pt), _mul(c, _neg(gamma)))
+    return _challenge([y_pt, h_pt, gamma, u, v]) == c
+
+
+def vrf_proof_to_hash(pi: bytes) -> bytes | None:
+    """beta (32 bytes) from a syntactically valid proof (RFC 9381 §5.2 shape,
+    truncated to the 32-byte HashType the precompile returns as uint256)."""
+    if len(pi) != PROOF_LEN:
+        return None
+    gamma = _decompress(pi[:32])
+    if gamma is None:
+        return None
+    return hashlib.sha512(
+        SUITE + b"\x03" + _compress(_cofactor_clear(gamma)) + b"\x00"
+    ).digest()[:32]

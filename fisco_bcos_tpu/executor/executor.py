@@ -234,6 +234,22 @@ class TransactionExecutor:
             rc.output = b"unknown contract address"
             rc.gas_used = BASE_GAS
             return rc
+        # account governance (TransactionExecutive.cpp:1292
+        # checkAccountAvailable): a frozen/abolished origin cannot transact
+        if not static_call:
+            from .precompiled.account import ABOLISH, FREEZE, account_status
+
+            st = account_status(overlay, tx.sender, block.number)
+            if st == FREEZE:
+                rc.status = int(TransactionStatus.ACCOUNT_FROZEN)
+                rc.output = b"account is frozen"
+                rc.gas_used = BASE_GAS
+                return rc
+            if st == ABOLISH:
+                rc.status = int(TransactionStatus.ACCOUNT_ABOLISHED)
+                rc.output = b"account is abolished"
+                rc.gas_used = BASE_GAS
+                return rc
         # auth governance (ContractAuthMgr enforcement): frozen contracts and
         # method ACLs gate deployed-contract calls before a frame starts
         if not is_create and tx.to not in self.registry:

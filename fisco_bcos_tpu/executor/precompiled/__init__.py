@@ -4,6 +4,7 @@ Addresses mirror the reference's map
 (bcos-framework/executor/PrecompiledTypeDef.h:57-116).
 """
 
+from .account import AccountManagerPrecompiled
 from .auth import ContractAuthPrecompiled
 from .bfs import BFSPrecompiled
 from .base import (  # noqa: F401
@@ -24,6 +25,11 @@ from .bench_contracts import (  # noqa: F401
     DagTransferPrecompiled,
     SmallBankPrecompiled,
 )
+from .privacy import (  # noqa: F401
+    GroupSigPrecompiled,
+    RingSigPrecompiled,
+    ZkpPrecompiled,
+)
 
 # PrecompiledTypeDef.h:57-66
 SYS_CONFIG_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001000")
@@ -34,7 +40,12 @@ CRYPTO_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100a")
 BFS_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100e")
 AUTH_MANAGER_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001005")
 CONTRACT_AUTH_MGR_ADDRESS = bytes.fromhex("0000000000000000000000000000000000010002")
+ACCOUNT_MGR_ADDRESS = bytes.fromhex("0000000000000000000000000000000000010003")
 DAG_TRANSFER_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100c")
+# PrecompiledTypeDef.h:70-73 — privacy suite
+GROUP_SIG_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005004")
+RING_SIG_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005005")
+DISCRETE_ZKP_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005100")
 # PrecompiledTypeDef.h:112/116 — benchmark families start at fixed bases
 CPU_HEAVY_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005200")
 SMALLBANK_ADDRESS = bytes.fromhex("0000000000000000000000000000000000006200")
@@ -50,7 +61,11 @@ def default_registry() -> dict[bytes, Precompiled]:
         BFS_ADDRESS: BFSPrecompiled(),
         AUTH_MANAGER_ADDRESS: ContractAuthPrecompiled(),
         CONTRACT_AUTH_MGR_ADDRESS: ContractAuthPrecompiled(),
+        ACCOUNT_MGR_ADDRESS: AccountManagerPrecompiled(),
         DAG_TRANSFER_ADDRESS: DagTransferPrecompiled(),
+        GROUP_SIG_ADDRESS: GroupSigPrecompiled(),
+        RING_SIG_ADDRESS: RingSigPrecompiled(),
+        DISCRETE_ZKP_ADDRESS: ZkpPrecompiled(),
         CPU_HEAVY_ADDRESS: CpuHeavyPrecompiled(),
         SMALLBANK_ADDRESS: SmallBankPrecompiled(),
     }
@@ -63,9 +78,13 @@ PRECOMPILED_ADDRESSES = {
     "bfs": BFS_ADDRESS,
     "auth_manager": AUTH_MANAGER_ADDRESS,
     "contract_auth": CONTRACT_AUTH_MGR_ADDRESS,
+    "account_manager": ACCOUNT_MGR_ADDRESS,
     "kv_table": KV_TABLE_ADDRESS,
     "crypto": CRYPTO_ADDRESS,
     "dag_transfer": DAG_TRANSFER_ADDRESS,
+    "group_sig": GROUP_SIG_ADDRESS,
+    "ring_sig": RING_SIG_ADDRESS,
+    "discrete_zkp": DISCRETE_ZKP_ADDRESS,
     "cpu_heavy": CPU_HEAVY_ADDRESS,
     "smallbank": SMALLBANK_ADDRESS,
 }

@@ -1,0 +1,177 @@
+"""Account governance — freeze / unfreeze / abolish EOA accounts.
+
+Reference: bcos-executor/src/precompiled/extension/
+{AccountManagerPrecompiled.cpp (0x10003), AccountPrecompiled.cpp (0x10004)}.
+The reference deploys one dynamic Account precompiled contract per governed
+address (createAccountWithStatus → per-account table ``/usr/<addr>`` with
+ACCOUNT_STATUS / ACCOUNT_LAST_STATUS / ACCOUNT_LAST_UPDATE rows) and routes
+manager calls to it via externalRequest; here the same state machine lives in
+one ``s_account`` table keyed by address — the observable surface
+(setAccountStatus(address,uint8) / getAccountStatus(address), status
+semantics, governor gating, terminal abolish) is identical, without the
+dynamic-contract indirection that exists only because the reference must ship
+per-account EVM code objects.
+
+Status semantics (bcos-executor/src/Common.h:83 AccountStatus):
+  0 normal, 1 freeze, 2 abolish.
+- A status write at block N takes effect at block N+1: reads at the write
+  block still see the previous status (AccountPrecompiled.cpp:158-170
+  lastUpdateNumber / ACCOUNT_LAST_STATUS dance).
+- abolish is terminal: once abolished, no other status may ever be set
+  (AccountPrecompiled.cpp:108-118).
+- Only governors may set statuses, and a governor's own status may never be
+  set (AccountManagerPrecompiled.cpp:130-148). Governors come from the
+  genesis ``auth_governors`` system-config entry — this framework's analog
+  of the reference's AuthCommittee governor list (the committee/proposal
+  Solidity layer is out of scope, as in :mod:`.auth`).
+
+Enforcement happens in the executor pre-frame (TransactionExecutive.cpp:1292
+checkAccountAvailable): a frozen origin cannot send transactions
+(ACCOUNT_FROZEN), an abolished one is rejected with ACCOUNT_ABOLISHED.
+"""
+
+from __future__ import annotations
+
+from ...protocol.receipt import TransactionStatus
+from ...storage.entry import Entry
+from .base import (
+    Precompiled,
+    PrecompiledCallContext,
+    PrecompiledError,
+    PrecompiledResult,
+)
+
+ACCOUNT_TABLE = "s_account"
+
+NORMAL = 0
+FREEZE = 1
+ABOLISH = 2
+
+# SystemConfigPrecompiled key carrying the comma-joined governor addresses
+GOVERNORS_CONFIG_KEY = "auth_governors"
+
+CODE_SUCCESS = 0
+CODE_NO_AUTHORIZED = -5000  # precompiled/common/Common.h CODE_NO_AUTHORIZED
+CODE_ACCOUNT_ALREADY_EXIST = -72001
+
+
+def _addr_bytes(a: str | bytes) -> bytes:
+    """Address as bytes20 — the ABI decoder hands addresses over as raw
+    bytes; config strings arrive hex."""
+    if isinstance(a, bytes):
+        raw = a
+    else:
+        raw = bytes.fromhex(a[2:] if a.startswith("0x") else a)
+    if len(raw) != 20:
+        raise PrecompiledError(f"bad address: {a!r}")
+    return raw
+
+
+def _load(storage, addr: bytes) -> dict | None:
+    e = storage.get_row(ACCOUNT_TABLE, addr)
+    if e is None:
+        return None
+    return {
+        "status": int(e.get("status").decode() or "0"),
+        "last_status": int(e.get("last_status").decode() or "0"),
+        "last_update": int(e.get("last_update").decode() or "0"),
+    }
+
+
+def account_status(storage, addr: bytes, block_number: int) -> int:
+    """Effective status of `addr` as seen by a frame at `block_number`.
+
+    A write at block N is visible from N+1 on (AccountPrecompiled.cpp:158:
+    ``blockContext->number() > lastUpdateNumber ? status : lastStatus``).
+    Unknown accounts are normal (getAccountStatus default-0 path).
+    """
+    row = _load(storage, addr)
+    if row is None:
+        return NORMAL
+    return row["status"] if block_number > row["last_update"] else row["last_status"]
+
+
+def set_account_status(storage, addr: bytes, status: int, block_number: int) -> None:
+    """The AccountPrecompiled setAccountStatus state transition (caller must
+    have already authorized)."""
+    row = _load(storage, addr)
+    if row is None:
+        last_status = NORMAL
+    else:
+        if row["status"] == ABOLISH and status != ABOLISH:
+            raise PrecompiledError(
+                "Account already abolish, should not set any status."
+            )
+        # a SECOND write in the same block must not promote the first
+        # (not-yet-effective) status into last_status — that would make it
+        # visible at the write block, breaking the N+1 rule above
+        if row["last_update"] == block_number:
+            last_status = row["last_status"]
+        else:
+            last_status = row["status"]
+    storage.set_row(
+        ACCOUNT_TABLE,
+        addr,
+        Entry(
+            {
+                "status": str(status).encode(),
+                "last_status": str(last_status).encode(),
+                "last_update": str(block_number).encode(),
+            }
+        ),
+    )
+
+
+def governor_list(storage) -> list[bytes]:
+    """Governor addresses from the genesis `auth_governors` system config
+    (the reference reads the AuthCommittee's governor list —
+    AccountManagerPrecompiled.cpp:210 getGovernorList)."""
+    from ...ledger.ledger import SYS_CONFIG
+
+    e = storage.get_row(SYS_CONFIG, GOVERNORS_CONFIG_KEY.encode())
+    if e is None:
+        return []
+    raw = e.get().decode()
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part:
+            out.append(_addr_bytes(part))
+    return out
+
+
+class AccountManagerPrecompiled(Precompiled):
+    """setAccountStatus(address,uint8) / getAccountStatus(address) at
+    0x10003 (PrecompiledTypeDef.h:80 ACCOUNT_MGR_ADDRESS)."""
+
+    def setup(self, codec):
+        self.register(codec, "setAccountStatus(address,uint8)", self._set_status)
+        self.register(codec, "getAccountStatus(address)", self._get_status)
+
+    def _set_status(self, ctx: PrecompiledCallContext, account: str, status: int):
+        if ctx.static_call:
+            raise PrecompiledError("setAccountStatus in static call")
+        if status not in (NORMAL, FREEZE, ABOLISH):
+            raise PrecompiledError(f"unknown account status {status}")
+        target = _addr_bytes(account)
+        governors = governor_list(ctx.storage)
+        if ctx.sender not in governors:
+            # not from governor — soft error code, not a revert
+            # (AccountManagerPrecompiled.cpp:131-139)
+            return PrecompiledResult(
+                output=ctx.codec.encode_output(["int32"], CODE_NO_AUTHORIZED)
+            )
+        if target in governors:
+            raise PrecompiledError("Should not set governor's status.")
+        set_account_status(ctx.storage, target, status, ctx.block_number)
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["int32"], CODE_SUCCESS)
+        )
+
+    def _get_status(self, ctx: PrecompiledCallContext, account: str):
+        status = account_status(
+            ctx.storage, _addr_bytes(account), ctx.block_number
+        )
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["uint8"], status)
+        )

@@ -188,12 +188,73 @@ class KVTablePrecompiled(Precompiled):
 
 
 class CryptoPrecompiled(Precompiled):
-    """keccak256Hash/sm3Hash (CryptoPrecompiled.cpp) — device-batchable ops
-    exposed on-chain; single calls use the CPU reference path."""
+    """keccak256Hash/sm3/sm2Verify/curve25519VRFVerify
+    (CryptoPrecompiled.cpp:40-48) — device-batchable hash ops plus the
+    signature/VRF verification surface; single calls use the CPU reference
+    path (one call per tx is never a batch plane)."""
 
     def setup(self, codec):
         self.register(codec, "keccak256Hash(bytes)", self._keccak)
         self.register(codec, "sm3(bytes)", self._sm3)
+        self.register(
+            codec, "sm2Verify(bytes32,bytes,bytes32,bytes32)", self._sm2_verify
+        )
+        self.register(
+            codec, "curve25519VRFVerify(bytes,bytes,bytes)", self._vrf_verify
+        )
+
+    def _sm2_verify(self, ctx, msg_hash: bytes, pub: bytes, r: bytes, s: bytes):
+        """(msgHash, publicKey, r, s) -> (ok, account) where account =
+        right160(sm3(pub)) (CryptoPrecompiled.cpp:155-185 sm2Verify via
+        sm2Recover on the pub-carrying signature blob)."""
+        from ...crypto.ref import ecdsa as ref
+        from ...crypto.ref.sm3 import sm3
+
+        if len(pub) == 65 and pub[0] == 4:
+            pub = pub[1:]
+        ok = False
+        account = b"\x00" * 20
+        if len(pub) == 64:
+            qx = int.from_bytes(pub[:32], "big")
+            qy = int.from_bytes(pub[32:], "big")
+            try:
+                ok = ref.sm2_verify(
+                    msg_hash,
+                    int.from_bytes(r, "big"),
+                    int.from_bytes(s, "big"),
+                    (qx, qy),
+                )
+            except Exception:
+                ok = False
+            if ok:
+                account = sm3(pub)[12:]
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["bool", "address"], ok, account)
+        )
+
+    def _vrf_verify(self, ctx, message: bytes, pub: bytes, proof: bytes):
+        """(vrfInput, vrfPublicKey, vrfProof) -> (ok, uint256 random) —
+        CryptoPrecompiled.cpp:117-154; ECVRF over edwards25519, the random
+        value is the proof's beta hash."""
+        from ...crypto.ref.vrf import (
+            is_valid_public_key,
+            vrf_proof_to_hash,
+            vrf_verify,
+        )
+
+        ok = False
+        rand = 0
+        try:
+            if is_valid_public_key(pub) and vrf_verify(pub, message, proof):
+                beta = vrf_proof_to_hash(proof)
+                if beta is not None:
+                    ok = True
+                    rand = int.from_bytes(beta, "big")
+        except Exception:
+            ok = False
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["bool", "uint256"], ok, rand)
+        )
 
     def _keccak(self, ctx, data: bytes):
         from ...crypto.ref.keccak import keccak256

@@ -1,10 +1,11 @@
 """Bandwidth policing — token buckets per connection/module/group.
 
 Reference: bcos-gateway/libratelimit/{TokenBucketRateLimiter.cpp,
-RateLimiterManager.cpp, GatewayRateLimiter.cpp} (outbound bandwidth caps per
-group / per module, total-outgoing cap; the redis-backed
-DistributedRateLimiter is a deployment variant of the same interface and is
-out of scope with no redis in the image — this manager is the seam).
+RateLimiterManager.cpp, GatewayRateLimiter.cpp, DistributedRateLimiter.cpp}
+(outbound bandwidth caps per group / per module, total-outgoing cap; the
+redis-backed distributed limiter maps to QuotaService +
+DistributedRateLimiter below — same windowed-counter semantics over the
+framework's service RPC, since the image has no redis).
 """
 
 from __future__ import annotations
@@ -79,3 +80,152 @@ class RateLimiterManager:
                 self.dropped += 1
             return False
         return True
+
+
+# ---------------------------------------------------------------------------
+# Distributed (cluster-wide) rate limiting
+# ---------------------------------------------------------------------------
+
+
+class QuotaService:
+    """Cluster quota coordinator — the redis that DistributedRateLimiter.cpp
+    scripts against, as a first-class service process (this image has no
+    redis; the Lua take-or-refill window script becomes a server method over
+    the framework's service RPC).
+
+    Per key: a fixed window of `max_permits` per `interval_s`, refilled when
+    the window expires; `acquire` grants min(requested, remaining) —
+    partial grants let clients batch-reserve local caches.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ..codec.flat import FlatReader, FlatWriter
+        from ..service.rpc import ServiceServer
+
+        self._FlatReader, self._FlatWriter = FlatReader, FlatWriter
+        # key -> (window start, permits used, window length s)
+        self._windows: dict[str, tuple[float, float, float]] = {}
+        self._lock = threading.Lock()
+        self.server = ServiceServer("quota", host, port)
+        self.server.register("acquire", self._acquire)
+        self.host, self.port = self.server.host, self.server.port
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def _acquire(self, payload: bytes) -> bytes:
+        r = self._FlatReader(payload)
+        key = r.str_()
+        want = r.u64()
+        max_permits = r.u64()
+        interval_ms = r.u64()
+        r.done()
+        now = time.monotonic()
+        ival = interval_ms / 1000.0
+        with self._lock:
+            start, used, _ = self._windows.get(key, (now, 0.0, ival))
+            if now - start >= ival:
+                start, used = now, 0.0  # window rolled: refill
+            granted = min(float(want), max(0.0, max_permits - used))
+            self._windows[key] = (start, used + granted, ival)
+            # evict long-expired windows (redis key TTL analog): keys are
+            # client-chosen, so the map must not grow with key churn
+            if len(self._windows) > 4096:
+                for k in [
+                    k
+                    for k, (s, _, iv) in self._windows.items()
+                    if now - s >= 4 * iv and k != key
+                ]:
+                    del self._windows[k]
+        w = self._FlatWriter()
+        w.u64(int(granted))
+        return w.out()
+
+
+class DistributedRateLimiter:
+    """Cluster-wide token budget shared by every gateway enforcing `key`.
+
+    Reference: bcos-gateway/libratelimit/DistributedRateLimiter.cpp — redis
+    windowed counter, a local permit cache of `local_cache_percent`% of the
+    budget to amortize round trips, and failover to a LOCAL token bucket when
+    the coordinator is unreachable (limiting must degrade to per-node, never
+    to unlimited). Same interface as TokenBucketRateLimiter, so
+    RateLimiterManager composes either.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        key: str,
+        max_permits: int,
+        interval_s: float = 1.0,
+        local_cache_percent: int = 15,
+        timeout: float = 5.0,
+    ):
+        from ..service.rpc import ServiceClient
+
+        self.key = key
+        self.max_permits = int(max_permits)
+        self.interval_ms = int(interval_s * 1000)
+        self.chunk = max(1, self.max_permits * local_cache_percent // 100)
+        self.client = ServiceClient(host, port, timeout)
+        self._cache = 0.0
+        self._lock = threading.Lock()
+        # failover: per-node bucket at the full rate (one node alone may
+        # then use the whole cluster budget, but never exceed it)
+        self._fallback = TokenBucketRateLimiter(
+            self.max_permits / max(interval_s, 1e-9), self.max_permits
+        )
+        self.coordinator_failures = 0
+
+    def _remote_acquire(self, want: int) -> int:
+        from ..codec.flat import FlatReader, FlatWriter
+
+        w = FlatWriter()
+        w.str_(self.key)
+        w.u64(want)
+        w.u64(self.max_permits)
+        w.u64(self.interval_ms)
+        out = self.client.call("acquire", w.out())
+        r = FlatReader(out)
+        granted = r.u64()
+        r.done()
+        return granted
+
+    def try_acquire(self, tokens: float = 1.0) -> bool:
+        if tokens > self.max_permits:
+            # can never be satisfied — reject WITHOUT consuming cluster
+            # budget (a partial grant kept here would starve every other
+            # gateway while forwarding nothing)
+            return False
+        with self._lock:
+            if tokens <= self._cache:
+                self._cache -= tokens
+                return True
+            want = max(int(tokens - self._cache + 0.5), self.chunk)
+        # the RPC runs OUTSIDE the lock: a silent coordinator outage must
+        # cost one caller a timeout, not serialize every sender behind it
+        try:
+            granted = self._remote_acquire(want)
+        except Exception:
+            self.coordinator_failures += 1
+            # coordinator down: degrade to the local bucket for THIS
+            # request only; the next call retries the coordinator
+            return self._fallback.try_acquire(tokens)
+        with self._lock:
+            self._cache += granted
+            if tokens <= self._cache:
+                self._cache -= tokens
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            return self._cache
+
+    def close(self) -> None:
+        self.client.close()

@@ -101,6 +101,9 @@ class GenesisConfig:
     # chain VM type (the reference genesis [executor] is_wasm flag): a wasm
     # chain runs liquid/WASM contracts, an EVM chain Solidity bytecode
     is_wasm: bool = False
+    # account-governance governor addresses (hex) — the AuthCommittee
+    # governor list analog consumed by AccountManagerPrecompiled
+    governors: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -182,6 +185,10 @@ class Ledger:
         ):
             e = Entry().set(str(val).encode()).set("enable_number", b"0")
             put(SYS_CONFIG, key, e)
+        if cfg.governors:
+            e = Entry().set(",".join(cfg.governors).encode())
+            e.set("enable_number", b"0")
+            put(SYS_CONFIG, b"auth_governors", e)
         _log.info("genesis built: hash=%s nodes=%d", h.hex()[:16], len(cfg.consensus_nodes))
         return header
 

@@ -34,11 +34,19 @@ class NodeConfig:
     group_id: str = "group0"
     sm_crypto: bool = False
     db_path: str = ":memory:"  # sqlite path; ":memory:"/"" -> MemoryStorage
+    # distributed backend (TiKVStorage analog): "host:port,host:port,..."
+    # storage service endpoints; non-empty overrides db_path
+    storage_endpoints: str = ""
     block_limit: int = 600
     pool_limit: int = 15000 * 9
     # storage_security (bcos-security DataEncryption): non-empty -> every
     # stored value is encrypted at rest with this key
     data_key: bytes = b""
+    # external KeyCenter (KeyCenter.cpp): when set ("host:port" +
+    # cipher_data_key), the node never holds its data key in config — it is
+    # fetched and derived at boot, overriding data_key
+    key_center: str = ""
+    cipher_data_key: str = ""
     genesis: GenesisConfig = field(default_factory=GenesisConfig)
 
 
@@ -52,16 +60,34 @@ class Node:
         self.config = config
         self.suite: CryptoSuite = sm_suite() if config.sm_crypto else ecdsa_suite()
         self.keypair = keypair or self.suite.signature_impl.generate_keypair()
-        self.storage: TransactionalStorage = (
-            MemoryStorage()
-            if config.db_path in ("", ":memory:")
-            else SQLiteStorage(config.db_path)
-        )
-        if config.data_key:
+        if config.storage_endpoints:
+            from ..storage.distributed import DistributedStorage
+
+            eps = []
+            for hp in config.storage_endpoints.split(","):
+                host, port = hp.strip().rsplit(":", 1)
+                eps.append((host, int(port)))
+            self.storage: TransactionalStorage = DistributedStorage(eps)
+        else:
+            self.storage = (
+                MemoryStorage()
+                if config.db_path in ("", ":memory:")
+                else SQLiteStorage(config.db_path)
+            )
+        raw_storage = self.storage  # pre-encryption handle (failover seam)
+        data_key = config.data_key
+        if config.key_center:
+            from ..security.key_center import KeyCenter
+
+            host, port = config.key_center.rsplit(":", 1)
+            data_key = KeyCenter(host, int(port)).get_data_key(
+                config.cipher_data_key, config.sm_crypto
+            )
+        if data_key:
             from ..security import DataEncryption, EncryptedStorage
 
             self.storage = EncryptedStorage(
-                self.storage, DataEncryption(config.data_key, config.sm_crypto)
+                self.storage, DataEncryption(data_key, config.sm_crypto)
             )
         config.genesis.chain_id = config.chain_id
         config.genesis.group_id = config.group_id
@@ -83,6 +109,10 @@ class Node:
         self.scheduler = Scheduler(
             self.executor, self.ledger, self.storage, self.suite, self.txpool
         )
+        # storage failover seam (Initializer.cpp:225-235): backend loss
+        # drops the in-flight scheduler term instead of wedging consensus
+        if hasattr(raw_storage, "set_switch_handler"):
+            raw_storage.set_switch_handler(self.scheduler.switch_term)
         # injected front = multi-group hosting (gateway/group.py GroupGateway
         # hands each group its own front over one shared transport)
         self.front = front if front is not None else FrontService(self.keypair.pub)

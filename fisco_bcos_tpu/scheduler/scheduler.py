@@ -61,6 +61,9 @@ class Scheduler:
         self.suite = suite
         self.txpool = txpool
         self._executed: dict[int, ExecutedBlock] = {}
+        # storage-failover term (SchedulerManager.cpp schedulerTerm analog):
+        # bumped by switch_term when the storage backend connection is lost
+        self.term = 0
         # block-commit listeners: cb(number, committed Block-with-receipts)
         self.on_committed: list = []
         self._lock = threading.RLock()
@@ -76,6 +79,29 @@ class Scheduler:
         """Drain + stop the notify worker (queued block notifications are
         delivered first — Worker.stop posts a sentinel and joins)."""
         self._notify.stop()
+
+    # -- storage failover (SchedulerManager.cpp asyncSwitchTerm) -------------
+
+    def switch_term(self) -> None:
+        """Drop the in-flight execution term after a storage-backend loss.
+
+        Reference: TiKVStorage's connection-loss handler triggers
+        SchedulerManager::triggerSwitch, which abandons the current
+        scheduler instance (its half-executed blocks reference state that
+        may not have been durably staged) and starts term+1. Here the same
+        reset clears the executed-block cache so consensus re-executes its
+        proposals against the recovered backend instead of committing
+        headers derived from writes the backend may have lost.
+        """
+        with self._lock:
+            self.term += 1
+            dropped = sorted(self._executed)
+            self._executed.clear()
+        _log.warning(
+            "storage switch: term -> %d, dropped in-flight blocks %s",
+            self.term,
+            dropped,
+        )
 
     # -- executeBlock:150 ----------------------------------------------------
 

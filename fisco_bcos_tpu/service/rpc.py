@@ -71,6 +71,9 @@ class ServiceServer:
         # one lock: service handlers mutate shared state (executor block
         # context, storage), and tars servants are effectively serialized too
         self._dispatch_lock = threading.Lock()
+        # live connections, closed on stop so a stopped service drops its
+        # clients like a crashed process would (failover tests depend on it)
+        self._conns: set[socket.socket] = set()
 
     def register(self, method: str, fn: Callable[[bytes], bytes]) -> None:
         self._methods[method] = fn
@@ -84,9 +87,31 @@ class ServiceServer:
     def stop(self) -> None:
         self._stop.set()
         try:
+            # shutdown BEFORE close: close alone does not release the
+            # listening socket while the accept thread is parked inside the
+            # accept(2) syscall (the open file description outlives the fd),
+            # leaving the port in LISTEN and un-rebindable
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._listener.close()
         except OSError:
             pass
+        for sock in list(self._conns):
+            try:
+                # RST, not FIN: a stopped service must free its port at once
+                # (no FIN_WAIT/TIME_WAIT) so a restart can rebind — the same
+                # abrupt teardown a crashed process would produce
+                sock.setsockopt(
+                    socket.SOL_SOCKET,
+                    socket.SO_LINGER,
+                    struct.pack("ii", 1, 0),
+                )
+                sock.shutdown(socket.SHUT_RDWR)
+                sock.close()
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -100,6 +125,7 @@ class ServiceServer:
             ).start()
 
     def _serve(self, sock: socket.socket) -> None:
+        self._conns.add(sock)
         while not self._stop.is_set():
             body = _recv_frame(sock)
             if body is None:
@@ -127,6 +153,7 @@ class ServiceServer:
                 _send_frame(sock, w.out())
             except OSError:
                 break
+        self._conns.discard(sock)
         try:
             sock.close()
         except OSError:
@@ -135,6 +162,13 @@ class ServiceServer:
 
 class ServiceRemoteError(RuntimeError):
     pass
+
+
+class ServiceConnectionError(ServiceRemoteError):
+    """Transport-level loss (dial failed / connection dropped) as a TYPE:
+    failover seams (storage switch handler, limiter fallback) key on this
+    class, never on message text — a remote handler error whose text happens
+    to mention connections must not trip a term switch."""
 
 
 class ServiceClient:
@@ -168,7 +202,7 @@ class ServiceClient:
                         self._addr, timeout=self._timeout
                     )
                 except OSError as e:
-                    raise ServiceRemoteError(f"{method}: reconnect failed: {e}")
+                    raise ServiceConnectionError(f"{method}: reconnect failed: {e}")
             req_id = next(self._ids)
             w = FlatWriter()
             w.u64(req_id)
@@ -182,7 +216,7 @@ class ServiceClient:
             if body is None:
                 self._drop_sock()
         if body is None:
-            raise ServiceRemoteError(f"{method}: connection lost")
+            raise ServiceConnectionError(f"{method}: connection lost")
         r = FlatReader(body)
         got_id = r.u64()
         ok = r.u8()

@@ -19,7 +19,7 @@ from ..storage.interfaces import (
     TraversableStorage,
     TwoPCParams,
 )
-from .rpc import ServiceClient, ServiceServer
+from .rpc import ServiceClient, ServiceConnectionError, ServiceServer
 
 
 class StorageService:
@@ -109,16 +109,51 @@ class StorageService:
 
 
 class RemoteStorage(TransactionalStorage):
-    """TransactionalStorage client over a StorageService."""
+    """TransactionalStorage client over a StorageService.
+
+    Failover seam (TiKVStorage.cpp:582 ``setSwitchHandler`` →
+    libinitializer/Initializer.cpp:225-235 → SchedulerManager term switch):
+    a transport-level loss fires ``switch_handler`` once per outage episode
+    before the error propagates, so the scheduler can drop its in-flight
+    term instead of wedging on half-committed state; the underlying
+    ServiceClient redials on the next call, which ends the episode.
+    """
 
     def __init__(self, host: str, port: int, timeout: float = 60.0):
         self.client = ServiceClient(host, port, timeout)
+        self.switch_handler = None  # callable() | None
+        self._outage = False
+
+    def set_switch_handler(self, fn) -> None:
+        self.switch_handler = fn
+
+    def _call(self, method: str, payload: bytes = b"") -> bytes:
+        try:
+            out = self.client.call(method, payload)
+        except ServiceConnectionError:
+            if not self._outage:
+                self._outage = True
+                handler = self.switch_handler
+                if handler is not None:
+                    try:
+                        handler()
+                    except Exception:
+                        pass  # the switch must never mask the storage error
+            raise
+        except Exception:
+            # a reply frame arrived — the transport healed, so the outage
+            # episode is over even though the HANDLER failed; otherwise the
+            # next real outage would be silently swallowed
+            self._outage = False
+            raise
+        self._outage = False
+        return out
 
     def get_row(self, table: str, key: bytes) -> Entry | None:
         w = FlatWriter()
         w.str_(table)
         w.bytes_(bytes(key))
-        out = self.client.call("get_row", w.out())
+        out = self._call("get_row", w.out())
         r = FlatReader(out)
         if not r.u8():
             r.done()
@@ -132,7 +167,7 @@ class RemoteStorage(TransactionalStorage):
         w.str_(table)
         w.bytes_(bytes(key))
         w.bytes_(entry.encode())
-        self.client.call("set_row", w.out())
+        self._call("set_row", w.out())
 
     def set_rows(self, table: str, items) -> None:
         w = FlatWriter()
@@ -141,12 +176,12 @@ class RemoteStorage(TransactionalStorage):
             list(items),
             lambda w2, kv: (w2.bytes_(bytes(kv[0])), w2.bytes_(kv[1].encode())),
         )
-        self.client.call("set_rows", w.out())
+        self._call("set_rows", w.out())
 
     def get_primary_keys(self, table: str) -> list[bytes]:
         w = FlatWriter()
         w.str_(table)
-        out = self.client.call("get_primary_keys", w.out())
+        out = self._call("get_primary_keys", w.out())
         r = FlatReader(out)
         keys = r.seq(lambda r2: r2.bytes_())
         r.done()
@@ -163,17 +198,17 @@ class RemoteStorage(TransactionalStorage):
                 w2.bytes_(row[2].encode()),
             ),
         )
-        self.client.call("prepare", w.out())
+        self._call("prepare", w.out())
 
     def commit(self, params: TwoPCParams) -> None:
         w = FlatWriter()
         w.u64(params.number)
-        self.client.call("commit", w.out())
+        self._call("commit", w.out())
 
     def rollback(self, params: TwoPCParams) -> None:
         w = FlatWriter()
         w.u64(params.number)
-        self.client.call("rollback", w.out())
+        self._call("rollback", w.out())
 
     def close(self) -> None:
         self.client.close()

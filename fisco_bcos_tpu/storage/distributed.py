@@ -1,0 +1,136 @@
+"""Distributed storage — sharded KV over multiple storage service processes.
+
+Reference: bcos-storage/bcos-storage/TiKVStorage.{h,cpp}: the Pro/Max
+deployments back the chain on a distributed KV store (TiKV regions +
+two-phase commit via a primary lock, connection-loss switch handler :582).
+This analog reaches the same capability TPU-natively cheap: N independent
+StorageService processes are the "regions", a deterministic hash partition
+(table, key) → shard replaces PD placement, and the chain's own block-number
+2PC (prepare/commit/rollback fan-out, primary-first) replaces Percolator.
+
+Semantics:
+- `get_row`/`set_row` route by ``shard_of(table, key)``; whole-table scans
+  (`get_primary_keys`) fan out and merge.
+- `prepare(params, writes)` partitions the write set and prepares every
+  shard — shard 0 is the PRIMARY (TiKV's primary-lock role): it is prepared
+  first and committed first; a crash between phases leaves secondaries
+  recoverable by re-driving the same block number (prepare is idempotent,
+  keyed on number).
+- Any transport loss fires ``switch_handler`` (once per outage episode)
+  before the error propagates — the same scheduler term-switch seam as
+  :class:`fisco_bcos_tpu.service.storage_service.RemoteStorage`.
+
+System tables (s_*) are small and hot; they shard like any other row — reads
+are one round trip either way, and one routing rule means a restarted node
+finds every row exactly where it wrote it (placement is per-node plumbing;
+consensus state roots are computed from overlay contents upstream of this
+layer, so shard layout never leaks into them).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterator
+
+from ..service.rpc import ServiceConnectionError
+from ..service.storage_service import RemoteStorage
+from ..storage.entry import Entry
+from ..storage.interfaces import (
+    TransactionalStorage,
+    TraversableStorage,
+    TwoPCParams,
+)
+from ..utils.log import get_logger
+
+_log = get_logger("dist-storage")
+
+
+class _RowsView(TraversableStorage):
+    def __init__(self, rows):
+        self._rows = rows
+
+    def traverse(self) -> Iterator:
+        yield from self._rows
+
+
+class DistributedStorage(TransactionalStorage):
+    """TransactionalStorage over N sharded StorageService endpoints."""
+
+    def __init__(self, endpoints: list[tuple[str, int]], timeout: float = 60.0):
+        if not endpoints:
+            raise ValueError("DistributedStorage needs at least one endpoint")
+        self.shards = [RemoteStorage(h, p, timeout) for h, p in endpoints]
+        self.switch_handler = None
+        for sh in self.shards:
+            # every shard loss funnels into ONE switch seam; RemoteStorage
+            # dedups per-shard episodes, this layer just forwards
+            sh.set_switch_handler(self._on_shard_loss)
+
+    def set_switch_handler(self, fn) -> None:
+        self.switch_handler = fn
+
+    def _on_shard_loss(self) -> None:
+        handler = self.switch_handler
+        if handler is not None:
+            handler()
+
+    # -- routing ------------------------------------------------------------
+
+    def shard_of(self, table: str, key: bytes) -> int:
+        """Deterministic placement: blake2b of (table, key) mod N — stable
+        across restarts for a fixed shard count (resharding is a migration,
+        not a runtime event; TiKV's PD does it live, out of scope)."""
+        h = hashlib.blake2b(
+            table.encode() + b"\x00" + bytes(key), digest_size=8
+        ).digest()
+        return int.from_bytes(h, "big") % len(self.shards)
+
+    # -- KV surface ---------------------------------------------------------
+
+    def get_row(self, table: str, key: bytes) -> Entry | None:
+        return self.shards[self.shard_of(table, key)].get_row(table, key)
+
+    def set_row(self, table: str, key: bytes, entry: Entry) -> None:
+        self.shards[self.shard_of(table, key)].set_row(table, key, entry)
+
+    def set_rows(self, table: str, items) -> None:
+        by_shard: dict[int, list] = {}
+        for k, e in items:
+            by_shard.setdefault(self.shard_of(table, k), []).append((k, e))
+        for idx, part in by_shard.items():
+            self.shards[idx].set_rows(table, part)
+
+    def get_primary_keys(self, table: str) -> list[bytes]:
+        keys: list[bytes] = []
+        for sh in self.shards:
+            keys.extend(sh.get_primary_keys(table))
+        return sorted(set(keys))
+
+    # -- 2PC (TiKVStorage asyncPrepare/asyncCommit/asyncRollback) -----------
+
+    def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
+        parts: dict[int, list] = {i: [] for i in range(len(self.shards))}
+        for t, k, e in writes.traverse():
+            parts[self.shard_of(t, k)].append((t, k, e))
+        # primary (shard 0) first — its prepared marker is the commit
+        # point-of-no-return witness, like TiKV's primary lock
+        for idx in range(len(self.shards)):
+            self.shards[idx].prepare(params, _RowsView(parts[idx]))
+
+    def commit(self, params: TwoPCParams) -> None:
+        for idx in range(len(self.shards)):  # primary first
+            self.shards[idx].commit(params)
+
+    def rollback(self, params: TwoPCParams) -> None:
+        errs = 0
+        for sh in self.shards:
+            try:
+                sh.rollback(params)
+            except ServiceConnectionError:
+                errs += 1  # a dead shard has nothing durable to roll back
+        if errs:
+            _log.warning("rollback skipped %d unreachable shards", errs)
+
+    def close(self) -> None:
+        for sh in self.shards:
+            sh.close()

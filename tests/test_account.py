@@ -1,0 +1,245 @@
+"""Account governance (freeze/unfreeze/abolish) + crypto precompile surface.
+
+Reference: bcos-executor/src/precompiled/extension/
+{AccountManagerPrecompiled.cpp, AccountPrecompiled.cpp},
+bcos-executor/src/executive/TransactionExecutive.cpp:1292 (pre-frame account
+status enforcement), bcos-executor/src/precompiled/CryptoPrecompiled.cpp
+(sm2Verify, curve25519VRFVerify).
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor import TransactionExecutor  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import (  # noqa: E402
+    ACCOUNT_MGR_ADDRESS,
+    CRYPTO_ADDRESS,
+)
+from fisco_bcos_tpu.executor.precompiled.account import (  # noqa: E402
+    CODE_NO_AUTHORIZED,
+)
+from fisco_bcos_tpu.protocol.block_header import BlockHeader  # noqa: E402
+from fisco_bcos_tpu.protocol.receipt import TransactionStatus  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import Transaction  # noqa: E402
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+from fisco_bcos_tpu.storage.entry import Entry  # noqa: E402
+
+SUITE = ecdsa_suite()
+GOVERNOR = b"\x0a" * 20
+ALICE = b"\x0b" * 20
+MALLORY = b"\x0c" * 20
+
+
+def make_executor(number=1):
+    backend = MemoryStorage()
+    backend.set_row(
+        "s_config", b"auth_governors", Entry().set(("0x" + GOVERNOR.hex()).encode())
+    )
+    ex = TransactionExecutor(backend, SUITE)
+    ex.next_block_header(BlockHeader(number=number, timestamp=1_700_000_000))
+    return ex
+
+
+def mgr_call(ex, sig, *args, sender=GOVERNOR):
+    tx = Transaction(
+        to=ACCOUNT_MGR_ADDRESS, input=ex.codec.encode_call(sig, *args), sender=sender
+    )
+    return ex.execute_transactions([tx])[0]
+
+
+def get_status(ex, account) -> int:
+    rc = mgr_call(ex, "getAccountStatus(address)", account)
+    assert rc.status == 0
+    (st,) = ex.codec.decode_output(["uint8"], rc.output)
+    return st
+
+
+def advance(ex, number):
+    # persist the open block's writes (the scheduler's 2PC does this live)
+    ex._block.storage.merge_into_prev()
+    ex.next_block_header(BlockHeader(number=number, timestamp=1_700_000_000))
+
+
+def test_freeze_blocks_sender_next_block():
+    ex = make_executor(number=1)
+    rc = mgr_call(ex, "setAccountStatus(address,uint8)", ALICE, 1)
+    assert rc.status == 0
+    (code,) = ex.codec.decode_output(["int32"], rc.output)
+    assert code == 0
+    # the write landed at block 1: reads at block 1 still see normal
+    assert get_status(ex, ALICE) == 0
+    # from block 2 on the freeze is effective (lastUpdateNumber semantics)
+    advance(ex, 2)
+    assert get_status(ex, ALICE) == 1
+    # frozen origin cannot transact
+    tx = Transaction(
+        to=ACCOUNT_MGR_ADDRESS,
+        input=ex.codec.encode_call("getAccountStatus(address)", ALICE),
+        sender=ALICE,
+    )
+    rc = ex.execute_transactions([tx])[0]
+    assert rc.status == int(TransactionStatus.ACCOUNT_FROZEN)
+    # unfreeze restores it one block later
+    assert mgr_call(ex, "setAccountStatus(address,uint8)", ALICE, 0).status == 0
+    advance(ex, 3)
+    assert get_status(ex, ALICE) == 0
+    rc = ex.execute_transactions([tx])[0]
+    assert rc.status == 0
+
+
+def test_abolish_is_terminal():
+    ex = make_executor(number=1)
+    assert mgr_call(ex, "setAccountStatus(address,uint8)", ALICE, 2).status == 0
+    advance(ex, 2)
+    assert get_status(ex, ALICE) == 2
+    # abolished accounts can never be set to any other status
+    rc = mgr_call(ex, "setAccountStatus(address,uint8)", ALICE, 0)
+    assert rc.status == int(TransactionStatus.PRECOMPILED_ERROR)
+    advance(ex, 3)
+    tx = Transaction(
+        to=ACCOUNT_MGR_ADDRESS,
+        input=ex.codec.encode_call("getAccountStatus(address)", ALICE),
+        sender=ALICE,
+    )
+    rc = ex.execute_transactions([tx])[0]
+    assert rc.status == int(TransactionStatus.ACCOUNT_ABOLISHED)
+
+
+def test_same_block_double_write_keeps_block_start_status():
+    """Two status writes in one block must not make the first visible at the
+    write block (the N+1 effectiveness rule)."""
+    ex = make_executor(number=1)
+    assert mgr_call(ex, "setAccountStatus(address,uint8)", ALICE, 1).status == 0
+    assert mgr_call(ex, "setAccountStatus(address,uint8)", ALICE, 0).status == 0
+    # reads AT block 1 (same block as both writes) still see block-start
+    # normal — not the intermediate freeze
+    assert get_status(ex, ALICE) == 0
+    tx = Transaction(
+        to=ACCOUNT_MGR_ADDRESS,
+        input=ex.codec.encode_call("getAccountStatus(address)", ALICE),
+        sender=ALICE,
+    )
+    assert ex.execute_transactions([tx])[0].status == 0
+    advance(ex, 2)
+    assert get_status(ex, ALICE) == 0  # final write wins from block 2
+
+
+def test_governor_gating():
+    ex = make_executor(number=1)
+    # non-governor gets the soft NO_AUTHORIZED code, not a revert
+    rc = mgr_call(ex, "setAccountStatus(address,uint8)", ALICE, 1, sender=MALLORY)
+    assert rc.status == 0
+    (code,) = ex.codec.decode_output(["int32"], rc.output)
+    assert code == CODE_NO_AUTHORIZED
+    advance(ex, 2)
+    assert get_status(ex, ALICE) == 0
+    # a governor's own status may never be set
+    rc = mgr_call(ex, "setAccountStatus(address,uint8)", GOVERNOR, 1)
+    assert rc.status == int(TransactionStatus.PRECOMPILED_ERROR)
+
+
+def test_vrf_prove_verify_roundtrip():
+    from fisco_bcos_tpu.crypto.ref.vrf import (
+        is_valid_public_key,
+        vrf_proof_to_hash,
+        vrf_prove,
+        vrf_verify,
+    )
+    from fisco_bcos_tpu.crypto.ref.ed25519 import BASE, _compress, _mul
+
+    secret = 0xC0FFEE
+    pub = _compress(_mul(secret, BASE))
+    assert is_valid_public_key(pub)
+    alpha = b"pbft view 7 round 3"
+    pi = vrf_prove(secret, alpha)
+    assert len(pi) == 80
+    assert vrf_verify(pub, alpha, pi)
+    beta = vrf_proof_to_hash(pi)
+    assert beta is not None and len(beta) == 32
+    # determinism: same key+input -> same proof hash
+    assert vrf_proof_to_hash(vrf_prove(secret, alpha)) == beta
+    # tampered proof / wrong input / wrong key all fail
+    bad = bytearray(pi)
+    bad[40] ^= 1
+    assert not vrf_verify(pub, alpha, bytes(bad))
+    assert not vrf_verify(pub, b"other input", pi)
+    pub2 = _compress(_mul(secret + 1, BASE))
+    assert not vrf_verify(pub2, alpha, pi)
+
+
+def test_crypto_precompiled_vrf_and_sm2():
+    from fisco_bcos_tpu.crypto.ref import ecdsa as refec
+    from fisco_bcos_tpu.crypto.ref.sm3 import sm3
+    from fisco_bcos_tpu.crypto.ref.vrf import vrf_prove
+    from fisco_bcos_tpu.crypto.ref.ed25519 import BASE, _compress, _mul
+
+    ex = make_executor(number=1)
+
+    secret = 0xBEEF
+    pub = _compress(_mul(secret, BASE))
+    alpha = b"random beacon input"
+    pi = vrf_prove(secret, alpha)
+    tx = Transaction(
+        to=CRYPTO_ADDRESS,
+        input=ex.codec.encode_call(
+            "curve25519VRFVerify(bytes,bytes,bytes)", alpha, pub, pi
+        ),
+        sender=ALICE,
+    )
+    rc = ex.execute_transactions([tx])[0]
+    assert rc.status == 0
+    ok, rand = ex.codec.decode_output(["bool", "uint256"], rc.output)
+    assert ok and rand != 0
+    # garbage proof -> (False, 0)
+    tx = Transaction(
+        to=CRYPTO_ADDRESS,
+        input=ex.codec.encode_call(
+            "curve25519VRFVerify(bytes,bytes,bytes)", alpha, pub, b"\x00" * 80
+        ),
+        sender=ALICE,
+    )
+    rc = ex.execute_transactions([tx])[0]
+    ok, rand = ex.codec.decode_output(["bool", "uint256"], rc.output)
+    assert not ok and rand == 0
+
+    # sm2Verify: a valid signature yields (True, right160(sm3(pub)))
+    import hashlib
+
+    d = 0x1234567
+    h = hashlib.sha256(b"sm2 precompile test").digest()
+    r, s = refec.sm2_sign(h, d)
+    qx, qy = refec.privkey_to_pubkey(refec.SM2_CURVE, d)
+    pub_sm2 = qx.to_bytes(32, "big") + qy.to_bytes(32, "big")
+    tx = Transaction(
+        to=CRYPTO_ADDRESS,
+        input=ex.codec.encode_call(
+            "sm2Verify(bytes32,bytes,bytes32,bytes32)",
+            h,
+            pub_sm2,
+            r.to_bytes(32, "big"),
+            s.to_bytes(32, "big"),
+        ),
+        sender=ALICE,
+    )
+    rc = ex.execute_transactions([tx])[0]
+    assert rc.status == 0
+    ok, account = ex.codec.decode_output(["bool", "address"], rc.output)
+    assert ok and account == sm3(pub_sm2)[12:]
+    # flipped hash -> verification fails
+    bad_h = bytes([h[0] ^ 1]) + h[1:]
+    tx = Transaction(
+        to=CRYPTO_ADDRESS,
+        input=ex.codec.encode_call(
+            "sm2Verify(bytes32,bytes,bytes32,bytes32)",
+            bad_h,
+            pub_sm2,
+            r.to_bytes(32, "big"),
+            s.to_bytes(32, "big"),
+        ),
+        sender=ALICE,
+    )
+    rc = ex.execute_transactions([tx])[0]
+    ok, _ = ex.codec.decode_output(["bool", "address"], rc.output)
+    assert not ok

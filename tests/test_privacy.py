@@ -1,0 +1,201 @@
+"""Privacy suite: LSAG ring signatures + Pedersen discrete-log ZKPs, and
+their precompile surface.
+
+Reference: bcos-executor/src/precompiled/extension/{RingSigPrecompiled.cpp,
+ZkpPrecompiled.cpp, GroupSigPrecompiled.cpp},
+bcos-crypto/bcos-crypto/zkp/discretezkp/DiscreteLogarithmZkp.cpp.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from fisco_bcos_tpu.crypto.ref import pedersen_zkp as zkp  # noqa: E402
+from fisco_bcos_tpu.crypto.ref import ringsig  # noqa: E402
+from fisco_bcos_tpu.crypto.ref.ed25519 import BASE, _compress, _mul  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor import TransactionExecutor  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import (  # noqa: E402
+    DISCRETE_ZKP_ADDRESS,
+    GROUP_SIG_ADDRESS,
+    RING_SIG_ADDRESS,
+)
+from fisco_bcos_tpu.protocol.block_header import BlockHeader  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import Transaction  # noqa: E402
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+
+SUITE = ecdsa_suite()
+
+G_B = _compress(BASE)
+H_B = _compress(zkp.default_blinding_base())
+
+
+# -- LSAG ring signatures ----------------------------------------------------
+
+
+def test_ring_sign_verify_and_linkability():
+    keys = [ringsig.keypair(secret=1000 + i) for i in range(4)]
+    ring = [pub for _, pub in keys]
+    msg = b"vote: proposal 7 = yes"
+    sig = ringsig.ring_sign(msg, ring, keys[2][0], 2)
+    assert ringsig.ring_verify(msg, ring, sig)
+    # verification hides the signer: signatures from every index verify
+    sig0 = ringsig.ring_sign(msg, ring, keys[0][0], 0)
+    assert ringsig.ring_verify(msg, ring, sig0)
+    # linkability: same signer -> same key image, across messages
+    sig2b = ringsig.ring_sign(b"other msg", ring, keys[2][0], 2)
+    assert ringsig.key_image(sig) == ringsig.key_image(sig2b)
+    assert ringsig.key_image(sig) != ringsig.key_image(sig0)
+    # tamper / wrong ring / wrong message all fail
+    bad = bytearray(sig)
+    bad[70] ^= 1
+    assert not ringsig.ring_verify(msg, ring, bytes(bad))
+    assert not ringsig.ring_verify(b"forged", ring, sig)
+    other_ring = ring[:3] + [ringsig.keypair(secret=9)[1]]
+    assert not ringsig.ring_verify(msg, other_ring, sig)
+
+
+# -- Pedersen ZKPs -----------------------------------------------------------
+
+
+def test_knowledge_proof():
+    c, proof = zkp.prove_knowledge(42, 777, G_B, H_B)
+    assert zkp.verify_knowledge(c, proof, G_B, H_B)
+    bad = bytearray(proof)
+    bad[40] ^= 1
+    assert not zkp.verify_knowledge(c, bytes(bad), G_B, H_B)
+    # a commitment to a different value fails under the same proof
+    c2, _ = zkp.prove_knowledge(43, 777, G_B, H_B)
+    assert not zkp.verify_knowledge(c2, proof, G_B, H_B)
+
+
+def test_equality_proof():
+    g2 = _compress(_mul(12345, BASE))
+    c1, c2, proof = zkp.prove_equality(31337, G_B, g2)
+    assert zkp.verify_equality(c1, c2, proof, G_B, g2)
+    assert not zkp.verify_equality(c2, c1, proof, G_B, g2)
+
+
+def test_format_proof():
+    h2 = _compress(_mul(777777, BASE))
+    c1, c2, proof = zkp.prove_format(9, 1234, G_B, H_B, h2)
+    assert zkp.verify_format(c1, c2, proof, G_B, H_B, h2)
+    # c2 committed with a different blinding breaks the relation
+    _, c2_bad, _ = zkp.prove_format(9, 1235, G_B, H_B, h2)
+    assert not zkp.verify_format(c1, c2_bad, proof, G_B, H_B, h2)
+
+
+def _commit(v, r):
+    return _compress(zkp.pedersen_commit(v, r))
+
+
+def test_sum_and_product_proofs():
+    v1, r1 = 11, 101
+    v2, r2 = 31, 202
+    # sum: v3 = v1 + v2
+    v3, r3 = v1 + v2, 303
+    c1, c2, c3 = _commit(v1, r1), _commit(v2, r2), _commit(v3, r3)
+    proof = zkp.prove_sum((r1, r2, r3), (c1, c2, c3), H_B)
+    assert zkp.verify_sum(c1, c2, c3, proof, G_B, H_B)
+    # a wrong sum commitment fails
+    c3_bad = _commit(v3 + 1, r3)
+    assert not zkp.verify_sum(c1, c2, c3_bad, proof, G_B, H_B)
+
+    # product: v3 = v1 * v2
+    v3p, r3p = v1 * v2, 404
+    c3p = _commit(v3p, r3p)
+    pproof = zkp.prove_product(
+        (v1, v2, v3p), (r1, r2, r3p), (c1, c2, c3p), G_B, H_B
+    )
+    assert zkp.verify_product(c1, c2, c3p, pproof, G_B, H_B)
+    c3p_bad = _commit(v3p + 1, r3p)
+    assert not zkp.verify_product(c1, c2, c3p_bad, pproof, G_B, H_B)
+
+
+def test_either_equality_or_proof():
+    v, r1 = 55, 11
+    v2, r2 = 66, 22
+    r3 = 33
+    c1, c2 = _commit(v, r1), _commit(v2, r2)
+    c3 = _commit(v, r3)  # equals C1's value
+    # true branch 0 (C3 vs C1)
+    proof = zkp.prove_either_equality(0, (r3 - r1), (c1, c2, c3), H_B)
+    assert zkp.verify_either_equality(c1, c2, c3, proof, G_B, H_B)
+    # true branch 1 (C3 vs C2)
+    c3b = _commit(v2, r3)
+    proof_b = zkp.prove_either_equality(1, (r3 - r2), (c1, c2, c3b), H_B)
+    assert zkp.verify_either_equality(c1, c2, c3b, proof_b, G_B, H_B)
+    # neither-equal fails even with a "proof" for the wrong statement
+    c3c = _commit(999, r3)
+    assert not zkp.verify_either_equality(c1, c2, c3c, proof, G_B, H_B)
+
+
+def test_aggregate_point():
+    p1 = _compress(_mul(5, BASE))
+    p2 = _compress(_mul(7, BASE))
+    assert zkp.aggregate_point(p1, p2) == _compress(_mul(12, BASE))
+    assert zkp.aggregate_point(b"\xff" * 32, p2) is None
+
+
+# -- precompile surface ------------------------------------------------------
+
+
+def _executor():
+    ex = TransactionExecutor(MemoryStorage(), SUITE)
+    ex.next_block_header(BlockHeader(number=1, timestamp=1_700_000_000))
+    return ex
+
+
+def _call(ex, to, sig, *args):
+    tx = Transaction(to=to, input=ex.codec.encode_call(sig, *args), sender=b"\x01" * 20)
+    return ex.execute_transactions([tx])[0]
+
+
+def test_precompile_surface():
+    ex = _executor()
+
+    # ring sig through the chain ABI (hex-string wire form, as the FFI takes)
+    keys = [ringsig.keypair(secret=2000 + i) for i in range(3)]
+    ring = [pub for _, pub in keys]
+    msg = "onchain-vote"
+    sig = ringsig.ring_sign(msg.encode(), ring, keys[1][0], 1)
+    rc = _call(
+        ex, RING_SIG_ADDRESS, "ringSigVerify(string,string,string)",
+        sig.hex(), msg, b"".join(ring).hex(),
+    )
+    assert rc.status == 0
+    code, ok = ex.codec.decode_output(["int32", "bool"], rc.output)
+    assert ok and code == 0
+    # a forged message is a negative RESULT, not a revert
+    rc = _call(
+        ex, RING_SIG_ADDRESS, "ringSigVerify(string,string,string)",
+        sig.hex(), "forged", b"".join(ring).hex(),
+    )
+    assert rc.status == 0
+    code, ok = ex.codec.decode_output(["int32", "bool"], rc.output)
+    assert not ok and code != 0
+
+    # zkp knowledge proof on-chain
+    c, proof = zkp.prove_knowledge(7, 99, G_B, H_B)
+    rc = _call(
+        ex, DISCRETE_ZKP_ADDRESS,
+        "verifyKnowledgeProof(bytes,bytes,bytes,bytes)", c, proof, G_B, H_B,
+    )
+    code, ok = ex.codec.decode_output(["int32", "bool"], rc.output)
+    assert ok
+    # aggregatePoint on-chain
+    rc = _call(
+        ex, DISCRETE_ZKP_ADDRESS, "aggregatePoint(bytes,bytes)",
+        _compress(_mul(3, BASE)), _compress(_mul(4, BASE)),
+    )
+    code, out = ex.codec.decode_output(["int32", "bytes"], rc.output)
+    assert code == 0 and out == _compress(_mul(7, BASE))
+
+    # group sig: explicit unsupported gate, deterministic failure result
+    rc = _call(
+        ex, GROUP_SIG_ADDRESS, "groupSigVerify(string,string,string,string)",
+        "00", "msg", "00", "00",
+    )
+    assert rc.status == 0
+    code, ok = ex.codec.decode_output(["int32", "bool"], rc.output)
+    assert not ok and code == -70502

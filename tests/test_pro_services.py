@@ -65,10 +65,14 @@ def _spawn_service(args):
     def drain():
         for line in proc.stdout:
             if line.startswith("READY"):
-                ready.update(
-                    (k, int(v))
+                # parse fully BEFORE publishing: the poll loop returns as
+                # soon as `ready` is non-empty, so a piecewise update could
+                # hand back a partial port map
+                parsed = {
+                    k: int(v)
                     for k, v in (kv.split("=") for kv in line.strip().split()[1:])
-                )
+                }
+                ready.update(parsed)
 
     threading.Thread(target=drain, daemon=True).start()
     deadline = time.monotonic() + 60

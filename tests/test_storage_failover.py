@@ -1,0 +1,151 @@
+"""Storage-backend failover: connection loss → scheduler term switch.
+
+Reference: bcos-storage/bcos-storage/TiKVStorage.cpp:582 (setSwitchHandler on
+connection loss), libinitializer/Initializer.cpp:225-235 (handler wired to
+SchedulerManager::triggerSwitch), bcos-scheduler/src/SchedulerManager.cpp
+(asyncSwitchTerm: abandon the in-flight term, re-drive after recovery).
+
+The node must not wedge when its storage process dies mid-2PC: the switch
+handler drops the in-flight executed-block cache (whose state may reference
+never-durably-staged writes), and once the storage process is back, the same
+proposal re-executes from clean state and commits.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from fisco_bcos_tpu.codec.abi import ABICodec  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.executor import TransactionExecutor  # noqa: E402
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig, Ledger  # noqa: E402
+from fisco_bcos_tpu.protocol.block import Block  # noqa: E402
+from fisco_bcos_tpu.protocol.block_header import BlockHeader, ParentInfo  # noqa: E402
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory  # noqa: E402
+from fisco_bcos_tpu.scheduler.scheduler import Scheduler  # noqa: E402
+from fisco_bcos_tpu.service import RemoteStorage, StorageService  # noqa: E402
+from fisco_bcos_tpu.service.rpc import ServiceRemoteError  # noqa: E402
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+def _make_block(ledger, kp, fac, number, n_txs):
+    parent = ledger.ledger_config()
+    txs = [
+        fac.create_signed(
+            kp,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500 + number,
+            nonce=f"fo-{number}-{i}",
+            to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call("userAdd(string,uint256)", f"fo{number}{i}", 5),
+        )
+        for i in range(n_txs)
+    ]
+    header = BlockHeader(
+        number=number,
+        parent_info=[ParentInfo(number - 1, parent.block_hash)],
+        timestamp=1_700_000_000 + number,
+        sealer_list=[kp.pub],
+        consensus_weights=[1],
+    )
+    block = Block(header=header, transactions=txs)
+    header.txs_root = block.calculate_txs_root(SUITE)
+    header.clear_hash_cache()
+    return block
+
+
+def test_storage_loss_triggers_term_switch_and_recovers():
+    backing = MemoryStorage()  # survives the service "crash" like a disk would
+    svc = StorageService(backing)
+    svc.start()
+    port = svc.port
+
+    storage = RemoteStorage(svc.host, port, timeout=5.0)
+    kp = SUITE.signature_impl.generate_keypair(secret=0x5707)
+    ledger = Ledger(storage, SUITE)
+    ledger.build_genesis(
+        GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub, weight=1)])
+    )
+    executor = TransactionExecutor(storage, SUITE)
+    scheduler = Scheduler(executor, ledger, storage, SUITE)
+    # the Initializer.cpp:225 wiring: connection loss → term switch
+    storage.set_switch_handler(scheduler.switch_term)
+    fac = TransactionFactory(SUITE)
+
+    # block 1 commits normally
+    b1 = _make_block(ledger, kp, fac, 1, 2)
+    h1 = scheduler.execute_block(b1)
+    scheduler.commit_block(h1)
+    assert ledger.block_number() == 1 and scheduler.term == 0
+
+    # block 2 executes, then the storage process dies before the commit 2PC
+    b2 = _make_block(ledger, kp, fac, 2, 3)
+    h2 = scheduler.execute_block(b2)
+    svc.stop()
+    with pytest.raises(ServiceRemoteError):
+        scheduler.commit_block(h2)
+    # the switch fired: term bumped, the in-flight block was dropped
+    assert scheduler.term == 1
+    assert scheduler._executed == {}
+
+    # storage process restarts on the same endpoint with the same disk
+    svc2 = StorageService(backing, host=svc.host, port=port)
+    svc2.start()
+    try:
+        # the SAME proposal re-executes from clean state and commits
+        b2b = _make_block(ledger, kp, fac, 2, 3)
+        h2b = scheduler.execute_block(b2b)
+        scheduler.commit_block(h2b)
+        assert ledger.block_number() == 2
+        assert scheduler.term == 1  # no further switches
+        # and the chain keeps going
+        b3 = _make_block(ledger, kp, fac, 3, 1)
+        h3 = scheduler.execute_block(b3)
+        scheduler.commit_block(h3)
+        assert ledger.block_number() == 3
+    finally:
+        svc2.stop()
+        scheduler.stop()
+
+
+def test_reads_fail_over_cleanly_mid_outage():
+    """During the outage window every storage call raises (never hangs), and
+    the first post-restart call heals without constructing a new client."""
+    backing = MemoryStorage()
+    svc = StorageService(backing)
+    svc.start()
+    port = svc.port
+    storage = RemoteStorage(svc.host, port, timeout=5.0)
+    fired = []
+    storage.set_switch_handler(lambda: fired.append(1))
+
+    from fisco_bcos_tpu.storage.entry import Entry
+
+    storage.set_row("t", b"k", Entry().set(b"v1"))
+    assert storage.get_row("t", b"k").get() == b"v1"
+
+    svc.stop()
+    with pytest.raises(ServiceRemoteError):
+        storage.get_row("t", b"k")
+    with pytest.raises(ServiceRemoteError):
+        storage.get_row("t", b"k")
+    assert fired == [1]  # once per outage episode, not per call
+
+    svc2 = StorageService(backing, host=svc.host, port=port)
+    svc2.start()
+    try:
+        assert storage.get_row("t", b"k").get() == b"v1"
+        # a second outage fires the handler again
+        svc2.stop()
+        with pytest.raises(ServiceRemoteError):
+            storage.get_row("t", b"k")
+        assert fired == [1, 1]
+    finally:
+        svc2.stop()
