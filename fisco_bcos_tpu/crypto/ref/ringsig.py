@@ -108,8 +108,14 @@ def ring_verify(msg: bytes, ring: list[bytes], sig: bytes) -> bool:
     image = _decompress(sig[:32])
     if image is None:
         return False
-    # small-order image would break linkability (torsion double-signing)
-    if _eq_points(_mul(8, image), IDENT):
+    # the image must lie in the PRIME-ORDER subgroup: a torsion-contaminated
+    # image I' = x*H(P) + T (T of order 8) verifies whenever the signer
+    # grinds the nonce until 8 | c, yielding a second unlinkable signature
+    # from the same key — the classic CryptoNote key-image forgery. L*I == O
+    # rejects every torsion component, not just pure small-order images.
+    if not _eq_points(_mul(L, image), IDENT):
+        return False
+    if _eq_points(image, IDENT):
         return False
     ring_blob = b"".join(ring)
     image_b = sig[:32]

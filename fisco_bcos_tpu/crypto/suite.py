@@ -197,23 +197,30 @@ class Ed25519Crypto(SignatureCrypto):
         return pub
 
     def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
-        return np.array(
-            [
-                self.verify(bytes(p), bytes(h), bytes(s))
-                for h, p, s in zip(msg_hashes, pubs, sigs)
-            ]
+        """One fused device program for the whole batch: all curve math
+        (decompression, dual ladder, cofactored identity check) on device;
+        SHA-512 challenges on host (ops/ed25519.py module docstring)."""
+        from ..ops import ed25519 as ed_ops
+
+        return ed_ops.verify_batch(
+            [bytes(h) for h in msg_hashes],
+            [bytes(p) for p in pubs],
+            [bytes(s) for s in sigs],
         )
 
     def batch_recover(self, msg_hashes, sigs):
-        pubs, ok = [], []
-        for h, s in zip(msg_hashes, sigs):
-            try:
-                pubs.append(self.recover(bytes(h), bytes(s)))
-                ok.append(True)
-            except ValueError:
-                pubs.append(b"\x00" * 32)
-                ok.append(False)
-        return np.frombuffer(b"".join(pubs), np.uint8).reshape(-1, 32), np.array(ok)
+        """Parse the appended key, then device-batch-verify (ed25519 has no
+        algebraic recovery; the 96-byte R‖S‖pub format carries the key)."""
+        sigs = [bytes(s) for s in sigs]
+        pubs = [s[64:96] for s in sigs]
+        ok = self.batch_verify(msg_hashes, pubs, sigs)
+        out = np.frombuffer(
+            b"".join(
+                p if good else b"\x00" * 32 for p, good in zip(pubs, ok)
+            ),
+            np.uint8,
+        ).reshape(-1, 32)
+        return out, np.asarray(ok)
 
 
 class Secp256k1Crypto(SignatureCrypto):

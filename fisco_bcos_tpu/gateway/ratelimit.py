@@ -174,6 +174,11 @@ class DistributedRateLimiter:
         self.chunk = max(1, self.max_permits * local_cache_percent // 100)
         self.client = ServiceClient(host, port, timeout)
         self._cache = 0.0
+        # cached permits die with the window they were granted in — carrying
+        # them across refills would let each gateway overshoot the cluster
+        # budget by one chunk per window (the reference clears its local
+        # cache on a per-interval timer)
+        self._cache_born = time.monotonic()
         self._lock = threading.Lock()
         # failover: per-node bucket at the full rate (one node alone may
         # then use the whole cluster budget, but never exceed it)
@@ -203,6 +208,10 @@ class DistributedRateLimiter:
             # gateway while forwarding nothing)
             return False
         with self._lock:
+            now = time.monotonic()
+            if now - self._cache_born >= self.interval_ms / 1000.0:
+                self._cache = 0.0  # window rolled: stale reservations expire
+                self._cache_born = now
             if tokens <= self._cache:
                 self._cache -= tokens
                 return True
@@ -217,6 +226,8 @@ class DistributedRateLimiter:
             # request only; the next call retries the coordinator
             return self._fallback.try_acquire(tokens)
         with self._lock:
+            if not self._cache:
+                self._cache_born = time.monotonic()  # fresh window's grant
             self._cache += granted
             if tokens <= self._cache:
                 self._cache -= tokens

@@ -1,0 +1,347 @@
+"""Batch Ed25519 verification on device — the third signature plane.
+
+Replaces the host loop that round 2 shipped for Ed25519 batch APIs
+(reference: bcos-crypto/signature/ed25519/Ed25519Crypto.cpp wedpr FFI, one
+signature at a time on CPU threads) with one fused device program over the
+whole batch, completing the claim that every signature suite carries a real
+device batch plane (secp256k1/SM2 in :mod:`.secp256k1`/:mod:`.sm2`).
+
+Split of labor:
+- **Host**: SHA-512 challenge k = H(R ‖ A ‖ M) mod L and its negation — a
+  few µs/signature of C-speed hashing with no data-parallel structure worth
+  a kernel (the reference hashes on CPU too), plus byte→limb packing.
+- **Device**: everything elliptic — point decompression (field inv + sqrt),
+  the dual scalar ladder s*B + (L-k)*A, the R subtraction, cofactor-8
+  clearing, identity test. This is >99% of the arithmetic.
+
+TPU-first formulation:
+- Field arithmetic rides the limb-major plane of :mod:`.limb` in the ring
+  Z/(2p), 2p = 2^256 - 38 — a pseudo-Mersenne FoldField (c = 38), so a mul
+  is ONE wide product + a cheap fold instead of Montgomery's three. Every
+  intermediate is a residue mod 2p; reduction to canonical mod-p form is a
+  single conditional subtract, applied only at comparisons. (Exponent-based
+  inv/sqrt use mod-p exponents — the Z/2p → Z/p quotient map commutes with
+  all ring ops, so folding stays valid throughout.)
+- Points are extended twisted-Edwards (X, Y, Z, T) tuples of [16, T] limb
+  arrays; the a = -1 unified addition (add-2008-hwcd-3) is COMPLETE on the
+  prime-order subgroup, so the ladder needs no exceptional-case selects at
+  all — branch-free by algebra, not by masking. Cofactor components cannot
+  break completeness because the final check multiplies by 8 first.
+- The fixed-base comb table for B is host-precomputed in the (Y+X, Y-X,
+  2dT) mixed-add form (7M per add); the per-lane table for A is 15 unified
+  adds at ladder start, exactly the secp256k1 pattern.
+
+Verification equation (RFC 8032 cofactored, matching crypto/ref/ed25519.py
+bit-for-bit): 8·(s*B − k*A − R) == O, with s range-checked < L and A, R
+required to decompress. Invalid lanes lower a validity bit, never raise.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..crypto.ref import ed25519 as ref
+from . import limb
+from .bigint import bytes_be_to_limbs
+from .ec import WINDOW, _select15, scalar_windows
+from .hash_common import bucket_batch as _bucket
+from .hash_common import pad_rows as _pad_rows
+from .limb import const_rows, eq, is_zero, lt, select
+
+P = ref.P  # 2^255 - 19
+L = ref.L
+D = ref.D
+TWO_P = 2 * P  # 2^256 - 38: the folding modulus
+
+F = limb.make_fold_field(TWO_P)
+
+_P_LIMBS = limb.int_to_rows(P)
+_L_LIMBS = limb.int_to_rows(L)
+_D2_LIMBS = limb.int_to_rows((2 * D) % P)
+_SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+
+def _canon(x: jax.Array) -> jax.Array:
+    """Z/2p residue -> canonical mod-p limbs (one conditional subtract)."""
+    return limb.cond_sub(x, _P_LIMBS)
+
+
+def eq_p(a: jax.Array, b: jax.Array) -> jax.Array:
+    return eq(_canon(a), _canon(b))
+
+
+def _inv(a: jax.Array) -> jax.Array:
+    """a^-1 mod p (Fermat; 0 -> 0). Exponent is the MOD-P exponent — the
+    quotient map Z/2p -> Z/p makes the fold-domain powering valid."""
+    return limb.pow_static(F, a, P - 2)
+
+
+def _sqrt_p58(a: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Square root mod p for p ≡ 5 (mod 8): candidate c = a^((p+3)/8),
+    corrected by sqrt(-1) when c² == -a. Returns (root, is_square)."""
+    c = limb.pow_static(F, a, (P + 3) // 8)
+    c2 = F.sqr(c)
+    neg_a = F.sub(jnp.zeros_like(a), a)
+    flip = eq_p(c2, neg_a)
+    c = select(flip, F.mul(c, const_rows(limb.int_to_rows(_SQRT_M1), a)), c)
+    ok = eq_p(F.sqr(c), a)
+    return c, ok
+
+
+# ---------------------------------------------------------------------------
+# Extended twisted-Edwards group law (a = -1), complete on the prime subgroup
+# ---------------------------------------------------------------------------
+
+
+def ed_identity(like: jax.Array):
+    z = jnp.zeros_like(like)
+    one = F.one(like)
+    return z, one, one, z  # (0, 1, 1, 0)
+
+
+def ed_add(p1, p2):
+    """add-2008-hwcd-3: 8M + 1 constant mul (2d). Unified — handles
+    doubling and identity operands with no selects."""
+    x1, y1, z1, t1 = p1
+    x2, y2, z2, t2 = p2
+    a0 = F.mul(F.sub(y1, x1), F.sub(y2, x2))
+    b0 = F.mul(F.add(y1, x1), F.add(y2, x2))
+    c0 = F.mul(F.mul(t1, const_rows(_D2_LIMBS, x1)), t2)
+    d0 = F.mul(z1, z2)
+    d0 = F.add(d0, d0)
+    e = F.sub(b0, a0)
+    f = F.sub(d0, c0)
+    g = F.add(d0, c0)
+    h = F.add(b0, a0)
+    return F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h)
+
+
+def ed_madd(p1, pre):
+    """Mixed add with a host-precomputed affine entry (Y+X, Y-X, 2dT): 7M."""
+    x1, y1, z1, t1 = p1
+    yx2, ymx2, dt2 = pre
+    a0 = F.mul(F.sub(y1, x1), ymx2)
+    b0 = F.mul(F.add(y1, x1), yx2)
+    c0 = F.mul(t1, dt2)
+    d0 = F.add(z1, z1)
+    e = F.sub(b0, a0)
+    f = F.sub(d0, c0)
+    g = F.add(d0, c0)
+    h = F.add(b0, a0)
+    return F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h)
+
+
+def ed_double(p1):
+    """dbl-2008-hwcd (a = -1): 4M + 4S."""
+    x1, y1, z1, _ = p1
+    a0 = F.sqr(x1)
+    b0 = F.sqr(y1)
+    zz = F.sqr(z1)
+    c0 = F.add(zz, zz)
+    h = F.add(a0, b0)
+    xy = F.add(x1, y1)
+    e = F.sub(h, F.sqr(xy))
+    g = F.sub(a0, b0)
+    f = F.add(c0, g)
+    return F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h)
+
+
+def ed_neg(p1):
+    x, y, z, t = p1
+    zero = jnp.zeros_like(x)
+    return F.sub(zero, x), y, z, F.sub(zero, t)
+
+
+def is_identity(p1) -> jax.Array:
+    x, y, z, _ = p1
+    return eq_p(x, jnp.zeros_like(x)) & eq_p(y, z)
+
+
+# ---------------------------------------------------------------------------
+# Decompression (device)
+# ---------------------------------------------------------------------------
+
+
+def decompress(y_limbs: jax.Array, sign: jax.Array):
+    """[16, T] y (LE-decoded, sign bit stripped) + [T] sign ->
+    ((X, Y, Z, T) extended, valid bool[T])."""
+    p_rows = const_rows(_P_LIMBS, y_limbs)
+    valid = lt(y_limbs, p_rows)
+    yy = F.sqr(y_limbs)
+    one = F.one(y_limbs)
+    u = F.sub(yy, one)  # y^2 - 1
+    v = F.add(F.mul(const_rows(limb.int_to_rows(D % P), y_limbs), yy), one)
+    x2 = F.mul(u, _inv(v))  # v never 0: d is a non-square
+    x, is_sq = _sqrt_p58(x2)
+    x_zero = is_zero(_canon(x2))
+    valid &= is_sq | x_zero
+    # x = 0 with sign 1 is invalid (RFC 8032 §5.1.3 step 4)
+    valid &= ~(x_zero & (sign != 0))
+    x = select(x_zero, jnp.zeros_like(x), x)
+    x_c = _canon(x)
+    flip = (limb.row(x_c, 0) & 1).astype(jnp.int32) != sign
+    x = select(flip, F.sub(jnp.zeros_like(x), x), x)
+    return (x, y_limbs, one, F.mul(x, y_limbs)), valid
+
+
+# ---------------------------------------------------------------------------
+# Fixed-base comb table for B (host-precomputed, (Y+X, Y-X, 2dT) rows)
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def b_comb_table() -> np.ndarray:
+    """[45, 16] uint32: rows 3c-3..3c-1 hold (y+x, y-x, 2dxy) mod p of c*B
+    for c in 1..15."""
+    tab = np.zeros((45, limb.LIMBS), dtype=np.uint32)
+    acc = None
+    base = (ref.BASE[0] * pow(ref.BASE[2], -1, P)) % P, (
+        ref.BASE[1] * pow(ref.BASE[2], -1, P)
+    ) % P
+    for c in range(1, 16):
+        acc = base if acc is None else _affine_add(acc, base)
+        x, y = acc
+        tab[3 * (c - 1) + 0] = limb.int_to_rows((y + x) % P)
+        tab[3 * (c - 1) + 1] = limb.int_to_rows((y - x) % P)
+        tab[3 * (c - 1) + 2] = limb.int_to_rows(2 * D * x % P * y % P)
+    return tab
+
+
+def _affine_add(p1, p2):
+    """Host affine Edwards addition (twisted, a = -1)."""
+    x1, y1 = p1
+    x2, y2 = p2
+    dxy = D * x1 % P * x2 % P * y1 % P * y2 % P
+    x3 = (x1 * y2 + y1 * x2) * pow(1 + dxy, -1, P) % P
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - dxy, -1, P) % P
+    return x3, y3
+
+
+# ---------------------------------------------------------------------------
+# The fused verification core
+# ---------------------------------------------------------------------------
+
+
+def verify_core(s, k_neg, a_y, a_sign, r_y, r_sign, b_table):
+    """All limb inputs [16, T]; signs [T] int32; b_table [45, 16] device.
+
+    ok = 8·(s*B + (L-k)*A − R) == O, with range/decode validity folded in.
+    """
+    A, ok_a = decompress(a_y, a_sign)
+    R, ok_r = decompress(r_y, r_sign)
+    valid = ok_a & ok_r
+    valid &= lt(s, const_rows(_L_LIMBS, s))  # malleability guard (s < L)
+
+    # 15-entry runtime table for A (unified adds; list form is Mosaic-safe)
+    ta = [A]
+    for _ in range(14):
+        ta.append(ed_add(ta[-1], A))
+    ta_x = [t[0] for t in ta]
+    ta_y = [t[1] for t in ta]
+    ta_z = [t[2] for t in ta]
+    ta_t = [t[3] for t in ta]
+
+    tb_rows = [
+        lax.slice_in_dim(b_table, i, i + 1, axis=0).reshape(16, 1)
+        for i in range(45)
+    ]
+
+    w_s = scalar_windows(s)[::-1]  # MSB-first [64, T]
+    w_k = scalar_windows(k_neg)[::-1]
+
+    def step(acc, xs):
+        ws_i, wk_i = xs
+        for _ in range(WINDOW):
+            acc = ed_double(acc)
+        # A term (runtime table, unified add — identity-safe so w==0 lanes
+        # just add nothing after the select)
+        ax = _select15(ta_x, wk_i)
+        ay = _select15(ta_y, wk_i)
+        az = _select15(ta_z, wk_i)
+        at = _select15(ta_t, wk_i)
+        added = ed_add(acc, (ax, ay, az, at))
+        acc = select(wk_i == 0, acc, added)
+        # B term (fixed comb, mixed add)
+        byx = _select15([tb_rows[3 * c] for c in range(15)], ws_i)
+        bymx = _select15([tb_rows[3 * c + 1] for c in range(15)], ws_i)
+        bdt = _select15([tb_rows[3 * c + 2] for c in range(15)], ws_i)
+        madded = ed_madd(acc, (byx, bymx, bdt))
+        acc = select(ws_i == 0, acc, madded)
+        return acc, None
+
+    acc, _ = lax.scan(step, ed_identity(s), (w_s, w_k))
+
+    acc = ed_add(acc, ed_neg(R))
+    for _ in range(3):  # cofactor 8
+        acc = ed_double(acc)
+    return valid & is_identity(acc)
+
+
+@jax.jit
+def _verify_xla(s, k_neg, a_y, a_sign, r_y, r_sign):
+    return verify_core(
+        s.T, k_neg.T, a_y.T, a_sign, r_y.T, r_sign, jnp.asarray(b_comb_table())
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host wrapper
+# ---------------------------------------------------------------------------
+
+
+def _le_point_limbs(comp32: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """[B, 32] compressed points -> ([B, 16] y limbs, [B] sign)."""
+    le = comp32.astype(np.uint8)
+    sign = (le[:, 31] >> 7).astype(np.int32)
+    y = le.copy()
+    y[:, 31] &= 0x7F
+    return bytes_be_to_limbs(y[:, ::-1]), sign
+
+
+def verify_batch(msgs, pubs, sigs) -> np.ndarray:
+    """Host API: per-item bytes (message, 32-byte pubkey, 64-byte R‖S) ->
+    bool[B]. Challenges are hashed on the host; ALL curve math is one
+    device program."""
+    import hashlib
+
+    bsz = len(msgs)
+    bb = _bucket(bsz)
+    pubs = np.asarray(
+        [np.frombuffer(bytes(p[:32]), np.uint8) for p in pubs], np.uint8
+    )
+    r_comp = np.asarray(
+        [np.frombuffer(bytes(s[:32]), np.uint8) for s in sigs], np.uint8
+    )
+    s_le = np.asarray(
+        [np.frombuffer(bytes(s[32:64]), np.uint8) for s in sigs], np.uint8
+    )
+    k_neg = np.zeros((bsz, 16), np.uint32)
+    for i in range(bsz):
+        k = (
+            int.from_bytes(
+                hashlib.sha512(
+                    bytes(r_comp[i]) + bytes(pubs[i]) + bytes(msgs[i])
+                ).digest(),
+                "little",
+            )
+            % L
+        )
+        k_neg[i] = limb.int_to_rows((L - k) % L)
+    s_limbs = bytes_be_to_limbs(s_le[:, ::-1])
+    a_y, a_sign = _le_point_limbs(pubs)
+    r_y, r_sign = _le_point_limbs(r_comp)
+
+    ok = _verify_xla(
+        _pad_rows(s_limbs, bb),
+        _pad_rows(k_neg, bb),
+        _pad_rows(a_y, bb),
+        _pad_rows(a_sign, bb),
+        _pad_rows(r_y, bb),
+        _pad_rows(r_sign, bb),
+    )
+    return np.asarray(ok)[:bsz]

@@ -127,6 +127,13 @@ def recover_core(z, r, s, v, g_table):
 
 
 def _use_pallas() -> bool:
+    """Pallas on real TPU unless FISCO_NO_PALLAS forces the XLA path — the
+    escape hatch for benching/diagnosing when the Mosaic kernel misbehaves
+    on hardware the CPU interpreter can't reproduce."""
+    import os
+
+    if os.environ.get("FISCO_NO_PALLAS"):
+        return False
     return jax.default_backend() == "tpu"
 
 

@@ -180,9 +180,11 @@ class ServiceClient:
     def __init__(self, host: str, port: int, timeout: float = 60.0):
         self._addr = (host, port)
         self._timeout = timeout
-        self.sock: socket.socket | None = socket.create_connection(
-            (host, port), timeout=timeout
-        )
+        # LAZY dial: the first call connects. Constructing a client of a
+        # not-yet-/currently-down service must not crash the mounting
+        # process — every caller with a failover path (gateway limiter,
+        # storage switch seam) depends on construction always succeeding.
+        self.sock: socket.socket | None = None
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
 

@@ -1,0 +1,72 @@
+"""Ed25519 device batch plane — golden-tested against the RFC 8032 reference.
+
+Reference: bcos-crypto/bcos-crypto/signature/ed25519/Ed25519Crypto.cpp (the
+wedpr per-signature FFI this batch plane replaces).
+"""
+
+import numpy as np
+
+from fisco_bcos_tpu.crypto.ref import ed25519 as ref
+from fisco_bcos_tpu.ops import ed25519 as ed_ops
+
+
+def _vectors(n, tamper=()):
+    msgs, pubs, sigs = [], [], []
+    for i in range(n):
+        seed = (0xED25519 + i).to_bytes(32, "little")
+        pub = ref.seed_to_pubkey(seed)
+        msg = b"ed25519 device lane %02d" % i
+        sig = ref.sign(seed, msg)
+        msgs.append(msg)
+        pubs.append(pub)
+        sigs.append(sig)
+    for idx, kind in tamper:
+        if kind == "sig":
+            s = bytearray(sigs[idx])
+            s[10] ^= 1
+            sigs[idx] = bytes(s)
+        elif kind == "msg":
+            msgs[idx] = b"forged message"
+        elif kind == "pub":
+            pubs[idx] = ref.seed_to_pubkey(b"\xee" * 32)
+        elif kind == "badpoint":
+            pubs[idx] = b"\xff" * 32  # y >= p: must fail to decompress
+        elif kind == "bigs":
+            s = bytearray(sigs[idx])
+            s[32:64] = (ref.L + 5).to_bytes(32, "little")  # s >= L
+            sigs[idx] = bytes(s)
+    return msgs, pubs, sigs
+
+
+def test_device_matches_reference_and_rejects_tampering():
+    n = 12
+    tamper = [(2, "sig"), (5, "msg"), (7, "pub"), (9, "badpoint"), (11, "bigs")]
+    msgs, pubs, sigs = _vectors(n, tamper)
+    got = ed_ops.verify_batch(msgs, pubs, sigs)
+    expect = np.array(
+        [ref.verify(pubs[i], msgs[i], sigs[i][:64]) for i in range(n)]
+    )
+    assert got.tolist() == expect.tolist()
+    bad = {i for i, _ in tamper}
+    for i in range(n):
+        assert got[i] == (i not in bad)
+
+
+def test_suite_batch_apis_ride_device():
+    from fisco_bcos_tpu.crypto.suite import Ed25519Crypto
+
+    impl = Ed25519Crypto()
+    kps = [impl.generate_keypair(secret=50 + i) for i in range(4)]
+    msgs = [b"%d" % i + b"\xaa" * 31 for i in range(4)]
+    sigs = [impl.sign(kp, m) for kp, m in zip(kps, msgs)]
+    pubs = [kp.pub for kp in kps]
+
+    ok = impl.batch_verify(msgs, pubs, sigs)
+    assert ok.all()
+    recovered, ok2 = impl.batch_recover(msgs, sigs)
+    assert ok2.all()
+    assert [bytes(r) for r in recovered] == pubs
+    # a swapped signature fails its lane only
+    sigs[1] = sigs[2]
+    ok = impl.batch_verify(msgs, pubs, sigs)
+    assert ok.tolist() == [True, False, True, True]
