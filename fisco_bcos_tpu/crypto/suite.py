@@ -210,10 +210,19 @@ class Ed25519Crypto(SignatureCrypto):
 
     def batch_recover(self, msg_hashes, sigs):
         """Parse the appended key, then device-batch-verify (ed25519 has no
-        algebraic recovery; the 96-byte R‖S‖pub format carries the key)."""
+        algebraic recovery; the 96-byte R‖S‖pub format carries the key).
+
+        Malformed (short) signatures lower their lane's ok bit — they must
+        never crash, and never reach the device as zero-filled dummies (a
+        zero pubkey decompresses to a torsion point that can verify)."""
         sigs = [bytes(s) for s in sigs]
-        pubs = [s[64:96] for s in sigs]
-        ok = self.batch_verify(msg_hashes, pubs, sigs)
+        wellformed = np.array([len(s) >= 96 for s in sigs])
+        safe = [
+            s if good else b"\x00" * 32 + b"\x01" + b"\x00" * 63
+            for s, good in zip(sigs, wellformed)
+        ]
+        pubs = [s[64:96] for s in safe]
+        ok = self.batch_verify(msg_hashes, pubs, safe) & wellformed
         out = np.frombuffer(
             b"".join(
                 p if good else b"\x00" * 32 for p, good in zip(pubs, ok)

@@ -1,0 +1,2 @@
+"""Perf/conformance samples (fisco-bcos-demo analog): P2P echo round-trip
+measurement and the distributed-rate-limiter budget checker."""

@@ -1,0 +1,175 @@
+"""P2P echo performance sample — gateway round-trip throughput/latency.
+
+Reference: fisco-bcos-demo/{echo_server_sample.cpp, echo_client_sample.cpp}:
+a standalone gateway registers an echo handler for packet type 999 and a
+client floods rate-limited fixed-size payloads, logging per-message round
+trip. Same shape here over the framework's TcpGateway + FrontService
+(module-ID demux instead of packetType).
+
+Run the pair::
+
+    python -m fisco_bcos_tpu.demo.echo_perf server [--port N]
+    python -m fisco_bcos_tpu.demo.echo_perf client --peer 127.0.0.1:N \
+        [--payload-kib 64] [--seconds 5] [--rate-mbit 0]
+
+or drive one in-process measurement (used by the tests)::
+
+    from fisco_bcos_tpu.demo.echo_perf import run_echo_measurement
+    stats = run_echo_measurement(n_messages=100, payload=4096)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import secrets
+import sys
+import threading
+import time
+
+ECHO_MODULE = 999  # the sample's packet type
+
+
+def _make_gateway(node_id: bytes, port: int = 0):
+    from ..front.front import FrontService
+    from ..gateway.tcp import TcpGateway
+
+    front = FrontService(node_id)
+    gw = TcpGateway(node_id, port=port)
+    gw.connect(front)
+    gw.start()
+    return gw, front
+
+
+def start_echo_server(port: int = 0):
+    """Gateway + echo responder; returns (gateway, front)."""
+    node_id = secrets.token_bytes(64)
+    gw, front = _make_gateway(node_id, port)
+
+    def echo(src: bytes, payload: bytes) -> None:
+        front.send_message(ECHO_MODULE + 1, src, payload)
+
+    front.register_module(ECHO_MODULE, echo)
+    return gw, front
+
+
+class EchoClient:
+    def __init__(self, host: str, port: int):
+        self.node_id = secrets.token_bytes(64)
+        self.gw, self.front = _make_gateway(self.node_id)
+        self._pending: dict[bytes, float] = {}
+        self._lock = threading.Lock()
+        self.rtts: list[float] = []
+        self.bytes_echoed = 0
+
+        def on_reply(src: bytes, payload: bytes) -> None:
+            key = payload[:16]
+            with self._lock:
+                t0 = self._pending.pop(key, None)
+                if t0 is not None:
+                    self.rtts.append(time.perf_counter() - t0)
+                    self.bytes_echoed += len(payload)
+
+        self.front.register_module(ECHO_MODULE + 1, on_reply)
+        if not self.gw.connect_peer(host, port):
+            raise ConnectionError(f"echo server {host}:{port} unreachable")
+        deadline = time.monotonic() + 10
+        while not self.gw.peers() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if not self.gw.peers():
+            raise ConnectionError("handshake with echo server timed out")
+        self.server_id = self.gw.peers()[0]
+
+    def send(self, payload_size: int) -> None:
+        body = secrets.token_bytes(16) + b"\xab" * max(payload_size - 16, 0)
+        with self._lock:
+            self._pending[body[:16]] = time.perf_counter()
+        self.front.send_message(ECHO_MODULE, self.server_id, body)
+
+    def stats(self) -> dict:
+        rtts = sorted(self.rtts)
+        if not rtts:
+            return {"echoed": 0}
+        return {
+            "echoed": len(rtts),
+            "bytes": self.bytes_echoed,
+            "rtt_avg_ms": sum(rtts) / len(rtts) * 1e3,
+            "rtt_p50_ms": rtts[len(rtts) // 2] * 1e3,
+            "rtt_p99_ms": rtts[min(len(rtts) - 1, int(len(rtts) * 0.99))] * 1e3,
+        }
+
+    def stop(self) -> None:
+        self.gw.stop()
+
+
+def run_echo_measurement(
+    n_messages: int = 100, payload: int = 4096, port: int = 0
+) -> dict:
+    """One in-process server+client round: returns the client's stats."""
+    gw, _front = start_echo_server(port)
+    client = None
+    try:
+        client = EchoClient("127.0.0.1", gw.port)
+        for _ in range(n_messages):
+            client.send(payload)
+        deadline = time.monotonic() + 30
+        while len(client.rtts) < n_messages and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return client.stats()
+    finally:
+        if client is not None:
+            client.stop()
+        gw.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="echo-perf", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("server")
+    s.add_argument("--port", type=int, default=0)
+    c = sub.add_parser("client")
+    c.add_argument("--peer", required=True, help="server host:port")
+    c.add_argument("--payload-kib", type=int, default=64)
+    c.add_argument("--seconds", type=float, default=5.0)
+    c.add_argument(
+        "--rate-mbit", type=float, default=0.0, help="0 = as fast as possible"
+    )
+    args = ap.parse_args(argv)
+
+    if args.cmd == "server":
+        gw, _front = start_echo_server(args.port)
+        print(f"READY p2p={gw.port}", flush=True)
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            gw.stop()
+        return 0
+
+    host, port = args.peer.rsplit(":", 1)
+    payload = args.payload_kib * 1024
+    client = EchoClient(host, int(port))
+    interval = 0.0
+    if args.rate_mbit:
+        pkt_per_s = args.rate_mbit * 1024 * 1024 / (payload * 8)
+        interval = 1.0 / max(pkt_per_s, 1e-9)
+    t_end = time.monotonic() + args.seconds
+    sent = 0
+    while time.monotonic() < t_end:
+        client.send(payload)
+        sent += 1
+        if interval:
+            time.sleep(interval)
+    time.sleep(1.0)  # drain in-flight echoes
+    st = client.stats()
+    st["sent"] = sent
+    if st.get("echoed"):
+        dur = args.seconds + 1.0
+        st["throughput_mbit"] = st["bytes"] * 8 / dur / (1024 * 1024)
+    print(st, flush=True)
+    client.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

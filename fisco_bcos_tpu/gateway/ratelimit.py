@@ -226,8 +226,15 @@ class DistributedRateLimiter:
             # request only; the next call retries the coordinator
             return self._fallback.try_acquire(tokens)
         with self._lock:
-            if not self._cache:
-                self._cache_born = time.monotonic()  # fresh window's grant
+            now = time.monotonic()
+            if now - self._cache_born >= self.interval_ms / 1000.0:
+                # window rolled while the RPC was in flight: the stale
+                # residue expires, but the fresh grant belongs to the
+                # coordinator's CURRENT window — stamp it so the next call
+                # doesn't immediately discard permits already deducted from
+                # the cluster budget
+                self._cache = 0.0
+                self._cache_born = now
             self._cache += granted
             if tokens <= self._cache:
                 self._cache -= tokens

@@ -1,0 +1,115 @@
+"""Pro-mode node core: consensus + txpool + scheduler as ONE process whose
+gateway, RPC front door, and storage live in OTHER processes.
+
+Reference: the fisco-bcos-tars-service deployment form — a BcosNodeService
+(PBFT/txpool/scheduler core) wired over tars to GatewayService, RpcService
+and the storage layer; libinitializer/ProNodeInitializer.cpp. This
+entrypoint assembles the same split from this framework's parts:
+
+    [gateway svc]  ◀─service RPC─  FrontEndpoint ┐
+    [storage svc]  ◀─RemoteStorage (N shards)────┤ node core (this process)
+    [rpc svc]      ─▶ RpcFacade  ◀───────────────┘
+
+Usage::
+
+    python -m fisco_bcos_tpu.node.pro_node -g config.genesis \
+        --key conf/node.key --gateway 127.0.0.1:41000 \
+        --storage 127.0.0.1:42000[,...] [--facade-port N] [--db chain.db]
+
+Prints ``READY facade=<port>`` once serving; SIGTERM/SIGINT stops cleanly.
+"""
+
+from __future__ import annotations
+
+# pin jax to CPU before anything imports it (the axon sitecustomize would
+# otherwise route import-time work through the TPU tunnel); the node core's
+# device kernels run wherever the platform default points at run time
+try:  # pragma: no cover - environment-dependent
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="fisco-bcos-tpu-pro-node", description=__doc__)
+    ap.add_argument("-g", "--genesis", default="config.genesis")
+    ap.add_argument("--key", default="conf/node.key")
+    ap.add_argument("--gateway", required=True, help="gateway service host:port")
+    ap.add_argument(
+        "--storage", default="", help="storage service endpoints h:p[,h:p...]"
+    )
+    ap.add_argument("--db", default="", help="local sqlite path (no storage svc)")
+    ap.add_argument("--facade-port", type=int, default=0)
+    ap.add_argument("--sealer-interval", type=float, default=0.2)
+    ap.add_argument("--warmup", type=int, default=0, metavar="B")
+    ap.add_argument("--sm", action="store_true", help="SM crypto suite")
+    args = ap.parse_args(argv)
+
+    from ..crypto.suite import ecdsa_suite, sm_suite
+    from ..node import Node, NodeConfig
+    from ..node.runtime import NodeRuntime
+    from ..rpc import JsonRpcImpl
+    from ..service import FrontEndpoint, RemoteGateway, RpcFacade
+    from ..tool.config import load_genesis, load_keypair
+    from ..utils.log import get_logger
+
+    log = get_logger("pro-node")
+    genesis = load_genesis(args.genesis)
+    suite = sm_suite() if args.sm else ecdsa_suite()
+    kp = load_keypair(args.key, suite)
+
+    cfg = NodeConfig(
+        chain_id=genesis.chain_id,
+        group_id=genesis.group_id,
+        sm_crypto=args.sm,
+        db_path=args.db or ":memory:",
+        storage_endpoints=args.storage,
+        genesis=genesis,
+    )
+    node = Node(cfg, keypair=kp)
+
+    # gateway-as-a-process: outbound frames go to the gateway service,
+    # inbound ones come back through our FrontEndpoint server
+    ep = FrontEndpoint(node.front)
+    ep.start()
+    gw_host, gw_port = args.gateway.rsplit(":", 1)
+    rgw = RemoteGateway(gw_host, int(gw_port))
+    node.front.set_gateway(rgw)
+    rgw.register_front(ep.host, ep.port)
+
+    if args.warmup:
+        node.warmup(batch_sizes=(args.warmup,))
+
+    facade = RpcFacade(JsonRpcImpl(node), port=args.facade_port)
+    facade.start()
+
+    runtime = NodeRuntime(node, sealer_interval=args.sealer_interval)
+    runtime.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_a: stop.set())
+    log.info(
+        "pro node core %s up: gateway=%s facade=%d storage=%s",
+        node.node_id.hex()[:16],
+        args.gateway,
+        facade.port,
+        args.storage or args.db or ":memory:",
+    )
+    print(f"READY facade={facade.port} front={ep.port}", flush=True)
+    stop.wait()
+    runtime.stop()
+    facade.stop()
+    ep.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,24 +3,23 @@
 Replaces the reference's per-signature CPU EC stack (wedpr-crypto Rust FFI
 behind bcos-crypto — `wedpr_secp256k1_verify` at
 bcos-crypto/bcos-crypto/signature/secp256k1/Secp256k1Crypto.cpp:57, SM2 at
-signature/sm2/SM2Crypto.cpp:29-91) with batch Jacobian-coordinate kernels over
-the limb-major field arithmetic in :mod:`fisco_bcos_tpu.ops.limb`.
+signature/sm2/SM2Crypto.cpp:29-91) with batch complete-projective kernels
+over the limb-major field arithmetic in :mod:`fisco_bcos_tpu.ops.limb`.
 
 TPU-first design:
-- A point is an (X, Y, Z) tuple of ``[16, T]`` limb-major arrays in the
-  curve's field domain (plain for the pseudo-Mersenne fast path, Montgomery
-  for SM2); Z == 0 encodes infinity. The batch lives in the minor axis so
-  every op runs at full VPU lane utilization.
-- All group ops are branch-free: exceptional cases (infinity operands,
-  P == Q, P == -Q) are resolved with lane-wise selects — one compiled
-  program serves honest and adversarial lanes alike (consensus code must
-  not diverge per lane).
+- A point is a homogeneous (X : Y : Z) tuple of ``[16, T]`` limb-major
+  arrays in the curve's field domain (plain for the pseudo-Mersenne fast
+  path, Montgomery for SM2); (0 : 1 : 0) is the identity. The batch lives
+  in the minor axis so every op runs at full VPU lane utilization.
+- The group law is the Renes–Costello–Batina COMPLETE addition (section
+  comment below): exceptional cases (identity operands, P == Q, P == -Q)
+  are covered by the algebra itself — no per-lane select chains and no
+  shadow doubling per add, which trims ~25% of the ladder's field muls
+  and shrinks the Pallas kernel's live set.
 - ``dual_mul_windowed`` computes u1*G + u2*Q with 4-bit windows and one
-  shared doubling chain (Shamir): a 15-entry runtime Jacobian table for Q,
-  and a host-precomputed affine table {c*G} so G contributions are cheap
-  mixed (Z=1) additions with no runtime table build. This replaces round
-  1's bit-at-a-time ladder (256 doublings + 256 full additions) with 256
-  doublings + 64 full + 64 mixed additions.
+  shared doubling chain (Shamir): a 15-entry runtime projective table for
+  Q, and a host-precomputed affine table {c*G} so G contributions are
+  cheap mixed (Z2 = 1) additions with no runtime table build.
 - The whole ladder is a ``lax.scan`` over 64 window steps; table selects
   are 15-way masked chains (schedule identical on every lane).
 
@@ -68,8 +67,11 @@ class CurveOps:
     F: FoldField | MontField  # field of the curve prime p
     Fn: FoldField | None  # scalar field mod n (None -> plain-limb helpers)
     a_is_zero: bool
+    a_is_minus3: bool  # SM2: a = p - 3, so a·x = -(3x) — no full mul
     a_enc: np.ndarray  # a in field domain, [16]
     b_enc: np.ndarray  # b in field domain, [16]
+    b3_small: int | None  # 3b when it fits a scalar broadcast (secp: 21)
+    b3_enc: np.ndarray = field(repr=False)  # 3b in field domain
     p_limbs: np.ndarray = field(repr=False)
     n_limbs: np.ndarray = field(repr=False)
 
@@ -85,14 +87,18 @@ def _make_curve_ops(c: Curve) -> CurveOps:
     # Montgomery otherwise (SM2's p has a 225-bit complement).
     F = make_fold_field(c.p) if _R - c.p < 1 << 132 else make_mont_field(c.p)
     Fn = make_fold_field(c.n) if _R - c.n < 1 << 132 else None
+    b3 = 3 * c.b % c.p
     return CurveOps(
         name=c.name,
         curve=c,
         F=F,
         Fn=Fn,
         a_is_zero=c.a == 0,
+        a_is_minus3=c.a == c.p - 3,
         a_enc=F.enc(c.a),
         b_enc=F.enc(c.b),
+        b3_small=b3 if (b3 < 1 << 15 and isinstance(F, FoldField)) else None,
+        b3_enc=F.enc(b3),
         p_limbs=limb.int_to_rows(c.p),
         n_limbs=limb.int_to_rows(c.n),
     )
@@ -103,115 +109,233 @@ SM2_OPS = _make_curve_ops(SM2_CURVE)
 
 
 # ---------------------------------------------------------------------------
-# Jacobian group law (field domain, branch-free)
+# Complete projective group law (Renes–Costello–Batina 2016)
 # ---------------------------------------------------------------------------
+#
+# Homogeneous (X : Y : Z), identity (0 : 1 : 0). The RCB formulas are
+# COMPLETE on prime-order short-Weierstrass curves (both tx curves have
+# cofactor 1): one straight-line program covers identity operands, P == Q
+# and P == -Q with no exceptional cases — the branch-freedom consensus code
+# needs comes from the algebra itself, with zero lane-select overhead, and
+# (unlike the round-2 Jacobian law) no shadow jac_double evaluated per add
+# just to cover the P == Q lane. Ladder cost drops ~25%.
+#
+# Dispatch: a = 0 (secp256k1) uses RCB algorithms 7/8/9 with b3 = 3b = 21 a
+# cheap scalar-broadcast multiply; the generic-a path (SM2, a = -3) uses
+# algorithms 1/2/3 with a·t = -(3t) addition chains.
 
 
-def jac_double(P, C: CurveOps):
-    """dbl-2007-bl. Safe without selects: doubling infinity (Z=0) or a
-    2-torsion point (Y=0) yields Z3 = 0 — the correct group result."""
-    X, Y, Z = P
+def _b3_mul(x, C: "CurveOps"):
+    if C.b3_small is not None:
+        return C.F.mul_small(x, C.b3_small)
+    return C.F.mul(x, const_rows(C.b3_enc, x))
+
+
+def _a_mul(x, C: "CurveOps"):
+    """a·x; SM2's a = p - 3 makes this -(3x)."""
     F = C.F
-    xx = F.sqr(X)
-    yy = F.sqr(Y)
-    yyyy = F.sqr(yy)
-    zz = F.sqr(Z)
-    t = F.sqr(F.add(X, yy))
-    s = F.sub(F.sub(t, xx), yyyy)
-    s = F.add(s, s)  # S = 2((X+YY)^2 - XX - YYYY)
-    m = F.add(F.add(xx, xx), xx)  # 3*XX
-    if not C.a_is_zero:
-        m = F.add(m, F.mul(const_rows(C.a_enc, X), F.sqr(zz)))
-    x3 = F.sub(F.sqr(m), F.add(s, s))
-    y8 = F.add(yyyy, yyyy)
-    y8 = F.add(y8, y8)
-    y8 = F.add(y8, y8)
-    y3 = F.sub(F.mul(m, F.sub(s, x3)), y8)
-    z3 = F.sub(F.sub(F.sqr(F.add(Y, Z)), yy), zz)
-    return x3, y3, z3
+    if C.a_is_minus3:
+        return F.neg(F.mul_small(x, 3))
+    return F.mul(x, const_rows(C.a_enc, x))
 
 
-def jac_add(P, Q, C: CurveOps):
-    """add-2007-bl with full exceptional-case handling via selects."""
+def pt_add(P, Q, C: CurveOps):
+    """Complete addition. a = 0: RCB alg 7 (12M + 2·b3); generic: alg 1
+    (12M + 3·a + 2·b3)."""
     X1, Y1, Z1 = P
     X2, Y2, Z2 = Q
     F = C.F
-    z1z1 = F.sqr(Z1)
-    z2z2 = F.sqr(Z2)
-    u1 = F.mul(X1, z2z2)
-    u2 = F.mul(X2, z1z1)
-    s1 = F.mul(F.mul(Y1, Z2), z2z2)
-    s2 = F.mul(F.mul(Y2, Z1), z1z1)
-    h = F.sub(u2, u1)
-    rr = F.sub(s2, s1)
-    h2 = F.add(h, h)
-    i = F.sqr(h2)
-    j = F.mul(h, i)
-    r2 = F.add(rr, rr)
-    v = F.mul(u1, i)
-    x3 = F.sub(F.sub(F.sqr(r2), j), F.add(v, v))
-    s1j = F.mul(s1, j)
-    y3 = F.sub(F.mul(r2, F.sub(v, x3)), F.add(s1j, s1j))
-    z3 = F.mul(F.sub(F.sub(F.sqr(F.add(Z1, Z2)), z1z1), z2z2), h)
-    inf1 = is_zero(Z1)
-    inf2 = is_zero(Z2)
-    same = is_zero(h) & is_zero(rr) & ~inf1 & ~inf2
-    dx, dy, dz = jac_double(P, C)
-    x = select(inf1, X2, select(inf2, X1, select(same, dx, x3)))
-    y = select(inf1, Y2, select(inf2, Y1, select(same, dy, y3)))
-    z = select(inf1, Z2, select(inf2, Z1, select(same, dz, z3)))
-    return x, y, z
+    if C.a_is_zero:
+        t0 = F.mul(X1, X2)
+        t1 = F.mul(Y1, Y2)
+        t2 = F.mul(Z1, Z2)
+        t3 = F.mul(F.add(X1, Y1), F.add(X2, Y2))
+        t3 = F.sub(t3, F.add(t0, t1))  # X1Y2 + X2Y1
+        t4 = F.mul(F.add(Y1, Z1), F.add(Y2, Z2))
+        t4 = F.sub(t4, F.add(t1, t2))  # Y1Z2 + Y2Z1
+        x3 = F.mul(F.add(X1, Z1), F.add(X2, Z2))
+        y3 = F.sub(x3, F.add(t0, t2))  # X1Z2 + X2Z1
+        x3 = F.add(t0, t0)
+        t0 = F.add(x3, t0)  # 3·X1X2
+        t2 = _b3_mul(t2, C)
+        z3 = F.add(t1, t2)
+        t1 = F.sub(t1, t2)
+        y3 = _b3_mul(y3, C)
+        x3 = F.mul(t4, y3)
+        t2 = F.mul(t3, t1)
+        x3 = F.sub(t2, x3)
+        y3 = F.mul(y3, t0)
+        t1 = F.mul(t1, z3)
+        y3 = F.add(t1, y3)
+        t0 = F.mul(t0, t3)
+        z3 = F.mul(z3, t4)
+        z3 = F.add(z3, t0)
+        return x3, y3, z3
+    t0 = F.mul(X1, X2)
+    t1 = F.mul(Y1, Y2)
+    t2 = F.mul(Z1, Z2)
+    t3 = F.mul(F.add(X1, Y1), F.add(X2, Y2))
+    t3 = F.sub(t3, F.add(t0, t1))  # X1Y2 + X2Y1
+    t4 = F.mul(F.add(X1, Z1), F.add(X2, Z2))
+    t4 = F.sub(t4, F.add(t0, t2))  # X1Z2 + X2Z1
+    t5 = F.mul(F.add(Y1, Z1), F.add(Y2, Z2))
+    t5 = F.sub(t5, F.add(t1, t2))  # Y1Z2 + Y2Z1
+    z3 = _a_mul(t4, C)
+    x3 = _b3_mul(t2, C)
+    z3 = F.add(x3, z3)
+    x3 = F.sub(t1, z3)
+    z3 = F.add(t1, z3)
+    y3 = F.mul(x3, z3)
+    t1 = F.add(t0, t0)
+    t1 = F.add(t1, t0)  # 3·X1X2
+    t2 = _a_mul(t2, C)
+    t4b = _b3_mul(t4, C)
+    t1 = F.add(t1, t2)
+    t2 = _a_mul(F.sub(t0, t2), C)
+    t4b = F.add(t4b, t2)
+    t0 = F.mul(t1, t4b)
+    y3 = F.add(y3, t0)
+    t0 = F.mul(t5, t4b)
+    x3 = F.mul(t3, x3)
+    x3 = F.sub(x3, t0)
+    t0 = F.mul(t3, t1)
+    z3 = F.mul(t5, z3)
+    z3 = F.add(z3, t0)
+    return x3, y3, z3
 
 
-def jac_add_mixed(P, A, C: CurveOps):
-    """P + (x2, y2) for affine A (Z2 = 1, A must not be infinity) — madd,
-    7M+4S vs the 11M+5S full addition. Exceptional cases via selects."""
+def pt_add_mixed(P, A, C: CurveOps):
+    """Complete mixed addition with affine A = (x2, y2), Z2 = 1 — A must be
+    a genuine curve point (never identity; comb-table entries qualify).
+    a = 0: RCB alg 8 (11M + 2·b3); generic: alg 2."""
     X1, Y1, Z1 = P
     X2, Y2 = A
     F = C.F
-    z1z1 = F.sqr(Z1)
-    u2 = F.mul(X2, z1z1)
-    s2 = F.mul(F.mul(Y2, Z1), z1z1)
-    h = F.sub(u2, X1)
-    hh = F.sqr(h)
-    i = F.add(hh, hh)
-    i = F.add(i, i)  # 4*HH
-    j = F.mul(h, i)
-    rr = F.sub(s2, Y1)
-    rr = F.add(rr, rr)  # 2*(S2-Y1)
-    v = F.mul(X1, i)
-    x3 = F.sub(F.sub(F.sqr(rr), j), F.add(v, v))
-    y1j = F.mul(Y1, j)
-    y3 = F.sub(F.mul(rr, F.sub(v, x3)), F.add(y1j, y1j))
-    z3 = F.sub(F.sub(F.sqr(F.add(Z1, h)), z1z1), hh)
-    inf1 = is_zero(Z1)
-    one = C.F.one(X1)
-    same = is_zero(h) & is_zero(rr) & ~inf1
-    dx, dy, dz = jac_double(P, C)
-    x = select(inf1, X2, select(same, dx, x3))
-    y = select(inf1, Y2, select(same, dy, y3))
-    z = select(inf1, one, select(same, dz, z3))
-    return x, y, z
+    if C.a_is_zero:
+        t0 = F.mul(X1, X2)
+        t1 = F.mul(Y1, Y2)
+        t3 = F.mul(F.add(X2, Y2), F.add(X1, Y1))
+        t3 = F.sub(t3, F.add(t0, t1))  # X1Y2 + X2Y1
+        t4 = F.add(F.mul(X2, Z1), X1)  # X1 + X2Z1
+        t5 = F.add(F.mul(Y2, Z1), Y1)  # Y1 + Y2Z1
+        x3 = F.add(t0, t0)
+        t0 = F.add(x3, t0)  # 3·X1X2
+        t2 = _b3_mul(Z1, C)
+        z3 = F.add(t1, t2)
+        t1 = F.sub(t1, t2)
+        y3 = _b3_mul(t4, C)
+        x3 = F.mul(t5, y3)
+        t2 = F.mul(t3, t1)
+        x3 = F.sub(t2, x3)
+        y3 = F.mul(y3, t0)
+        t1 = F.mul(t1, z3)
+        y3 = F.add(t1, y3)
+        t0 = F.mul(t0, t3)
+        z3 = F.mul(z3, t5)
+        z3 = F.add(z3, t0)
+        return x3, y3, z3
+    t0 = F.mul(X1, X2)
+    t1 = F.mul(Y1, Y2)
+    t3 = F.mul(F.add(X2, Y2), F.add(X1, Y1))
+    t3 = F.sub(t3, F.add(t0, t1))  # X1Y2 + X2Y1
+    t4 = F.add(F.mul(X2, Z1), X1)  # X1 + X2Z1
+    t5 = F.add(F.mul(Y2, Z1), Y1)  # Y1 + Y2Z1
+    z3 = _a_mul(t4, C)
+    x3 = _b3_mul(Z1, C)
+    z3 = F.add(x3, z3)
+    x3 = F.sub(t1, z3)
+    z3 = F.add(t1, z3)
+    y3 = F.mul(x3, z3)
+    t1 = F.add(t0, t0)
+    t1 = F.add(t1, t0)  # 3·X1X2
+    t2 = _a_mul(Z1, C)
+    t4b = _b3_mul(t4, C)
+    t1 = F.add(t1, t2)
+    t2 = _a_mul(F.sub(t0, t2), C)
+    t4b = F.add(t4b, t2)
+    t0 = F.mul(t1, t4b)
+    y3 = F.add(y3, t0)
+    t0 = F.mul(t5, t4b)
+    x3 = F.mul(t3, x3)
+    x3 = F.sub(x3, t0)
+    t0 = F.mul(t3, t1)
+    z3 = F.mul(t5, z3)
+    z3 = F.add(z3, t0)
+    return x3, y3, z3
 
 
-def jac_infinity(like: jax.Array):
-    """Point at infinity: (1, 1, 0) in any domain-encoding (Z=0 is the flag;
-    X/Y values are never read for infinity lanes)."""
+def pt_double(P, C: CurveOps):
+    """Complete doubling. a = 0: RCB alg 9 (6M + 2S + 1·b3); generic:
+    alg 3."""
+    X, Y, Z = P
+    F = C.F
+    if C.a_is_zero:
+        t0 = F.sqr(Y)
+        z3 = F.add(t0, t0)
+        z3 = F.add(z3, z3)
+        z3 = F.add(z3, z3)  # 8·Y^2
+        t1 = F.mul(Y, Z)
+        t2 = F.sqr(Z)
+        t2 = _b3_mul(t2, C)
+        x3 = F.mul(t2, z3)
+        y3 = F.add(t0, t2)
+        z3 = F.mul(t1, z3)
+        t1 = F.add(t2, t2)
+        t2 = F.add(t1, t2)  # 3·b3·Z^2
+        t0 = F.sub(t0, t2)
+        y3 = F.mul(t0, y3)
+        y3 = F.add(x3, y3)
+        t1 = F.mul(X, Y)
+        x3 = F.mul(t0, t1)
+        x3 = F.add(x3, x3)
+        return x3, y3, z3
+    t0 = F.sqr(X)
+    t1 = F.sqr(Y)
+    t2 = F.sqr(Z)
+    t3 = F.mul(X, Y)
+    t3 = F.add(t3, t3)
+    z3 = F.mul(X, Z)
+    z3 = F.add(z3, z3)
+    x3 = _a_mul(z3, C)
+    y3 = _b3_mul(t2, C)
+    y3 = F.add(x3, y3)
+    x3 = F.sub(t1, y3)
+    y3 = F.add(t1, y3)
+    y3 = F.mul(x3, y3)
+    x3 = F.mul(t3, x3)
+    z3 = _b3_mul(z3, C)
+    t2a = _a_mul(t2, C)
+    t3 = _a_mul(F.sub(t0, t2a), C)
+    t3 = F.add(t3, z3)
+    z3 = F.add(t0, t0)
+    t0 = F.add(z3, t0)
+    t0 = F.add(t0, t2a)
+    t0 = F.mul(t0, t3)
+    y3 = F.add(y3, t0)
+    t2 = F.mul(Y, Z)
+    t2 = F.add(t2, t2)
+    t0 = F.mul(t2, t3)
+    x3 = F.sub(x3, t0)
+    z3 = F.mul(t2, t1)
+    z3 = F.add(z3, z3)
+    z3 = F.add(z3, z3)
+    return x3, y3, z3
+
+
+def pt_infinity(like: jax.Array, C: CurveOps):
+    """Projective identity (0 : 1 : 0) — Y must be the field's one (the
+    complete formulas READ it, unlike the Jacobian law's placeholder)."""
     z = jnp.zeros_like(like)
-    one = jnp.concatenate([jnp.ones_like(like[:1]), z[1:]], axis=0)
-    return one, one, z
+    return z, C.F.one(like), z
 
 
-def jac_to_affine(P, C: CurveOps):
-    """(X, Y, Z) -> (x, y, inf_mask); affine coords stay in the field domain.
-
-    Infinity lanes get x = y = 0 (F.inv(0) == 0)."""
+def pt_to_affine(P, C: CurveOps):
+    """(X : Y : Z) -> (x, y, inf_mask); affine coords stay in the field
+    domain. Identity lanes get x = y = 0 (F.inv(0) == 0)."""
     X, Y, Z = P
     F = C.F
     zinv = F.inv(Z)
-    zi2 = F.sqr(zinv)
-    zi3 = F.mul(zi2, zinv)
-    return F.mul(X, zi2), F.mul(Y, zi3), is_zero(Z)
+    return F.mul(X, zinv), F.mul(Y, zinv), is_zero(Z)
 
 
 def on_curve(x_enc: jax.Array, y_enc: jax.Array, C: CurveOps) -> jax.Array:
@@ -309,7 +433,7 @@ def _point_table_list(t1, C: CurveOps):
     Pallas TPU has no dynamic_update_slice for scan ys outputs)."""
     tab = [t1]
     for _ in range(14):
-        tab.append(jac_add(tab[-1], t1, C))
+        tab.append(pt_add(tab[-1], t1, C))
     return tab
 
 
@@ -318,7 +442,7 @@ def _point_table_scan(t1, C: CurveOps):
     the compact HLO shape for plain XLA (fast CPU compiles)."""
 
     def step(prev, _):
-        nxt = jac_add(prev, t1, C)
+        nxt = pt_add(prev, t1, C)
         return nxt, nxt
 
     _, rest = lax.scan(step, t1, None, length=14)
@@ -354,7 +478,7 @@ def dual_mul_windowed(k1, k2, Q, C: CurveOps, g_table: jax.Array):
     F = C.F
     one = F.one(k1)
     t1 = (Q[0], Q[1], one)
-    acc0 = jac_infinity(k1)
+    acc0 = pt_infinity(k1, C)
 
     if limb.is_mosaic_trace():
         tq = _point_table_list(t1, C)
@@ -374,13 +498,13 @@ def dual_mul_windowed(k1, k2, Q, C: CurveOps, g_table: jax.Array):
             w1_i = window_at(k1, wi)
             w2_i = window_at(k2, wi)
             for _ in range(WINDOW):
-                acc = jac_double(acc, C)
+                acc = pt_double(acc, C)
             qx, qy, qz = _select15(tq, w2_i)
-            added = jac_add(acc, (qx, qy, qz), C)
+            added = pt_add(acc, (qx, qy, qz), C)
             acc = select(w2_i == 0, acc, added)
             gx = _select15(tg_x, w1_i)  # [16, T]
             gy = _select15(tg_y, w1_i)
-            madded = jac_add_mixed(acc, (gx, gy), C)
+            madded = pt_add_mixed(acc, (gx, gy), C)
             acc = select(w1_i == 0, acc, madded)
             return acc
 
@@ -393,14 +517,14 @@ def dual_mul_windowed(k1, k2, Q, C: CurveOps, g_table: jax.Array):
     def sstep(acc, xs):
         w1_i, w2_i = xs
         for _ in range(WINDOW):
-            acc = jac_double(acc, C)
-        added = jac_add(
+            acc = pt_double(acc, C)
+        added = pt_add(
             acc, (_select15(tq_x, w2_i), _select15(tq_y, w2_i), _select15(tq_z, w2_i)), C
         )
         acc = select(w2_i == 0, acc, added)
         gx = _select15(g_table[:15][:, :, None], w1_i)  # [16, T]
         gy = _select15(g_table[15:][:, :, None], w1_i)
-        madded = jac_add_mixed(acc, (gx, gy), C)
+        madded = pt_add_mixed(acc, (gx, gy), C)
         acc = select(w1_i == 0, acc, madded)
         return acc, None
 
@@ -423,24 +547,24 @@ def scalar_mul(k, P, C: CurveOps):
         def step(i, acc):
             w_i = window_at(k, 63 - i)
             for _ in range(WINDOW):
-                acc = jac_double(acc, C)
-            added = jac_add(acc, _select15(tq, w_i), C)
+                acc = pt_double(acc, C)
+            added = pt_add(acc, _select15(tq, w_i), C)
             return select(w_i == 0, acc, added)
 
-        return lax.fori_loop(0, N_WINDOWS, step, jac_infinity(k))
+        return lax.fori_loop(0, N_WINDOWS, step, pt_infinity(k, C))
 
     tq_x, tq_y, tq_z = _point_table_scan(t1, C)
     w = scalar_windows(k)[::-1]
 
     def sstep(acc, w_i):
         for _ in range(WINDOW):
-            acc = jac_double(acc, C)
-        added = jac_add(
+            acc = pt_double(acc, C)
+        added = pt_add(
             acc, (_select15(tq_x, w_i), _select15(tq_y, w_i), _select15(tq_z, w_i)), C
         )
         return select(w_i == 0, acc, added), None
 
-    acc, _ = lax.scan(sstep, jac_infinity(k), w)
+    acc, _ = lax.scan(sstep, pt_infinity(k, C), w)
     return acc
 
 
@@ -457,11 +581,11 @@ __all__ = [
     "CurveOps",
     "SECP256K1_OPS",
     "SM2_OPS",
-    "jac_double",
-    "jac_add",
-    "jac_add_mixed",
-    "jac_infinity",
-    "jac_to_affine",
+    "pt_double",
+    "pt_add",
+    "pt_add_mixed",
+    "pt_infinity",
+    "pt_to_affine",
     "on_curve",
     "valid_scalar",
     "reduce_mod_n",

@@ -364,6 +364,16 @@ class FoldField:
     def sqr(self, a: jax.Array) -> jax.Array:
         return self.mul(a, a)
 
+    def mul_small(self, a: jax.Array, c: int) -> jax.Array:
+        """a * c for a small host constant c < 2^15 — one scalar-broadcast
+        multiply + carry + fold (~1/10 of a full mul). The RCB complete
+        group law multiplies by 3b per add; for secp256k1 b3 = 21."""
+        if not 0 < c < 1 << 15:
+            raise ValueError("mul_small needs 0 < c < 2^15")
+        cols = a * np.uint32(c)  # limbs < 2^16 * 2^15 = 2^31: no overflow
+        wide = carry_norm(cols)[: LIMBS + 1]
+        return self.reduce_wide(wide, (_R - 1) * c + 1)
+
     def add(self, a: jax.Array, b: jax.Array) -> jax.Array:
         return cond_sub(add_widen(a, b), self.m_limbs)
 
@@ -442,6 +452,22 @@ class MontField:
 
     def sqr(self, a: jax.Array) -> jax.Array:
         return self.mul(a, a)
+
+    def mul_small(self, a: jax.Array, c: int) -> jax.Array:
+        """a * c for tiny c via an addition chain (scaling commutes with the
+        Montgomery representation; each step is one conditional subtract,
+        far cheaper than a REDC mul). Used by the complete group law's
+        a = -3 path (c = 3)."""
+        if not 0 < c < 32:
+            raise ValueError("MontField.mul_small supports 0 < c < 32")
+        # double-and-add on the bits of c, msb first
+        acc = None
+        for bit in bin(c)[2:]:
+            if acc is not None:
+                acc = self.add(acc, acc)
+            if bit == "1":
+                acc = a if acc is None else self.add(acc, a)
+        return acc
 
     def add(self, a: jax.Array, b: jax.Array) -> jax.Array:
         return cond_sub(add_widen(a, b), self.m_limbs)

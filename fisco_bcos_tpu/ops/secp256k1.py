@@ -37,7 +37,7 @@ from .ec import (
     SECP256K1_OPS,
     dual_mul_windowed,
     g_comb_table,
-    jac_to_affine,
+    pt_to_affine,
     on_curve,
     reduce_mod_n,
     valid_scalar,
@@ -76,7 +76,7 @@ def verify_core(z, r, s, qx, qy, g_table):
     u1 = Fn.mul(z_n, w)
     u2 = Fn.mul(reduce_mod_n(r, C), w)
     R = dual_mul_windowed(u1, u2, (qx_e, qy_e), C, g_table)
-    x_e, _, inf = jac_to_affine(R, C)
+    x_e, _, inf = pt_to_affine(R, C)
     x_n = reduce_mod_n(F.to_plain(x_e), C)
     return valid & ~inf & eq(x_n, r)
 
@@ -114,7 +114,7 @@ def recover_core(z, r, s, v, g_table):
     u1 = Fn.neg(Fn.mul(z_n, rinv))
     u2 = Fn.mul(s, rinv)
     Q = dual_mul_windowed(u1, u2, (x, y), C, g_table)
-    qx_e, qy_e, inf = jac_to_affine(Q, C)
+    qx_e, qy_e, inf = pt_to_affine(Q, C)
     valid &= ~inf
     qx = select(valid, F.to_plain(qx_e), jnp.zeros_like(x))
     qy = select(valid, F.to_plain(qy_e), jnp.zeros_like(x))

@@ -32,7 +32,7 @@ from .ec import (
     add_mod_n,
     dual_mul_windowed,
     g_comb_table,
-    jac_to_affine,
+    pt_to_affine,
     on_curve,
     reduce_mod_n,
     valid_scalar,
@@ -62,7 +62,7 @@ def verify_core(e, r, s, qx, qy, g_table):
     t = add_mod_n(reduce_mod_n(r, C), s, C)
     valid &= ~is_zero(t)
     P1 = dual_mul_windowed(s, t, (qx_e, qy_e), C, g_table)
-    x1_e, _, inf = jac_to_affine(P1, C)
+    x1_e, _, inf = pt_to_affine(P1, C)
     x1 = reduce_mod_n(F.to_plain(x1_e), C)
     e_n = reduce_mod_n(e, C)
     R = add_mod_n(e_n, x1, C)

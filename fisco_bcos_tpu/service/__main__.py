@@ -7,6 +7,8 @@ their own OS process, serving node cores over service RPC.
     python -m fisco_bcos_tpu.service gateway --node-id <hex> \
         [--service-port N] [--p2p-port N] [--peers h:p,...]
     python -m fisco_bcos_tpu.service rpc --facade h:p [--port N]
+    python -m fisco_bcos_tpu.service storage [--db path.db] [--port N]
+    python -m fisco_bcos_tpu.service executor --storage h:p [--port N]
 
 Each prints one ``READY key=port ...`` line once listening (port 0 resolves
 to a kernel-assigned port), then serves until SIGTERM/SIGINT.
@@ -40,6 +42,14 @@ def main(argv: list[str] | None = None) -> int:
     r = sub.add_parser("rpc", help="JSON-RPC front-door process")
     r.add_argument("--facade", required=True, help="node RpcFacade host:port")
     r.add_argument("--port", type=int, default=0)
+    s = sub.add_parser("storage", help="storage backend process")
+    s.add_argument("--db", default="", help="sqlite path; empty = in-memory")
+    s.add_argument("--port", type=int, default=0)
+    e = sub.add_parser("executor", help="transaction executor process")
+    e.add_argument("--storage", required=True, help="storage service host:port")
+    e.add_argument("--port", type=int, default=0)
+    e.add_argument("--sm", action="store_true", help="SM crypto suite")
+    e.add_argument("--name", default="executor")
     args = ap.parse_args(argv)
 
     stop = threading.Event()
@@ -59,11 +69,36 @@ def main(argv: list[str] | None = None) -> int:
         print(f"READY service={svc.port} p2p={gw.port}", flush=True)
         stop.wait()
         svc.stop()
-    else:
+    elif args.cmd == "rpc":
         from .rpc_service import RpcService
 
         host, port = args.facade.rsplit(":", 1)
         svc = RpcService(host, int(port), port=args.port)
+        svc.start()
+        print(f"READY service={svc.port}", flush=True)
+        stop.wait()
+        svc.stop()
+    elif args.cmd == "storage":
+        from ..storage import MemoryStorage, SQLiteStorage
+        from .storage_service import StorageService
+
+        backend = SQLiteStorage(args.db) if args.db else MemoryStorage()
+        svc = StorageService(backend, port=args.port)
+        svc.start()
+        print(f"READY service={svc.port}", flush=True)
+        stop.wait()
+        svc.stop()
+    else:  # executor
+        from ..crypto.suite import ecdsa_suite, sm_suite
+        from ..executor import TransactionExecutor
+        from .executor_service import ExecutorService
+        from .storage_service import RemoteStorage
+
+        host, port = args.storage.rsplit(":", 1)
+        store = RemoteStorage(host, int(port))
+        suite = sm_suite() if args.sm else ecdsa_suite()
+        executor = TransactionExecutor(store, suite)
+        svc = ExecutorService(executor, name=args.name, port=args.port)
         svc.start()
         print(f"READY service={svc.port}", flush=True)
         stop.wait()

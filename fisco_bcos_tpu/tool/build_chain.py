@@ -197,6 +197,125 @@ def build_chain(
     return node_dirs
 
 
+# ---------------------------------------------------------------------------
+# Pro-mode deployer (the BcosBuilder/ProNodeInitializer analog)
+# ---------------------------------------------------------------------------
+
+_PRO_SVC_SH = """#!/bin/bash
+cd "$(dirname "$0")"
+nohup {python} -m {module} {args} > {name}.log 2>&1 &
+echo $! > {name}.pid
+"""
+
+_PRO_STOP_SH = """#!/bin/bash
+cd "$(dirname "$0")"
+for pid in rpc.pid core.pid gateway.pid storage.pid; do
+    [ -f "$pid" ] && kill "$(cat "$pid")" 2>/dev/null && rm -f "$pid"
+done
+exit 0
+"""
+
+
+def build_pro_chain(
+    out_dir: str,
+    count: int,
+    host: str = "127.0.0.1",
+    port_base: int = 40000,
+    sm: bool = False,
+    chain_id: str = "chain0",
+    group_id: str = "group0",
+) -> list[str]:
+    """Generate a Pro-topology deployment: per node a storage service, a
+    gateway service, the node core (pro_node) and an RPC front-door process,
+    each with its own start script and a deterministic port block.
+
+    Reference: tools/BcosBuilder (the python deployer that renders per-
+    service config/start artifacts for the tars Pro deployment form) +
+    fisco-bcos-tars-service process layout. Port block per node i:
+    base+10i = storage, +1 gateway service, +2 p2p, +3 node facade,
+    +4 rpc http.
+    """
+    from ..crypto.suite import ecdsa_suite, sm_suite
+
+    from .config import save_keypair
+
+    suite = sm_suite() if sm else ecdsa_suite()
+    os.makedirs(out_dir, exist_ok=True)
+    keypairs = [suite.signature_impl.generate_keypair() for _ in range(count)]
+    genesis = _genesis_text([kp.pub.hex() for kp in keypairs], chain_id, group_id)
+
+    def ports(i):
+        b = port_base + 10 * i
+        return {"storage": b, "gwsvc": b + 1, "p2p": b + 2, "facade": b + 3, "rpc": b + 4}
+
+    node_dirs = []
+    for i in range(count):
+        ndir = os.path.join(out_dir, f"node{i}")
+        conf = os.path.join(ndir, "conf")
+        os.makedirs(conf, exist_ok=True)
+        p = ports(i)
+        with open(os.path.join(ndir, "config.genesis"), "w") as f:
+            f.write(genesis)
+        save_keypair(os.path.join(conf, "node.key"), keypairs[i])
+        peers = ",".join(
+            f"{host}:{ports(j)['p2p']}" for j in range(count) if j != i
+        )
+        sm_flag = " --sm" if sm else ""
+        svcs = [
+            (
+                "storage",
+                "fisco_bcos_tpu.service",
+                f"storage --db chain.db --port {p['storage']}",
+            ),
+            (
+                "gateway",
+                "fisco_bcos_tpu.service",
+                f"gateway --node-id {keypairs[i].pub.hex()} "
+                f"--service-port {p['gwsvc']} --p2p-port {p['p2p']}"
+                + (f" --peers {peers}" if peers else ""),
+            ),
+            (
+                "core",
+                "fisco_bcos_tpu.node.pro_node",
+                f"-g config.genesis --key conf/node.key "
+                f"--gateway {host}:{p['gwsvc']} --storage {host}:{p['storage']} "
+                f"--facade-port {p['facade']}" + sm_flag,
+            ),
+            (
+                "rpc",
+                "fisco_bcos_tpu.service",
+                f"rpc --facade {host}:{p['facade']} --port {p['rpc']}",
+            ),
+        ]
+        for name, module, svc_args in svcs:
+            _write_exec(
+                os.path.join(ndir, f"start_{name}.sh"),
+                _PRO_SVC_SH.format(
+                    python=sys.executable, module=module, args=svc_args, name=name
+                ),
+            )
+        _write_exec(
+            os.path.join(ndir, "start.sh"),
+            "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n"
+            "./start_storage.sh\nsleep 0.5\n./start_gateway.sh\nsleep 0.5\n"
+            "./start_core.sh\nsleep 1\n./start_rpc.sh\n",
+        )
+        _write_exec(os.path.join(ndir, "stop.sh"), _PRO_STOP_SH)
+        node_dirs.append(ndir)
+
+    _write_exec(
+        os.path.join(out_dir, "start_all.sh"),
+        "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n"
+        + "".join(f"./node{i}/start.sh\n" for i in range(count)),
+    )
+    _write_exec(
+        os.path.join(out_dir, "stop_all.sh"),
+        "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n"
+        + "".join(f"./node{i}/stop.sh\n" for i in range(count)),
+    )
+    return node_dirs
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="build_chain", description=__doc__)
     ap.add_argument("-l", "--listen", default="127.0.0.1:4", help="host:count")
@@ -206,9 +325,33 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--ssl", action="store_true", help="mutual TLS on P2P + RPC")
     ap.add_argument("--chain-id", default="chain0")
     ap.add_argument("--group-id", default="group0")
+    ap.add_argument(
+        "--mode",
+        choices=("air", "pro"),
+        default="air",
+        help="air = one process per node; pro = storage/gateway/core/rpc "
+        "as separate service processes per node (BcosBuilder analog)",
+    )
     args = ap.parse_args(argv)
 
     host, count = args.listen.rsplit(":", 1)
+    if args.mode == "pro":
+        if args.ssl:
+            ap.error(
+                "--ssl is not supported with --mode pro yet; the pro "
+                "service mesh runs plaintext service RPC on localhost"
+            )
+        dirs = build_pro_chain(
+            args.output,
+            int(count),
+            host=host,
+            port_base=int(args.ports.split(",")[0]),
+            sm=args.sm,
+            chain_id=args.chain_id,
+            group_id=args.group_id,
+        )
+        print(f"generated {len(dirs)} pro node groups under {args.output}/")
+        return 0
     p2p_base, rpc_base = (int(x) for x in args.ports.split(","))
     dirs = build_chain(
         args.output,

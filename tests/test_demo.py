@@ -1,0 +1,25 @@
+"""Demo samples: echo perf pair + distributed rate limiter checker.
+
+Reference: fisco-bcos-demo/{echo_server_sample.cpp, echo_client_sample.cpp,
+distributed_ratelimiter_checker.cpp}.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from fisco_bcos_tpu.demo.echo_perf import run_echo_measurement  # noqa: E402
+from fisco_bcos_tpu.demo.ratelimit_checker import run_check  # noqa: E402
+
+
+def test_echo_roundtrip_measurement():
+    stats = run_echo_measurement(n_messages=50, payload=2048)
+    assert stats["echoed"] == 50
+    assert stats["bytes"] == 50 * 2048
+    assert stats["rtt_p50_ms"] > 0
+
+
+def test_ratelimit_checker_within_budget():
+    res = run_check(clients=3, budget=200, interval=0.25, seconds=1.0)
+    assert res["ok"], res
+    assert res["granted_total"] > 0

@@ -70,3 +70,10 @@ def test_suite_batch_apis_ride_device():
     sigs[1] = sigs[2]
     ok = impl.batch_verify(msgs, pubs, sigs)
     assert ok.tolist() == [True, False, True, True]
+    # malformed (short) signatures lower their ok bit, never crash
+    sigs[2] = sigs[2][:64]  # no appended pub
+    sigs[3] = b""
+    recovered, ok3 = impl.batch_recover(msgs, sigs)
+    assert ok3.tolist() == [True, False, False, False]
+    assert bytes(recovered[0]) == pubs[0]
+    assert bytes(recovered[2]) == b"\x00" * 32
