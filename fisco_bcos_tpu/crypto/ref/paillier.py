@@ -1,0 +1,151 @@
+"""Paillier additively-homomorphic cryptosystem (host reference).
+
+Backs PaillierPrecompiled's on-chain ciphertext addition. The reference
+snapshot (v3.1.2) reserves the error-code band and gas opcode for Paillier
+(bcos-executor/src/precompiled/common/Common.h:108 "PaillierPrecompiled
+-51699 ~ -51600", PrecompiledGas.h:55 `PaillierAdd = 0x13`) but ships no
+implementation file; the callable precompile exists in the 2.x line. This
+module provides the full scheme so the chain surface is complete and
+testable end-to-end: keygen, encrypt, decrypt, and the homomorphic add the
+precompile exposes.
+
+Scheme (standard Paillier with g = n + 1):
+    n = p*q,  ciphertext  c = (1 + m*n) * r^n  mod n^2
+    Enc(m1) * Enc(m2) mod n^2  =  Enc(m1 + m2 mod n)
+
+Ciphertext wire format (hex string on the ABI surface):
+    2 bytes  key bit-length K, big-endian (must be a multiple of 8)
+    K/8      n, big-endian
+    K/4      c, big-endian  (one element of Z_{n^2})
+
+The format is self-describing so `paillierAdd` can validate that both
+operands were produced under the same public key — adding ciphertexts from
+different keys is meaningless and is rejected, mapped into the reserved
+error band rather than raised.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from math import gcd
+
+
+@dataclass(frozen=True)
+class PaillierPublicKey:
+    n: int
+    bits: int  # modulus bit-length as serialized (multiple of 8)
+
+    @property
+    def n_sq(self) -> int:
+        return self.n * self.n
+
+
+@dataclass(frozen=True)
+class PaillierPrivateKey:
+    pub: PaillierPublicKey
+    lam: int  # lcm(p-1, q-1)
+    mu: int  # (L(g^lam mod n^2))^-1 mod n
+
+
+def _is_probable_prime(n: int, rounds: int = 32) -> bool:
+    if n < 2:
+        return False
+    for p in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for _ in range(rounds):
+        a = secrets.randbelow(n - 3) + 2
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _random_prime(bits: int) -> int:
+    while True:
+        c = secrets.randbits(bits) | (1 << (bits - 1)) | 1
+        if _is_probable_prime(c):
+            return c
+
+
+def generate_keypair(bits: int = 1024) -> PaillierPrivateKey:
+    """Key pair with an n of exactly ``bits`` bits (bits % 16 == 0)."""
+    if bits % 16:
+        raise ValueError("key size must be a multiple of 16 bits")
+    while True:
+        p = _random_prime(bits // 2)
+        q = _random_prime(bits // 2)
+        n = p * q
+        if p != q and n.bit_length() == bits:
+            break
+    lam = (p - 1) * (q - 1) // gcd(p - 1, q - 1)
+    pub = PaillierPublicKey(n=n, bits=bits)
+    # g = n + 1: L(g^lam mod n^2) = lam mod n, so mu = lam^-1 mod n
+    mu = pow(lam % n, -1, n)
+    return PaillierPrivateKey(pub=pub, lam=lam, mu=mu)
+
+
+def encrypt(pub: PaillierPublicKey, m: int) -> int:
+    if not 0 <= m < pub.n:
+        raise ValueError("plaintext out of range")
+    while True:
+        r = secrets.randbelow(pub.n - 1) + 1
+        if gcd(r, pub.n) == 1:
+            break
+    n_sq = pub.n_sq
+    return (1 + m * pub.n) % n_sq * pow(r, pub.n, n_sq) % n_sq
+
+
+def decrypt(priv: PaillierPrivateKey, c: int) -> int:
+    n, n_sq = priv.pub.n, priv.pub.n_sq
+    if not 0 < c < n_sq:
+        raise ValueError("ciphertext out of range")
+    u = pow(c, priv.lam, n_sq)
+    return (u - 1) // n % n * priv.mu % n
+
+
+def serialize(pub: PaillierPublicKey, c: int) -> bytes:
+    nb = pub.bits // 8
+    return (
+        pub.bits.to_bytes(2, "big")
+        + pub.n.to_bytes(nb, "big")
+        + c.to_bytes(2 * nb, "big")
+    )
+
+
+def deserialize(blob: bytes) -> tuple[PaillierPublicKey, int]:
+    if len(blob) < 2:
+        raise ValueError("ciphertext blob too short")
+    bits = int.from_bytes(blob[:2], "big")
+    if bits == 0 or bits % 8:
+        raise ValueError("bad key bit-length")
+    nb = bits // 8
+    if len(blob) != 2 + 3 * nb:
+        raise ValueError("ciphertext blob length mismatch")
+    n = int.from_bytes(blob[2 : 2 + nb], "big")
+    c = int.from_bytes(blob[2 + nb :], "big")
+    if n.bit_length() != bits:
+        raise ValueError("modulus bit-length mismatch")
+    if not 0 < c < n * n:
+        raise ValueError("ciphertext out of range")
+    return PaillierPublicKey(n=n, bits=bits), c
+
+
+def add_serialized(blob1: bytes, blob2: bytes) -> bytes:
+    """Homomorphic add of two serialized ciphertexts (same public key)."""
+    pub1, c1 = deserialize(blob1)
+    pub2, c2 = deserialize(blob2)
+    if pub1.n != pub2.n:
+        raise ValueError("ciphertexts under different public keys")
+    return serialize(pub1, c1 * c2 % pub1.n_sq)

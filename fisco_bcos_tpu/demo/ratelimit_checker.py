@@ -28,7 +28,6 @@ def run_check(
     svc = QuotaService()
     svc.start()
     granted = [0] * clients
-    windows: list[set] = [set() for _ in range(clients)]
     stop = threading.Event()
 
     def worker(idx: int):
@@ -38,7 +37,6 @@ def run_check(
         while not stop.is_set():
             if lim.try_acquire(1):
                 granted[idx] += 1
-                windows[idx].add(int(time.monotonic() / interval))
 
     threads = [
         threading.Thread(target=worker, args=(i,), daemon=True)

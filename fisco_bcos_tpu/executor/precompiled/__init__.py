@@ -27,6 +27,7 @@ from .bench_contracts import (  # noqa: F401
 )
 from .privacy import (  # noqa: F401
     GroupSigPrecompiled,
+    PaillierPrecompiled,
     RingSigPrecompiled,
     ZkpPrecompiled,
 )
@@ -42,7 +43,9 @@ AUTH_MANAGER_ADDRESS = bytes.fromhex("0000000000000000000000000000000000001005")
 CONTRACT_AUTH_MGR_ADDRESS = bytes.fromhex("0000000000000000000000000000000000010002")
 ACCOUNT_MGR_ADDRESS = bytes.fromhex("0000000000000000000000000000000000010003")
 DAG_TRANSFER_ADDRESS = bytes.fromhex("000000000000000000000000000000000000100c")
-# PrecompiledTypeDef.h:70-73 — privacy suite
+# PrecompiledTypeDef.h:70-73 — privacy suite (0x5003 is the 2.x
+# Paillier slot; v3.1.2 reserves its error band, Common.h:108)
+PAILLIER_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005003")
 GROUP_SIG_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005004")
 RING_SIG_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005005")
 DISCRETE_ZKP_ADDRESS = bytes.fromhex("0000000000000000000000000000000000005100")
@@ -63,6 +66,7 @@ def default_registry() -> dict[bytes, Precompiled]:
         CONTRACT_AUTH_MGR_ADDRESS: ContractAuthPrecompiled(),
         ACCOUNT_MGR_ADDRESS: AccountManagerPrecompiled(),
         DAG_TRANSFER_ADDRESS: DagTransferPrecompiled(),
+        PAILLIER_ADDRESS: PaillierPrecompiled(),
         GROUP_SIG_ADDRESS: GroupSigPrecompiled(),
         RING_SIG_ADDRESS: RingSigPrecompiled(),
         DISCRETE_ZKP_ADDRESS: ZkpPrecompiled(),
@@ -82,6 +86,7 @@ PRECOMPILED_ADDRESSES = {
     "kv_table": KV_TABLE_ADDRESS,
     "crypto": CRYPTO_ADDRESS,
     "dag_transfer": DAG_TRANSFER_ADDRESS,
+    "paillier": PAILLIER_ADDRESS,
     "group_sig": GROUP_SIG_ADDRESS,
     "ring_sig": RING_SIG_ADDRESS,
     "discrete_zkp": DISCRETE_ZKP_ADDRESS,

@@ -1,8 +1,13 @@
-"""Privacy precompiles — ring signatures, discrete-log ZKPs, group sig seam.
+"""Privacy precompiles — ring signatures, discrete-log ZKPs, Paillier,
+group sig seam.
 
 Reference: bcos-executor/src/precompiled/extension/
 {RingSigPrecompiled.cpp (0x5005), ZkpPrecompiled.cpp (0x5100),
-GroupSigPrecompiled.cpp (0x5004)} over the wedpr FFI suites.
+GroupSigPrecompiled.cpp (0x5004)} over the wedpr FFI suites; Paillier's
+error band and gas opcode are reserved in v3.1.2
+(precompiled/common/Common.h:108, PrecompiledGas.h:55) with the callable
+precompile shipped in the 2.x line — implemented here over
+:mod:`fisco_bcos_tpu.crypto.ref.paillier`.
 
 - RingSigPrecompiled: ``ringSigVerify(string,string,string)`` — linkable
   ring signature verification (:mod:`fisco_bcos_tpu.crypto.ref.ringsig`,
@@ -25,16 +30,24 @@ device plane is warranted — the chain's batch crypto lever is tx admission.
 
 from __future__ import annotations
 
+from ...crypto.ref import paillier
 from ...crypto.ref import pedersen_zkp as zkp
 from ...crypto.ref import ringsig
 from ...utils.log import get_logger
-from .base import Precompiled, PrecompiledCallContext, PrecompiledResult
+from .base import (
+    Precompiled,
+    PrecompiledCallContext,
+    PrecompiledError,
+    PrecompiledResult,
+)
 
 _log = get_logger("privacy-precompiled")
 
 CODE_SUCCESS = 0
 VERIFY_RING_SIG_FAILED = -70501  # precompiled/common Common.h codes
 VERIFY_GROUP_SIG_FAILED = -70502
+CODE_INVALID_CIPHERS = -51600  # Paillier band: Common.h:108 (-51699..-51600)
+VERIFY_GAS = 20_000  # PrecompiledGas.h:77 VerifyGas (PaillierAdd maps to it)
 
 
 def _hex(s: str) -> bytes:
@@ -147,4 +160,32 @@ class ZkpPrecompiled(Precompiled):
             output=ctx.codec.encode_output(
                 ["int32", "bytes"], CODE_SUCCESS if ok else -1, out or b""
             )
+        )
+
+
+class PaillierPrecompiled(Precompiled):
+    """``paillierAdd(string,string) -> string`` — homomorphic ciphertext add.
+
+    The 2.x callable surface behind the band/gas slots v3.1.2 reserves
+    (Common.h:108, PrecompiledGas.h:55). Operands and result are hex
+    ciphertexts in the self-describing format of
+    :mod:`fisco_bcos_tpu.crypto.ref.paillier`; malformed or key-mismatched
+    operands fail the transaction (a deterministic failed receipt carrying
+    the reserved band code, never an exception that aborts the block).
+    """
+
+    def setup(self, codec):
+        self.register(codec, "paillierAdd(string,string)", self._add)
+
+    def _add(self, ctx: PrecompiledCallContext, cipher1: str, cipher2: str):
+        try:
+            out = paillier.add_serialized(_hex(cipher1), _hex(cipher2))
+        except Exception as e:
+            _log.info("paillierAdd rejected: %s", e)
+            raise PrecompiledError(
+                f"paillierAdd invalid ciphertexts ({CODE_INVALID_CIPHERS}): {e}"
+            )
+        return PrecompiledResult(
+            output=ctx.codec.encode_output(["string"], out.hex()),
+            gas_used=VERIFY_GAS,
         )

@@ -21,15 +21,20 @@ Prints ``READY facade=<port>`` once serving; SIGTERM/SIGINT stops cleanly.
 
 from __future__ import annotations
 
-# pin jax to CPU before anything imports it (the axon sitecustomize would
-# otherwise route import-time work through the TPU tunnel); the node core's
-# device kernels run wherever the platform default points at run time
-try:  # pragma: no cover - environment-dependent
-    import jax
+# The node core owns the chain's device crypto plane: unlike the pure-IO
+# gateway/rpc/storage services, it must NOT pin jax to CPU — batch admission
+# and QC verification run on whatever accelerator the platform default
+# resolves to (the TPU tunnel in production, CPU under FISCO_FORCE_CPU or in
+# tests/subprocess fixtures where no TPU is reachable).
+import os
 
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+if os.environ.get("FISCO_FORCE_CPU"):  # pragma: no cover - env-dependent
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 import argparse
 import signal

@@ -137,7 +137,10 @@ def _carry_in(g: jax.Array, p: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 def carry_norm(cols: jax.Array) -> jax.Array:
-    """Carry-propagate column sums: [L, T] uint32 (each < 2^27) ->
+    """Carry-propagate column sums: [L, T] uint32 (any uint32 value: the
+    two-pass split bounds s = lo16 + prev_hi16 < 2^17 and t ≤ 2^16 before
+    the Kogge–Stone increment pass, so no intermediate can overflow —
+    mul_cols feeds columns < 2^22, mul_small up to ~2^31) ->
     [L+1, T] normalized 16-bit limbs (top row = final carry-out)."""
     cols = jnp.concatenate([cols, jnp.zeros_like(cols[:1])], axis=0)
     s = (cols & _MASK) + _shift_up(cols >> LIMB_BITS)  # < 2^16 + 2^11

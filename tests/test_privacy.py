@@ -10,6 +10,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+from fisco_bcos_tpu.crypto.ref import paillier  # noqa: E402
 from fisco_bcos_tpu.crypto.ref import pedersen_zkp as zkp  # noqa: E402
 from fisco_bcos_tpu.crypto.ref import ringsig  # noqa: E402
 from fisco_bcos_tpu.crypto.ref.ed25519 import BASE, _compress, _mul  # noqa: E402
@@ -18,6 +19,7 @@ from fisco_bcos_tpu.executor import TransactionExecutor  # noqa: E402
 from fisco_bcos_tpu.executor.precompiled import (  # noqa: E402
     DISCRETE_ZKP_ADDRESS,
     GROUP_SIG_ADDRESS,
+    PAILLIER_ADDRESS,
     RING_SIG_ADDRESS,
 )
 from fisco_bcos_tpu.protocol.block_header import BlockHeader  # noqa: E402
@@ -199,3 +201,53 @@ def test_precompile_surface():
     assert rc.status == 0
     code, ok = ex.codec.decode_output(["int32", "bool"], rc.output)
     assert not ok and code == -70502
+
+
+# -- Paillier ----------------------------------------------------------------
+
+
+def test_paillier_roundtrip_and_homomorphism():
+    priv = paillier.generate_keypair(bits=512)  # small key: test speed only
+    pub = priv.pub
+    c1, c2 = paillier.encrypt(pub, 1234), paillier.encrypt(pub, 8765)
+    assert paillier.decrypt(priv, c1) == 1234
+    summed = paillier.add_serialized(
+        paillier.serialize(pub, c1), paillier.serialize(pub, c2)
+    )
+    pub2, csum = paillier.deserialize(summed)
+    assert pub2.n == pub.n and paillier.decrypt(priv, csum) == 9999
+    # wrap-around is mod n, by construction of the scheme
+    big = paillier.encrypt(pub, pub.n - 1)
+    one = paillier.encrypt(pub, 2)
+    _, cw = paillier.deserialize(
+        paillier.add_serialized(
+            paillier.serialize(pub, big), paillier.serialize(pub, one)
+        )
+    )
+    assert paillier.decrypt(priv, cw) == 1
+
+
+def test_paillier_precompile():
+    ex = _executor()
+    priv = paillier.generate_keypair(bits=512)
+    pub = priv.pub
+    b1 = paillier.serialize(pub, paillier.encrypt(pub, 41))
+    b2 = paillier.serialize(pub, paillier.encrypt(pub, 1))
+    rc = _call(
+        ex, PAILLIER_ADDRESS, "paillierAdd(string,string)", b1.hex(), b2.hex()
+    )
+    assert rc.status == 0
+    (out_hex,) = ex.codec.decode_output(["string"], rc.output)
+    _, csum = paillier.deserialize(bytes.fromhex(out_hex))
+    assert paillier.decrypt(priv, csum) == 42
+
+    # mismatched keys -> deterministic failed receipt, not an exception
+    other = paillier.generate_keypair(bits=512)
+    b3 = paillier.serialize(other.pub, paillier.encrypt(other.pub, 1))
+    rc = _call(
+        ex, PAILLIER_ADDRESS, "paillierAdd(string,string)", b1.hex(), b3.hex()
+    )
+    assert rc.status != 0
+    # malformed hex -> same
+    rc = _call(ex, PAILLIER_ADDRESS, "paillierAdd(string,string)", "zz", "00")
+    assert rc.status != 0

@@ -77,6 +77,9 @@ def test_pro_deployment_boots_and_commits(tmp_path):
     env = dict(os.environ)
     env.setdefault("FISCO_TEST_BUCKET", "32")
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(repo, ".jax_cache"))
+    # the node core follows the platform default (TPU in production); test
+    # subprocesses must stay off the tunnel
+    env["FISCO_FORCE_CPU"] = "1"
     # services run from the node dir (chain.db lands there); the package
     # still resolves from the repo
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
