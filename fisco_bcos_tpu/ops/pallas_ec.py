@@ -9,8 +9,14 @@ intermediate through HBM; keeping it on-chip is worth an order of magnitude
 (this was the main lever for the round-2 north-star target).
 
 Grid: 1-D over batch tiles of ``TILE`` lanes; each program owns [16, TILE]
-blocks of every operand. The affine G window table ([30, 16] uint32) is
-replicated into VMEM for every program.
+blocks of every operand. The affine GLV comb table for G and 2^128·G
+([60, 16] uint32, :func:`fisco_bcos_tpu.ops.ec.g_comb_table_glv`) is
+replicated into VMEM for every program. The batched scalar inversions
+(r/s mod n, final Z mod p) run OUTSIDE the kernel as plain XLA
+(:func:`fisco_bcos_tpu.ops.ec.lane_inv`) — Montgomery's trick needs
+sub-vreg lane slicing Mosaic lacks, and the HBM round-trip of a few
+[16, B] arrays is negligible next to the ~320-op per-lane Fermat chains
+it deletes.
 
 CPU/virtual-mesh execution never routes here (see ``_use_pallas``) — the XLA
 path produces bit-identical results by integer semantics.
@@ -54,24 +60,28 @@ def _pad_lanes(x: jnp.ndarray, b_pad: int) -> jnp.ndarray:
 from .limb import mosaic_trace as _mosaic_trace
 
 
-def _recover_kernel(z_ref, r_ref, s_ref, v_ref, gt_ref, qx_ref, qy_ref, ok_ref):
-    from .secp256k1 import recover_core
+def _recover_kernel(
+    z_ref, r_ref, s_ref, v_ref, rinv_ref, gt_ref, x_ref, y_ref, zz_ref, ok_ref
+):
+    from .secp256k1 import recover_project_core
 
     with _mosaic_trace():
-        qx, qy, ok = recover_core(
-            z_ref[:], r_ref[:], s_ref[:], v_ref[0], gt_ref[:]
+        X, Y, Z, ok = recover_project_core(
+            z_ref[:], r_ref[:], s_ref[:], v_ref[0], rinv_ref[:], gt_ref[:]
         )
-    qx_ref[:] = qx
-    qy_ref[:] = qy
+    x_ref[:] = X
+    y_ref[:] = Y
+    zz_ref[:] = Z
     ok_ref[0] = ok.astype(jnp.int32)
 
 
-def _verify_kernel(z_ref, r_ref, s_ref, qx_ref, qy_ref, gt_ref, ok_ref):
+def _verify_kernel(z_ref, r_ref, s_ref, qx_ref, qy_ref, sinv_ref, gt_ref, ok_ref):
     from .secp256k1 import verify_core
 
     with _mosaic_trace():
         ok = verify_core(
-            z_ref[:], r_ref[:], s_ref[:], qx_ref[:], qy_ref[:], gt_ref[:]
+            z_ref[:], r_ref[:], s_ref[:], qx_ref[:], qy_ref[:],
+            sinv_ref[:], gt_ref[:],
         )
     ok_ref[0] = ok.astype(jnp.int32)
 
@@ -85,7 +95,7 @@ def _row_spec(tile: int):
 
 
 def _gt_spec():
-    return pl.BlockSpec((30, 16), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    return pl.BlockSpec((60, 16), lambda i: (0, 0), memory_space=pltpu.VMEM)
 
 
 @lru_cache(maxsize=None)
@@ -94,7 +104,10 @@ def _recover_call(b: int, interpret: bool = False):
 
     @jax.jit
     def run(z, r, s, v, gt):
-        qx, qy, ok = pl.pallas_call(
+        from .secp256k1 import inv_mod_n, recover_finish
+
+        rinv = inv_mod_n(r)  # batched Fermat, outside the kernel
+        X, Y, Z, ok = pl.pallas_call(
             _recover_kernel,
             interpret=interpret,
             grid=(b // tile,),
@@ -103,9 +116,11 @@ def _recover_call(b: int, interpret: bool = False):
                 _limb_spec(tile),
                 _limb_spec(tile),
                 _row_spec(tile),
+                _limb_spec(tile),
                 _gt_spec(),
             ],
             out_specs=(
+                _limb_spec(tile),
                 _limb_spec(tile),
                 _limb_spec(tile),
                 _row_spec(tile),
@@ -113,10 +128,12 @@ def _recover_call(b: int, interpret: bool = False):
             out_shape=(
                 jax.ShapeDtypeStruct((16, b), jnp.uint32),
                 jax.ShapeDtypeStruct((16, b), jnp.uint32),
+                jax.ShapeDtypeStruct((16, b), jnp.uint32),
                 jax.ShapeDtypeStruct((1, b), jnp.int32),
             ),
-        )(z, r, s, v, gt)
-        return qx.T, qy.T, ok[0] != 0
+        )(z, r, s, v, rinv, gt)
+        qx, qy, okf = recover_finish(X, Y, Z, ok[0] != 0)
+        return qx.T, qy.T, okf
 
     return run
 
@@ -127,14 +144,17 @@ def _verify_call(b: int, interpret: bool = False):
 
     @jax.jit
     def run(z, r, s, qx, qy, gt):
+        from .secp256k1 import inv_mod_n
+
+        sinv = inv_mod_n(s)
         ok = pl.pallas_call(
             _verify_kernel,
             interpret=interpret,
             grid=(b // tile,),
-            in_specs=[_limb_spec(tile)] * 5 + [_gt_spec()],
+            in_specs=[_limb_spec(tile)] * 5 + [_limb_spec(tile), _gt_spec()],
             out_specs=_row_spec(tile),
             out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
-        )(z, r, s, qx, qy, gt)
+        )(z, r, s, qx, qy, sinv, gt)
         return ok[0] != 0
 
     return run
@@ -142,12 +162,12 @@ def _verify_call(b: int, interpret: bool = False):
 
 def recover_pallas(z, r, s, v):
     """[B, 16] batch-major limbs + [B] v -> (qx, qy [B, 16], ok bool[B])."""
-    from .ec import g_comb_table
+    from .ec import g_comb_table_glv
     from .secp256k1 import SECP256K1_OPS
 
     b = z.shape[0]
     b_pad = max(MIN_TILE, -(-b // MIN_TILE) * MIN_TILE)
-    gt = jnp.asarray(g_comb_table(SECP256K1_OPS.name))
+    gt = jnp.asarray(g_comb_table_glv(SECP256K1_OPS.name))
     qx, qy, ok = _recover_call(b_pad, INTERPRET)(
         _pad_lanes(jnp.asarray(z).T, b_pad),
         _pad_lanes(jnp.asarray(r).T, b_pad),
@@ -160,12 +180,12 @@ def recover_pallas(z, r, s, v):
 
 def verify_pallas(z, r, s, qx, qy):
     """[B, 16] batch-major limb inputs -> ok bool[B]."""
-    from .ec import g_comb_table
+    from .ec import g_comb_table_glv
     from .secp256k1 import SECP256K1_OPS
 
     b = z.shape[0]
     b_pad = max(MIN_TILE, -(-b // MIN_TILE) * MIN_TILE)
-    gt = jnp.asarray(g_comb_table(SECP256K1_OPS.name))
+    gt = jnp.asarray(g_comb_table_glv(SECP256K1_OPS.name))
     ok = _verify_call(b_pad, INTERPRET)(
         _pad_lanes(jnp.asarray(z).T, b_pad),
         _pad_lanes(jnp.asarray(r).T, b_pad),

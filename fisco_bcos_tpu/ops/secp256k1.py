@@ -35,10 +35,12 @@ from . import limb
 from .bigint import bytes_be_to_limbs, limbs_to_bytes_be
 from .ec import (
     SECP256K1_OPS,
-    dual_mul_windowed,
-    g_comb_table,
-    pt_to_affine,
+    g_comb_table_glv,
+    glv_decompose,
+    lane_inv,
     on_curve,
+    pt_to_affine_batch,
+    quad_mul_windowed,
     reduce_mod_n,
     valid_scalar,
 )
@@ -50,7 +52,19 @@ _C = SECP256K1_OPS
 
 
 def _g_table() -> jnp.ndarray:
-    return jnp.asarray(g_comb_table(_C.name))
+    return jnp.asarray(g_comb_table_glv(_C.name))
+
+
+# ---------------------------------------------------------------------------
+# Batched scalar inversion (runs OUTSIDE the Pallas kernel, plain XLA)
+# ---------------------------------------------------------------------------
+
+
+def inv_mod_n(x):
+    """Batch x^-1 mod n via one Fermat exponentiation for the whole lane
+    axis (:func:`lane_inv`). Canonicalizes first so an adversarial x ≡ 0
+    (mod n) with nonzero limbs cannot poison the shared product tree."""
+    return lane_inv(_C.Fn, reduce_mod_n(x, _C))
 
 
 # ---------------------------------------------------------------------------
@@ -58,10 +72,14 @@ def _g_table() -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def verify_core(z, r, s, qx, qy, g_table):
-    """Batch ECDSA verify. z/r/s/qx/qy: [16, T] plain-domain limb-major.
+def verify_core(z, r, s, qx, qy, sinv, g_table):
+    """Batch ECDSA verify. z/r/s/qx/qy: [16, T] plain-domain limb-major;
+    sinv = :func:`inv_mod_n`(s) computed outside (batched — garbage on
+    s ≡ 0 lanes, which `valid` masks).
 
-    Returns bool[T]: signature valid.
+    Returns bool[T]: signature valid. The affine comparison is projective
+    (x(R) ≡ r mod n ⟺ X = r·Z or X = (r+n)·Z, r+n < p) so no per-lane
+    inversion remains anywhere in the verify path.
     """
     C = _C
     F, Fn = C.F, C.Fn
@@ -72,22 +90,29 @@ def verify_core(z, r, s, qx, qy, g_table):
     qy_e = F.from_plain(qy)
     valid &= on_curve(qx_e, qy_e, C)
     z_n = reduce_mod_n(z, C)
-    w = Fn.inv(s)
-    u1 = Fn.mul(z_n, w)
-    u2 = Fn.mul(reduce_mod_n(r, C), w)
-    R = dual_mul_windowed(u1, u2, (qx_e, qy_e), C, g_table)
-    x_e, _, inf = pt_to_affine(R, C)
-    x_n = reduce_mod_n(F.to_plain(x_e), C)
-    return valid & ~inf & eq(x_n, r)
+    u1 = Fn.mul(z_n, sinv)
+    u2 = Fn.mul(reduce_mod_n(r, C), sinv)
+    ka, sa, kb, sb = glv_decompose(u2, C)
+    X, _Y, Z = quad_mul_windowed(
+        u1, ka, sa, kb, sb, (qx_e, qy_e), C, g_table
+    )
+    # r < n < p: r is already a canonical field element in the plain domain
+    ok = eq(X, F.mul(r, Z))
+    rn17 = limb.add_widen(r, const_rows(C.n_limbs, r))  # [17, T]
+    rn_fits = (limb.row(rn17, 16) == 0) & lt(rn17[:16], p_rows)
+    ok |= rn_fits & eq(X, F.mul(rn17[:16], Z))
+    return valid & ~is_zero(Z) & ok
 
 
-def recover_core(z, r, s, v, g_table):
-    """Batch ECDSA public-key recovery.
+def recover_project_core(z, r, s, v, rinv, g_table):
+    """Batch ECDSA public-key recovery, projective part (Pallas-resident).
 
     z, r, s: [16, T] plain limb-major; v: [T] int32 recovery id (0..3 or
     27/28, exactly the reference's accepted encodings —
-    Secp256k1Crypto.cpp:106; 29/30 must NOT alias to 2/3).
-    Returns (qx, qy [16, T] plain limbs, ok bool[T]); invalid lanes 0.
+    Secp256k1Crypto.cpp:106; 29/30 must NOT alias to 2/3);
+    rinv = :func:`inv_mod_n`(r) computed outside.
+    Returns (X, Y, Z [16, T] field-domain projective Q, ok bool[T]);
+    :func:`recover_finish` converts to plain affine outside the kernel.
     """
     C = _C
     F, Fn = C.F, C.Fn
@@ -109,16 +134,31 @@ def recover_core(z, r, s, v, g_table):
     flip = (limb.row(y, 0) & 1).astype(jnp.int32) != (v & 1)  # plain parity
     y = select(flip, F.neg(y), y)
     # Q = r^-1 * (s*R - z*G)
-    rinv = Fn.inv(r)
     z_n = reduce_mod_n(z, C)
     u1 = Fn.neg(Fn.mul(z_n, rinv))
     u2 = Fn.mul(s, rinv)
-    Q = dual_mul_windowed(u1, u2, (x, y), C, g_table)
-    qx_e, qy_e, inf = pt_to_affine(Q, C)
+    ka, sa, kb, sb = glv_decompose(u2, C)
+    X, Y, Z = quad_mul_windowed(u1, ka, sa, kb, sb, (x, y), C, g_table)
+    return X, Y, Z, valid
+
+
+def recover_finish(X, Y, Z, valid):
+    """Projective Q -> plain affine (qx, qy, ok), Z inversion batched
+    across lanes (plain XLA, runs after the kernel)."""
+    C = _C
+    qx_e, qy_e, inf = pt_to_affine_batch((X, Y, Z), C)
     valid &= ~inf
-    qx = select(valid, F.to_plain(qx_e), jnp.zeros_like(x))
-    qy = select(valid, F.to_plain(qy_e), jnp.zeros_like(x))
+    qx = select(valid, C.F.to_plain(qx_e), jnp.zeros_like(X))
+    qy = select(valid, C.F.to_plain(qy_e), jnp.zeros_like(X))
     return qx, qy, valid
+
+
+def recover_core(z, r, s, v, g_table):
+    """Whole-program recovery (plain-XLA path): pre-inversion +
+    :func:`recover_project_core` + :func:`recover_finish`."""
+    rinv = inv_mod_n(r)
+    X, Y, Z, valid = recover_project_core(z, r, s, v, rinv, g_table)
+    return recover_finish(X, Y, Z, valid)
 
 
 # ---------------------------------------------------------------------------
@@ -139,8 +179,8 @@ def _use_pallas() -> bool:
 
 @jax.jit
 def _verify_xla(z, r, s, qx, qy):
-    ok = verify_core(z.T, r.T, s.T, qx.T, qy.T, _g_table())
-    return ok
+    sT = s.T
+    return verify_core(z.T, r.T, sT, qx.T, qy.T, inv_mod_n(sT), _g_table())
 
 
 @jax.jit

@@ -227,3 +227,76 @@ class TestSM2Batch:
         sig128[0, 0] ^= 0xFF
         got2, ok2 = sm2.recover_batch(hashes, sig128)
         assert not ok2[0] and (got2[0] == 0).all()
+
+
+class TestGlvMachinery:
+    def test_lane_inv_matches_fermat(self):
+        """Batched Montgomery-trick inversion must equal per-lane Fermat
+        bit-exactly (the inverse is unique mod m), with 0 -> 0 and an
+        adversarial x = n lane (≡ 0 mod n after canonicalization) isolated
+        from the shared product tree rather than poisoning it."""
+        C = ec.SECP256K1_OPS
+        n = C.curve.n
+        vals = [1, 2, n - 1, 0, n + 5, 12345, n, 7]  # via inv_mod_n: x mod n
+        x = _rows(vals)
+        got = limb.rows_to_ints(np.asarray(secp256k1.inv_mod_n(x)))
+        for v, g in zip(vals, got):
+            expect = pow(v % n, -1, n) if v % n else 0
+            assert g == expect, (v, g, expect)
+
+    def test_glv_decompose_identity_and_bounds(self):
+        """u2 ≡ (-1)^sa*ka + (-1)^sb*kb*λ (mod n), ka/kb < 2^131 — the
+        congruence is what makes the quad ladder compute u2*Q at all; the
+        bound is what N_QWINDOWS covers."""
+        C = ec.SECP256K1_OPS
+        n = C.curve.n
+        lam = ec._SECP_LAMBDA
+        rng = np.random.default_rng(7)
+        vals = [0, 1, n - 1, lam, n - lam] + [
+            int(rng.integers(0, 2**63)) ** 4 % n for _ in range(11)
+        ]
+        ka, sa, kb, sb = ec.glv_decompose(_rows(vals), C)
+        ka_i = limb.rows_to_ints(np.asarray(ka))
+        kb_i = limb.rows_to_ints(np.asarray(kb))
+        sa_b, sb_b = np.asarray(sa), np.asarray(sb)
+        for u2, a, b, na, nb in zip(vals, ka_i, kb_i, sa_b, sb_b):
+            a_s = -a if na else a
+            b_s = -b if nb else b
+            assert (a_s + b_s * lam - u2) % n == 0, u2
+            assert a < 2**131 and b < 2**131, (u2, a, b)
+
+    def test_quad_mul_matches_dual_mul(self):
+        """The GLV quad ladder and the plain Shamir ladder must agree on
+        u1*G + u2*Q (same group element -> same affine coordinates)."""
+        C = ec.SECP256K1_OPS
+        c = C.curve
+        rng = np.random.default_rng(11)
+        u1s, u2s, qs = [], [], []
+        for i in range(4):
+            u1s.append(int(rng.integers(1, 2**62)) ** 4 % c.n)
+            u2s.append(int(rng.integers(1, 2**62)) ** 4 % c.n)
+            qs.append(_keypair(c, i + 99)[1])
+        u1s.append(0)
+        u2s.append(5)
+        qs.append(_keypair(c, 7)[1])
+        Q = (
+            C.F.from_plain(_rows([q[0] for q in qs])),
+            C.F.from_plain(_rows([q[1] for q in qs])),
+        )
+        u1 = _rows(u1s)
+        ka, sa, kb, sb = ec.glv_decompose(_rows(u2s), C)
+        gt2 = jnp.asarray(ec.g_comb_table_glv(C.name))
+        got = _aff_ints(
+            C,
+            ec.pt_to_affine(
+                ec.quad_mul_windowed(u1, ka, sa, kb, sb, Q, C, gt2), C
+            )[:2],
+        )
+        gt = jnp.asarray(ec.g_comb_table(C.name))
+        want = _aff_ints(
+            C,
+            ec.pt_to_affine(
+                ec.dual_mul_windowed(u1, _rows(u2s), Q, C, gt), C
+            )[:2],
+        )
+        assert got == want
