@@ -211,9 +211,11 @@ def bench_sm2() -> None:
 
 
 def bench_merkle() -> None:
+    import jax.numpy as jnp
+
     from fisco_bcos_tpu import native_bind
     from fisco_bcos_tpu.crypto.ref.keccak import keccak256
-    from fisco_bcos_tpu.ops.merkle import merkle_root
+    from fisco_bcos_tpu.ops.merkle import MerkleTree, merkle_root
 
     n = BLOCK_TXS
     leaves = np.frombuffer(
@@ -221,11 +223,15 @@ def bench_merkle() -> None:
         dtype=np.uint8,
     )[: n * 32].reshape(n, 32).copy()
 
-    root = merkle_root(leaves, hasher="keccak256")  # warmup + correctness anchor
+    # leaves live on device: in the sealing path tx/receipt hashes come out
+    # of the batch hash kernels, so the root computation starts device-side
+    dev_leaves = jnp.asarray(leaves)
+    root = merkle_root(dev_leaves, hasher="keccak256")  # warmup
+    assert root == MerkleTree(leaves).root  # correctness anchor
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        root = merkle_root(leaves, hasher="keccak256")
+        root = merkle_root(dev_leaves, hasher="keccak256")
         times.append(time.perf_counter() - t0)
     dev_ms = min(times) * 1000.0
 
@@ -261,30 +267,50 @@ def bench_flood() -> None:
     fac = TransactionFactory(suite)
     sender = suite.signature_impl.generate_keypair(secret=0xF200D)
     n = FLOOD_TXS
-    txs = [
-        fac.create_signed(
-            sender,
-            chain_id="chain0",
-            group_id="group0",
-            block_limit=500,
-            nonce=f"flood-{i}",
-            to=DAG_TRANSFER_ADDRESS,
-            input=codec.encode_call("userAdd(string,uint256)", f"u{i}", 1),
-        )
-        for i in range(n)
-    ]
+
+    def make_txs(tag: str):
+        return [
+            fac.create_signed(
+                sender,
+                chain_id="chain0",
+                group_id="group0",
+                block_limit=500,
+                nonce=f"flood-{tag}-{i}",
+                to=DAG_TRANSFER_ADDRESS,
+                input=codec.encode_call("userAdd(string,uint256)", f"u{tag}{i}", 1),
+            )
+            for i in range(n)
+        ]
+
     err = None
+
+    def flood_round(txs):
+        nonlocal err
+        results = node.txpool.submit_batch(txs)
+        rejected = sum(1 for r in results if r.status != 0)
+        if rejected:
+            err = err or f"{rejected}/{len(txs)} txs rejected at admission"
+        stalls = 0
+        while node.txpool.pending_count() > 0 and stalls < 3:
+            if not node.sealer.seal_and_submit():
+                stalls += 1  # report a degraded number instead of dying
+
+    # round 1 warms every device program on the block path (admission batch
+    # shapes, tx/receipt merkle, state root) — a production node compiles
+    # once per shape for its whole lifetime, so steady-state TPS is the
+    # meaningful number; round 2 is the measured one. Client-side signing
+    # happens outside the timed window (the reference's flood helper
+    # likewise pre-builds txs — DuplicateTransactionFactory.cpp).
+    flood_round(make_txs("w"))
+    backlog = node.txpool.pending_count()
+    if backlog:
+        err = f"warm round left {backlog} txs pending"  # would inflate TPS
+    measured_txs = make_txs("m")
+    before = node.ledger.total_transaction_count()
     t0 = time.perf_counter()
-    results = node.txpool.submit_batch(txs)
-    rejected = sum(1 for r in results if r.status != 0)
-    if rejected:
-        err = f"{rejected}/{n} txs rejected at admission"
-    stalls = 0
-    while node.txpool.pending_count() > 0 and stalls < 3:
-        if not node.sealer.seal_and_submit():
-            stalls += 1  # report a degraded number instead of dying
+    flood_round(measured_txs)
     dt = time.perf_counter() - t0
-    committed = node.ledger.total_transaction_count()
+    committed = node.ledger.total_transaction_count() - before
     if committed < n:
         err = err or f"only {committed}/{n} txs committed"
     tps = committed / dt

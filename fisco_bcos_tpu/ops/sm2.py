@@ -32,7 +32,7 @@ from .ec import (
     add_mod_n,
     dual_mul_windowed,
     g_comb_table,
-    pt_to_affine,
+    pt_to_affine_batch,
     on_curve,
     reduce_mod_n,
     valid_scalar,
@@ -49,7 +49,8 @@ def verify_core(e, r, s, qx, qy, g_table):
     """Batch SM2 verify, limb-major [16, T] plain-domain inputs.
 
     e: SM3(ZA ‖ M) digest as an integer; (r, s): signature; (qx, qy): affine
-    public key. Returns bool[T]. Runs under Pallas or plain XLA.
+    public key. Returns bool[T]. Plain XLA (the batched Z inversion's lane
+    tree does not lower under Mosaic; SM2 has no Pallas kernel yet).
     """
     C = _C
     F = C.F
@@ -62,7 +63,9 @@ def verify_core(e, r, s, qx, qy, g_table):
     t = add_mod_n(reduce_mod_n(r, C), s, C)
     valid &= ~is_zero(t)
     P1 = dual_mul_windowed(s, t, (qx_e, qy_e), C, g_table)
-    x1_e, _, inf = pt_to_affine(P1, C)
+    # batched Z inversion (one Fermat chain for the whole lane axis); SM2
+    # verify has no scalar inversions, so this is the only one left
+    x1_e, _, inf = pt_to_affine_batch(P1, C)
     x1 = reduce_mod_n(F.to_plain(x1_e), C)
     e_n = reduce_mod_n(e, C)
     R = add_mod_n(e_n, x1, C)

@@ -85,3 +85,30 @@ def test_truncated_proof_cannot_certify_internal_node():
     # and a proof that's too long fails as well
     padded = full + [full[-1]]
     assert not MerkleTree.verify_proof(bytes(leaves[0]), 0, 256, padded, tree.root, width=16)
+
+
+@pytest.mark.parametrize("n", [256, 271, 400, 1000])
+def test_fused_device_root_matches_host_path(n):
+    """merkle_root's >= 256-leaf fused single-program device path must be
+    bit-identical to the generic MerkleTree levels (consensus-critical:
+    tx/receipt roots) — including short last groups at every level, and for
+    device-resident (jax.Array) leaf input."""
+    import jax.numpy as jnp
+
+    from fisco_bcos_tpu.ops.merkle import merkle_root
+
+    rng = np.random.default_rng(n)
+    leaves = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    want = MerkleTree(leaves, width=16).root
+    assert merkle_root(leaves) == want
+    assert merkle_root(jnp.asarray(leaves)) == want
+
+
+def test_fused_device_root_input_validation():
+    from fisco_bcos_tpu.ops.merkle import merkle_root
+
+    leaves = np.zeros((300, 32), dtype=np.uint8)
+    with pytest.raises(ValueError):
+        merkle_root(leaves, width=1)  # would never shrink
+    with pytest.raises(ValueError):
+        merkle_root(np.zeros((300, 64), dtype=np.uint8))
