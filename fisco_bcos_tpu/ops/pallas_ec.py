@@ -160,6 +160,78 @@ def _verify_call(b: int, interpret: bool = False):
     return run
 
 
+def _sm2_verify_kernel(e_ref, r_ref, s_ref, qx_ref, qy_ref, gt_ref, x_ref, z_ref, ok_ref):
+    from .sm2 import verify_project_core
+
+    with _mosaic_trace():
+        X, Z, ok = verify_project_core(
+            e_ref[:], r_ref[:], s_ref[:], qx_ref[:], qy_ref[:], gt_ref[:]
+        )
+    x_ref[:] = X
+    z_ref[:] = Z
+    ok_ref[0] = ok.astype(jnp.int32)
+
+
+def _sm2_gt_spec():
+    return pl.BlockSpec((30, 16), lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+
+# SM2's Montgomery field triples the per-mul intermediates of the secp
+# pseudo-Mersenne fold; half the lane tile keeps the ladder's live set
+# inside the scoped-VMEM budget
+SM2_TILE = 128
+
+
+@lru_cache(maxsize=None)
+def _sm2_verify_call(b: int, interpret: bool = False):
+    if b % SM2_TILE:
+        raise ValueError(f"SM2 pallas batch must be a multiple of {SM2_TILE}, got {b}")
+    tile = SM2_TILE
+
+    @jax.jit
+    def run(e, r, s, qx, qy, gt):
+        from .sm2 import verify_finish
+
+        X, Z, ok = pl.pallas_call(
+            _sm2_verify_kernel,
+            interpret=interpret,
+            grid=(b // tile,),
+            in_specs=[_limb_spec(tile)] * 5 + [_sm2_gt_spec()],
+            out_specs=(
+                _limb_spec(tile),
+                _limb_spec(tile),
+                _row_spec(tile),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((16, b), jnp.uint32),
+                jax.ShapeDtypeStruct((16, b), jnp.uint32),
+                jax.ShapeDtypeStruct((1, b), jnp.int32),
+            ),
+        )(e, r, s, qx, qy, gt)
+        return verify_finish(e, r, X, Z, ok[0] != 0)
+
+    return run
+
+
+def sm2_verify_pallas(e, r, s, qx, qy):
+    """[B, 16] batch-major limb inputs -> ok bool[B] (SM2)."""
+    from .ec import g_comb_table
+    from .sm2 import SM2_OPS
+
+    b = e.shape[0]
+    b_pad = max(MIN_TILE, -(-b // MIN_TILE) * MIN_TILE)
+    gt = jnp.asarray(g_comb_table(SM2_OPS.name))
+    ok = _sm2_verify_call(b_pad, INTERPRET)(
+        _pad_lanes(jnp.asarray(e).T, b_pad),
+        _pad_lanes(jnp.asarray(r).T, b_pad),
+        _pad_lanes(jnp.asarray(s).T, b_pad),
+        _pad_lanes(jnp.asarray(qx).T, b_pad),
+        _pad_lanes(jnp.asarray(qy).T, b_pad),
+        gt,
+    )
+    return ok[:b]
+
+
 def recover_pallas(z, r, s, v):
     """[B, 16] batch-major limbs + [B] v -> (qx, qy [B, 16], ok bool[B])."""
     from .ec import g_comb_table_glv

@@ -32,7 +32,7 @@ from .ec import (
     add_mod_n,
     dual_mul_windowed,
     g_comb_table,
-    pt_to_affine_batch,
+    lane_inv,
     on_curve,
     reduce_mod_n,
     valid_scalar,
@@ -45,13 +45,16 @@ from .sm3 import sm3_batch
 _C = SM2_OPS
 
 
-def verify_core(e, r, s, qx, qy, g_table):
-    """Batch SM2 verify, limb-major [16, T] plain-domain inputs.
+def verify_project_core(e, r, s, qx, qy, g_table):
+    """Batch SM2 verify, projective part — Mosaic-compatible (runs inside
+    the Pallas kernel on TPU, or plain XLA on CPU).
 
-    e: SM3(ZA ‖ M) digest as an integer; (r, s): signature; (qx, qy): affine
-    public key. Returns bool[T]. Plain XLA (the batched Z inversion's lane
-    tree does not lower under Mosaic; SM2 has no Pallas kernel yet).
-    """
+    Limb-major [16, T] plain-domain inputs: e = SM3(ZA ‖ M) digest as an
+    integer; (r, s): signature; (qx, qy): affine public key.
+    Returns (X, Z [16, T] Montgomery-domain projective coords of
+    s*G + t*Q, valid bool[T]) — the final comparison needs the affine x1
+    value, so the lane-batched Z inversion happens outside in
+    :func:`verify_finish`."""
     C = _C
     F = C.F
     p_rows = const_rows(C.p_limbs, e)
@@ -62,21 +65,44 @@ def verify_core(e, r, s, qx, qy, g_table):
     valid &= on_curve(qx_e, qy_e, C)
     t = add_mod_n(reduce_mod_n(r, C), s, C)
     valid &= ~is_zero(t)
-    P1 = dual_mul_windowed(s, t, (qx_e, qy_e), C, g_table)
-    # batched Z inversion (one Fermat chain for the whole lane axis); SM2
-    # verify has no scalar inversions, so this is the only one left
-    x1_e, _, inf = pt_to_affine_batch(P1, C)
+    X, _Y, Z = dual_mul_windowed(s, t, (qx_e, qy_e), C, g_table)
+    return X, Z, valid
+
+
+def verify_finish(e, r, X, Z, valid):
+    """(e + x1) mod n == r with the Z inversion batched across lanes
+    (plain XLA; one Fermat chain per batch)."""
+    C = _C
+    F = C.F
+    zinv = lane_inv(F, Z)
+    x1_e = F.mul(X, zinv)
     x1 = reduce_mod_n(F.to_plain(x1_e), C)
     e_n = reduce_mod_n(e, C)
     R = add_mod_n(e_n, x1, C)
-    return valid & ~inf & eq(R, r)
+    return valid & ~is_zero(Z) & eq(R, r)
+
+
+def verify_core(e, r, s, qx, qy, g_table):
+    """Whole-program SM2 verify (plain-XLA path)."""
+    X, Z, valid = verify_project_core(e, r, s, qx, qy, g_table)
+    return verify_finish(e, r, X, Z, valid)
 
 
 @jax.jit
-def verify_device(e, r, s, qx, qy):
-    """Batch SM2 verify. All inputs [B, 16] plain-domain batch-major limbs."""
+def _verify_xla(e, r, s, qx, qy):
     gt = jnp.asarray(g_comb_table(_C.name))
     return verify_core(e.T, r.T, s.T, qx.T, qy.T, gt)
+
+
+def verify_device(e, r, s, qx, qy):
+    """Batch SM2 verify. All inputs [B, 16] plain-domain batch-major limbs."""
+    from .secp256k1 import _use_pallas
+
+    if _use_pallas():
+        from .pallas_ec import sm2_verify_pallas
+
+        return sm2_verify_pallas(e, r, s, qx, qy)
+    return _verify_xla(e, r, s, qx, qy)
 
 
 # ---------------------------------------------------------------------------

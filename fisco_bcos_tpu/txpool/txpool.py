@@ -90,43 +90,50 @@ class TxPool:
         return TxSubmitResult(h, ErrorCode.SUCCESS, tx.sender)
 
     def submit_batch(self, txs: list[Transaction]) -> list[TxSubmitResult]:
-        """Batch admission: one device program for every signature
-        (the TPU replacement for the reference's per-tx verify loop)."""
-        hashes = hash_transactions_batch(txs, self.suite)
+        """Batch admission: ONE fused device program (keccak → recover →
+        address) for the whole batch — the TPU replacement for the
+        reference's per-tx verify loop.
+
+        Gate order matches the reference (dup/static → pool-full → sig):
+        only the statically-admissible, within-room subset reaches the
+        device, so a full pool or an all-replay batch costs no device
+        program at all. A pooled duplicate is caught by its nonce
+        (``_insert`` registers every pooled nonce, and equal hash implies
+        equal nonce), so no pre-verification hash pass is needed — the
+        fused program's digests fill the hash caches of verified lanes,
+        and only rejected lanes pay a host hash for their result row."""
         results: list[TxSubmitResult | None] = [None] * len(txs)
         to_verify: list[int] = []
         with self._lock:
             room = self.pool_limit - len(self._txs)
         batch_nonces: set[str] = set()
-        for i, (tx, h) in enumerate(zip(txs, hashes)):
-            with self._lock:
-                known = h in self._txs
-            if known:
-                results[i] = TxSubmitResult(h, ErrorCode.ALREADY_IN_TX_POOL)
-                continue
+        for i, tx in enumerate(txs):
             code = self.validator.check_static(tx)
             if code == ErrorCode.SUCCESS and tx.nonce in batch_nonces:
                 code = ErrorCode.ALREADY_IN_TX_POOL  # intra-batch nonce replay
             if code != ErrorCode.SUCCESS:
-                results[i] = TxSubmitResult(h, code)
+                results[i] = TxSubmitResult(tx.hash(self.suite), code)
+                continue
+            if len(to_verify) >= room:
+                results[i] = TxSubmitResult(
+                    tx.hash(self.suite), ErrorCode.TX_POOL_FULL
+                )
                 continue
             batch_nonces.add(tx.nonce)
-            if len(to_verify) >= room:
-                results[i] = TxSubmitResult(h, ErrorCode.TX_POOL_FULL)
-                continue
             to_verify.append(i)
         if to_verify:
+            # ONE fused device program (keccak → recover → address); fills
+            # hash + sender caches for every verified lane
             ok = batch_admit([txs[i] for i in to_verify], self.suite)
             persisted: list[tuple[bytes, "Entry"]] = []
             for j, i in enumerate(to_verify):
+                h = txs[i].hash(self.suite)  # cached by the fused pass
                 if ok[j]:
-                    self._insert(txs[i], hashes[i], persist=False)
-                    persisted.append((hashes[i], txs[i]))
-                    results[i] = TxSubmitResult(
-                        hashes[i], ErrorCode.SUCCESS, txs[i].sender
-                    )
+                    self._insert(txs[i], h, persist=False)
+                    persisted.append((h, txs[i]))
+                    results[i] = TxSubmitResult(h, ErrorCode.SUCCESS, txs[i].sender)
                 else:
-                    results[i] = TxSubmitResult(hashes[i], ErrorCode.INVALID_SIGNATURE)
+                    results[i] = TxSubmitResult(h, ErrorCode.INVALID_SIGNATURE)
             if self.pstore is not None and persisted:
                 from ..storage.entry import Entry
 

@@ -94,3 +94,55 @@ def test_recover_and_verify_interpret_match_reference():
         )
     )
     assert not okv2[0] and okv2[1:n].all()
+
+
+def test_sm2_verify_interpret_matches_reference():
+    n = 3
+    hashes, rs, ss, pubs = [], [], [], []
+    for i in range(n):
+        d = 0xB00B + i * 7919
+        h = hashlib.sha256(b"pallas sm2 %d" % i).digest()
+        r, s = ref.sm2_sign(h, d)
+        hashes.append(h)
+        rs.append(r)
+        ss.append(s)
+        pubs.append(ref.privkey_to_pubkey(ref.SM2_CURVE, d))
+    from fisco_bcos_tpu.ops.sm2 import sm2_e_batch
+
+    hz = np.frombuffer(b"".join(hashes), np.uint8).reshape(n, 32)
+    pub_b = np.stack(
+        [
+            np.frombuffer(x.to_bytes(32, "big") + y.to_bytes(32, "big"), np.uint8)
+            for x, y in pubs
+        ]
+    )
+    e = bytes_be_to_limbs(sm2_e_batch(hz, pub_b))
+    r_l = bytes_be_to_limbs(
+        np.stack([np.frombuffer(r.to_bytes(32, "big"), np.uint8) for r in rs])
+    )
+    s_l = bytes_be_to_limbs(
+        np.stack([np.frombuffer(s.to_bytes(32, "big"), np.uint8) for s in ss])
+    )
+    qx = bytes_be_to_limbs(
+        np.stack([np.frombuffer(x.to_bytes(32, "big"), np.uint8) for x, _ in pubs])
+    )
+    qy = bytes_be_to_limbs(
+        np.stack([np.frombuffer(y.to_bytes(32, "big"), np.uint8) for _, y in pubs])
+    )
+    ok = np.asarray(
+        pallas_ec.sm2_verify_pallas(
+            jnp.asarray(e), jnp.asarray(r_l), jnp.asarray(s_l),
+            jnp.asarray(qx), jnp.asarray(qy),
+        )
+    )
+    assert ok[:n].all()
+    assert not ok[n:].any()  # zero padding lanes invalid
+    s_bad = s_l.copy()
+    s_bad[0, 0] ^= 1
+    ok2 = np.asarray(
+        pallas_ec.sm2_verify_pallas(
+            jnp.asarray(e), jnp.asarray(r_l), jnp.asarray(s_bad),
+            jnp.asarray(qx), jnp.asarray(qy),
+        )
+    )
+    assert not ok2[0] and ok2[1:n].all()
