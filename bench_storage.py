@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Storage throughput harness — the analog of the reference's
+tests/perf/benchmark.cpp (StateStorage vs KeyPageStorage vs RocksDB
+read/write CLI, /root/reference/tests/perf/benchmark.cpp:22-100).
+
+Prints one JSON line per (backend, op) with rows/s. Usage:
+
+    python bench_storage.py [N]          # default 20k rows
+
+Backends: StateStorage overlay (the executor's working set),
+KeyPageStorage (page-packed key layout), SqliteStorage (the durable
+RocksDB analog, batch-committed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+from fisco_bcos_tpu.storage.entry import Entry
+from fisco_bcos_tpu.storage.keypage import KeyPageStorage
+from fisco_bcos_tpu.storage.memory_storage import MemoryStorage
+from fisco_bcos_tpu.storage.sqlite_storage import SQLiteStorage
+from fisco_bcos_tpu.storage.state_storage import StateStorage
+
+TABLE = "t_bench"
+
+
+def _emit(backend: str, op: str, n: int, dt: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": f"storage_{backend}_{op}_rows_per_s",
+                "value": round(n / dt, 1),
+                "unit": "rows/s",
+                "n": n,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _bench(backend: str, store, n: int, batched=None) -> None:
+    keys = [b"key-%08d" % i for i in range(n)]
+    entries = [Entry({"value": b"v" * 32 + b"%08d" % i}) for i in range(n)]
+    t0 = time.perf_counter()
+    if batched is not None:
+        batched(TABLE, list(zip(keys, entries)))
+    else:
+        for k, e in zip(keys, entries):
+            store.set_row(TABLE, k, e)
+    _emit(backend, "write", n, time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    miss = 0
+    for k in keys:
+        if store.get_row(TABLE, k) is None:
+            miss += 1
+    dt = time.perf_counter() - t0
+    assert miss == 0, f"{backend}: {miss} missing rows"
+    _emit(backend, "read", n, dt)
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
+
+    _bench("state_storage", StateStorage(MemoryStorage()), n)
+    kp = KeyPageStorage(MemoryStorage())
+    _bench("keypage", kp, n, batched=kp.set_rows)
+
+    with tempfile.TemporaryDirectory() as d:
+        sq = SQLiteStorage(os.path.join(d, "bench.db"))
+        _bench("sqlite", sq, n, batched=sq.set_rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,18 +61,35 @@ def _decode_meta(buf: bytes) -> list[bytes]:
 
 
 class KeyPageStorage(TransactionalStorage):
+    # decoded-page cache bound: ~page_size entries per page, so 1024 pages
+    # ≈ 256k cached rows — cleared wholesale when exceeded (reads repopulate)
+    _CACHE_MAX_PAGES = 1024
+
     def __init__(self, inner: TransactionalStorage, page_size: int = 256):
         self.inner = inner
         self.page_size = page_size
         self._lock = threading.RLock()
+        # decoded caches (the reference's KeyPageStorage likewise keeps
+        # decoded PageData in memory; re-decoding a 256-entry page per row
+        # read is what the page layout exists to avoid)
+        self._page_cache: dict[tuple[str, bytes], list[tuple[bytes, Entry]]] = {}
+        self._meta_cache: dict[str, list[bytes]] = {}
 
     # -- page plumbing --------------------------------------------------------
 
     def _meta(self, table: str) -> list[bytes]:
+        cached = self._meta_cache.get(table)
+        if cached is not None:
+            return list(cached)
         e = self.inner.get_row(META_TABLE, table.encode())
-        return _decode_meta(e.get()) if e is not None else []
+        starts = _decode_meta(e.get()) if e is not None else []
+        if len(self._meta_cache) >= self._CACHE_MAX_PAGES:
+            self._meta_cache.clear()
+        self._meta_cache[table] = list(starts)
+        return starts
 
     def _save_meta(self, table: str, starts: list[bytes]) -> None:
+        self._meta_cache[table] = list(starts)
         self.inner.set_row(META_TABLE, table.encode(), Entry({"value": _encode_meta(starts)}))
 
     @staticmethod
@@ -80,10 +97,21 @@ class KeyPageStorage(TransactionalStorage):
         return table.encode() + b"\x00" + start
 
     def _load_page(self, table: str, start: bytes) -> list[tuple[bytes, Entry]]:
+        pk = (table, start)
+        cached = self._page_cache.get(pk)
+        if cached is not None:
+            return list(cached)  # shallow copy: callers mutate the list
         e = self.inner.get_row(PAGE_TABLE, self._page_key(table, start))
-        return _decode_page(e.get()) if e is not None else []
+        items = _decode_page(e.get()) if e is not None and not e.deleted else []
+        if len(self._page_cache) >= self._CACHE_MAX_PAGES:
+            self._page_cache.clear()
+        self._page_cache[pk] = list(items)
+        return items
 
     def _save_page(self, table: str, start: bytes, items: list[tuple[bytes, Entry]]) -> None:
+        if len(self._page_cache) >= self._CACHE_MAX_PAGES:
+            self._page_cache.clear()
+        self._page_cache[(table, start)] = list(items)
         self.inner.set_row(
             PAGE_TABLE, self._page_key(table, start), Entry({"value": _encode_page(items)})
         )
@@ -94,6 +122,53 @@ class KeyPageStorage(TransactionalStorage):
             return None
         i = bisect.bisect_right(starts, key) - 1
         return max(i, 0)
+
+    def _delete_page_row(self, table: str, start: bytes) -> None:
+        self._page_cache.pop((table, start), None)
+        self.inner.set_row(
+            PAGE_TABLE,
+            self._page_key(table, start),
+            Entry(status=EntryStatus.DELETED),
+        )
+
+    def _chunk_page(
+        self,
+        start: bytes,
+        merged: list[tuple[bytes, Entry]],
+        starts: list[bytes],
+    ) -> tuple[list[tuple[bytes, list[tuple[bytes, Entry]] | None]], bool]:
+        """Split the merged (sorted) content of the page registered at
+        ``start`` into page_size chunks and assign each its registration
+        key. Returns (ops, meta_dirty): ops is [(cstart, items)] with
+        items=None meaning "tombstone the page row at cstart".
+
+        Invariant maintained: every registered start ≤ its page's min key.
+        Only the table-head page can accumulate keys below its registered
+        start (reads clamp to page 0) — splitting such a page without
+        rekeying would register later chunks at starts that sort BELOW the
+        head page's own key, sending reads of the head page's rows to the
+        wrong page (rows silently unreadable). The head page is therefore
+        rekeyed to its true min key before chunk registration."""
+        ops: list[tuple[bytes, list[tuple[bytes, Entry]] | None]] = []
+        dirty = False
+        head = start
+        if merged and merged[0][0] < start:
+            ops.append((start, None))  # tombstone the old page row
+            starts.remove(start)
+            head = merged[0][0]
+            bisect.insort(starts, head)
+            dirty = True
+        chunks = [
+            merged[i : i + self.page_size]
+            for i in range(0, len(merged), self.page_size)
+        ] or [[]]
+        for chunk in chunks:
+            cstart = head if chunk is chunks[0] else chunk[0][0]
+            ops.append((cstart, chunk))
+            if cstart not in starts:
+                bisect.insort(starts, cstart)
+                dirty = True
+        return ops, dirty
 
     # -- StorageInterface -----------------------------------------------------
 
@@ -110,41 +185,38 @@ class KeyPageStorage(TransactionalStorage):
         return None
 
     def set_row(self, table: str, key: bytes, entry: Entry) -> None:
-        with self._lock:
-            self._set_locked(table, bytes(key), entry)
-
-    def _set_locked(self, table: str, key: bytes, entry: Entry) -> None:
-        starts = self._meta(table)
-        idx = self._page_for(starts, key)
-        if idx is None:
-            # first page of the table
-            self._save_page(table, key, [(key, entry.copy())])
-            self._save_meta(table, [key])
-            return
-        start = starts[idx]
-        items = self._load_page(table, start)
-        keys = [k for k, _ in items]
-        j = bisect.bisect_left(keys, key)
-        if j < len(items) and items[j][0] == key:
-            items[j] = (key, entry.copy())
-        else:
-            items.insert(j, (key, entry.copy()))
-        if len(items) > self.page_size:
-            # split: upper half becomes a new page (KeyPageStorage::split)
-            mid = len(items) // 2
-            lower, upper = items[:mid], items[mid:]
-            self._save_page(table, start, lower)
-            new_start = upper[0][0]
-            self._save_page(table, new_start, upper)
-            starts.insert(idx + 1, new_start)
-            self._save_meta(table, starts)
-        else:
-            self._save_page(table, start, items)
+        self.set_rows(table, [(key, entry)])
 
     def set_rows(self, table: str, items) -> None:
+        """Bulk write with one decode/encode per TOUCHED page (the same
+        page-grouping the 2PC prepare path uses) — a per-row path would
+        re-codec a whole page per row, ~1000x slower for bulk loads."""
         with self._lock:
+            starts = self._meta(table)
+            meta_dirty = False
+            # per-page pending writes as a dict (last write wins), merged
+            # into the decoded page ONCE at write-out — per-item list
+            # surgery on a deferred-split page would be quadratic
+            staged: dict[bytes, dict[bytes, Entry]] = {}
             for key, entry in items:
-                self._set_locked(table, bytes(key), entry)
+                key = bytes(key)
+                if not starts:
+                    starts.append(key)
+                    meta_dirty = True
+                start = starts[self._page_for(starts, key)]
+                staged.setdefault(start, {})[key] = entry.copy()
+            for start, pending in staged.items():
+                merged = {k: e for k, e in self._load_page(table, start)}
+                merged.update(pending)
+                ops, dirty = self._chunk_page(start, sorted(merged.items()), starts)
+                meta_dirty |= dirty
+                for cstart, chunk in ops:
+                    if chunk is None:
+                        self._delete_page_row(table, cstart)
+                    else:
+                        self._save_page(table, cstart, chunk)
+            if meta_dirty:
+                self._save_meta(table, starts)
 
     def get_primary_keys(self, table: str) -> list[bytes]:
         out: list[bytes] = []
@@ -161,6 +233,8 @@ class KeyPageStorage(TransactionalStorage):
             return
         for t, k, e in traverse():
             if t == PAGE_TABLE:
+                if e.deleted:
+                    continue  # tombstoned page row (rekeyed head page)
                 table, _, _start = k.partition(b"\x00")
                 for key, entry in _decode_page(e.get()):
                     yield table.decode(), key, entry
@@ -178,7 +252,7 @@ class KeyPageStorage(TransactionalStorage):
 
     def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
         with self._lock:
-            staged: dict[tuple[str, bytes], list[tuple[bytes, Entry]]] = {}
+            staged: dict[tuple[str, bytes], dict[bytes, Entry]] = {}
             metas: dict[str, list[bytes]] = {}
             for table, key, entry in writes.traverse():
                 key = bytes(key)
@@ -186,43 +260,35 @@ class KeyPageStorage(TransactionalStorage):
                 idx = self._page_for(starts, key)
                 if idx is None:
                     starts.append(key)
-                    starts.sort()
-                    idx = self._page_for(starts, key)
+                    idx = 0
                 start = starts[idx]
-                pk = (table, start)
-                if pk not in staged:
-                    staged[pk] = self._load_page(table, start)
-                items = staged[pk]
-                keys = [k for k, _ in items]
-                j = bisect.bisect_left(keys, key)
-                if j < len(items) and items[j][0] == key:
-                    items[j] = (key, entry.copy())
-                else:
-                    items.insert(j, (key, entry.copy()))
+                # pending writes as a dict (last wins), merged into the
+                # decoded page once — per-item list surgery is quadratic
+                # on a 2000-row block write-set
+                staged.setdefault((table, start), {})[key] = entry.copy()
             rows: list[tuple[str, bytes, Entry]] = []
-            for (table, start), items in staged.items():
-                # split oversized staged pages before write-out
-                chunks = [
-                    items[i : i + self.page_size]
-                    for i in range(0, len(items), self.page_size)
-                ] or [[]]
+            for (table, start), pending in staged.items():
                 starts = metas[table]
-                for chunk in chunks:
-                    if not chunk:
-                        continue
-                    # first chunk keeps the existing page key (its range may
-                    # begin below any staged key); later chunks start fresh
-                    cstart = start if chunk is chunks[0] else chunk[0][0]
-                    rows.append(
-                        (
-                            PAGE_TABLE,
-                            self._page_key(table, cstart),
-                            Entry({"value": _encode_page(chunk)}),
+                merged = {k: e for k, e in self._load_page(table, start)}
+                merged.update(pending)
+                ops, _dirty = self._chunk_page(start, sorted(merged.items()), starts)
+                for cstart, chunk in ops:
+                    if chunk is None:
+                        rows.append(
+                            (
+                                PAGE_TABLE,
+                                self._page_key(table, cstart),
+                                Entry(status=EntryStatus.DELETED),
+                            )
                         )
-                    )
-                    if cstart not in starts:
-                        starts.append(cstart)
-                        starts.sort()
+                    else:
+                        rows.append(
+                            (
+                                PAGE_TABLE,
+                                self._page_key(table, cstart),
+                                Entry({"value": _encode_page(chunk)}),
+                            )
+                        )
             for table, starts in metas.items():
                 rows.append(
                     (
@@ -234,7 +300,14 @@ class KeyPageStorage(TransactionalStorage):
             self.inner.prepare(params, self._PageView(rows))
 
     def commit(self, params: TwoPCParams) -> None:
-        self.inner.commit(params)
+        # the 2PC write-set lands through inner.prepare/commit, bypassing
+        # _save_page — drop decoded caches so reads see the committed pages.
+        # The lock spans inner.commit so no reader can serve a stale cached
+        # page in the window after the data is durable but before the clear.
+        with self._lock:
+            self.inner.commit(params)
+            self._page_cache.clear()
+            self._meta_cache.clear()
 
     def rollback(self, params: TwoPCParams) -> None:
         self.inner.rollback(params)

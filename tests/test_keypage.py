@@ -81,3 +81,70 @@ def test_traverse_unpacks_pages():
         kp.set_row("t", b"k%d" % i, Entry({"value": b"v%d" % i}))
     seen = {(t, k): e.get() for t, k, e in kp.traverse()}
     assert seen[("t", b"k3")] == b"v3" and len(seen) == 10
+
+
+def test_bulk_set_rows_pages_and_cache_coherence():
+    """set_rows batches whole pages (one codec per touched page); the
+    decoded-page cache must stay coherent across direct writes, 2PC
+    commits (which bypass _save_page), and interleaved reads."""
+    kp = KeyPageStorage(MemoryStorage(), page_size=8)
+    rows = [(b"k%04d" % i, Entry({"value": b"v%d" % i})) for i in range(100)]
+    kp.set_rows("b", rows)
+    for i in range(100):
+        assert kp.get_row("b", b"k%04d" % i).get() == b"v%d" % i
+    # overwrite a slice plus fresh keys in one bulk call (last-wins)
+    kp.set_rows(
+        "b",
+        [(b"k0005", Entry({"value": b"A"})), (b"k0005", Entry({"value": b"B"})),
+         (b"k9000", Entry({"value": b"new"}))],
+    )
+    assert kp.get_row("b", b"k0005").get() == b"B"
+    assert kp.get_row("b", b"k9000").get() == b"new"
+    assert len(kp.get_primary_keys("b")) == 101
+    # 2PC lands through inner.prepare/commit: cached pages must refresh
+    assert kp.get_row("b", b"k0042").get() == b"v42"  # warm the cache
+    writes = MemoryStorage()
+    writes.set_row("b", b"k0042", Entry({"value": b"committed"}))
+    params = TwoPCParams(number=9)
+    kp.prepare(params, writes)
+    kp.commit(params)
+    assert kp.get_row("b", b"k0042").get() == b"committed"
+
+
+def test_head_page_rekey_on_split_keeps_rows_readable():
+    """Keys inserted BELOW the table's first registered start accumulate in
+    the head page; splitting that page must rekey it to its true min key —
+    registering later chunks at starts that sort below the head page's key
+    silently orphaned the head rows (round-3 review repro)."""
+    kp = KeyPageStorage(MemoryStorage(), page_size=8)
+    # seed with a non-minimal key, then bulk-write 20 smaller keys
+    rows = [(b"m0", Entry({"value": b"head"}))]
+    rows += [(b"a%02d" % i, Entry({"value": b"x%d" % i})) for i in range(20)]
+    kp.set_rows("t", rows)
+    for i in range(20):
+        assert kp.get_row("t", b"a%02d" % i).get() == b"x%d" % i, i
+    assert kp.get_row("t", b"m0").get() == b"head"
+    assert len(kp.get_primary_keys("t")) == 21
+    # same scenario through the per-row path (incremental inserts)
+    kp2 = KeyPageStorage(MemoryStorage(), page_size=4)
+    kp2.set_row("u", b"zz", Entry({"value": b"tail"}))
+    for i in range(10):
+        kp2.set_row("u", b"b%02d" % i, Entry({"value": b"y%d" % i}))
+    for i in range(10):
+        assert kp2.get_row("u", b"b%02d" % i).get() == b"y%d" % i, i
+    assert kp2.get_row("u", b"zz").get() == b"tail"
+    # and through the 2PC path
+    kp3 = KeyPageStorage(MemoryStorage(), page_size=4)
+    kp3.set_row("w", b"q5", Entry({"value": b"first"}))
+    writes = MemoryStorage()
+    for i in range(12):
+        writes.set_row("w", b"c%02d" % i, Entry({"value": b"z%d" % i}))
+    params = TwoPCParams(number=12)
+    kp3.prepare(params, writes)
+    kp3.commit(params)
+    for i in range(12):
+        assert kp3.get_row("w", b"c%02d" % i).get() == b"z%d" % i, i
+    assert kp3.get_row("w", b"q5").get() == b"first"
+    # traverse must not resurrect tombstoned page rows
+    seen = {k for _t, k, _e in kp3.traverse()}
+    assert b"q5" in seen and len(seen) == 13
