@@ -83,9 +83,23 @@ class CurveOps:
 
 
 def _make_curve_ops(c: Curve) -> CurveOps:
-    # Pseudo-Mersenne fast path when p = 2^256 - small (secp256k1); generic
-    # Montgomery otherwise (SM2's p has a 225-bit complement).
-    F = make_fold_field(c.p) if _R - c.p < 1 << 132 else make_mont_field(c.p)
+    # Pseudo-Mersenne fast path when p = 2^256 - small (secp256k1);
+    # generic Montgomery otherwise (SM2). SM2's prime is also a Solinas
+    # prime, and limb.SparseFoldField implements the shift-add fold with
+    # proven exactness — but its 8 carry-chain fold rounds have not shown
+    # a runtime win over REDC yet, so it stays opt-in (FISCO_SM2_SPARSE=1)
+    # until profiled on hardware.
+    import os
+
+    from .limb import _SPARSE_COMPLEMENTS, make_sparse_fold_field
+
+    if _R - c.p < 1 << 132:
+        F = make_fold_field(c.p)
+    elif c.p in _SPARSE_COMPLEMENTS and os.environ.get("FISCO_SM2_SPARSE") == "1":
+        # read once at import (curve ops are module-level singletons)
+        F = make_sparse_fold_field(c.p)
+    else:
+        F = make_mont_field(c.p)
     Fn = make_fold_field(c.n) if _R - c.n < 1 << 132 else None
     b3 = 3 * c.b % c.p
     return CurveOps(

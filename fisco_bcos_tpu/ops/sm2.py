@@ -14,9 +14,12 @@ Verification: t = (r + s) mod n (t ≠ 0); (x1, y1) = s*G + t*Q;
 valid iff (e + x1) mod n == r.
 
 The EC plane is the limb-major windowed ladder shared with secp256k1
-(:mod:`fisco_bcos_tpu.ops.ec`); SM2's prime has a 225-bit complement, so the
-field is the generic Montgomery path (``limb.MontField``) rather than the
-pseudo-Mersenne fold.
+(:mod:`fisco_bcos_tpu.ops.ec`); SM2's prime has a 225-bit complement, so
+the field is the generic Montgomery path (``limb.MontField``) by default.
+The prime is also a Solinas prime (2^256 − p = 2^224 + 2^96 − 2^64 + 1),
+and ``limb.SparseFoldField`` implements the shift-add fold bit-exactly —
+opt in with FISCO_SM2_SPARSE=1 (kept off pending a measured win over
+REDC; see the note in :func:`fisco_bcos_tpu.ops.ec._make_curve_ops`).
 """
 
 from __future__ import annotations

@@ -256,7 +256,9 @@ class KeyPageStorage(TransactionalStorage):
             metas: dict[str, list[bytes]] = {}
             for table, key, entry in writes.traverse():
                 key = bytes(key)
-                starts = metas.setdefault(table, self._meta(table))
+                if table not in metas:  # setdefault would re-copy per row
+                    metas[table] = self._meta(table)
+                starts = metas[table]
                 idx = self._page_for(starts, key)
                 if idx is None:
                     starts.append(key)

@@ -105,3 +105,32 @@ def test_pow_static_windows():
     for e in (2, 3, 17, (N + 1) // 2, N - 2):
         got = limb.rows_to_ints(np.asarray(limb.pow_static(F, to_rows(xs), e)))
         assert got == [pow(x, e, N) for x in xs]
+
+
+def test_sparse_fold_field_matches_host_ints():
+    """SparseFoldField (the opt-in SM2 Solinas shift-add fold) must be
+    bit-exact against host integers and against MontField for every op —
+    the gate for ever flipping FISCO_SM2_SPARSE on."""
+    import jax.numpy as jnp
+
+    from fisco_bcos_tpu.ops import limb
+
+    p = 0xFFFFFFFEFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF00000000FFFFFFFFFFFFFFFF
+    F = limb.make_sparse_fold_field(p)
+    rng = np.random.default_rng(5)
+    vals_a = [0, 1, p - 1, p - 2, 2**255 % p, int(rng.integers(1, 2**63)) ** 4 % p]
+    vals_b = [p - 1, 1, p - 1, 7, 2**200 % p, 0]
+
+    def rows(vs):
+        return jnp.asarray(np.stack([limb.int_to_rows(v) for v in vs], axis=1))
+
+    a, b = rows(vals_a), rows(vals_b)
+    for name, got, expect in (
+        ("mul", F.mul(a, b), [x * y % p for x, y in zip(vals_a, vals_b)]),
+        ("sqr", F.sqr(a), [x * x % p for x in vals_a]),
+        ("add", F.add(a, b), [(x + y) % p for x, y in zip(vals_a, vals_b)]),
+        ("sub", F.sub(a, b), [(x - y) % p for x, y in zip(vals_a, vals_b)]),
+        ("mul_small", F.mul_small(a, 3), [3 * x % p for x in vals_a]),
+        ("inv", F.inv(a), [pow(x, -1, p) if x else 0 for x in vals_a]),
+    ):
+        assert limb.rows_to_ints(np.asarray(got)) == expect, name
