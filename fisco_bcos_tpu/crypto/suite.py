@@ -51,6 +51,13 @@ class HashImpl:
         """list[bytes] -> [B, 32] uint8 digests, one device program."""
         raise NotImplementedError
 
+    def hash_batch_async(self, msgs):
+        """Dispatch the device batch, defer the sync: () -> [B, 32] uint8.
+        Default runs eagerly; device-backed impls override to let callers
+        queue several hash programs before any round trip."""
+        out = self.hash_batch(msgs)
+        return lambda: out
+
 
 class Keccak256(HashImpl):
     """Single-item host path: native C core when available (native_bind —
@@ -66,6 +73,9 @@ class Keccak256(HashImpl):
     def hash_batch(self, msgs) -> np.ndarray:
         return keccak_ops.keccak256_batch(msgs)
 
+    def hash_batch_async(self, msgs):
+        return keccak_ops.keccak256_batch_async(msgs)
+
 
 class SM3(HashImpl):
     name = "sm3"
@@ -78,6 +88,9 @@ class SM3(HashImpl):
     def hash_batch(self, msgs) -> np.ndarray:
         return sm3_ops.sm3_batch(msgs)
 
+    def hash_batch_async(self, msgs):
+        return sm3_ops.sm3_batch_async(msgs)
+
 
 class Sha256(HashImpl):
     name = "sha256"
@@ -89,6 +102,9 @@ class Sha256(HashImpl):
 
     def hash_batch(self, msgs) -> np.ndarray:
         return sha256_ops.sha256_batch(msgs)
+
+    def hash_batch_async(self, msgs):
+        return sha256_ops.sha256_batch_async(msgs)
 
 
 # ---------------------------------------------------------------------------
@@ -335,6 +351,9 @@ class CryptoSuite:
 
     def hash_batch(self, msgs) -> np.ndarray:
         return self.hash_impl.hash_batch(msgs)
+
+    def hash_batch_async(self, msgs):
+        return self.hash_impl.hash_batch_async(msgs)
 
     def calculate_address(self, pub: bytes) -> bytes:
         """right160(hash(pubkey)) — CryptoSuite.h:56-59."""

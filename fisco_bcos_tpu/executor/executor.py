@@ -131,11 +131,15 @@ class TransactionExecutor:
         self._block.next_ctx += n
         return base
 
-    def get_hash(self) -> bytes:
-        """State root of the current block's dirty set (one device batch)."""
+    def get_hash_async(self):
+        """Dispatch the state-root batch, defer the sync: () -> bytes."""
         if self._block is None:
             raise RuntimeError("no block in progress")
-        return self._block.storage.hash(self.suite)
+        return self._block.storage.hash_async(self.suite)
+
+    def get_hash(self) -> bytes:
+        """State root of the current block's dirty set (one device batch)."""
+        return self.get_hash_async()()
 
     # -- execution ----------------------------------------------------------
 

@@ -148,6 +148,14 @@ def keccak256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
 
 def keccak256_batch(msgs) -> np.ndarray:
     """Host convenience: list of bytes -> [B, 32] uint8 digests (device batch)."""
+    return keccak256_batch_async(msgs)()
+
+
+def keccak256_batch_async(msgs):
+    """Dispatch the device batch and defer the sync: returns a resolver
+    () -> [B, 32] uint8. Lets callers queue several hash programs (tx
+    root, receipts root, state root) before paying any device round
+    trip."""
     blocks, nblocks = pad_keccak(msgs)
-    words = np.asarray(keccak256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks)))
-    return digest_words_to_bytes_le(words)
+    words = keccak256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
+    return lambda: digest_words_to_bytes_le(np.asarray(words))

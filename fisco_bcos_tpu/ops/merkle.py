@@ -233,13 +233,15 @@ def _device_root_fn(n: int, width: int):
     return run
 
 
-def merkle_root(
+def merkle_root_async(
     leaves: np.ndarray, width: int = 16, hasher: str = "keccak256"
-) -> bytes:
-    """Root only (the hot path for block sealing: tx/receipt roots).
+):
+    """Dispatch the root computation, defer the device sync: () -> bytes.
 
-    Large keccak trees run the fused single-program device path; proofs and
-    other hashers take the generic level-by-level path."""
+    Large keccak trees dispatch the fused single-program device path and
+    resolve on call (letting the sealing path queue tx root, receipts root
+    and state root before paying any device round trip); proofs, small
+    trees and other hashers compute eagerly inside this call."""
     if not isinstance(leaves, jax.Array):
         leaves = np.asarray(leaves, dtype=np.uint8)
     # same validation whichever path runs (MerkleTree re-checks on its path)
@@ -251,10 +253,18 @@ def merkle_root(
         # jax.Array input stays on device — tx/receipt hashes come from the
         # batch hash kernels, so the hot sealing path never round-trips the
         # leaf tensor through the host
-        root = np.asarray(
-            _device_root_fn(len(leaves), width)(
-                jnp.asarray(leaves).astype(jnp.uint8)
-            )
+        dev = _device_root_fn(len(leaves), width)(
+            jnp.asarray(leaves).astype(jnp.uint8)
         )
-        return bytes(root)
-    return MerkleTree(np.asarray(leaves, dtype=np.uint8), width=width, hasher=hasher).root
+        return lambda: bytes(np.asarray(dev))
+    root = MerkleTree(
+        np.asarray(leaves, dtype=np.uint8), width=width, hasher=hasher
+    ).root
+    return lambda: root
+
+
+def merkle_root(
+    leaves: np.ndarray, width: int = 16, hasher: str = "keccak256"
+) -> bytes:
+    """Root only (the hot path for block sealing: tx/receipt roots)."""
+    return merkle_root_async(leaves, width=width, hasher=hasher)()

@@ -97,7 +97,15 @@ def sha256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
 
 
 def sha256_batch(msgs) -> np.ndarray:
-    """Host convenience: list of bytes -> [B, 32] uint8 digests."""
+    """Host convenience: list of bytes -> [B, 32] uint8 digests (device batch)."""
+    return sha256_batch_async(msgs)()
+
+
+def sha256_batch_async(msgs):
+    """Dispatch the device batch and defer the sync: returns a resolver
+    () -> [B, 32] uint8. Lets callers queue several hash programs (tx
+    root, receipts root, state root) before paying any device round
+    trip."""
     blocks, nblocks = pad_md64(msgs)
-    words = np.asarray(sha256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks)))
-    return digest_words_to_bytes_be(words)
+    words = sha256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
+    return lambda: digest_words_to_bytes_be(np.asarray(words))

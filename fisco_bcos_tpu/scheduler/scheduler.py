@@ -167,9 +167,18 @@ class Scheduler:
 
         header = block.header
         header.gas_used = sum(rc.gas_used for rc in block.receipts)
-        state_root = self.executor.get_hash()
-        txs_root = block.calculate_txs_root(self.suite)
-        receipts_root = block.calculate_receipts_root(self.suite)
+        # dispatch all three root programs before syncing any — on a
+        # tunneled device each forced sync is a round trip, and the three
+        # computations are independent
+        get_hash_async = getattr(self.executor, "get_hash_async", None)
+        state_f = (
+            get_hash_async() if get_hash_async else (lambda: self.executor.get_hash())
+        )
+        txs_f = block.calculate_txs_root_async(self.suite)
+        receipts_f = block.calculate_receipts_root_async(self.suite)
+        state_root = state_f()
+        txs_root = txs_f()
+        receipts_root = receipts_f()
         if verify and (
             (header.state_root != state_root)
             or (header.txs_root != txs_root)

@@ -90,9 +90,10 @@ class StateStorage(TraversableStorage):
 
     # -- state root (hot spot #3) -------------------------------------------
 
-    def hash(self, suite: CryptoSuite) -> bytes:
-        """Order-independent XOR root over dirty entries, hashed as one
-        device batch (vs the reference's tbb loop, StateStorage.h:457-486)."""
+    def hash_async(self, suite: CryptoSuite):
+        """Dispatch the state-root hash batch, defer the sync: () -> bytes.
+        Order-independent XOR root over dirty entries, hashed as one device
+        batch (vs the reference's tbb loop, StateStorage.h:457-486)."""
         preimages = []
         for t, k, e in self.traverse():
             w = FlatWriter()
@@ -100,6 +101,9 @@ class StateStorage(TraversableStorage):
             w.bytes_(k)
             preimages.append(w.out() + e.encode())
         if not preimages:
-            return _ZERO32
-        digests = suite.hash_batch(preimages)
-        return bytes(np.bitwise_xor.reduce(digests, axis=0))
+            return lambda: _ZERO32
+        resolve = suite.hash_batch_async(preimages)
+        return lambda: bytes(np.bitwise_xor.reduce(resolve(), axis=0))
+
+    def hash(self, suite: CryptoSuite) -> bytes:
+        return self.hash_async(suite)()
