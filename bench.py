@@ -33,12 +33,18 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
 )
-import jax  # noqa: E402
 
-jax.config.update(
-    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+def _init_jax() -> None:
+    """jax import + cache config — called by the --only children (and the
+    bench functions' own imports), NOT by the orchestrating parent, which
+    never touches a device."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 BLOCK_TXS = 10_000
 UNIQUE = 64
@@ -347,17 +353,84 @@ def main() -> None:
         # parseable even when the axon tunnel is down
         _emit_missing("TPU backend unreachable (axon tunnel down)")
         raise SystemExit(2)
+    import re
+    import subprocess
+    import sys
+
+    try:
+        budget_s = int(os.environ.get("FISCO_BENCH_METRIC_TIMEOUT", "2400"))
+    except ValueError:
+        budget_s = 2400  # malformed env must not cost the artifact
+
+    def _text(raw) -> str:
+        if raw is None:
+            return ""
+        if isinstance(raw, bytes):  # kill can truncate mid-character
+            return raw.decode(errors="replace")
+        return raw
+
     rc = 0
-    for fn in (bench_admission, bench_sm2, bench_merkle, bench_flood):
+    # each metric runs in its own killable subprocess: a tunnel that flaps
+    # mid-run hangs inside native gRPC where no Python signal can fire
+    # (the same failure mode _probe_backend isolates), so a hang must cost
+    # one metric's budget, not the whole run
+    for name in ("admission", "sm2", "merkle", "flood"):
+        out = err = ""
         try:
-            fn()
-        except Exception as e:  # a failed bench degrades its metrics, never dies
-            print(f"# bench {fn.__name__} failed: {e}", flush=True)
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--only", name],
+                timeout=budget_s,
+                capture_output=True,
+            )
+            out, err = _text(res.stdout), _text(res.stderr)
+            failed = bool(res.returncode)
+        except subprocess.TimeoutExpired as e:
+            out, err = _text(e.stdout), _text(e.stderr)
+            print(f"# bench {name} timed out after {budget_s}s", flush=True)
+            failed = True
+        except Exception as e:  # exec failure etc. — artifact must survive
+            print(f"# bench {name} could not run: {e}", flush=True)
+            failed = True
+        if failed:
             rc = 1
+            for line in err.splitlines()[-4:]:  # surface the crash reason
+                print(f"# {name} stderr: {line[:300]}", flush=True)
+        for line in out.splitlines():
+            if line.startswith("{") or line.startswith("#"):
+                print(line, flush=True)
+                m = re.search(r'"metric":\s*"([^"]+)"', line)
+                if m:
+                    _EMITTED.add(m.group(1))
     _emit_missing("bench raised before measuring — see '#' comment lines")
     if rc:
         raise SystemExit(rc)
 
 
+def _main_only(name: str) -> None:
+    fns = {
+        "admission": bench_admission,
+        "sm2": bench_sm2,
+        "merkle": bench_merkle,
+        "flood": bench_flood,
+    }
+    if name not in fns:
+        print(f"# unknown bench '{name}'", flush=True)
+        raise SystemExit(2)
+    _init_jax()
+    try:
+        fns[name]()
+    except Exception as e:
+        print(f"# bench bench_{name} failed: {e}", flush=True)
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if len(_sys.argv) >= 2 and _sys.argv[1] == "--only":
+        if len(_sys.argv) < 3:
+            print("usage: bench.py [--only admission|sm2|merkle|flood]")
+            raise SystemExit(2)
+        _main_only(_sys.argv[2])
+    else:
+        main()
