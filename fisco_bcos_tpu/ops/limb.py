@@ -222,6 +222,19 @@ def _placed(x: jax.Array, offset: int, out: int) -> jax.Array:
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
+def _add_rows(x: jax.Array) -> jax.Array:
+    """Sum the rows of [L, T] -> [1, T] via a log-depth halving tree (no
+    jnp.sum: Mosaic lacks integer reductions). Caller bounds the values so
+    sums cannot overflow uint32."""
+    while x.shape[0] > 1:
+        half = x.shape[0] // 2
+        rest = x[2 * half :]
+        x = x[:half] + x[half : 2 * half]
+        if rest.shape[0]:
+            x = jnp.concatenate([x[:1] + rest, x[1:]], axis=0)
+    return x
+
+
 def _sum_terms(terms: list[jax.Array]) -> jax.Array:
     """Balanced tree-add of equal-shape u32 arrays.
 
@@ -462,15 +475,48 @@ class SparseFoldField(FoldField):
     def _c_pos(self) -> int:
         return sum(1 << (16 * o) for o in self.pos_offsets)
 
+    @property
+    def _fold_rows(self) -> np.ndarray:
+        """[16, 16] uint32: row k = limbs of 2^(256+16k) mod m — the dense
+        per-limb fold table for wide products."""
+        return np.stack(
+            [
+                int_to_rows(pow(2, 256 + 16 * k, self.m_int))
+                for k in range(LIMBS)
+            ]
+        )
+
+    def _table_fold(self, lo: jax.Array, hi: jax.Array) -> tuple[jax.Array, int]:
+        """lo [16,T] + hi [H≤16,T] -> normalized limbs of
+        lo + Σ_k hi_k · (2^(256+16k) mod m), with its exclusive bound.
+
+        One output column j sums h_k·T[k][j] over k: a single broadcast
+        multiply per column plus a log-tree row sum (≤16 terms of < 2^16
+        after the lo/hi split, so sums stay < 2^20 — far inside uint32)."""
+        h = hi.shape[0]
+        t = hi.shape[1]
+        tab = self._fold_rows[:h]  # [h, 16]
+        width = 18  # value < 2^256 + 16·2^16·m < 2^277
+        terms = [_placed(lo, 0, width)]
+        for j in range(LIMBS):
+            tj = dev_vec(tab[:, j]).reshape(h, 1)  # column constants
+            prod = hi * tj  # [h, T], products < 2^32
+            terms.append(_placed(_add_rows(prod & _MASK), j, width))
+            terms.append(_placed(_add_rows(prod >> LIMB_BITS), j + 1, width))
+        bound = _R + (LIMBS * ((1 << LIMB_BITS) - 1)) * self.m_int
+        return carry_norm(_sum_terms(terms))[:width], bound
+
     def reduce_wide(self, x: jax.Array, bound: int) -> jax.Array:
         """x (normalized limbs, value < bound) -> x mod m.
 
-        Per round: value = lo + hi·2^256 ≡ lo + Σ(hi << 16o) − Σ(hi << 16o')
-        (mod m). The positive side is column-summed and carried; the single
-        normalized subtraction cannot borrow because the true value
-        (pos − neg) is non-negative. The 2^224 complement term shrinks the
-        bound ~2^32 per round (8 static rounds from a 512-bit product)."""
+        Wide inputs (a full product) take ONE dense table fold
+        (lo + Σ hi_k·(2^(256+16k) mod m)), leaving a ~2^21 hi that a single
+        signed shift-add round (value = lo + Σ(hi<<16o) − Σ(hi<<16o'),
+        which cannot go negative) folds under 2m. Narrow inputs skip
+        straight to shift-add rounds."""
         c_pos = self._c_pos
+        if x.shape[0] > LIMBS + 2 and bound > 2 * self.m_int:
+            x, bound = self._table_fold(x[:LIMBS], x[LIMBS:])
         while bound > 2 * self.m_int:
             lo, hi = x[:LIMBS], x[LIMBS:]
             if hi.shape[0] == 0:
