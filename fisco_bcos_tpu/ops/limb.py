@@ -458,12 +458,15 @@ class SparseFoldField(FoldField):
     hi·c is pure shifted adds/subs with NO multiplies at all. SM2's prime
     qualifies (2^256 − p = 2^224 + 2^96 − 2^64 + 1): this replaces the
     generic Montgomery REDC (~2.5 wide products per mul) with one wide
-    product plus ~8 cheap fold rounds, and makes the domain conversions
-    identity. Everything except :meth:`reduce_wide` is inherited from the
-    plain-domain :class:`FoldField`."""
+    product, one dense per-limb table fold and one signed shift-add round,
+    and makes the domain conversions identity. Everything except
+    :meth:`reduce_wide` is inherited from the plain-domain
+    :class:`FoldField`."""
 
     pos_offsets: tuple[int, ...] = ()  # limb offsets o with +2^(16o)
     neg_offsets: tuple[int, ...] = ()
+    # [16, 16] uint32: row k = limbs of 2^(256+16k) mod m (dense fold table)
+    fold_rows: np.ndarray = field(default=None, repr=False)
 
     def __hash__(self):
         return hash(("sparsefold", self.m_int))
@@ -475,17 +478,6 @@ class SparseFoldField(FoldField):
     def _c_pos(self) -> int:
         return sum(1 << (16 * o) for o in self.pos_offsets)
 
-    @property
-    def _fold_rows(self) -> np.ndarray:
-        """[16, 16] uint32: row k = limbs of 2^(256+16k) mod m — the dense
-        per-limb fold table for wide products."""
-        return np.stack(
-            [
-                int_to_rows(pow(2, 256 + 16 * k, self.m_int))
-                for k in range(LIMBS)
-            ]
-        )
-
     def _table_fold(self, lo: jax.Array, hi: jax.Array) -> tuple[jax.Array, int]:
         """lo [16,T] + hi [H≤16,T] -> normalized limbs of
         lo + Σ_k hi_k · (2^(256+16k) mod m), with its exclusive bound.
@@ -494,8 +486,7 @@ class SparseFoldField(FoldField):
         multiply per column plus a log-tree row sum (≤16 terms of < 2^16
         after the lo/hi split, so sums stay < 2^20 — far inside uint32)."""
         h = hi.shape[0]
-        t = hi.shape[1]
-        tab = self._fold_rows[:h]  # [h, 16]
+        tab = self.fold_rows[:h]  # [h, 16]
         width = 18  # value < 2^256 + 16·2^16·m < 2^277
         terms = [_placed(lo, 0, width)]
         for j in range(LIMBS):
@@ -558,6 +549,9 @@ def make_sparse_fold_field(m: int) -> SparseFoldField:
         m_limbs=int_to_rows(m),
         pos_offsets=pos,
         neg_offsets=neg,
+        fold_rows=np.stack(
+            [int_to_rows(pow(2, 256 + 16 * k, m)) for k in range(LIMBS)]
+        ),
     )
 
 
