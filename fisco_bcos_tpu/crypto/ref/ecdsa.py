@@ -92,9 +92,17 @@ def point_mul(c: Curve, k: int, P):
 
 
 def on_curve(c: Curve, P) -> bool:
+    """On-curve check for CANONICAL affine coordinates: 0 <= x, y < p.
+
+    Coordinates outside [0, p) are rejected rather than reduced — an
+    attacker-chosen x+p encoding of a valid point must not verify on one
+    implementation (this one reduces mod p) and fail on another (the native
+    core and the device kernels range-check), or the chain forks on that tx."""
     if P is None:
         return True
     x, y = P
+    if not (0 <= x < c.p and 0 <= y < c.p):
+        return False
     return (y * y - (x * x * x + c.a * x + c.b)) % c.p == 0
 
 
@@ -204,10 +212,16 @@ def ecdsa_recover(msg_hash: bytes, r: int, s: int, v: int, c: Curve = SECP256K1)
 SM2_DEFAULT_ID = b"1234567812345678"
 
 
-def sm2_za(pub, user_id: bytes = SM2_DEFAULT_ID, c: Curve = SM2_CURVE) -> bytes:
-    """ZA = SM3(ENTL ‖ ID ‖ a ‖ b ‖ Gx ‖ Gy ‖ Px ‖ Py)."""
+def sm2_za_bytes(
+    pub_xy: bytes,
+    user_id: bytes = SM2_DEFAULT_ID,
+    c: Curve = SM2_CURVE,
+    sm3_fn=sm3,
+) -> bytes:
+    """ZA = SM3(ENTL ‖ ID ‖ a ‖ b ‖ Gx ‖ Gy ‖ Px ‖ Py); ``pub_xy`` is the
+    64-byte x‖y encoding; ``sm3_fn`` lets callers ride a faster hasher
+    (the native core) without forking the layout."""
     entl = (len(user_id) * 8).to_bytes(2, "big")
-    px, py = pub
     data = (
         entl
         + user_id
@@ -215,15 +229,34 @@ def sm2_za(pub, user_id: bytes = SM2_DEFAULT_ID, c: Curve = SM2_CURVE) -> bytes:
         + c.b.to_bytes(32, "big")
         + c.gx.to_bytes(32, "big")
         + c.gy.to_bytes(32, "big")
-        + px.to_bytes(32, "big")
-        + py.to_bytes(32, "big")
+        + pub_xy
     )
-    return sm3(data)
+    return sm3_fn(data)
+
+
+def sm2_za(pub, user_id: bytes = SM2_DEFAULT_ID, c: Curve = SM2_CURVE) -> bytes:
+    px, py = pub
+    return sm2_za_bytes(
+        px.to_bytes(32, "big") + py.to_bytes(32, "big"), user_id, c
+    )
+
+
+def sm2_e_bytes(
+    pub_xy: bytes,
+    msg_hash: bytes,
+    user_id: bytes = SM2_DEFAULT_ID,
+    sm3_fn=sm3,
+) -> bytes:
+    """e = SM3(ZA ‖ M) as 32 bytes; M is the 32-byte tx hash being signed."""
+    return sm3_fn(sm2_za_bytes(pub_xy, user_id, sm3_fn=sm3_fn) + msg_hash)
 
 
 def sm2_e(msg_hash: bytes, pub, user_id: bytes = SM2_DEFAULT_ID) -> int:
-    """e = SM3(ZA ‖ M); here M is the 32-byte transaction hash being signed."""
-    return int.from_bytes(sm3(sm2_za(pub, user_id) + msg_hash), "big")
+    px, py = pub
+    return int.from_bytes(
+        sm2_e_bytes(px.to_bytes(32, "big") + py.to_bytes(32, "big"), msg_hash, user_id),
+        "big",
+    )
 
 
 def sm2_sign(msg_hash: bytes, d: int, user_id: bytes = SM2_DEFAULT_ID):

@@ -250,27 +250,56 @@ class Ed25519Crypto(SignatureCrypto):
 
 class Secp256k1Crypto(SignatureCrypto):
     """65-byte r‖s‖v signatures, v ∈ {0..3} ∪ {27, 28}
-    (reference: Secp256k1Crypto.cpp:32-136)."""
+    (reference: Secp256k1Crypto.cpp:32-136).
+
+    Single-item paths go through the native C core when available (the
+    wedpr-FFI analog — every PBFT packet and single-tx RPC admission pays
+    this latency, Secp256k1Crypto.cpp:57/:85), falling back to the
+    bit-identical pure-Python reference."""
 
     name = "secp256k1"
     sig_len = 65
 
     def generate_keypair(self, secret: int | None = None) -> KeyPair:
-        return _make_keypair(ref_ecdsa.SECP256K1, secret)
+        if secret is None:
+            return _make_keypair(ref_ecdsa.SECP256K1, None)
+        from .. import native_bind
+
+        pub = native_bind.ec_pubkey("secp256k1", secret)
+        if pub is None:
+            return _make_keypair(ref_ecdsa.SECP256K1, secret)
+        return KeyPair(secret, pub)
 
     def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
-        r, s, v = ref_ecdsa.ecdsa_sign(msg_hash, kp.secret)
+        from .. import native_bind
+
+        out = native_bind.secp256k1_sign(msg_hash, kp.secret)
+        if out is None:
+            out = ref_ecdsa.ecdsa_sign(msg_hash, kp.secret)
+        r, s, v = out
         return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
 
     def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        from .. import native_bind
+
         r = int.from_bytes(sig[:32], "big")
         s = int.from_bytes(sig[32:64], "big")
+        ok = native_bind.secp256k1_verify(msg_hash, r, s, pub)
+        if ok is not None:
+            return ok
         p = (int.from_bytes(pub[:32], "big"), int.from_bytes(pub[32:], "big"))
         return ref_ecdsa.ecdsa_verify(msg_hash, r, s, p)
 
     def recover(self, msg_hash: bytes, sig: bytes) -> bytes:
+        from .. import native_bind
+
         r = int.from_bytes(sig[:32], "big")
         s = int.from_bytes(sig[32:64], "big")
+        native = native_bind.secp256k1_recover(msg_hash, r, s, sig[64])
+        if native is not None:
+            if not native:
+                raise ValueError("secp256k1 recover failed")
+            return native
         pub = ref_ecdsa.ecdsa_recover(msg_hash, r, s, sig[64])
         if pub is None:
             raise ValueError("secp256k1 recover failed")
@@ -300,16 +329,46 @@ class SM2Crypto(SignatureCrypto):
     name = "sm2"
     sig_len = 128
 
+    @staticmethod
+    def _e_bytes(pub: bytes, msg_hash: bytes) -> bytes:
+        """e = SM3(ZA ‖ M) with the default user id, riding the native
+        hasher when available (layout lives in one place: ecdsa.sm2_za_bytes)."""
+        from .. import native_bind
+
+        return ref_ecdsa.sm2_e_bytes(
+            pub, msg_hash, sm3_fn=lambda b: native_bind.sm3(b) or ref_sm3(b)
+        )
+
     def generate_keypair(self, secret: int | None = None) -> KeyPair:
-        return _make_keypair(ref_ecdsa.SM2_CURVE, secret)
+        if secret is None:
+            return _make_keypair(ref_ecdsa.SM2_CURVE, None)
+        from .. import native_bind
+
+        pub = native_bind.ec_pubkey("sm2", secret)
+        if pub is None:
+            return _make_keypair(ref_ecdsa.SM2_CURVE, secret)
+        return KeyPair(secret, pub)
 
     def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
-        r, s = ref_ecdsa.sm2_sign(msg_hash, kp.secret)
+        from .. import native_bind
+
+        out = None
+        if native_bind.load() is not None:
+            out = native_bind.sm2_sign(self._e_bytes(kp.pub, msg_hash), kp.secret)
+        if out is None:
+            out = ref_ecdsa.sm2_sign(msg_hash, kp.secret)
+        r, s = out
         return r.to_bytes(32, "big") + s.to_bytes(32, "big") + kp.pub
 
     def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        from .. import native_bind
+
         r = int.from_bytes(sig[:32], "big")
         s = int.from_bytes(sig[32:64], "big")
+        if native_bind.load() is not None:
+            ok = native_bind.sm2_verify(self._e_bytes(pub, msg_hash), r, s, pub)
+            if ok is not None:
+                return ok
         p = (int.from_bytes(pub[:32], "big"), int.from_bytes(pub[32:], "big"))
         return ref_ecdsa.sm2_verify(msg_hash, r, s, p)
 

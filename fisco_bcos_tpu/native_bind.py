@@ -11,6 +11,7 @@ suite asserts bit-identical outputs between both.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -22,16 +23,38 @@ _log = get_logger("native")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO, "native", "fisco_native.cpp")
 _LIB = os.path.join(_REPO, "native", "libfisco_native.so")
+_ISA_TAG = _LIB + ".isa"  # host-ISA signature of the existing build
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
 
 
+def _host_isa() -> str:
+    """Stable signature of this host's instruction set. The library is built
+    with -march=native (2x on the 4x64 Montgomery core via mulx/adx), so a
+    build moved to a different CPU — shared volume, docker image — must be
+    rebuilt, not executed: a SIGILL would kill the process instead of
+    falling back to crypto/ref."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(
+                        " ".join(sorted(line.split()[2:])).encode()
+                    ).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 def _build() -> bool:
     try:
         res = subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
+             "-fPIC", "-o", _LIB, _SRC],
             capture_output=True,
             text=True,
             timeout=120,
@@ -42,7 +65,24 @@ def _build() -> bool:
     if res.returncode != 0:
         _log.warning("native build failed:\n%s", res.stderr[-2000:])
         return False
+    try:
+        with open(_ISA_TAG, "w") as f:
+            f.write(_host_isa())
+    except OSError:
+        pass
     return True
+
+
+def _needs_rebuild() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    if os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_LIB):
+        return True
+    try:
+        with open(_ISA_TAG) as f:
+            return f.read().strip() != _host_isa()
+    except OSError:
+        return True  # unknown provenance: rebuild rather than risk SIGILL
 
 
 def load() -> ctypes.CDLL | None:
@@ -54,10 +94,7 @@ def load() -> ctypes.CDLL | None:
         _tried = True
         if os.environ.get("FISCO_NO_NATIVE"):
             return None
-        if not os.path.exists(_LIB) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
-        ):
+        if _needs_rebuild():
             if not os.path.exists(_SRC) or not _build():
                 return None
         try:
@@ -66,17 +103,54 @@ def load() -> ctypes.CDLL | None:
             _log.warning("native load failed: %s", e)
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        for name in ("fisco_keccak256", "fisco_sha256", "fisco_sm3"):
-            fn = getattr(lib, name)
-            fn.argtypes = [u8p, ctypes.c_size_t, u8p]
-            fn.restype = None
-        lib.fisco_sm4_cbc.argtypes = [
-            u8p, u8p, u8p, ctypes.c_size_t, u8p, ctypes.c_int,
-        ]
-        lib.fisco_sm4_cbc.restype = None
+        try:
+            _bind_symbols(lib, u8p)
+        except AttributeError as e:
+            # a stale .so missing newer symbols: disable rather than crash
+            # every later call (the mtime/ISA checks normally prevent this,
+            # but a source-less packaged install can still hit it)
+            _log.warning("native library is stale, ignoring it: %s", e)
+            return None
         _lib = lib
         _log.info("native crypto core loaded (%s)", _LIB)
         return _lib
+
+
+def _bind_symbols(lib: ctypes.CDLL, u8p) -> None:
+    for name in ("fisco_keccak256", "fisco_sha256", "fisco_sm3"):
+        fn = getattr(lib, name)
+        fn.argtypes = [u8p, ctypes.c_size_t, u8p]
+        fn.restype = None
+    lib.fisco_sm4_cbc.argtypes = [
+        u8p, u8p, u8p, ctypes.c_size_t, u8p, ctypes.c_int,
+    ]
+    lib.fisco_sm4_cbc.restype = None
+    lib.fisco_secp256k1_verify.argtypes = [u8p, u8p, u8p, u8p]
+    lib.fisco_secp256k1_verify.restype = ctypes.c_int
+    lib.fisco_secp256k1_recover.argtypes = [u8p, u8p, u8p, ctypes.c_int, u8p]
+    lib.fisco_secp256k1_recover.restype = ctypes.c_int
+    lib.fisco_secp256k1_sign.argtypes = [
+        u8p, u8p, u8p, u8p, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.fisco_secp256k1_sign.restype = ctypes.c_int
+    lib.fisco_sm2_verify.argtypes = [u8p, u8p, u8p, u8p]
+    lib.fisco_sm2_verify.restype = ctypes.c_int
+    lib.fisco_sm2_sign.argtypes = [u8p, u8p, u8p, u8p]
+    lib.fisco_sm2_sign.restype = ctypes.c_int
+    lib.fisco_ec_pubkey.argtypes = [ctypes.c_int, u8p, u8p]
+    lib.fisco_ec_pubkey.restype = ctypes.c_int
+    lib.fisco_secp256k1_verify_batch.argtypes = [
+        ctypes.c_size_t, u8p, u8p, u8p, u8p, u8p,
+    ]
+    lib.fisco_secp256k1_verify_batch.restype = None
+    lib.fisco_secp256k1_recover_batch.argtypes = [
+        ctypes.c_size_t, u8p, u8p, u8p, u8p, u8p, u8p,
+    ]
+    lib.fisco_secp256k1_recover_batch.restype = None
+    lib.fisco_sm2_verify_batch.argtypes = [
+        ctypes.c_size_t, u8p, u8p, u8p, u8p, u8p,
+    ]
+    lib.fisco_sm2_verify_batch.restype = None
 
 
 def _hash_via(name: str, data: bytes) -> bytes | None:
@@ -113,3 +187,126 @@ def sm4_cbc(key: bytes, iv: bytes, data: bytes, decrypt: bool) -> bytes | None:
     ibuf = (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(data or b"\x00")
     lib.fisco_sm4_cbc(kbuf, ivbuf, ibuf, n, out, 1 if decrypt else 0)
     return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Elliptic-curve single-item paths (the wedpr_secp256k1_* / SM2 EVP analog).
+# All wrappers return None when the native core is unavailable so callers can
+# fall back to crypto/ref; verified results are plain bool/bytes.
+# ---------------------------------------------------------------------------
+
+
+def _b32(v: int | bytes) -> bytes:
+    return v if isinstance(v, bytes) else v.to_bytes(32, "big")
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+
+
+def secp256k1_verify(z: bytes, r: int, s: int, pub: bytes) -> bool | None:
+    lib = load()
+    if lib is None:
+        return None
+    if not (0 <= r < 1 << 256 and 0 <= s < 1 << 256) or len(pub) != 64:
+        return False
+    return bool(
+        lib.fisco_secp256k1_verify(_buf(z), _buf(_b32(r)), _buf(_b32(s)), _buf(pub))
+    )
+
+
+def secp256k1_recover(z: bytes, r: int, s: int, v: int) -> bytes | None:
+    """Recovered 64-byte pubkey, b"" when the signature is unrecoverable,
+    None when the native core is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    if not (0 <= r < 1 << 256 and 0 <= s < 1 << 256):
+        return b""
+    out = (ctypes.c_uint8 * 64)()
+    ok = lib.fisco_secp256k1_recover(
+        _buf(z), _buf(_b32(r)), _buf(_b32(s)), int(v), out
+    )
+    return bytes(out) if ok else b""
+
+
+def secp256k1_sign(z: bytes, d: int) -> tuple[int, int, int] | None:
+    lib = load()
+    if lib is None:
+        return None
+    r_out = (ctypes.c_uint8 * 32)()
+    s_out = (ctypes.c_uint8 * 32)()
+    v_out = ctypes.c_int(0)
+    ok = lib.fisco_secp256k1_sign(
+        _buf(z), _buf(_b32(d)), r_out, s_out, ctypes.byref(v_out)
+    )
+    if not ok:
+        return None
+    return (
+        int.from_bytes(bytes(r_out), "big"),
+        int.from_bytes(bytes(s_out), "big"),
+        v_out.value,
+    )
+
+
+def sm2_verify(e: bytes, r: int, s: int, pub: bytes) -> bool | None:
+    """e = SM3(ZA ‖ M) — the caller computes the SM2 digest prefix."""
+    lib = load()
+    if lib is None:
+        return None
+    if not (0 <= r < 1 << 256 and 0 <= s < 1 << 256) or len(pub) != 64:
+        return False
+    return bool(lib.fisco_sm2_verify(_buf(e), _buf(_b32(r)), _buf(_b32(s)), _buf(pub)))
+
+
+def sm2_sign(e: bytes, d: int) -> tuple[int, int] | None:
+    lib = load()
+    if lib is None:
+        return None
+    r_out = (ctypes.c_uint8 * 32)()
+    s_out = (ctypes.c_uint8 * 32)()
+    ok = lib.fisco_sm2_sign(_buf(e), _buf(_b32(d)), r_out, s_out)
+    if not ok:
+        return None
+    return (int.from_bytes(bytes(r_out), "big"), int.from_bytes(bytes(s_out), "big"))
+
+
+def ec_pubkey(curve: str, d: int) -> bytes | None:
+    lib = load()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * 64)()
+    ok = lib.fisco_ec_pubkey(1 if curve == "sm2" else 0, _buf(_b32(d)), out)
+    return bytes(out) if ok else None
+
+
+def secp256k1_verify_batch(zs: bytes, rs: bytes, ss: bytes, pubs: bytes, n: int):
+    """n-item loop in one native call — the honest CPU baseline for bench.py.
+    Returns a list[bool] or None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * n)()
+    lib.fisco_secp256k1_verify_batch(n, _buf(zs), _buf(rs), _buf(ss), _buf(pubs), out)
+    return [bool(b) for b in out]
+
+
+def secp256k1_recover_batch(zs: bytes, rs: bytes, ss: bytes, vs: bytes, n: int):
+    lib = load()
+    if lib is None:
+        return None
+    pubs_out = (ctypes.c_uint8 * (64 * n))()
+    ok_out = (ctypes.c_uint8 * n)()
+    lib.fisco_secp256k1_recover_batch(
+        n, _buf(zs), _buf(rs), _buf(ss), _buf(vs), pubs_out, ok_out
+    )
+    return bytes(pubs_out), [bool(b) for b in ok_out]
+
+
+def sm2_verify_batch(es: bytes, rs: bytes, ss: bytes, pubs: bytes, n: int):
+    lib = load()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * n)()
+    lib.fisco_sm2_verify_batch(n, _buf(es), _buf(rs), _buf(ss), _buf(pubs), out)
+    return [bool(b) for b in out]
