@@ -1,0 +1,269 @@
+"""EVM builtin precompiles 0x05–0x09: modexp, alt_bn128 add/mul/pairing,
+blake2f.
+
+Reference role: bcos-executor/src/vm/Precompiled.cpp:101-263 (modexp,
+alt_bn128_G1_add/_mul, alt_bn128_pairing_product, blake2_compression),
+bound to fixed addresses in TransactionExecutor.cpp:176-189 with the gas
+schedule: modexp uses the EIP-198 pricer (multComplexity·adjExpLen/20),
+bn128 add/mul are flat 150/6000, pairing 45000 + 34000·k, blake2f costs
+`rounds`.
+
+Each entry point takes (data, gas) and returns (status, output, gas_left):
+status 0 = success; nonzero = hard precompile failure (malformed input or
+out of gas — the EVM call consumes all gas, per the reference's
+{false, …} returns).  Gas is charged BEFORE execution so an
+attacker-priced blake2f/modexp cannot burn host CPU beyond what it paid
+for.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from . import bn128
+
+# status codes mirror TransactionStatus usage in executor.py
+_OK = 0
+_FAIL = 1  # mapped by the caller to TransactionStatus values
+
+
+def _word_count(n: int) -> int:
+    return (n + 31) // 32
+
+
+def _right_padded(data: bytes, off: int, length: int) -> bytes:
+    """`length` bytes at `off`, zero-right-padded past the end
+    (Precompiled.cpp parseBigEndianRightPadded)."""
+    chunk = data[off : off + length]
+    return chunk + b"\x00" * (length - len(chunk))
+
+
+# ---------------------------------------------------------------------------
+# 0x05 modexp (EIP-198)
+# ---------------------------------------------------------------------------
+
+
+def _mult_complexity(x: int) -> int:
+    if x <= 64:
+        return x * x
+    if x <= 1024:
+        return x * x // 4 + 96 * x - 3072
+    return x * x // 16 + 480 * x - 199680
+
+
+def modexp_gas(data: bytes) -> int:
+    base_len = int.from_bytes(_right_padded(data, 0, 32), "big")
+    exp_len = int.from_bytes(_right_padded(data, 32, 32), "big")
+    mod_len = int.from_bytes(_right_padded(data, 64, 32), "big")
+    max_len = max(mod_len, base_len)
+    if exp_len <= 32:
+        exp = int.from_bytes(_right_padded(data, 96 + base_len, exp_len), "big")
+        adj = exp.bit_length() - 1 if exp else 0
+    else:
+        first = int.from_bytes(_right_padded(data, 96 + base_len, 32), "big")
+        adj = 8 * (exp_len - 32) + (first.bit_length() - 1 if first else 0)
+    return _mult_complexity(max_len) * max(adj, 1) // 20
+
+
+def modexp(data: bytes, gas: int) -> tuple[int, bytes, int]:
+    base_len = int.from_bytes(_right_padded(data, 0, 32), "big")
+    exp_len = int.from_bytes(_right_padded(data, 32, 32), "big")
+    mod_len = int.from_bytes(_right_padded(data, 64, 32), "big")
+    if mod_len == 0 and base_len == 0:
+        # Precompiled.cpp:113-114: expLength may be enormous here; the
+        # pricer's multComplexity(0) == 0 makes this free
+        return (_OK, b"", gas)
+    # base/mod lengths drive ALLOCATION (the output buffer is mod_len bytes
+    # even for a zero result), so they get a hard memory bound — the
+    # reference's `assert length <= max size_t/8` plays the same role
+    if max(base_len, mod_len) > 1 << 24:
+        return (_FAIL, b"", 0)
+    # the exponent only costs gas (adjusted length enters the pricer), but a
+    # nonzero exponent of 2^26+ bytes means >5*10^8 squarings — an
+    # unservable host-CPU burn whose EIP-198 price is far below its cost
+    # (the flaw EIP-2565 later repriced).  Zero-valued exponents of any
+    # declared length stay cheap and exact (only supplied calldata bytes are
+    # examined; the virtual right-padding is all zeros).
+    supplied_exp = data[96 + base_len : 96 + base_len + exp_len]
+    if exp_len > 1 << 26 and any(supplied_exp):
+        return (_FAIL, b"", 0)
+    cost = modexp_gas(data)
+    if gas < cost:
+        return (_FAIL, b"", 0)
+    base = int.from_bytes(_right_padded(data, 96, base_len), "big")
+    exp = int.from_bytes(supplied_exp, "big")
+    if any(supplied_exp):
+        exp <<= 8 * (exp_len - len(supplied_exp))
+    mod = int.from_bytes(
+        _right_padded(data, 96 + base_len + exp_len, mod_len), "big"
+    )
+    result = pow(base, exp, mod) if mod else 0
+    return (_OK, result.to_bytes(mod_len, "big"), gas - cost)
+
+
+# ---------------------------------------------------------------------------
+# 0x06 / 0x07 alt_bn128 G1 add / scalar-mul (EIP-196)
+# ---------------------------------------------------------------------------
+
+_BN_ADD_GAS = 150
+_BN_MUL_GAS = 6000
+
+
+def _parse_g1(data: bytes, off: int):
+    """(x, y) G1 point or raise ValueError; (0, 0) is the identity."""
+    x = int.from_bytes(_right_padded(data, off, 32), "big")
+    y = int.from_bytes(_right_padded(data, off + 32, 32), "big")
+    if x >= bn128.P or y >= bn128.P:
+        raise ValueError("G1 coordinate out of field range")
+    if x == 0 and y == 0:
+        return None
+    pt = (x, y)
+    if not bn128.g1_on_curve(pt):
+        raise ValueError("G1 point not on curve")
+    return pt
+
+
+def _encode_g1(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 64
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def bn128_add(data: bytes, gas: int) -> tuple[int, bytes, int]:
+    if gas < _BN_ADD_GAS:
+        return (_FAIL, b"", 0)
+    try:
+        a = _parse_g1(data, 0)
+        b = _parse_g1(data, 64)
+    except ValueError:
+        return (_FAIL, b"", 0)
+    return (_OK, _encode_g1(bn128.g1_add(a, b)), gas - _BN_ADD_GAS)
+
+
+def bn128_mul(data: bytes, gas: int) -> tuple[int, bytes, int]:
+    if gas < _BN_MUL_GAS:
+        return (_FAIL, b"", 0)
+    try:
+        a = _parse_g1(data, 0)
+    except ValueError:
+        return (_FAIL, b"", 0)
+    k = int.from_bytes(_right_padded(data, 64, 32), "big")
+    return (_OK, _encode_g1(bn128.g1_mul(a, k)), gas - _BN_MUL_GAS)
+
+
+# ---------------------------------------------------------------------------
+# 0x08 alt_bn128 pairing product (EIP-197)
+# ---------------------------------------------------------------------------
+
+_PAIR_BASE_GAS = 45000
+_PAIR_PER_GAS = 34000
+
+
+def _parse_g2(data: bytes, off: int):
+    """G2 point from the EIP-197 (imaginary, real) coefficient order;
+    validates curve AND prime-subgroup membership."""
+    xi = int.from_bytes(data[off : off + 32], "big")
+    xr = int.from_bytes(data[off + 32 : off + 64], "big")
+    yi = int.from_bytes(data[off + 64 : off + 96], "big")
+    yr = int.from_bytes(data[off + 96 : off + 128], "big")
+    if max(xi, xr, yi, yr) >= bn128.P:
+        raise ValueError("G2 coordinate out of field range")
+    if xi == xr == yi == yr == 0:
+        return None
+    pt = ((xr, xi), (yr, yi))
+    if not bn128.g2_in_subgroup(pt):
+        raise ValueError("G2 point not in the prime subgroup")
+    return pt
+
+
+def bn128_pairing(data: bytes, gas: int) -> tuple[int, bytes, int]:
+    if len(data) % 192 != 0:
+        return (_FAIL, b"", 0)
+    k = len(data) // 192
+    cost = _PAIR_BASE_GAS + _PAIR_PER_GAS * k
+    if gas < cost:
+        return (_FAIL, b"", 0)
+    pairs = []
+    try:
+        for i in range(k):
+            p1 = _parse_g1(data, 192 * i)
+            q2 = _parse_g2(data, 192 * i + 64)
+            if p1 is not None and q2 is not None:
+                pairs.append((p1, q2))
+    except ValueError:
+        return (_FAIL, b"", 0)
+    ok = bn128.pairing_check(pairs)
+    return (_OK, (1 if ok else 0).to_bytes(32, "big"), gas - cost)
+
+
+# ---------------------------------------------------------------------------
+# 0x09 blake2f compression (EIP-152)
+# ---------------------------------------------------------------------------
+
+_BLAKE2_IV = (
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1, 0x510E527FADE682D1, 0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+)
+
+_BLAKE2_SIGMA = (
+    (0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+    (14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3),
+    (11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4),
+    (7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8),
+    (9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13),
+    (2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9),
+    (12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11),
+    (13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10),
+    (6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5),
+    (10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0),
+)
+
+_M64 = (1 << 64) - 1
+
+
+def _blake2_compress(rounds: int, h: list[int], m: list[int], t0: int,
+                     t1: int, final: bool) -> list[int]:
+    v = list(h) + list(_BLAKE2_IV)
+    v[12] ^= t0
+    v[13] ^= t1
+    if final:
+        v[14] ^= _M64
+
+    def g(a, b, c, d, x, y):
+        v[a] = (v[a] + v[b] + x) & _M64
+        v[d] = ((v[d] ^ v[a]) >> 32 | (v[d] ^ v[a]) << 32) & _M64
+        v[c] = (v[c] + v[d]) & _M64
+        v[b] = ((v[b] ^ v[c]) >> 24 | (v[b] ^ v[c]) << 40) & _M64
+        v[a] = (v[a] + v[b] + y) & _M64
+        v[d] = ((v[d] ^ v[a]) >> 16 | (v[d] ^ v[a]) << 48) & _M64
+        v[c] = (v[c] + v[d]) & _M64
+        v[b] = ((v[b] ^ v[c]) >> 63 | (v[b] ^ v[c]) << 1) & _M64
+
+    for r in range(rounds):
+        s = _BLAKE2_SIGMA[r % 10]
+        g(0, 4, 8, 12, m[s[0]], m[s[1]])
+        g(1, 5, 9, 13, m[s[2]], m[s[3]])
+        g(2, 6, 10, 14, m[s[4]], m[s[5]])
+        g(3, 7, 11, 15, m[s[6]], m[s[7]])
+        g(0, 5, 10, 15, m[s[8]], m[s[9]])
+        g(1, 6, 11, 12, m[s[10]], m[s[11]])
+        g(2, 7, 8, 13, m[s[12]], m[s[13]])
+        g(3, 4, 9, 14, m[s[14]], m[s[15]])
+    return [h[i] ^ v[i] ^ v[i + 8] for i in range(8)]
+
+
+def blake2f(data: bytes, gas: int) -> tuple[int, bytes, int]:
+    if len(data) != 213:
+        return (_FAIL, b"", 0)
+    rounds = int.from_bytes(data[:4], "big")
+    final = data[212]
+    if final not in (0, 1):
+        return (_FAIL, b"", 0)
+    if gas < rounds:  # gas == rounds: charge before compute
+        return (_FAIL, b"", 0)
+    h = list(struct.unpack("<8Q", data[4:68]))
+    m = list(struct.unpack("<16Q", data[68:196]))
+    t0, t1 = struct.unpack("<2Q", data[196:212])
+    out = _blake2_compress(rounds, h, m, t0, t1, final == 1)
+    return (_OK, struct.pack("<8Q", *out), gas - rounds)

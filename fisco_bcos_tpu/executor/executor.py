@@ -47,6 +47,7 @@ from .evm import (
     EVMResult,
     interpret,
 )
+from . import eth_builtins
 from .precompiled import default_registry
 from .precompiled.base import (
     BASE_GAS,
@@ -63,6 +64,23 @@ _ECRECOVER = (1).to_bytes(20, "big")
 _SHA256 = (2).to_bytes(20, "big")
 _RIPEMD160 = (3).to_bytes(20, "big")
 _IDENTITY = (4).to_bytes(20, "big")
+_MODEXP = (5).to_bytes(20, "big")
+_BN128_ADD = (6).to_bytes(20, "big")
+_BN128_MUL = (7).to_bytes(20, "big")
+_BN128_PAIRING = (8).to_bytes(20, "big")
+_BLAKE2F = (9).to_bytes(20, "big")
+_BUILTINS = (
+    _ECRECOVER, _SHA256, _RIPEMD160, _IDENTITY,
+    _MODEXP, _BN128_ADD, _BN128_MUL, _BN128_PAIRING, _BLAKE2F,
+)
+# 0x05-0x09 handlers (eth_builtins; reference Precompiled.cpp:101-263)
+_EXT_BUILTINS = {
+    _MODEXP: eth_builtins.modexp,
+    _BN128_ADD: eth_builtins.bn128_add,
+    _BN128_MUL: eth_builtins.bn128_mul,
+    _BN128_PAIRING: eth_builtins.bn128_pairing,
+    _BLAKE2F: eth_builtins.blake2f,
+}
 
 
 @dataclass
@@ -115,7 +133,7 @@ class TransactionExecutor:
     def known_callee(self, addr: bytes, storage: StorageInterface | None = None) -> bool:
         """True if a top-level call to `addr` has something to run (registry
         precompile, EVM builtin, or deployed code)."""
-        if addr in self.registry or addr in (_ECRECOVER, _SHA256, _RIPEMD160, _IDENTITY):
+        if addr in self.registry or addr in _BUILTINS:
             return True
         st = storage if storage is not None else (
             self._block.storage if self._block else StateStorage(self.backend)
@@ -177,6 +195,16 @@ class TransactionExecutor:
                 output=data,
                 gas_left=max(msg.gas - 15 - 3 * ((len(data) + 31) // 32), 0),
             )
+        ext = _EXT_BUILTINS.get(msg.code_address)
+        if ext is not None:
+            status, out, gas_left = ext(data, msg.gas)
+            if status != 0:
+                return EVMResult(
+                    status=int(TransactionStatus.PRECOMPILED_ERROR),
+                    output=b"",
+                    gas_left=0,
+                )
+            return EVMResult(output=out, gas_left=gas_left)
         return None
 
     def _run_registry_precompile(

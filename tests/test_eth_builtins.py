@@ -1,0 +1,288 @@
+"""EVM builtin precompiles 0x05-0x09 (modexp, alt_bn128, blake2f).
+
+Reference parity: bcos-executor/src/vm/Precompiled.cpp:101-263 bound at
+TransactionExecutor.cpp:176-189.  Vectors are from the public EIP-198/196/
+197/152 specifications; bn128 algebra is additionally pinned by the
+bilinearity identities in TestPairingAlgebra.
+"""
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor import bn128
+from fisco_bcos_tpu.executor import eth_builtins as eb
+from fisco_bcos_tpu.executor.executor import TransactionExecutor
+from fisco_bcos_tpu.protocol.block_header import BlockHeader
+from fisco_bcos_tpu.protocol.receipt import TransactionStatus
+from fisco_bcos_tpu.protocol.transaction import Transaction
+from fisco_bcos_tpu.storage.memory_storage import MemoryStorage
+
+GAS = 10_000_000
+
+
+def _w(v: int) -> bytes:
+    return v.to_bytes(32, "big")
+
+
+class TestModexp:
+    def test_eip198_fermat_vector(self):
+        # 3^(p-2) mod p == 3^{-1}: the canonical EIP-198 example
+        p = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+        data = _w(1) + _w(32) + _w(32) + b"\x03" + _w(p - 2) + _w(p)
+        st, out, gas_left = eb.modexp(data, GAS)
+        assert st == 0
+        assert int.from_bytes(out, "big") == pow(3, p - 2, p)
+        assert gas_left < GAS
+
+    def test_zero_mod_and_base_is_empty(self):
+        # modLength == 0 and baseLength == 0 -> empty output even with a
+        # huge expLength (Precompiled.cpp:113-114 special case)
+        data = _w(0) + _w(1 << 200) + _w(0)
+        st, out, _ = eb.modexp(data, GAS)
+        assert st == 0 and out == b""
+
+    def test_mod_zero_gives_zeroes(self):
+        data = _w(1) + _w(1) + _w(2) + b"\x05" + b"\x03" + _w(0)[:2]
+        st, out, _ = eb.modexp(data, GAS)
+        assert st == 0 and out == b"\x00\x00"
+
+    def test_right_padding(self):
+        # truncated input is zero-right-padded: 5^0 mod 0x0100 = 1
+        data = _w(1) + _w(1) + _w(2) + b"\x05" + b"\x00" + b"\x01"
+        st, out, _ = eb.modexp(data, GAS)
+        assert st == 0 and out == b"\x00\x01"  # mod = 0x0100, result 1
+
+    def test_gas_charges_before_compute(self):
+        data = _w(32) + _w(32) + _w(32) + _w(2) + _w((1 << 256) - 1) + _w(97)
+        cost = eb.modexp_gas(data)
+        assert cost > 0
+        st, out, gas_left = eb.modexp(data, cost - 1)
+        assert st != 0 and gas_left == 0
+
+    def test_absurd_lengths_rejected(self):
+        data = _w(1 << 30) + _w(32) + _w(32)
+        st, _, gas_left = eb.modexp(data, 1 << 62)
+        assert st != 0 and gas_left == 0
+
+
+class TestBn128AddMul:
+    def test_add_doubles_generator(self):
+        data = _w(1) + _w(2) + _w(1) + _w(2)
+        st, out, gas_left = eb.bn128_add(data, GAS)
+        assert st == 0 and gas_left == GAS - 150
+        want = bn128.g1_mul(bn128.G1_GEN, 2)
+        assert out == _w(want[0]) + _w(want[1])
+
+    def test_add_identity(self):
+        data = _w(1) + _w(2) + _w(0) + _w(0)
+        st, out, _ = eb.bn128_add(data, GAS)
+        assert st == 0 and out == _w(1) + _w(2)
+        # empty input = two identities
+        st, out, _ = eb.bn128_add(b"", GAS)
+        assert st == 0 and out == b"\x00" * 64
+
+    def test_add_rejects_off_curve(self):
+        data = _w(1) + _w(3) + _w(0) + _w(0)
+        st, _, gas_left = eb.bn128_add(data, GAS)
+        assert st != 0 and gas_left == 0
+
+    def test_add_rejects_out_of_field(self):
+        data = _w(bn128.P) + _w(2) + _w(0) + _w(0)
+        st, _, _ = eb.bn128_add(data, GAS)
+        assert st != 0
+
+    def test_mul_matches_repeated_add(self):
+        data = _w(1) + _w(2) + _w(9)
+        st, out, gas_left = eb.bn128_mul(data, GAS)
+        assert st == 0 and gas_left == GAS - 6000
+        want = bn128.g1_mul(bn128.G1_GEN, 9)
+        assert out == _w(want[0]) + _w(want[1])
+
+    def test_mul_by_zero_is_identity(self):
+        data = _w(1) + _w(2) + _w(0)
+        st, out, _ = eb.bn128_mul(data, GAS)
+        assert st == 0 and out == b"\x00" * 64
+
+    def test_gas_shortfall(self):
+        assert eb.bn128_add(b"", 149)[0] != 0
+        assert eb.bn128_mul(b"", 5999)[0] != 0
+
+
+def _g2_bytes(q) -> bytes:
+    (xr, xi), (yr, yi) = q
+    return _w(xi) + _w(xr) + _w(yi) + _w(yr)  # EIP-197: imaginary first
+
+
+class TestBn128Pairing:
+    def test_pair_and_inverse_is_one(self):
+        p = bn128.G1_GEN
+        neg_p = (p[0], bn128.P - p[1])
+        data = (
+            _w(p[0]) + _w(p[1]) + _g2_bytes(bn128.G2_GEN)
+            + _w(neg_p[0]) + _w(neg_p[1]) + _g2_bytes(bn128.G2_GEN)
+        )
+        st, out, gas_left = eb.bn128_pairing(data, GAS)
+        assert st == 0
+        assert int.from_bytes(out, "big") == 1
+        assert gas_left == GAS - 45000 - 2 * 34000
+
+    def test_single_pair_is_not_one(self):
+        p = bn128.G1_GEN
+        data = _w(p[0]) + _w(p[1]) + _g2_bytes(bn128.G2_GEN)
+        st, out, _ = eb.bn128_pairing(data, GAS)
+        assert st == 0 and int.from_bytes(out, "big") == 0
+
+    def test_empty_input_is_one(self):
+        st, out, gas_left = eb.bn128_pairing(b"", GAS)
+        assert st == 0 and int.from_bytes(out, "big") == 1
+        assert gas_left == GAS - 45000
+
+    def test_bilinearity_through_wire(self):
+        # e(2P, 3Q) * e(-6P, Q) == 1
+        p2 = bn128.g1_mul(bn128.G1_GEN, 2)
+        q3 = bn128.g2_mul(bn128.G2_GEN, 3)
+        p6n = bn128.g1_mul(bn128.G1_GEN, bn128.N - 6)
+        data = (
+            _w(p2[0]) + _w(p2[1]) + _g2_bytes(q3)
+            + _w(p6n[0]) + _w(p6n[1]) + _g2_bytes(bn128.G2_GEN)
+        )
+        st, out, _ = eb.bn128_pairing(data, GAS)
+        assert st == 0 and int.from_bytes(out, "big") == 1
+
+    def test_ragged_length_rejected(self):
+        st, _, gas_left = eb.bn128_pairing(b"\x00" * 191, GAS)
+        assert st != 0 and gas_left == 0
+
+    def test_g2_subgroup_enforced(self):
+        # a point ON the twist curve but OUTSIDE the order-N subgroup (the
+        # twist's group order is N·(2P−N), so a random curve point has
+        # torsion with overwhelming probability)
+        from fisco_bcos_tpu.executor.bn128 import B2, P
+
+        def f2_sqrt(c):
+            a, b = c[0] % P, c[1] % P
+            norm = (a * a + b * b) % P
+            s = pow(norm, (P + 1) // 4, P)
+            if s * s % P != norm:
+                return None
+            half = pow(2, P - 2, P)
+            for sg in (s, P - s):
+                t2 = (a + sg) * half % P
+                t = pow(t2, (P + 1) // 4, P)
+                if t * t % P != t2 or t == 0:
+                    continue
+                cand = (t, b * pow(2 * t, P - 2, P) % P)
+                if bn128.f2_sqr(cand) == (a, b):
+                    return cand
+            return None
+
+        found = None
+        for xr in range(1, 60):
+            x = (xr, 1)
+            y = f2_sqrt(bn128.f2_add(bn128.f2_mul(bn128.f2_sqr(x), x), B2))
+            if y is None:
+                continue
+            cand = (x, y)
+            assert bn128.g2_on_curve(cand)
+            if not bn128.g2_in_subgroup(cand):
+                found = cand
+                break
+        assert found is not None, "no torsion point found in scan range"
+        p1 = bn128.G1_GEN
+        data = _w(p1[0]) + _w(p1[1]) + _g2_bytes(found)
+        st, _, _ = eb.bn128_pairing(data, GAS)
+        assert st != 0
+
+
+def _blake2f_input(rounds: int, msg: bytes, final: int = 1) -> bytes:
+    """EIP-152 calldata for one unkeyed blake2b-512 compression over a
+    single sub-128-byte block (rounds ‖ h ‖ m ‖ t0 ‖ t1 ‖ final)."""
+    import struct
+
+    iv = list(eb._BLAKE2_IV)
+    iv[0] ^= 0x01010040  # digest_len=64, fanout=1, depth=1
+    return (
+        rounds.to_bytes(4, "big")
+        + struct.pack("<8Q", *iv)
+        + msg.ljust(128, b"\x00")
+        + struct.pack("<2Q", len(msg), 0)
+        + bytes([final])
+    )
+
+
+import hashlib as _hashlib
+
+
+class TestBlake2f:
+    # 12 rounds over the "abc" block == blake2b-512("abc"); the expected
+    # digest comes from the independent hashlib implementation, and the
+    # leading 8 bytes match EIP-152 vector 5 ("ba80a53f...")
+    VEC_IN = _blake2f_input(12, b"abc")
+    VEC_OUT = _hashlib.blake2b(b"abc").digest()
+
+    def test_eip152_vector(self):
+        st, out, gas_left = eb.blake2f(self.VEC_IN, GAS)
+        assert st == 0
+        assert out == self.VEC_OUT
+        assert gas_left == GAS - 12
+
+    def test_wrong_length_rejected(self):
+        assert eb.blake2f(self.VEC_IN[:-1], GAS)[0] != 0
+        assert eb.blake2f(self.VEC_IN + b"\x00", GAS)[0] != 0
+
+    def test_bad_final_flag_rejected(self):
+        bad = self.VEC_IN[:-1] + b"\x02"
+        assert eb.blake2f(bad, GAS)[0] != 0
+
+    def test_gas_equals_rounds_charged_up_front(self):
+        st, _, gas_left = eb.blake2f(self.VEC_IN, 11)
+        assert st != 0 and gas_left == 0
+
+
+class TestThroughExecutor:
+    """The builtins must be reachable from EVM CALLs at their fixed
+    addresses (TransactionExecutor.cpp:176-189)."""
+
+    @pytest.fixture()
+    def executor(self):
+        ex = TransactionExecutor(MemoryStorage(), ecdsa_suite())
+        ex.next_block_header(BlockHeader(number=1, timestamp=1700000000))
+        return ex
+
+    @staticmethod
+    def _tx(to: bytes, data: bytes) -> Transaction:
+        return Transaction(to=to, input=data, sender=b"\x11" * 20)
+
+    def test_modexp_at_0x05(self, executor):
+        data = _w(1) + _w(1) + _w(1) + b"\x03" + b"\x05" + b"\x07"  # 3^5 mod 7
+        rc = executor.execute_transactions(
+            [self._tx((5).to_bytes(20, "big"), data)]
+        )[0]
+        assert rc.status == 0
+        assert rc.output == b"\x05"  # 243 mod 7
+
+    def test_pairing_at_0x08(self, executor):
+        p = bn128.G1_GEN
+        neg_p = (p[0], bn128.P - p[1])
+        data = (
+            _w(p[0]) + _w(p[1]) + _g2_bytes(bn128.G2_GEN)
+            + _w(neg_p[0]) + _w(neg_p[1]) + _g2_bytes(bn128.G2_GEN)
+        )
+        rc = executor.execute_transactions(
+            [self._tx((8).to_bytes(20, "big"), data)]
+        )[0]
+        assert rc.status == 0
+        assert int.from_bytes(rc.output, "big") == 1
+
+    def test_blake2f_at_0x09(self, executor):
+        rc = executor.execute_transactions(
+            [self._tx((9).to_bytes(20, "big"), TestBlake2f.VEC_IN)]
+        )[0]
+        assert rc.status == 0
+        assert rc.output == TestBlake2f.VEC_OUT
+
+    def test_malformed_pairing_fails_cleanly(self, executor):
+        rc = executor.execute_transactions(
+            [self._tx((8).to_bytes(20, "big"), b"\x01" * 100)]
+        )[0]
+        assert rc.status == int(TransactionStatus.PRECOMPILED_ERROR)
