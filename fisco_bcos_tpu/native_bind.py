@@ -73,16 +73,27 @@ def _build() -> bool:
     return True
 
 
+def _isa_tag() -> str | None:
+    try:
+        with open(_ISA_TAG) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
 def _needs_rebuild() -> bool:
     if not os.path.exists(_LIB):
         return True
     if os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_LIB):
         return True
-    try:
-        with open(_ISA_TAG) as f:
-            return f.read().strip() != _host_isa()
-    except OSError:
-        return True  # unknown provenance: rebuild rather than risk SIGILL
+    tag = _isa_tag()
+    if tag is not None and tag != _host_isa():
+        return True  # -march=native artifact from a different CPU: SIGILL risk
+    if tag is None and os.path.exists(_SRC):
+        return True  # unknown provenance but we CAN rebuild: do it
+    # tag matches, or a source-less prebuilt install (tag absent): trust it —
+    # the stale-symbol guard in load() catches ABI drift
+    return False
 
 
 def load() -> ctypes.CDLL | None:
@@ -95,7 +106,15 @@ def load() -> ctypes.CDLL | None:
         if os.environ.get("FISCO_NO_NATIVE"):
             return None
         if _needs_rebuild():
-            if not os.path.exists(_SRC) or not _build():
+            if not os.path.exists(_SRC):
+                if os.path.exists(_LIB):
+                    _log.warning(
+                        "prebuilt %s was built for a different CPU and no "
+                        "source is available to rebuild; using pure-Python "
+                        "crypto instead", _LIB,
+                    )
+                return None
+            if not _build():
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
