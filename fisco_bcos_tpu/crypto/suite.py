@@ -139,6 +139,15 @@ def _make_keypair(curve: ref_ecdsa.Curve, secret: int | None) -> KeyPair:
 # Signature implementations
 # ---------------------------------------------------------------------------
 
+# Batches below this ride the native host loop instead of the device: a
+# tunneled device program pays a full round trip (~100ms+) regardless of
+# batch size, while the native single-item path is ~0.3ms/sig — the
+# break-even sits near a few hundred items.  PBFT QC signature lists
+# (3-4 sigs per block, BlockValidator.cpp:141-177) and small-block
+# admission are the beneficiaries.  Results are bit-identical across both
+# legs (tests/test_native_ec.py pins it).
+_SMALL_BATCH = 256
+
 
 class SignatureCrypto:
     """Signature interface (reference: Signature.h:31-58) + batch extension.
@@ -308,17 +317,44 @@ class Secp256k1Crypto(SignatureCrypto):
 
     def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
         sigs = np.asarray(sigs, dtype=np.uint8)
-        return secp_ops.verify_batch(
-            np.asarray(msg_hashes, dtype=np.uint8),
-            sigs[:, :32],
-            sigs[:, 32:64],
-            np.asarray(pubs, dtype=np.uint8),
-        )
+        hashes = np.asarray(msg_hashes, dtype=np.uint8)
+        pubs = np.asarray(pubs, dtype=np.uint8)
+        n = len(sigs)
+        if 0 < n < _SMALL_BATCH:
+            from .. import native_bind
+
+            out = native_bind.secp256k1_verify_batch(
+                np.ascontiguousarray(hashes).tobytes(),
+                np.ascontiguousarray(sigs[:, :32]).tobytes(),
+                np.ascontiguousarray(sigs[:, 32:64]).tobytes(),
+                np.ascontiguousarray(pubs).tobytes(),
+                n,
+            )
+            if out is not None:
+                return np.asarray(out, dtype=bool)
+        return secp_ops.verify_batch(hashes, sigs[:, :32], sigs[:, 32:64], pubs)
 
     def batch_recover(self, msg_hashes, sigs):
-        return secp_ops.recover_batch(
-            np.asarray(msg_hashes, dtype=np.uint8), np.asarray(sigs, dtype=np.uint8)
-        )
+        sigs = np.asarray(sigs, dtype=np.uint8)
+        hashes = np.asarray(msg_hashes, dtype=np.uint8)
+        n = len(sigs)
+        if 0 < n < _SMALL_BATCH:
+            from .. import native_bind
+
+            out = native_bind.secp256k1_recover_batch(
+                np.ascontiguousarray(hashes).tobytes(),
+                np.ascontiguousarray(sigs[:, :32]).tobytes(),
+                np.ascontiguousarray(sigs[:, 32:64]).tobytes(),
+                np.ascontiguousarray(sigs[:, 64]).tobytes(),
+                n,
+            )
+            if out is not None:
+                pubs_raw, oks = out
+                pubs = np.frombuffer(pubs_raw, np.uint8).reshape(n, 64).copy()
+                ok = np.asarray(oks, dtype=bool)
+                pubs[~ok] = 0
+                return pubs, ok
+        return secp_ops.recover_batch(hashes, sigs)
 
 
 class SM2Crypto(SignatureCrypto):
@@ -378,19 +414,50 @@ class SM2Crypto(SignatureCrypto):
             raise ValueError("sm2 recover: carried pubkey fails verification")
         return pub
 
+    def _native_batch_verify(self, hashes, pubs, rs, ss):
+        """Native host loop for sub-threshold batches (e computed with the
+        native SM3); None when the native core is unavailable."""
+        from .. import native_bind
+
+        if native_bind.load() is None:
+            return None
+        n = len(hashes)
+        es = b"".join(
+            self._e_bytes(bytes(pubs[i]), bytes(hashes[i])) for i in range(n)
+        )
+        out = native_bind.sm2_verify_batch(
+            es,
+            np.ascontiguousarray(rs).tobytes(),
+            np.ascontiguousarray(ss).tobytes(),
+            np.ascontiguousarray(pubs).tobytes(),
+            n,
+        )
+        return None if out is None else np.asarray(out, dtype=bool)
+
     def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
         sigs = np.asarray(sigs, dtype=np.uint8)
-        return sm2_ops.verify_batch(
-            np.asarray(msg_hashes, dtype=np.uint8),
-            sigs[:, :32],
-            sigs[:, 32:64],
-            np.asarray(pubs, dtype=np.uint8),
-        )
+        hashes = np.asarray(msg_hashes, dtype=np.uint8)
+        pubs = np.asarray(pubs, dtype=np.uint8)
+        if 0 < len(sigs) < _SMALL_BATCH:
+            out = self._native_batch_verify(
+                hashes, pubs, sigs[:, :32], sigs[:, 32:64]
+            )
+            if out is not None:
+                return out
+        return sm2_ops.verify_batch(hashes, sigs[:, :32], sigs[:, 32:64], pubs)
 
     def batch_recover(self, msg_hashes, sigs):
-        return sm2_ops.recover_batch(
-            np.asarray(msg_hashes, dtype=np.uint8), np.asarray(sigs, dtype=np.uint8)
-        )
+        sigs = np.asarray(sigs, dtype=np.uint8)
+        hashes = np.asarray(msg_hashes, dtype=np.uint8)
+        if 0 < len(sigs) < _SMALL_BATCH:
+            pubs = sigs[:, 64:128]
+            ok = self._native_batch_verify(
+                hashes, pubs, sigs[:, :32], sigs[:, 32:64]
+            )
+            if ok is not None:
+                out = np.where(ok[:, None], pubs, np.zeros_like(pubs))
+                return out, ok
+        return sm2_ops.recover_batch(hashes, sigs)
 
 
 # ---------------------------------------------------------------------------

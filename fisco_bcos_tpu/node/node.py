@@ -47,6 +47,13 @@ class NodeConfig:
     # fetched and derived at boot, overriding data_key
     key_center: str = ""
     cipher_data_key: str = ""
+    # Max topology (TarsRemoteExecutorManager): non-empty "host:port" hosts
+    # an executor registry here and replaces the in-process executor with
+    # the remote fleet (CompositeRemoteExecutor); port 0 picks a free port.
+    # executor_min = executors to wait for at boot
+    # (waitForExecutorConnection).
+    executor_registry: str = ""
+    executor_min: int = 1
     genesis: GenesisConfig = field(default_factory=GenesisConfig)
 
 
@@ -103,12 +110,33 @@ class Node:
             block_limit=config.block_limit,
             persistent_store=self.storage if durable else None,
         )
-        self.executor = TransactionExecutor(
-            self.storage, self.suite, is_wasm=config.genesis.is_wasm
-        )
+        self.executor_manager = None
+        if config.executor_registry:
+            # Max form: stateless executor fleet over the shared storage
+            # service, discovered via the registry servant hosted here
+            from ..service.remote_manager import (
+                CompositeRemoteExecutor,
+                RemoteExecutorManager,
+            )
+
+            host, port = config.executor_registry.rsplit(":", 1)
+            self.executor_manager = RemoteExecutorManager(host, int(port))
+            self.executor_manager.start()
+            self.executor_manager.wait_for_executors(config.executor_min)
+            self.executor = CompositeRemoteExecutor(self.executor_manager)
+        else:
+            self.executor = TransactionExecutor(
+                self.storage, self.suite, is_wasm=config.genesis.is_wasm
+            )
         self.scheduler = Scheduler(
             self.executor, self.ledger, self.storage, self.suite, self.txpool
         )
+        if self.executor_manager is not None:
+            # fleet change mid-block = in-flight execution is suspect:
+            # drop the term like a storage switch (asyncSwitchTerm analog)
+            self.executor_manager.on_change.append(
+                lambda _term: self.scheduler.switch_term()
+            )
         # storage failover seam (Initializer.cpp:225-235): backend loss
         # drops the in-flight scheduler term instead of wedging consensus
         if hasattr(raw_storage, "set_switch_handler"):

@@ -55,6 +55,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--sealer-interval", type=float, default=0.2)
     ap.add_argument("--warmup", type=int, default=0, metavar="B")
     ap.add_argument("--sm", action="store_true", help="SM crypto suite")
+    ap.add_argument(
+        "--executor-registry-port", type=int, default=-1, metavar="PORT",
+        help="Max form: host an executor registry on this port and use the "
+        "remote executor fleet instead of the in-process executor",
+    )
+    ap.add_argument(
+        "--executors", type=int, default=1,
+        help="Max form: executors to wait for at boot",
+    )
     args = ap.parse_args(argv)
 
     from ..crypto.suite import ecdsa_suite, sm_suite
@@ -76,9 +85,19 @@ def main(argv: list[str] | None = None) -> int:
         sm_crypto=args.sm,
         db_path=args.db or ":memory:",
         storage_endpoints=args.storage,
+        executor_registry=(
+            f"127.0.0.1:{args.executor_registry_port}"
+            if args.executor_registry_port >= 0
+            else ""
+        ),
+        executor_min=args.executors,
         genesis=genesis,
     )
     node = Node(cfg, keypair=kp)
+    if node.executor_manager is not None:
+        print(
+            f"REGISTRY port={node.executor_manager.port}", flush=True
+        )
 
     # gateway-as-a-process: outbound frames go to the gateway service,
     # inbound ones come back through our FrontEndpoint server

@@ -425,6 +425,7 @@ def window_at(k: jax.Array, wi: jax.Array) -> jax.Array:
     dynamic_slice, so the same code lowers under Mosaic (Pallas TPU), where
     ``lax.scan`` over a precomputed [64, T] window array would not (its xs
     slicing needs dynamic_slice)."""
+    wi = jnp.asarray(wi)  # plain int under eager fori_loop (disable_jit)
     li = wi // (16 // WINDOW)  # limb index 0..15
     sh = (wi % (16 // WINDOW)).astype(jnp.uint32) * WINDOW
     r = limb.row(k, 0)

@@ -267,6 +267,19 @@ class ExecutorShard:
         self._ctx_storage.pop(ctx, None)
         self._next_seq.pop(ctx, None)
 
+    def reset(self) -> None:
+        """Drop ALL per-block DMC state — called when a new block opens.
+
+        Without this, a block abandoned mid-execution (Max form: an
+        executor died, the scheduler re-executes on the survivors) leaves
+        parked executives and context overlays layered on the DEAD block's
+        storage; the re-execution would then reuse the same context ids,
+        merge writes into the abandoned storage, and drop them from the
+        new block's state root — silent state loss."""
+        self.parked.clear()
+        self._next_seq.clear()
+        self._ctx_storage.clear()
+
     def commit_context(self, ctx: int) -> None:
         """Merge the context overlay into the block state (top-level OK)."""
         st = self._ctx_storage.pop(ctx, None)

@@ -39,6 +39,14 @@ class SchedulerError(Exception):
         self.code = code
 
 
+def _is_executor_loss(e: Exception) -> bool:
+    """An RPC failure against a remote executor (Max form) — retryable
+    after the fleet drops the dead member."""
+    from ..service.rpc import ServiceRemoteError
+
+    return isinstance(e, (ServiceRemoteError, ConnectionError, OSError))
+
+
 @dataclass
 class ExecutedBlock:
     header: BlockHeader
@@ -146,22 +154,47 @@ class Scheduler:
             block.transactions = txs
         timer.stage("fillBlock", txs=len(txs))
 
-        self.executor.next_block_header(block.header)
         dag_idx = [
             i for i, t in enumerate(txs) if t.attribute & TransactionAttribute.DAG
         ]
         serial_idx = [
             i for i, t in enumerate(txs) if not (t.attribute & TransactionAttribute.DAG)
         ]
-        receipts = [None] * len(txs)
-        if dag_idx:
-            dag_rcs = self.executor.dag_execute_transactions([txs[i] for i in dag_idx])
-            for i, rc in zip(dag_idx, dag_rcs):
-                receipts[i] = rc
-        if serial_idx:
-            ser_rcs = self.executor.execute_transactions([txs[i] for i in serial_idx])
-            for i, rc in zip(serial_idx, ser_rcs):
-                receipts[i] = rc
+
+        def run_block():
+            self.executor.next_block_header(block.header)
+            receipts = [None] * len(txs)
+            if dag_idx:
+                dag_rcs = self.executor.dag_execute_transactions(
+                    [txs[i] for i in dag_idx]
+                )
+                for i, rc in zip(dag_idx, dag_rcs):
+                    receipts[i] = rc
+            if serial_idx:
+                ser_rcs = self.executor.execute_transactions(
+                    [txs[i] for i in serial_idx]
+                )
+                for i, rc in zip(serial_idx, ser_rcs):
+                    receipts[i] = rc
+            return receipts
+
+        try:
+            receipts = run_block()
+        except Exception as e:
+            # Max form: an executor died mid-block. The composite executor
+            # already dropped it from the fleet (term bump); stateless
+            # executors over shared storage make whole-block re-execution
+            # sound — the SchedulerManager term-switch-and-retry
+            # (TarsRemoteExecutorManager executor loss -> asyncSwitchTerm).
+            if not _is_executor_loss(e) or not hasattr(
+                self.executor, "replay_block_header"
+            ):
+                raise
+            _log.warning(
+                "executor fleet changed mid-block %d (%s): re-executing on "
+                "the survivors", number, e,
+            )
+            receipts = run_block()
         block.receipts = receipts  # type: ignore[assignment]
         timer.stage("execute", dag=len(dag_idx), serial=len(serial_idx))
 

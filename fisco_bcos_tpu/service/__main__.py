@@ -50,6 +50,10 @@ def main(argv: list[str] | None = None) -> int:
     e.add_argument("--port", type=int, default=0)
     e.add_argument("--sm", action="store_true", help="SM crypto suite")
     e.add_argument("--name", default="executor")
+    e.add_argument(
+        "--registry", default="",
+        help="Max form: executor-registry host:port to join (heartbeats)",
+    )
     args = ap.parse_args(argv)
 
     stop = threading.Event()
@@ -100,6 +104,9 @@ def main(argv: list[str] | None = None) -> int:
         executor = TransactionExecutor(store, suite)
         svc = ExecutorService(executor, name=args.name, port=args.port)
         svc.start()
+        if args.registry:
+            rhost, rport = args.registry.rsplit(":", 1)
+            svc.register_with(rhost, int(rport))
         print(f"READY service={svc.port}", flush=True)
         stop.wait()
         svc.stop()

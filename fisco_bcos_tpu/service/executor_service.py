@@ -18,6 +18,8 @@ ride the messages).
 
 from __future__ import annotations
 
+import threading
+
 from ..codec.flat import FlatReader, FlatWriter
 from ..protocol.block_header import BlockHeader
 from ..protocol.receipt import TransactionReceipt
@@ -46,6 +48,7 @@ class ExecutorService:
         self, executor, host: str = "127.0.0.1", port: int = 0, name: str = "executor0"
     ):
         self.executor = executor
+        self._name = name
         self.shard = ExecutorShard(executor, name)
         self.server = ServiceServer("executor", host, port)
         s = self.server
@@ -56,6 +59,7 @@ class ExecutorService:
         s.register("call", self._call)
         s.register("get_code", self._get_code)
         s.register("get_abi", self._get_abi)
+        s.register("known_callee", self._known_callee)
         s.register("prepare", self._prepare)
         s.register("commit", self._commit)
         s.register("rollback", self._rollback)
@@ -72,7 +76,54 @@ class ExecutorService:
     def start(self) -> None:
         self.server.start()
 
+    def register_with(
+        self, registry_host: str, registry_port: int, interval: float = 1.0
+    ) -> None:
+        """Join a Max-topology executor fleet: register with the scheduler's
+        registry servant, then heartbeat with this process's status seq
+        (TarsRemoteExecutorManager's endpoint+seq discovery, push-based: the
+        tars name service is replaced by direct registration).  A heartbeat
+        answered with "unknown" re-registers — the registry restarted."""
+        import time as _time
+
+        self._seq = getattr(self, "_seq", int(_time.time() * 1000) % (1 << 31))
+        self._hb_stop = threading.Event()
+        client = ServiceClient(registry_host, registry_port, timeout=5.0)
+
+        def _register() -> None:
+            w = FlatWriter()
+            w.str_(self._name)
+            w.str_(self.host)
+            w.i64(self.port)
+            w.i64(self._seq)
+            client.call("register", w.out())
+
+        def _loop() -> None:
+            try:
+                _register()
+            except Exception:
+                pass
+            while not self._hb_stop.wait(interval):
+                try:
+                    w = FlatWriter()
+                    w.str_(self._name)
+                    w.i64(self._seq)
+                    resp = client.call("heartbeat", w.out())
+                    r = FlatReader(resp)
+                    if r.u32() != 0:  # registry lost us: re-register
+                        _register()
+                except Exception:
+                    continue  # registry down/restarting; keep trying
+
+        self._hb_thread = threading.Thread(
+            target=_loop, name=f"hb-{self._name}", daemon=True
+        )
+        self._hb_thread.start()
+
     def stop(self) -> None:
+        hb = getattr(self, "_hb_stop", None)
+        if hb is not None:
+            hb.set()
         self.server.stop()
 
     # -- handlers -------------------------------------------------------------
@@ -82,6 +133,10 @@ class ExecutorService:
         header = BlockHeader.decode(r.bytes_())
         gas_limit = r.u64()
         r.done()
+        # a new block invalidates all DMC state from the previous one —
+        # including a block ABANDONED mid-execution (executor-loss retry):
+        # stale contexts must not merge writes into the dead block storage
+        self.shard.reset()
         self.executor.next_block_header(header, gas_limit=gas_limit)
         return b""
 
@@ -116,6 +171,11 @@ class ExecutorService:
 
     def _get_code(self, payload: bytes) -> bytes:
         return self.executor.get_code(payload)
+
+    def _known_callee(self, payload: bytes) -> bytes:
+        w = FlatWriter()
+        w.u32(1 if self.executor.known_callee(payload) else 0)
+        return w.out()
 
     def _get_abi(self, payload: bytes) -> bytes:
         return self.executor.get_abi(payload)
@@ -256,6 +316,12 @@ class RemoteExecutor:
 
     def get_abi(self, addr: bytes) -> bytes:
         return self.client.call("get_abi", bytes(addr))
+
+    def known_callee(self, addr: bytes) -> bool:
+        r = FlatReader(self.client.call("known_callee", bytes(addr)))
+        v = r.u32()
+        r.done()
+        return bool(v)
 
     def prepare(self, params: TwoPCParams, extra_writes: StorageInterface | None = None) -> None:
         w = FlatWriter()

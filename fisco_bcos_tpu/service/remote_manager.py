@@ -1,0 +1,406 @@
+"""Max-topology executor fleet: registry, heartbeats, failover.
+
+Reference: bcos-scheduler/src/TarsRemoteExecutorManager.cpp (the Max
+architecture's remote-executor discovery: a work loop polls the tars name
+service for active ExecutorService endpoints and each executor's status
+seq; any membership or seq change triggers executor-set rebuild and a
+scheduler term switch via SchedulerManager::onExecutorSwitch) and
+ExecutorManager.h:29-37 (contract -> executor dispatch).
+
+The tars name service is replaced by a registry servant hosted INSIDE the
+scheduler process: executor services call ``register`` once and
+``heartbeat`` periodically over the same service RPC used for execution
+traffic.  The manager reaps executors whose heartbeat goes stale and
+notices seq changes (an executor that restarted lost its in-memory block
+context even though its state lives in the shared storage service), both
+of which bump ``term`` and invalidate in-flight blocks — the caller
+re-executes against the surviving fleet, which works because Max
+executors are STATELESS over shared distributed storage (TiKVStorage in
+the reference; the storage service here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..protocol.block_header import BlockHeader
+from ..protocol.receipt import TransactionReceipt
+from ..protocol.transaction import Transaction
+from ..storage.interfaces import TwoPCParams
+from ..utils.log import get_logger
+from .executor_service import RemoteExecutor, RemoteShard
+from .rpc import ServiceClient, ServiceRemoteError, ServiceServer
+
+_log = get_logger("remote-exec-manager")
+
+
+class _Member:
+    def __init__(self, name: str, host: str, port: int, seq: int, now: float):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.seq = seq
+        self.last_seen = now
+        self.executor = RemoteExecutor(host, port)
+        self.shard = RemoteShard(host, port, name)
+
+    def close(self) -> None:
+        self.executor.close()
+        try:
+            self.shard.client.close()
+        except Exception:
+            pass
+
+
+class RemoteExecutorManager:
+    """Registry + live executor set + contract dispatch (Max form).
+
+    ``term`` increments on every membership/seq change; callers snapshot it
+    before executing a block and re-execute when it moved (the
+    SchedulerManager::asyncSwitchTerm analog for executor loss)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_timeout: float = 6.0,
+    ):
+        self.heartbeat_timeout = heartbeat_timeout
+        self.term = 0
+        self.on_change: list = []  # cb(term) after every fleet change
+        self._members: dict[str, _Member] = {}
+        self._lock = threading.RLock()
+        self.server = ServiceServer("executor-registry", host, port)
+        self.server.register("register", self._rpc_register)
+        self.server.register("heartbeat", self._rpc_heartbeat)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+        # the reaper IS the failure detector: without it a hung executor
+        # (heartbeats stop, socket stays open) would stall block RPCs for
+        # the full client timeout instead of being dropped within
+        # heartbeat_timeout (TarsRemoteExecutorManager's executeWorker loop)
+        self._reap_stop = threading.Event()
+        self._reap_thread = threading.Thread(
+            target=self._reap_loop, name="executor-reaper", daemon=True
+        )
+        self._reap_thread.start()
+
+    def _reap_loop(self) -> None:
+        interval = max(0.2, self.heartbeat_timeout / 3.0)
+        while not self._reap_stop.wait(interval):
+            try:
+                self.reap()
+            except Exception:
+                _log.exception("reaper pass failed")
+
+    def stop(self) -> None:
+        stop = getattr(self, "_reap_stop", None)
+        if stop is not None:
+            stop.set()
+        self.server.stop()
+        with self._lock:
+            for m in self._members.values():
+                m.close()
+            self._members.clear()
+
+    @property
+    def host(self) -> str:
+        return self.server.host
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    # -- registry servant ----------------------------------------------------
+
+    def _rpc_register(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        name, host, port, seq = r.str_(), r.str_(), r.i64(), r.i64()
+        r.done()
+        self._admit(name, host, int(port), int(seq))
+        return b""
+
+    def _rpc_heartbeat(self, payload: bytes) -> bytes:
+        r = FlatReader(payload)
+        name, seq = r.str_(), r.i64()
+        r.done()
+        changed = False
+        with self._lock:
+            m = self._members.get(name)
+            if m is None:
+                w = FlatWriter()
+                w.u32(1)  # unknown: executor must re-register
+                return w.out()
+            if m.seq != seq:
+                # restarted executor: its block context is gone
+                _log.warning(
+                    "executor %s seq %d -> %d (restart): term switch",
+                    name, m.seq, seq,
+                )
+                m.seq = int(seq)
+                m.last_seen = time.monotonic()
+                changed = True
+            else:
+                m.last_seen = time.monotonic()
+        if changed:
+            self._bump()
+        w = FlatWriter()
+        w.u32(0)
+        return w.out()
+
+    def _admit(self, name: str, host: str, port: int, seq: int) -> None:
+        with self._lock:
+            old = self._members.pop(name, None)
+            if old is not None:
+                old.close()
+            self._members[name] = _Member(name, host, port, seq, time.monotonic())
+            _log.info(
+                "executor %s registered at %s:%d seq=%d (%d live)",
+                name, host, port, seq, len(self._members),
+            )
+        self._bump()
+
+    # -- liveness ------------------------------------------------------------
+
+    def reap(self) -> bool:
+        """Drop members whose heartbeat went stale; True when the fleet
+        changed (TarsRemoteExecutorManager::refresh's endpoint-set diff)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [
+                n for n, m in self._members.items()
+                if now - m.last_seen > self.heartbeat_timeout
+            ]
+            for n in stale:
+                _log.warning("executor %s heartbeat stale: dropping", n)
+                self._members.pop(n).close()
+        if stale:
+            self._bump()
+        return bool(stale)
+
+    def mark_dead(self, name: str) -> None:
+        """Immediate removal after an observed RPC failure (faster than
+        waiting out the heartbeat timeout)."""
+        with self._lock:
+            m = self._members.pop(name, None)
+            if m is not None:
+                _log.warning("executor %s marked dead after RPC failure", name)
+                m.close()
+        if m is not None:
+            self._bump()
+
+    def _bump(self) -> None:
+        """Advance the term and notify listeners. Deliberately OUTSIDE the
+        member lock: listeners take their own locks (scheduler term switch),
+        and an executor-death callback racing a reaper must not ABBA."""
+        with self._lock:
+            self.term += 1
+            term = self.term
+        for cb in list(self.on_change):
+            try:
+                cb(term)
+            except Exception:
+                _log.exception("on_change callback failed")
+
+    # -- dispatch ------------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def members(self) -> list[_Member]:
+        with self._lock:
+            return sorted(self._members.values(), key=lambda m: m.name)
+
+    def shard_of(self, contract: bytes) -> RemoteShard:
+        return self._member_of(contract).shard
+
+    def _member_of(self, contract: bytes) -> _Member:
+        live = self.members()
+        if not live:
+            raise RuntimeError("no live executors")
+        idx = int.from_bytes(contract[-4:] or b"\x00", "big") % len(live)
+        return live[idx]
+
+    def wait_for_executors(self, n: int = 1, timeout: float = 30.0) -> None:
+        """Block until at least n executors registered
+        (TarsRemoteExecutorManager::waitForExecutorConnection)."""
+        deadline = time.monotonic() + timeout
+        while self.size < n:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"only {self.size}/{n} executors connected after {timeout}s"
+                )
+            time.sleep(0.05)
+
+
+class _ShardGuard:
+    """Forwards shard calls to a member's RemoteShard; an RPC failure marks
+    the member dead on the manager before re-raising."""
+
+    def __init__(self, member: _Member, manager: RemoteExecutorManager):
+        self._member = member
+        self._manager = manager
+
+    @property
+    def name(self) -> str:
+        return self._member.shard.name
+
+    def __getattr__(self, item):
+        attr = getattr(self._member.shard, item)
+        if not callable(attr):
+            return attr
+        member, manager = self._member, self._manager
+
+        def wrapped(*a, **kw):
+            try:
+                return attr(*a, **kw)
+            except (ServiceRemoteError, OSError) as e:
+                manager.mark_dead(member.name)
+                raise ServiceRemoteError(
+                    f"executor {member.name} failed: {e}"
+                ) from e
+
+        return wrapped
+
+
+class CompositeRemoteExecutor:
+    """The scheduler's single-executor seam over a fleet of remote
+    executors (Max form): contract-partitioned dispatch, DMC for serial
+    batches (cross-contract calls migrate between executor processes),
+    XOR-combined state roots, fanned-out 2PC.
+
+    Any RPC failure marks the executor dead on the manager (term bump) and
+    re-raises — the block driver re-executes against the survivors, which
+    is sound because executors share one storage service."""
+
+    def __init__(self, manager: RemoteExecutorManager):
+        self.manager = manager
+        self._header: BlockHeader | None = None
+        self._gas_limit = 3_000_000_000
+        # one guard per member name: DMCScheduler dedups shards by identity
+        # ({shard_of(tx.to) for tx in txs}), so shard_failfast must return
+        # the SAME object for the same member across calls
+        self._guards: dict[str, object] = {}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _fanout(self, fn, *args):
+        out = []
+        for m in self.manager.members():
+            try:
+                out.append((m, fn(m, *args)))
+            except (ServiceRemoteError, OSError) as e:
+                self.manager.mark_dead(m.name)
+                raise ServiceRemoteError(f"executor {m.name} failed: {e}") from e
+        return out
+
+    def _on_member(self, m: _Member, fn, *args):
+        try:
+            return fn(*args)
+        except (ServiceRemoteError, OSError) as e:
+            self.manager.mark_dead(m.name)
+            raise ServiceRemoteError(f"executor {m.name} failed: {e}") from e
+
+    # -- executor surface ----------------------------------------------------
+
+    def next_block_header(self, header: BlockHeader, gas_limit: int = 3_000_000_000) -> None:
+        self._header = header
+        self._gas_limit = gas_limit
+        self._fanout(lambda m: m.executor.next_block_header(header, gas_limit))
+
+    def replay_block_header(self) -> None:
+        """Re-open the current block on the (possibly changed) fleet after a
+        term switch: new members never saw next_block_header."""
+        if self._header is not None:
+            self.next_block_header(self._header, self._gas_limit)
+
+    def execute_transactions(self, txs: list[Transaction]) -> list[TransactionReceipt]:
+        from ..scheduler.dmc import DMCScheduler
+
+        sched = DMCScheduler(lambda c: self.shard_failfast(c))
+        return sched.execute(txs)
+
+    def shard_failfast(self, contract: bytes):
+        m = self.manager._member_of(contract)
+        guard = self._guards.get(m.name)
+        if guard is None or guard._member is not m:  # member was replaced
+            guard = _ShardGuard(m, self.manager)
+            self._guards[m.name] = guard
+        return guard
+
+    def dag_execute_transactions(self, txs: list[Transaction]) -> list[TransactionReceipt]:
+        """Partition the conflict-free batch by owning executor and run each
+        partition in one RPC (BlockExecutive's DAG dispatch across the
+        executor fleet)."""
+        by_member: dict[str, tuple[_Member, list[int]]] = {}
+        for i, tx in enumerate(txs):
+            m = self.manager._member_of(tx.to)
+            by_member.setdefault(m.name, (m, []))[1].append(i)
+        receipts: list[TransactionReceipt | None] = [None] * len(txs)
+        for m, idxs in by_member.values():
+            rcs = self._on_member(
+                m, m.executor.dag_execute_transactions, [txs[i] for i in idxs]
+            )
+            for i, rc in zip(idxs, rcs):
+                receipts[i] = rc
+        return receipts  # type: ignore[return-value]
+
+    def get_hash(self) -> bytes:
+        """XOR of per-executor dirty-set roots — ownership partitions are
+        disjoint, so the combined root is order-independent (the same
+        combiner the single-process state root uses across shards)."""
+        roots = self._fanout(lambda m: m.executor.get_hash())
+        out = bytes(32)
+        for _m, r in roots:
+            out = bytes(a ^ b for a, b in zip(out, r))
+        return out
+
+    def get_hash_async(self):
+        out = self.get_hash()
+        return lambda: out
+
+    def call(self, tx: Transaction) -> TransactionReceipt:
+        m = self.manager._member_of(tx.to)
+        return self._on_member(m, m.executor.call, tx)
+
+    def get_code(self, addr: bytes) -> bytes:
+        m = self.manager._member_of(addr)
+        return self._on_member(m, m.executor.get_code, addr)
+
+    def get_abi(self, addr: bytes) -> bytes:
+        m = self.manager._member_of(addr)
+        return self._on_member(m, m.executor.get_abi, addr)
+
+    def known_callee(self, addr: bytes, storage=None) -> bool:
+        """The owner executor answers (registry precompiles, EVM builtins,
+        deployed code) — same admission semantics as the in-process form."""
+        m = self.manager._member_of(addr)
+        return self._on_member(m, m.executor.known_callee, addr)
+
+    # -- 2PC -----------------------------------------------------------------
+
+    def prepare(self, params: TwoPCParams, extra_writes=None) -> None:
+        # extra_writes (the ledger rows) go through ONE member only — the
+        # executors share a single storage backend, and staging the same
+        # rows from every member would double-write the 2PC slot
+        first = True
+        for m in self.manager.members():
+            try:
+                m.executor.prepare(params, extra_writes if first else None)
+            except (ServiceRemoteError, OSError) as e:
+                self.manager.mark_dead(m.name)
+                raise ServiceRemoteError(f"executor {m.name} failed: {e}") from e
+            first = False
+
+    def commit(self, params: TwoPCParams) -> None:
+        self._fanout(lambda m: m.executor.commit(params))
+
+    def rollback(self, params: TwoPCParams) -> None:
+        self._fanout(lambda m: m.executor.rollback(params))

@@ -39,15 +39,20 @@ class MemoryStorage(TransactionalStorage):
     # -- 2PC ------------------------------------------------------------
 
     def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
+        """Stage writes for `number`. PER-KEY MERGE, not slot replacement:
+        a Max-form block is prepared by several executor participants, each
+        staging its own (disjoint) dirty set into the same number — TiKV's
+        multi-participant prewrite semantics. Re-preparing the same key
+        (block re-execution after a term switch) overwrites per key."""
         with self._lock:
-            self._pending[params.number] = [
-                (t, k, e.copy()) for t, k, e in writes.traverse()
-            ]
+            slot = self._pending.setdefault(params.number, {})
+            for t, k, e in writes.traverse():
+                slot[(t, bytes(k))] = e.copy()
 
     def commit(self, params: TwoPCParams) -> None:
         with self._lock:
-            for t, k, e in self._pending.pop(params.number, []):
-                self._data[(t, bytes(k))] = e
+            for (t, k), e in self._pending.pop(params.number, {}).items():
+                self._data[(t, k)] = e
 
     def rollback(self, params: TwoPCParams) -> None:
         with self._lock:

@@ -74,16 +74,20 @@ class SQLiteStorage(TransactionalStorage):
     # -- 2PC ------------------------------------------------------------
 
     def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
-        staged = [(t, bytes(k), e.copy()) for t, k, e in writes.traverse()]
+        """Per-key merge into the number's slot (multi-participant 2PC:
+        several Max executors prepare the same block; see
+        MemoryStorage.prepare)."""
         with self._lock:
-            self._pending[params.number] = staged
+            slot = self._pending.setdefault(params.number, {})
+            for t, k, e in writes.traverse():
+                slot[(t, bytes(k))] = e.copy()
 
     def commit(self, params: TwoPCParams) -> None:
         with self._lock:
-            staged = self._pending.pop(params.number, [])
+            staged = self._pending.pop(params.number, {})
             self._conn.executemany(
                 "INSERT OR REPLACE INTO kv (tbl, k, v) VALUES (?, ?, ?)",
-                [(t, k, e.encode()) for t, k, e in staged],
+                [(t, k, e.encode()) for (t, k), e in staged.items()],
             )
             self._conn.commit()
 

@@ -215,6 +215,15 @@ done
 exit 0
 """
 
+# Max nodes additionally run N executor services; *.pid catches them all
+_MAX_STOP_SH = """#!/bin/bash
+cd "$(dirname "$0")"
+for pid in *.pid; do
+    [ -f "$pid" ] && kill "$(cat "$pid")" 2>/dev/null && rm -f "$pid"
+done
+exit 0
+"""
+
 
 def build_pro_chain(
     out_dir: str,
@@ -316,6 +325,140 @@ def build_pro_chain(
     return node_dirs
 
 
+def build_max_chain(
+    out_dir: str,
+    count: int,
+    executors: int = 2,
+    host: str = "127.0.0.1",
+    port_base: int = 40000,
+    sm: bool = False,
+    chain_id: str = "chain0",
+    group_id: str = "group0",
+) -> list[str]:
+    """Generate a Max-topology deployment: ONE shared storage service (the
+    TiKV analog), and per consensus node a gateway service, the node core
+    hosting an executor registry, an RPC front door, and a fleet of
+    ``executors`` stateless executor processes that register with the core
+    and heartbeat (killing one mid-block is survivable — the scheduler
+    term-switches and re-executes on the survivors).
+
+    Reference: tools/BcosBuilder max profile + fisco-bcos-tars-service
+    (every subsystem its own service; TarsRemoteExecutorManager discovery).
+    Port block: base = shared storage; per node i at base+20(i+1):
+    +0 gateway svc, +1 p2p, +2 facade, +3 rpc, +4 registry,
+    +5.. executor services.
+    """
+    from ..crypto.suite import ecdsa_suite, sm_suite
+
+    from .config import save_keypair
+
+    if not 1 <= executors <= 14:
+        # the per-node port block is 20 wide (5 fixed + executor slots);
+        # more executors would collide with the next node's block
+        raise ValueError(f"max mode supports 1..14 executors per node, got {executors}")
+    suite = sm_suite() if sm else ecdsa_suite()
+    os.makedirs(out_dir, exist_ok=True)
+    keypairs = [suite.signature_impl.generate_keypair() for _ in range(count)]
+    genesis = _genesis_text([kp.pub.hex() for kp in keypairs], chain_id, group_id)
+    sm_flag = " --sm" if sm else ""
+
+    storage_port = port_base
+    _write_exec(
+        os.path.join(out_dir, "start_storage.sh"),
+        _PRO_SVC_SH.format(
+            python=sys.executable,
+            module="fisco_bcos_tpu.service",
+            args=f"storage --db max_chain.db --port {storage_port}",
+            name="storage",
+        ),
+    )
+
+    def ports(i):
+        b = port_base + 20 * (i + 1)
+        return {
+            "gwsvc": b, "p2p": b + 1, "facade": b + 2, "rpc": b + 3,
+            "registry": b + 4, "exec0": b + 5,
+        }
+
+    node_dirs = []
+    for i in range(count):
+        ndir = os.path.join(out_dir, f"node{i}")
+        conf = os.path.join(ndir, "conf")
+        os.makedirs(conf, exist_ok=True)
+        p = ports(i)
+        with open(os.path.join(ndir, "config.genesis"), "w") as f:
+            f.write(genesis)
+        save_keypair(os.path.join(conf, "node.key"), keypairs[i])
+        peers = ",".join(
+            f"{host}:{ports(j)['p2p']}" for j in range(count) if j != i
+        )
+        svcs = [
+            (
+                "gateway",
+                "fisco_bcos_tpu.service",
+                f"gateway --node-id {keypairs[i].pub.hex()} "
+                f"--service-port {p['gwsvc']} --p2p-port {p['p2p']}"
+                + (f" --peers {peers}" if peers else ""),
+            ),
+            (
+                "core",
+                "fisco_bcos_tpu.node.pro_node",
+                f"-g config.genesis --key conf/node.key "
+                f"--gateway {host}:{p['gwsvc']} --storage {host}:{storage_port} "
+                f"--facade-port {p['facade']} "
+                f"--executor-registry-port {p['registry']} "
+                f"--executors {executors}" + sm_flag,
+            ),
+            (
+                "rpc",
+                "fisco_bcos_tpu.service",
+                f"rpc --facade {host}:{p['facade']} --port {p['rpc']}",
+            ),
+        ]
+        for e in range(executors):
+            svcs.append(
+                (
+                    f"executor{e}",
+                    "fisco_bcos_tpu.service",
+                    f"executor --storage {host}:{storage_port} "
+                    f"--port {p['exec0'] + e} --name node{i}-executor{e} "
+                    f"--registry {host}:{p['registry']}" + sm_flag,
+                )
+            )
+        for name, module, svc_args in svcs:
+            _write_exec(
+                os.path.join(ndir, f"start_{name}.sh"),
+                _PRO_SVC_SH.format(
+                    python=sys.executable, module=module, args=svc_args, name=name
+                ),
+            )
+        exec_starts = "".join(
+            f"./start_executor{e}.sh\n" for e in range(executors)
+        )
+        _write_exec(
+            os.path.join(ndir, "start.sh"),
+            "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n"
+            "./start_gateway.sh\nsleep 0.5\n"
+            + exec_starts
+            + "sleep 0.5\n./start_core.sh\nsleep 1\n./start_rpc.sh\n",
+        )
+        _write_exec(os.path.join(ndir, "stop.sh"), _MAX_STOP_SH)
+        node_dirs.append(ndir)
+
+    _write_exec(
+        os.path.join(out_dir, "start_all.sh"),
+        "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n./start_storage.sh\nsleep 1\n"
+        + "".join(f"./node{i}/start.sh\n" for i in range(count)),
+    )
+    _write_exec(
+        os.path.join(out_dir, "stop_all.sh"),
+        "#!/bin/bash\ncd \"$(dirname \"$0\")\"\n"
+        + "".join(f"./node{i}/stop.sh\n" for i in range(count))
+        + "pkill -f 'fisco_bcos_tpu.service storage' 2>/dev/null\ntrue\n",
+    )
+    return node_dirs
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="build_chain", description=__doc__)
     ap.add_argument("-l", "--listen", default="127.0.0.1:4", help="host:count")
@@ -327,14 +470,35 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--group-id", default="group0")
     ap.add_argument(
         "--mode",
-        choices=("air", "pro"),
+        choices=("air", "pro", "max"),
         default="air",
         help="air = one process per node; pro = storage/gateway/core/rpc "
-        "as separate service processes per node (BcosBuilder analog)",
+        "as separate service processes per node (BcosBuilder analog); "
+        "max = shared storage + per-node executor fleet with registry "
+        "discovery and failover",
+    )
+    ap.add_argument(
+        "--executors", type=int, default=2,
+        help="max mode: executor services per node",
     )
     args = ap.parse_args(argv)
 
     host, count = args.listen.rsplit(":", 1)
+    if args.mode == "max":
+        if args.ssl:
+            ap.error("--ssl is not supported with --mode max")
+        dirs = build_max_chain(
+            args.output,
+            int(count),
+            executors=args.executors,
+            host=host,
+            port_base=int(args.ports.split(",")[0]),
+            sm=args.sm,
+            chain_id=args.chain_id,
+            group_id=args.group_id,
+        )
+        print(f"generated {len(dirs)} max node groups under {args.output}/")
+        return 0
     if args.mode == "pro":
         if args.ssl:
             ap.error(

