@@ -103,9 +103,12 @@ class TransactionExecutor:
         suite: CryptoSuite,
         registry: dict[bytes, Precompiled] | None = None,
         is_wasm: bool = False,
+        wasm_gas_mode: str = "dispatch",
     ):
         self.backend = backend
         self.suite = suite
+        # chain-level WASM metering strategy (GenesisConfig.wasm_gas_mode)
+        self.wasm_gas_mode = wasm_gas_mode
         self.codec = ABICodec(suite.hash)
         self.registry = registry if registry is not None else default_registry()
         # chain VM type from the genesis `is_wasm` flag (the reference gates
@@ -518,7 +521,7 @@ class Executive:
                 data=b"", gas=msg.gas, value=msg.value, depth=msg.depth,
             )
             if deploying_wasm:
-                gen = wasm_deploy(host, run_msg, msg.data)
+                gen = wasm_deploy(host, run_msg, msg.data, self.ex.wasm_gas_mode)
             else:
                 gen = interpret(host, run_msg, msg.data)
             self.frames.append(_ExecFrame(gen, overlay, msg, addr, abi))
@@ -544,7 +547,7 @@ class Executive:
         # prefix dispatch would then run wasm on an EVM chain, bypassing
         # the genesis gate the deploy path enforces
         if self.ex.is_wasm:
-            gen = wasm_interpret(host, msg, code)
+            gen = wasm_interpret(host, msg, code, self.ex.wasm_gas_mode)
         else:
             gen = interpret(host, msg, code)
         self.frames.append(_ExecFrame(gen, overlay, msg))

@@ -17,17 +17,21 @@ contract conventions:
 - parameters are SCALE-coded (codec/scale.py) — fixed-width little-endian
   ints, compact vectors — matching the reference's ScaleEncoderStream;
 - gas is metered deterministically at bytecode level from a per-opcode
-  schedule (the reference's GasInjector rewrites modules to insert
-  ``useGas`` at basic-block starts; an interpreter charges the identical
-  schedule at dispatch time, which is the same deterministic function of
-  the executed instruction trace — documented deviation: no module
-  rewriting pass).
+  schedule, in either of two equivalent strategies: per-instruction at
+  dispatch time (default), or per-BASIC-BLOCK at block entry — the
+  reference's GasInjector rewriting strategy (GasInjector.cpp inserts
+  ``useGas(blockCost)`` at metered-block starts), selected with
+  FISCO_WASM_GAS_MODE=inject. Both charge the identical total on any
+  non-trapping trace (pinned by tests on a corpus incl. indirect calls);
+  a mid-block trap charges the whole entered block under inject — the
+  same over-charge the reference's injected modules exhibit.
 
-Scope (v0, documented): MVP integer subset — i32/i64 arithmetic, structured
-control flow (block/loop/if/br/br_if/return/call), linear memory with
-load/store and memory.size/grow, globals, data segments. No floats (the
-reference REJECTS float opcodes for determinism — GasInjector.cpp
-InvalidInstruction), no tables/call_indirect, no multi-value blocks.
+Scope: MVP integer subset — i32/i64 arithmetic, structured control flow
+(block/loop/if/br/br_if/br_table/return/call), funcref tables +
+call_indirect (liquid vtable dispatch) with active element segments,
+linear memory with load/store and memory.size/grow, globals, data
+segments. No floats (the reference REJECTS float opcodes for
+determinism — GasInjector.cpp InvalidInstruction), no multi-value blocks.
 
 Storage model: byte-string keys in the same per-contract table the EVM uses
 for its 32-byte slots (executor/evm.py contract_table) — liquid contracts
@@ -84,6 +88,7 @@ _GAS_DEFAULT = 1
 _GAS_TABLE = {
     0x0C: 2, 0x0D: 2, 0x0E: 2, 0x0F: 2,  # br / br_if / br_table / return
     0x10: 5,                              # call
+    0x11: 8,                              # call_indirect (table load + check)
     0x28: 3, 0x29: 3, 0x2D: 3,            # loads
     0x36: 3, 0x37: 3, 0x3A: 3,            # stores
     0x3F: 2,                              # memory.size
@@ -91,6 +96,43 @@ _GAS_TABLE = {
     0x6E: 4, 0x70: 4,                     # i32.div_u / rem_u
     0x7F: 4, 0x81: 4,                     # i64.div_u / rem_u
 }
+
+# instructions that end a metered basic block (the reference's GasInjector
+# splits modules at these and injects one useGas(blockCost) at each block
+# start — GasInjector.cpp InstructionTable/metering pass); the interpreter's
+# "inject" gas mode charges the same per-segment sums at segment entry,
+# which is the identical deterministic function of any non-trapping trace
+_BLOCK_ENDERS = frozenset(
+    {0x02, 0x03, 0x04, 0x05, 0x0B, 0x0C, 0x0D, 0x0E, 0x0F, 0x10, 0x11}
+)
+
+
+def _segment_costs(code: list) -> tuple[list[int], list[int]]:
+    """(segment_of[i], seg_cost[sid]).
+
+    Segments are maximal straight-line runs; every control/call instruction
+    is its OWN single-op segment — control ops are also jump TARGETS
+    (if-false jumps to `end`, resume jumps past `call`), and a metered
+    block must start at every target or a jump into a block's tail would
+    charge the whole block."""
+    segment_of: list[int] = [0] * len(code)
+    seg_cost: list[int] = []
+    sid = -1
+    open_seg = False
+    for i, (op, _imm) in enumerate(code):
+        if op in _BLOCK_ENDERS:
+            sid += 1
+            seg_cost.append(_GAS_TABLE.get(op, _GAS_DEFAULT))
+            segment_of[i] = sid
+            open_seg = False
+            continue
+        if not open_seg:
+            sid += 1
+            seg_cost.append(0)
+            open_seg = True
+        segment_of[i] = sid
+        seg_cost[sid] += _GAS_TABLE.get(op, _GAS_DEFAULT)
+    return segment_of, seg_cost
 # host-function costs (external API pricing, cf. the EVM-side schedule)
 GAS_STORAGE_SET = 5000
 GAS_STORAGE_GET = 200
@@ -140,6 +182,10 @@ def _leb_s(buf: bytes, pos: int) -> tuple[int, int]:
 class _FuncType:
     params: int
     results: int
+    # raw valtype bytes — call_indirect type equality is on the FULL
+    # signature (an (i64)->i64 entry invoked as (i32)->i32 must trap, not
+    # dispatch on matching arity)
+    sig: tuple[bytes, bytes] = (b"", b"")
 
 
 @dataclass
@@ -148,6 +194,7 @@ class _Function:
     locals_count: int = 0
     code: list = field(default_factory=list)  # [(op, imm)]
     ctrl: dict = field(default_factory=dict)  # idx of block/loop/if -> (end, else)
+    segments: tuple | None = None  # lazy (segment_of, seg_cost) for inject mode
 
 
 # opcodes with a single u32-leb immediate
@@ -197,6 +244,10 @@ def _decode_body(buf: bytes, pos: int, end: int) -> list:
             _a, pos = _leb_u(buf, pos)
             off, pos = _leb_u(buf, pos)
             out.append((op, off))
+        elif op == 0x11:  # call_indirect: type idx + reserved table byte
+            ti, pos = _leb_u(buf, pos)
+            _tbl, pos = _leb_u(buf, pos)
+            out.append((op, ti))
         elif op in (0x3F, 0x40):  # memory.size/grow: reserved byte
             _r, pos = _leb_u(buf, pos)
             out.append((op, None))
@@ -229,6 +280,23 @@ def _match_ctrl(code: list) -> dict:
     return ctrl
 
 
+def _const_expr(binary: bytes, pos: int, what: str) -> tuple[int, int, bool]:
+    """Parse an `i32.const N end` / `i64.const N end` init expr; returns
+    (value, new_pos, is_i64). Shared by the globals, element and data
+    sections, whose offsets/initializers all take this MVP const form."""
+    op = binary[pos]
+    if op not in (0x41, 0x42):
+        raise _Trap(
+            TransactionStatus.WASM_VALIDATION_FAILURE, f"{what} must be const"
+        )
+    val, pos = _leb_s(binary, pos + 1)
+    if binary[pos] != 0x0B:
+        raise _Trap(
+            TransactionStatus.WASM_VALIDATION_FAILURE, f"bad {what} expr"
+        )
+    return val, pos + 1, op == 0x42
+
+
 class WasmModule:
     """Parsed module: types, imports, functions, memory, globals, exports,
     data segments."""
@@ -244,6 +312,8 @@ class WasmModule:
         self.globals: list[int] = []
         self.exports: dict[str, tuple[int, int]] = {}  # name -> (kind, idx)
         self.data: list[tuple[int, bytes]] = []
+        self.table_min = 0  # funcref table size (liquid vtable dispatch)
+        self.elems: list[tuple[int, list[int]]] = []  # (offset, func idxs)
         pos = 8
         func_types: list[int] = []
         while pos < len(binary):
@@ -260,15 +330,17 @@ class WasmModule:
                         )
                     pos += 1
                     np, pos = _leb_u(binary, pos)
-                    pos += np  # param valtypes (ints only; widths unchecked)
+                    p_sig = bytes(binary[pos : pos + np])
+                    pos += np
                     nr, pos = _leb_u(binary, pos)
+                    r_sig = bytes(binary[pos : pos + nr])
                     pos += nr
                     if nr > 1:
                         raise _Trap(
                             TransactionStatus.WASM_VALIDATION_FAILURE,
                             "multi-value unsupported",
                         )
-                    self.types.append(_FuncType(np, nr))
+                    self.types.append(_FuncType(np, nr, (p_sig, r_sig)))
             elif sec == 2:  # imports
                 n, pos = _leb_u(binary, pos)
                 for _ in range(n):
@@ -292,6 +364,29 @@ class WasmModule:
                 for _ in range(n):
                     ti, pos = _leb_u(binary, pos)
                     func_types.append(ti)
+            elif sec == 4:  # table — one funcref table (liquid vtables)
+                n, pos = _leb_u(binary, pos)
+                if n:
+                    if n > 1:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "at most one table",
+                        )
+                    if binary[pos] != 0x70:  # funcref
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "table must be funcref",
+                        )
+                    pos += 1
+                    flags, pos = _leb_u(binary, pos)
+                    self.table_min, pos = _leb_u(binary, pos)
+                    if flags & 1:
+                        _mx, pos = _leb_u(binary, pos)
+                    if self.table_min > 1 << 16:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "table too large",
+                        )
             elif sec == 5:  # memory
                 n, pos = _leb_u(binary, pos)
                 if n:
@@ -305,19 +400,7 @@ class WasmModule:
                 n, pos = _leb_u(binary, pos)
                 for _ in range(n):
                     pos += 2  # valtype + mutability
-                    if binary[pos] not in (0x41, 0x42):
-                        raise _Trap(
-                            TransactionStatus.WASM_VALIDATION_FAILURE,
-                            "global init must be const",
-                        )
-                    wide = binary[pos] == 0x42
-                    val, pos = _leb_s(binary, pos + 1)
-                    if binary[pos] != 0x0B:
-                        raise _Trap(
-                            TransactionStatus.WASM_VALIDATION_FAILURE,
-                            "bad global init expr",
-                        )
-                    pos += 1
+                    val, pos, wide = _const_expr(binary, pos, "global init")
                     self.globals.append(val & (_M64 if wide else _M32))
             elif sec == 7:  # exports
                 n, pos = _leb_u(binary, pos)
@@ -329,6 +412,27 @@ class WasmModule:
                     pos += 1
                     idx, pos = _leb_u(binary, pos)
                     self.exports[name] = (kind, idx)
+            elif sec == 9:  # element segments (vtable initialization)
+                n, pos = _leb_u(binary, pos)
+                for _ in range(n):
+                    flags, pos = _leb_u(binary, pos)
+                    if flags != 0:  # MVP active segment, table 0
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "only active funcref elem segments supported",
+                        )
+                    off, pos, wide = _const_expr(binary, pos, "elem offset")
+                    if wide:
+                        raise _Trap(
+                            TransactionStatus.WASM_VALIDATION_FAILURE,
+                            "elem offset must be i32.const",
+                        )
+                    cnt, pos = _leb_u(binary, pos)
+                    idxs = []
+                    for _ in range(cnt):
+                        fi2, pos = _leb_u(binary, pos)
+                        idxs.append(fi2)
+                    self.elems.append((off, idxs))
             elif sec == 10:  # code
                 n, pos = _leb_u(binary, pos)
                 for fi in range(n):
@@ -349,18 +453,12 @@ class WasmModule:
                 n, pos = _leb_u(binary, pos)
                 for _ in range(n):
                     _mi, pos = _leb_u(binary, pos)
-                    # offset expr: i32.const N end
-                    if binary[pos] != 0x41:
+                    off, pos, wide = _const_expr(binary, pos, "data offset")
+                    if wide:
                         raise _Trap(
                             TransactionStatus.WASM_VALIDATION_FAILURE,
                             "data offset must be i32.const",
                         )
-                    off, pos = _leb_s(binary, pos + 1)
-                    if binary[pos] != 0x0B:
-                        raise _Trap(
-                            TransactionStatus.WASM_VALIDATION_FAILURE, "bad data expr"
-                        )
-                    pos += 1
                     ln, pos = _leb_u(binary, pos)
                     self.data.append((off, binary[pos : pos + ln]))
                     pos += ln
@@ -452,7 +550,10 @@ class WasmInstance:
     (cross-contract call) yield an EVMCall and resume with the EVMResult,
     the same pause protocol as the EVM interpreter."""
 
-    def __init__(self, module: WasmModule, host_funcs: dict, gas: int):
+    def __init__(
+        self, module: WasmModule, host_funcs: dict, gas: int,
+        gas_mode: str = "dispatch",
+    ):
         self.m = module
         self.mem = bytearray(module.mem_min * PAGE)
         for off, data in module.data:
@@ -462,8 +563,28 @@ class WasmInstance:
                 )
             self.mem[off : off + len(data)] = data
         self.globals = list(module.globals)
+        # funcref table: None = uninitialized element (call_indirect traps)
+        self.table: list[int | None] = [None] * module.table_min
+        for off, idxs in module.elems:
+            if off < 0 or off + len(idxs) > len(self.table):
+                raise _Trap(
+                    TransactionStatus.WASM_ARGUMENT_OUT_OF_RANGE, "elem segment OOB"
+                )
+            n_funcs = module.n_imports + len(module.functions)
+            for j, fi in enumerate(idxs):
+                if fi >= n_funcs:
+                    raise _Trap(
+                        TransactionStatus.WASM_VALIDATION_FAILURE,
+                        "elem references unknown function",
+                    )
+                self.table[off + j] = fi
         self.host_funcs = host_funcs
         self.gas = gas
+        # "dispatch" charges per executed instruction; "inject" charges each
+        # basic block's precomputed sum at block entry — the reference's
+        # GasInjector module-rewriting strategy. Identical totals on any
+        # non-trapping trace (tests/test_wasm.py pins it on a corpus).
+        self.gas_mode = gas_mode
 
     # -- gas / memory ----------------------------------------------------
 
@@ -554,9 +675,29 @@ class WasmInstance:
             ctrl.pop()
             return end_idx + 1
 
+        inject = self.gas_mode == "inject"
+        if inject:
+            if fn.segments is None:
+                fn.segments = _segment_costs(code)
+            segment_of, seg_cost = fn.segments
+            charge_pending = True  # armed by every jump/control op
+            cur_seg = -1
         while pc < len(code):
             op, imm = code[pc]
-            self.use_gas(_GAS_TABLE.get(op, _GAS_DEFAULT))
+            if inject:
+                # one charge per basic-block ENTRY (the injected useGas at
+                # block start): fall-through into the next segment OR any
+                # control transfer (which can land back in the same segment
+                # id — a one-segment loop body) triggers it
+                s = segment_of[pc]
+                if charge_pending or s != cur_seg:
+                    self.use_gas(seg_cost[s])
+                    cur_seg = s
+                    charge_pending = False
+                if op in _BLOCK_ENDERS:
+                    charge_pending = True
+            else:
+                self.use_gas(_GAS_TABLE.get(op, _GAS_DEFAULT))
             if len(stack) > MAX_STACK:
                 raise _Trap(TransactionStatus.OUT_OF_STACK, "value stack")
             if op == 0x00:  # unreachable
@@ -613,6 +754,30 @@ class WasmInstance:
                 cargs = stack[len(stack) - callee_t.params :]
                 del stack[len(stack) - callee_t.params :]
                 r = yield from self._call_func(imm, cargs, depth + 1)
+                if callee_t.results:
+                    stack.append((r or 0) & _M64)
+            elif op == 0x11:  # call_indirect (liquid vtable dispatch)
+                elem_i = stack.pop()
+                if not 0 <= elem_i < len(self.table):
+                    raise _Trap(
+                        TransactionStatus.WASM_TRAP, "call_indirect out of bounds"
+                    )
+                callee = self.table[elem_i]
+                if callee is None:
+                    raise _Trap(
+                        TransactionStatus.WASM_TRAP, "uninitialized table element"
+                    )
+                expect = self.m.types[imm]
+                callee_t = self.m.func_type(callee)
+                if callee_t.sig != expect.sig:
+                    raise _Trap(
+                        TransactionStatus.WASM_TRAP, "indirect call type mismatch"
+                    )
+                if callee_t.params > len(stack):
+                    raise _Trap(TransactionStatus.STACK_UNDERFLOW, "call args")
+                cargs = stack[len(stack) - callee_t.params :]
+                del stack[len(stack) - callee_t.params :]
+                r = yield from self._call_func(callee, cargs, depth + 1)
                 if callee_t.results:
                     stack.append((r or 0) & _M64)
             elif op == 0x1A:  # drop
@@ -839,7 +1004,9 @@ def _bcos_host(inst_ref: list, host, msg: EVMCall, logs: list, ret_data: list):
     }
 
 
-def _run_export(host, msg: EVMCall, code: bytes, entry: str):
+def _run_export(
+    host, msg: EVMCall, code: bytes, entry: str, gas_mode: str = "dispatch"
+):
     """Generator: run one exported entry point to an EVMResult (yielding
     EVMCalls for cross-contract requests, like executor/evm.py interpret)."""
     logs: list[LogEntry] = []
@@ -848,7 +1015,7 @@ def _run_export(host, msg: EVMCall, code: bytes, entry: str):
     try:
         module = WasmModule(code)
         funcs = _bcos_host(inst_ref, host, msg, logs, ret_data)
-        inst = WasmInstance(module, funcs, msg.gas)
+        inst = WasmInstance(module, funcs, msg.gas, gas_mode=gas_mode)
         inst_ref[0] = inst
         output = b""
         try:
@@ -880,16 +1047,18 @@ def _run_export(host, msg: EVMCall, code: bytes, entry: str):
         )
 
 
-def wasm_interpret(host, msg: EVMCall, code: bytes):
+def wasm_interpret(host, msg: EVMCall, code: bytes, gas_mode: str = "dispatch"):
     """Entry-point call: runs the module's ``main``."""
-    return (yield from _run_export(host, msg, code, "main"))
+    return (yield from _run_export(host, msg, code, "main", gas_mode))
 
 
-def wasm_deploy(host, msg: EVMCall, module_bytes: bytes):
+def wasm_deploy(
+    host, msg: EVMCall, module_bytes: bytes, gas_mode: str = "dispatch"
+):
     """Deploy: validates the module, runs its ``deploy`` constructor, and
     returns the MODULE as the code to store (wasm stores the module itself,
     unlike EVM init code returning runtime code)."""
-    res = yield from _run_export(host, msg, module_bytes, "deploy")
+    res = yield from _run_export(host, msg, module_bytes, "deploy", gas_mode)
     if not res.ok:
         return res
     return EVMResult(

@@ -101,6 +101,11 @@ class GenesisConfig:
     # chain VM type (the reference genesis [executor] is_wasm flag): a wasm
     # chain runs liquid/WASM contracts, an EVM chain Solidity bytecode
     is_wasm: bool = False
+    # WASM gas strategy — "dispatch" (per-instruction) or "inject"
+    # (GasInjector-style per-basic-block). CHAIN-level because the two
+    # differ on trap receipts (inject charges the whole entered block); a
+    # per-node setting would fork receipt roots
+    wasm_gas_mode: str = "dispatch"
     # account-governance governor addresses (hex) — the AuthCommittee
     # governor list analog consumed by AccountManagerPrecompiled
     governors: list[str] = field(default_factory=list)

@@ -126,7 +126,10 @@ class Node:
             self.executor = CompositeRemoteExecutor(self.executor_manager)
         else:
             self.executor = TransactionExecutor(
-                self.storage, self.suite, is_wasm=config.genesis.is_wasm
+                self.storage,
+                self.suite,
+                is_wasm=config.genesis.is_wasm,
+                wasm_gas_mode=config.genesis.wasm_gas_mode,
             )
         self.scheduler = Scheduler(
             self.executor, self.ledger, self.storage, self.suite, self.txpool

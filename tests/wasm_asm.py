@@ -64,8 +64,26 @@ I32_ADD = b"\x6a"
 I32_SUB = b"\x6b"
 DROP = b"\x1a"
 END = b"\x0b"
+BLOCK = b"\x02\x40"  # blocktype: empty
 LOOP = b"\x03\x40"  # blocktype: empty
+IF = b"\x04\x40"
+ELSE = b"\x05"
 BR0 = b"\x0c\x00"
+I32_EQZ = b"\x45"
+I32_AND = b"\x71"
+I32_MUL = b"\x6c"
+
+
+def br(depth: int) -> bytes:
+    return b"\x0c" + leb_u(depth)
+
+
+def br_if(depth: int) -> bytes:
+    return b"\x0d" + leb_u(depth)
+
+
+def call_indirect(type_idx: int) -> bytes:
+    return b"\x11" + leb_u(type_idx) + b"\x00"
 
 
 def module(
@@ -75,6 +93,9 @@ def module(
     exports: list[tuple[str, int]],
     data: bytes = b"",
     mem_min: int = 1,
+    table: list[int] | None = None,
+    table_offset: int = 0,
+    table_min: int | None = None,
 ) -> bytes:
     out = b"\x00asm\x01\x00\x00\x00"
     out += _section(
@@ -98,6 +119,9 @@ def module(
             ),
         )
     out += _section(3, _vec([leb_u(ti) for ti, _l, _b in funcs]))
+    if table is not None or table_min is not None:
+        tmin = table_min if table_min is not None else table_offset + len(table or [])
+        out += _section(4, _vec([b"\x70\x00" + leb_u(tmin)]))
     out += _section(5, _vec([b"\x00" + leb_u(mem_min)]))
     out += _section(
         7,
@@ -108,6 +132,16 @@ def module(
             ]
         ),
     )
+    if table:
+        out += _section(
+            9,
+            _vec(
+                [
+                    leb_u(0) + i32c(table_offset) + END
+                    + _vec([leb_u(fi) for fi in table])
+                ]
+            ),
+        )
     bodies = []
     for _ti, locals_, body in funcs:
         decls = _vec([leb_u(1) + bytes([t]) for t in locals_])
@@ -194,6 +228,82 @@ def caller_module() -> bytes:
         TYPES,
         IMPORTS,
         [(0, [], b""), (0, [I32, I32], main)],  # deploy = no-op
+        [("deploy", N_IMPORTS), ("main", N_IMPORTS + 1)],
+    )
+
+
+def vtable_module() -> bytes:
+    """A liquid-style contract with function pointers: the vtable holds
+    {double, square, add40} of type (i32)->i32; main reads a SCALE-coded
+    (selector u32, arg u32) from calldata, dispatches via call_indirect,
+    and finishes with the u32 result at mem[8].
+
+    Table layout deliberately starts at offset 1 so slot 0 stays an
+    UNINITIALIZED element — selector 0xFFFF.. style bugs must trap, not
+    call garbage."""
+    ty_i32_i32 = len(TYPES)  # 6: (i32)->i32
+    types = TYPES + [([I32], [I32])]
+    f_double = local_get(0) + local_get(0) + I32_ADD
+    f_square = (
+        local_get(0) + local_get(0) + b"\x6c"  # i32.mul
+    )
+    f_add40 = local_get(0) + i32c(40) + I32_ADD
+    main = (
+        i32c(0) + call(GET_CD)                       # calldata -> mem[0..8)
+        + i32c(8)                                    # result slot ptr
+        + i32c(4) + I32_LOAD                         # arg = mem[4]
+        + i32c(0) + I32_LOAD                         # selector = mem[0]
+        + call_indirect(ty_i32_i32)
+        + I32_STORE
+        + i32c(8) + i32c(4) + call(FINISH)
+    )
+    base = N_IMPORTS
+    return module(
+        types,
+        IMPORTS,
+        [
+            (0, [], b""),            # deploy (no-op)
+            (ty_i32_i32, [], f_double),
+            (ty_i32_i32, [], f_square),
+            (ty_i32_i32, [], f_add40),
+            (0, [], main),
+        ],
+        [("deploy", base), ("main", base + 4)],
+        table=[base + 1, base + 2, base + 3],
+        table_offset=1,
+        table_min=5,  # slots 0 and 4 uninitialized
+    )
+
+
+def loopy_module() -> bytes:
+    """Control-flow corpus fixture: reads u32 n from calldata, loops n
+    down to 0 accumulating, with an if/else parity adjustment each
+    iteration — exercises loop back-edges, br_if exits, both if arms and
+    fall-through for the gas-strategy equivalence tests. Finishes with
+    the u32 accumulator."""
+    main = (
+        i32c(0) + call(GET_CD)                     # calldata -> mem[0..4)
+        + i32c(0) + I32_LOAD + local_set(0)        # n
+        + BLOCK
+        + LOOP
+        + local_get(0) + I32_EQZ + br_if(1)        # exit when n == 0
+        + local_get(1) + local_get(0) + I32_ADD + local_set(1)  # acc += n
+        + local_get(1) + i32c(1) + I32_AND + IF    # odd acc?
+        + local_get(1) + i32c(1) + I32_ADD + local_set(1)
+        + ELSE
+        + local_get(1) + i32c(2) + I32_ADD + local_set(1)
+        + END
+        + local_get(0) + i32c(1) + I32_SUB + local_set(0)
+        + br(0)
+        + END
+        + END
+        + i32c(8) + local_get(1) + I32_STORE
+        + i32c(8) + i32c(4) + call(FINISH)
+    )
+    return module(
+        TYPES,
+        IMPORTS,
+        [(0, [], b""), (0, [I32, I32], main)],
         [("deploy", N_IMPORTS), ("main", N_IMPORTS + 1)],
     )
 
