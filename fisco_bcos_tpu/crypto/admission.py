@@ -17,11 +17,12 @@ bcos-txpool/sync/TransactionSync.cpp:521-553 (tbb::parallel_for over verify).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops import keccak, secp256k1
 from ..ops.address import sender_address_device
-from ..ops.bigint import bytes_be_to_limbs, digest_words_le_to_limbs, limbs_to_bytes_be
+from ..ops.bigint import bytes_be_to_limbs, digest_words_le_to_limbs
 from ..ops.hash_common import bucket_batch, pad_keccak, pad_rows
 
 
@@ -48,12 +49,35 @@ def admission_core(blocks, nblocks, r, s, v):
 admission_step = jax.jit(admission_core)
 
 
+def _admission_packed(blocks, nblocks, r, s, v):
+    """admission_core with every output PACKED into one uint8 tensor
+    [B, 117] = addr(20) ‖ ok(1) ‖ pubkey(64) ‖ tx_hash(32): on a tunneled
+    device each host fetch is a round trip, so the whole admission result
+    crosses once instead of five times."""
+    from ..ops.bigint import limbs_to_bytes_device
+
+    addr, ok, qx, qy, z = admission_core(blocks, nblocks, r, s, v)
+    return jnp.concatenate(
+        [
+            addr.astype(jnp.uint8),
+            ok.astype(jnp.uint8)[:, None],
+            limbs_to_bytes_device(qx).astype(jnp.uint8),
+            limbs_to_bytes_device(qy).astype(jnp.uint8),
+            limbs_to_bytes_device(z).astype(jnp.uint8),
+        ],
+        axis=1,
+    )
+
+
+admission_step_packed = jax.jit(_admission_packed)
+
+
 def admit_batch(
     payloads, sigs65
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Host API: list[bytes] signed payloads + [B, 65] r‖s‖v signatures ->
     (senders [B, 20] uint8, ok bool[B], pubkeys [B, 64] uint8,
-    tx hashes [B, 32] uint8)."""
+    tx hashes [B, 32] uint8). One device program, ONE result transfer."""
     bsz = len(payloads)
     bb = bucket_batch(bsz)
     blocks, nblocks = pad_keccak(list(payloads) + [b""] * (bb - bsz))
@@ -61,13 +85,10 @@ def admit_batch(
     r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
     s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
     v = pad_rows(sigs65[:, 64].astype(np.int32), bb)
-    addr, ok, qx, qy, z = admission_step(blocks, nblocks, r, s, v)
-    pubs = np.concatenate(
-        [limbs_to_bytes_be(np.asarray(qx)), limbs_to_bytes_be(np.asarray(qy))], axis=-1
-    ).astype(np.uint8)
+    packed = np.asarray(admission_step_packed(blocks, nblocks, r, s, v))[:bsz]
     return (
-        np.asarray(addr, dtype=np.uint8)[:bsz],
-        np.asarray(ok)[:bsz],
-        pubs[:bsz],
-        limbs_to_bytes_be(np.asarray(z)).astype(np.uint8)[:bsz],
+        packed[:, :20],
+        packed[:, 20] != 0,
+        packed[:, 21:85],
+        packed[:, 85:117],
     )

@@ -303,14 +303,15 @@ def _le_point_limbs(comp32: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return bytes_be_to_limbs(y[:, ::-1]), sign
 
 
-def verify_batch(msgs, pubs, sigs) -> np.ndarray:
-    """Host API: per-item bytes (message, 32-byte pubkey, 64-byte R‖S) ->
-    bool[B]. Challenges are hashed on the host; ALL curve math is one
-    device program."""
+def device_inputs(msgs, pubs, sigs, pad_to: int | None = None):
+    """Host bytes -> the 6 device tensors _verify_xla / the sharded form
+    take: (s, k_neg, a_y [B,16], a_sign [B], r_y [B,16], r_sign [B]), with
+    the SHA-512 challenges hashed on the host and padded to `pad_to`
+    lanes (default: the shape bucket)."""
     import hashlib
 
     bsz = len(msgs)
-    bb = _bucket(bsz)
+    bb = pad_to if pad_to is not None else _bucket(bsz)
     pubs = np.asarray(
         [np.frombuffer(bytes(p[:32]), np.uint8) for p in pubs], np.uint8
     )
@@ -335,8 +336,7 @@ def verify_batch(msgs, pubs, sigs) -> np.ndarray:
     s_limbs = bytes_be_to_limbs(s_le[:, ::-1])
     a_y, a_sign = _le_point_limbs(pubs)
     r_y, r_sign = _le_point_limbs(r_comp)
-
-    ok = _verify_xla(
+    return (
         _pad_rows(s_limbs, bb),
         _pad_rows(k_neg, bb),
         _pad_rows(a_y, bb),
@@ -344,4 +344,12 @@ def verify_batch(msgs, pubs, sigs) -> np.ndarray:
         _pad_rows(r_y, bb),
         _pad_rows(r_sign, bb),
     )
+
+
+def verify_batch(msgs, pubs, sigs) -> np.ndarray:
+    """Host API: per-item bytes (message, 32-byte pubkey, 64-byte R‖S) ->
+    bool[B]. Challenges are hashed on the host; ALL curve math is one
+    device program."""
+    bsz = len(msgs)
+    ok = _verify_xla(*device_inputs(msgs, pubs, sigs))
     return np.asarray(ok)[:bsz]

@@ -1,4 +1,5 @@
 """JSON-RPC 2.0 API layer."""
 
+from .dup_test import DupTestJsonRpcImpl  # noqa: F401
 from .jsonrpc import JsonRpcImpl  # noqa: F401
 from .http_server import RpcHttpServer  # noqa: F401

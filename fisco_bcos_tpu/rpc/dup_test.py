@@ -1,0 +1,77 @@
+"""TPS-bench load duplication — flood a chain from one seed transaction.
+
+Reference: bcos-rpc/jsonrpc/DupTestTxJsonRpcImpl_2_0.h (a JsonRpcImpl
+subclass whose sendTransaction ALSO multiplies the tx into the pool) +
+DuplicateTransactionFactory.cpp:11-37 (each copy gets a fresh
+``nonce + utcTimeUs`` and is re-signed with a bench keypair).  This is how
+the reference measures its published 4-node TPS: one client connection,
+one signed tx, N pool entries.
+
+The duplicated copies are REAL transactions — fresh nonce, full re-sign,
+normal admission — so the flood exercises the same batch-verification
+plane as N distinct clients would; only client-side socket I/O is skipped.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..crypto.suite import KeyPair
+from ..protocol.transaction import Transaction, TransactionFactory
+from ..utils.error import ErrorCode
+from ..utils.log import get_logger
+from ..utils.bytesutil import from_hex
+from .jsonrpc import JsonRpcImpl
+
+_log = get_logger("dup-test-rpc")
+
+
+def multi_build(
+    suite, seed_tx: Transaction, keypair: KeyPair, num: int
+) -> list[Transaction]:
+    """`num` fresh copies of seed_tx: same call, new nonce, re-signed
+    (DuplicateTransactionFactory::multiBuild)."""
+    fac = TransactionFactory(suite)
+    base = int(time.time() * 1_000_000)
+    return [
+        fac.create_signed(
+            keypair,
+            chain_id=seed_tx.chain_id,
+            group_id=seed_tx.group_id,
+            block_limit=seed_tx.block_limit,
+            nonce=f"{seed_tx.nonce}-dup-{base + i}",
+            to=seed_tx.to,
+            input=seed_tx.input,
+            abi=seed_tx.abi,
+        )
+        for i in range(num)
+    ]
+
+
+class DupTestJsonRpcImpl(JsonRpcImpl):
+    """JsonRpcImpl that multiplies every sendTransaction by ``dup_count``
+    using ``bench_keypair`` — the TPS-bench RPC front
+    (DupTestTxJsonRpcImpl_2_0). Deploys are not duplicated (same guard as
+    the reference: `tx->to().empty()` is ignored)."""
+
+    def __init__(self, node, bench_keypair: KeyPair, dup_count: int = 100):
+        super().__init__(node)
+        self.bench_keypair = bench_keypair
+        self.dup_count = dup_count
+
+    def send_transaction(
+        self, group: str, node_name: str, data: str, require_proof: bool = False
+    ) -> dict:
+        out = super().send_transaction(group, node_name, data, require_proof)
+        seed = Transaction.decode(from_hex(data))
+        if not seed.to:
+            return out  # ignore deploy tx
+        dups = multi_build(self.suite, seed, self.bench_keypair, self.dup_count)
+        results = self.node.txpool.submit_batch(dups)
+        accepted = sum(1 for r in results if r.status == ErrorCode.SUCCESS)
+        self.node.tx_sync.maintain()
+        _log.info(
+            "duplicated sendTransaction x%d (%d accepted)", self.dup_count, accepted
+        )
+        out["duplicated"] = accepted
+        return out

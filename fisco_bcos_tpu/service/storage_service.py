@@ -34,6 +34,7 @@ class StorageService:
         s.register("prepare", self._prepare)
         s.register("commit", self._commit)
         s.register("rollback", self._rollback)
+        s.register("pending_2pc", self._pending_2pc)
         self.host, self.port = s.host, s.port
 
     def start(self) -> None:
@@ -106,6 +107,14 @@ class StorageService:
         r.done()
         self.backend.rollback(TwoPCParams(number=number))
         return b""
+
+    def _pending_2pc(self, payload: bytes) -> bytes:
+        # interface method (TransactionalStorage.pending_numbers): every
+        # backend must answer truthfully or recovery skips its stuck slots
+        nums = self.backend.pending_numbers()
+        w = FlatWriter()
+        w.seq(nums, lambda w2, n: w2.u64(n))
+        return w.out()
 
 
 class RemoteStorage(TransactionalStorage):
@@ -209,6 +218,12 @@ class RemoteStorage(TransactionalStorage):
         w = FlatWriter()
         w.u64(params.number)
         self._call("rollback", w.out())
+
+    def pending_numbers(self) -> list[int]:
+        r = FlatReader(self._call("pending_2pc"))
+        nums = r.seq(lambda r2: r2.u64())
+        r.done()
+        return [int(n) for n in nums]
 
     def close(self) -> None:
         self.client.close()

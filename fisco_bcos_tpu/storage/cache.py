@@ -102,6 +102,10 @@ class CacheStorage(TransactionalStorage):
 
     def rollback(self, params: TwoPCParams) -> None:
         self.inner.rollback(params)
+
+    def pending_numbers(self) -> list[int]:
+        return self.inner.pending_numbers()
+
         with self._lock:
             self._staged_keys.pop(params.number, None)
 

@@ -70,6 +70,9 @@ class DistributedStorage(TransactionalStorage):
         self.switch_handler = fn
 
     def _on_shard_loss(self) -> None:
+        # an outage can strand prepared-but-unresolved slots: arm the
+        # recovery pass so the next 2PC op resolves them before new work
+        self.mark_needs_recovery()
         handler = self.switch_handler
         if handler is not None:
             handler()
@@ -108,18 +111,96 @@ class DistributedStorage(TransactionalStorage):
 
     # -- 2PC (TiKVStorage asyncPrepare/asyncCommit/asyncRollback) -----------
 
+    # the primary's commit WITNESS row: staged with the primary's slot so it
+    # lands atomically with the primary commit; recovery reads it to decide
+    # roll-forward vs roll-back (TiKV: secondary locks resolve by checking
+    # the primary lock/commit record)
+    _WITNESS_TABLE = "s_2pc_witness"
+
+    @staticmethod
+    def _witness_key(number: int) -> bytes:
+        return b"commit-%d" % number
+
     def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
+        # recovery may freely resolve params.number here: we are about to
+        # RE-stage it, so an abandoned old slot rolling back is the point
+        self.recover_in_flight_if_needed()
         parts: dict[int, list] = {i: [] for i in range(len(self.shards))}
         for t, k, e in writes.traverse():
             parts[self.shard_of(t, k)].append((t, k, e))
-        # primary (shard 0) first — its prepared marker is the commit
-        # point-of-no-return witness, like TiKV's primary lock
+        # primary (shard 0) first — its prepared slot carries the commit
+        # witness, so the witness becomes durable exactly when the primary
+        # commits (the point of no return, like TiKV's primary lock)
+        parts[0].append(
+            (
+                self._WITNESS_TABLE,
+                self._witness_key(params.number),
+                Entry().set(b"1"),
+            )
+        )
         for idx in range(len(self.shards)):
             self.shards[idx].prepare(params, _RowsView(parts[idx]))
 
     def commit(self, params: TwoPCParams) -> None:
+        # NEVER let recovery touch the number being committed: its slot is
+        # legitimately pending RIGHT NOW and has no witness yet — an armed
+        # recovery pass would roll it back and this commit would "succeed"
+        # with empty slots, silently losing the block's writes
+        self.recover_in_flight_if_needed(exclude=params.number)
         for idx in range(len(self.shards)):  # primary first
             self.shards[idx].commit(params)
+        # retire the PREVIOUS block's witness: a commit of N proves N-1 is
+        # fully resolved, so at most one live witness row remains instead
+        # of one per block forever
+        if params.number > 0:
+            from .entry import EntryStatus
+
+            self.shards[0].set_row(
+                self._WITNESS_TABLE,
+                self._witness_key(params.number - 1),
+                Entry(status=EntryStatus.DELETED),
+            )
+
+    # -- in-flight 2PC recovery (the re-replay across a switch) -------------
+
+    def mark_needs_recovery(self) -> None:
+        """Arm a recovery pass for the next 2PC operation — wired to the
+        same outage episodes that fire the switch handler."""
+        self._needs_recovery = True
+
+    def recover_in_flight_if_needed(self, exclude: int | None = None) -> None:
+        if getattr(self, "_needs_recovery", False):
+            self._needs_recovery = False
+            try:
+                self.recover_in_flight(exclude=exclude)
+            except ServiceConnectionError:
+                # a shard is still down: stay armed, retry on next 2PC op
+                self._needs_recovery = True
+                raise
+
+    def recover_in_flight(self, exclude: int | None = None) -> None:
+        """Resolve prepared-but-unresolved slots left by a crash/outage
+        between phases: a slot whose number has the primary's commit
+        witness rolls FORWARD (the coordinator had passed the point of no
+        return), anything else rolls back — then consensus re-drives the
+        block (TiKVStorage.cpp:582's switch handler + lock resolution)."""
+        pending: set[int] = set()
+        for sh in self.shards:
+            pending.update(sh.pending_numbers())
+        pending.discard(exclude)  # the caller owns that number's decision
+        for n in sorted(pending):
+            witness = self.shards[0].get_row(
+                self._WITNESS_TABLE, self._witness_key(n)
+            )
+            params = TwoPCParams(number=n)
+            if witness is not None:
+                _log.warning("2PC recovery: rolling FORWARD block %d", n)
+                for sh in self.shards:
+                    sh.commit(params)
+            else:
+                _log.warning("2PC recovery: rolling back block %d", n)
+                for sh in self.shards:
+                    sh.rollback(params)
 
     def rollback(self, params: TwoPCParams) -> None:
         errs = 0
@@ -128,8 +209,27 @@ class DistributedStorage(TransactionalStorage):
                 sh.rollback(params)
             except ServiceConnectionError:
                 errs += 1  # a dead shard has nothing durable to roll back
+        # an explicit rollback declares the number DEAD: retire any witness
+        # a partial commit attempt may have left, or a later crash would
+        # roll a never-decided re-prepare forward off the stale marker
+        try:
+            from .entry import EntryStatus
+
+            self.shards[0].set_row(
+                self._WITNESS_TABLE,
+                self._witness_key(params.number),
+                Entry(status=EntryStatus.DELETED),
+            )
+        except ServiceConnectionError:
+            errs += 1
         if errs:
             _log.warning("rollback skipped %d unreachable shards", errs)
+
+    def pending_numbers(self) -> list[int]:
+        out: set[int] = set()
+        for sh in self.shards:
+            out.update(sh.pending_numbers())
+        return sorted(out)
 
     def close(self) -> None:
         for sh in self.shards:

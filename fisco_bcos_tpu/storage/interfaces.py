@@ -61,3 +61,12 @@ class TransactionalStorage(StorageInterface):
 
     def rollback(self, params: TwoPCParams) -> None:
         raise NotImplementedError
+
+    def pending_numbers(self) -> list[int]:
+        """Block numbers with a prepared-but-unresolved 2PC slot.
+
+        Part of the interface because the distributed recovery plane
+        (DistributedStorage.recover_in_flight) DEPENDS on every backend
+        answering truthfully — a backend silently reporting [] would make
+        recovery skip its stuck slots forever."""
+        raise NotImplementedError

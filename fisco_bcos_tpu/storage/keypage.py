@@ -314,6 +314,10 @@ class KeyPageStorage(TransactionalStorage):
     def rollback(self, params: TwoPCParams) -> None:
         self.inner.rollback(params)
 
+    def pending_numbers(self) -> list[int]:
+        return self.inner.pending_numbers()
+
+
     def close(self) -> None:
         close = getattr(self.inner, "close", None)
         if close is not None:

@@ -12,7 +12,7 @@ from .interfaces import TransactionalStorage, TraversableStorage, TwoPCParams
 class MemoryStorage(TransactionalStorage):
     def __init__(self) -> None:
         self._data: dict[tuple[str, bytes], Entry] = {}
-        self._pending: dict[int, list[tuple[str, bytes, Entry]]] = {}
+        self._pending: dict[int, dict[tuple[str, bytes], Entry]] = {}
         self._lock = threading.RLock()
 
     def get_row(self, table: str, key: bytes) -> Entry | None:
@@ -57,3 +57,7 @@ class MemoryStorage(TransactionalStorage):
     def rollback(self, params: TwoPCParams) -> None:
         with self._lock:
             self._pending.pop(params.number, None)
+
+    def pending_numbers(self) -> list[int]:
+        with self._lock:
+            return sorted(self._pending)

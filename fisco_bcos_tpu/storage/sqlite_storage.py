@@ -22,13 +22,21 @@ class SQLiteStorage(TransactionalStorage):
     def __init__(self, path: str = ":memory:") -> None:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
-        self._pending: dict[int, list[tuple[str, bytes, Entry]]] = {}
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv ("
                 " tbl TEXT NOT NULL, k BLOB NOT NULL, v BLOB NOT NULL,"
                 " PRIMARY KEY (tbl, k))"
+            )
+            # prepared-but-uncommitted 2PC slots are DURABLE (TiKV persists
+            # prewrite locks): a participant that crashes between prepare
+            # and commit must still roll FORWARD after restart when the
+            # coordinator's primary commit witness exists
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS pending_2pc ("
+                " num INTEGER NOT NULL, tbl TEXT NOT NULL, k BLOB NOT NULL,"
+                " v BLOB NOT NULL, PRIMARY KEY (num, tbl, k))"
             )
             self._conn.commit()
 
@@ -74,26 +82,50 @@ class SQLiteStorage(TransactionalStorage):
     # -- 2PC ------------------------------------------------------------
 
     def prepare(self, params: TwoPCParams, writes: TraversableStorage) -> None:
-        """Per-key merge into the number's slot (multi-participant 2PC:
-        several Max executors prepare the same block; see
-        MemoryStorage.prepare)."""
+        """Durably stage writes for `number`. Per-key merge, not slot
+        replacement (multi-participant 2PC: several Max executors prepare
+        the same block; see MemoryStorage.prepare)."""
         with self._lock:
-            slot = self._pending.setdefault(params.number, {})
-            for t, k, e in writes.traverse():
-                slot[(t, bytes(k))] = e.copy()
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO pending_2pc (num, tbl, k, v)"
+                " VALUES (?, ?, ?, ?)",
+                [
+                    (params.number, t, bytes(k), e.encode())
+                    for t, k, e in writes.traverse()
+                ],
+            )
+            self._conn.commit()
 
     def commit(self, params: TwoPCParams) -> None:
         with self._lock:
-            staged = self._pending.pop(params.number, {})
-            self._conn.executemany(
-                "INSERT OR REPLACE INTO kv (tbl, k, v) VALUES (?, ?, ?)",
-                [(t, k, e.encode()) for (t, k), e in staged.items()],
+            # apply + clear the slot in ONE sqlite transaction: a crash
+            # mid-commit leaves either the staged slot (re-commit resolves)
+            # or the applied state, never half of each
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (tbl, k, v)"
+                " SELECT tbl, k, v FROM pending_2pc WHERE num=?",
+                (params.number,),
+            )
+            self._conn.execute(
+                "DELETE FROM pending_2pc WHERE num=?", (params.number,)
             )
             self._conn.commit()
 
     def rollback(self, params: TwoPCParams) -> None:
         with self._lock:
-            self._pending.pop(params.number, None)
+            self._conn.execute(
+                "DELETE FROM pending_2pc WHERE num=?", (params.number,)
+            )
+            self._conn.commit()
+
+    def pending_numbers(self) -> list[int]:
+        """Block numbers with a durable prepared-but-unresolved slot
+        (the recovery scan's input — TiKV's leftover prewrite locks)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT num FROM pending_2pc ORDER BY num"
+            ).fetchall()
+        return [int(r[0]) for r in rows]
 
     def close(self) -> None:
         with self._lock:

@@ -103,3 +103,127 @@ def test_shard_loss_fires_switch_and_recovers():
     finally:
         for s in svcs:
             s.stop()
+
+
+class _Writes:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def traverse(self):
+        yield from self.rows
+
+
+def test_2pc_recovery_rolls_forward_past_primary_commit():
+    """TiKV lock-resolution semantics: a crash AFTER the primary commit
+    (the witness is durable) but before the secondaries' commits must roll
+    the stragglers FORWARD on recovery, not back — the coordinator had
+    passed the point of no return."""
+    backings, svcs, dist = _cluster(3)
+    try:
+        rows = [("t", b"rf%02d" % i, Entry().set(b"v%d" % i)) for i in range(24)]
+        params = TwoPCParams(number=7)
+        dist.prepare(params, _Writes(rows))
+        # crash between phases: only the PRIMARY commits (witness lands)
+        backings[0].commit(params)
+        assert backings[1].pending_numbers() or backings[2].pending_numbers()
+
+        dist.mark_needs_recovery()
+        dist.recover_in_flight_if_needed()
+        for _t, k, e in rows:
+            got = dist.get_row("t", k)
+            assert got is not None and got.get() == e.get(), k
+        for b in backings:
+            assert b.pending_numbers() == []
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_2pc_recovery_rolls_back_without_witness():
+    """A crash BEFORE the primary commit leaves no witness: every shard's
+    staged slot rolls back and the data never becomes visible."""
+    backings, svcs, dist = _cluster(3)
+    try:
+        rows = [("t", b"rb%02d" % i, Entry().set(b"x")) for i in range(24)]
+        params = TwoPCParams(number=9)
+        dist.prepare(params, _Writes(rows))
+        dist.mark_needs_recovery()
+        dist.recover_in_flight_if_needed()
+        for _t, k, _e in rows:
+            assert dist.get_row("t", k) is None
+        for b in backings:
+            assert b.pending_numbers() == []
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_sqlite_prepared_slot_survives_restart(tmp_path):
+    """Durable prewrite (TiKV persists locks): a prepared slot must survive
+    the participant process restarting, so recovery can still roll it
+    forward."""
+    from fisco_bcos_tpu.storage import SQLiteStorage
+
+    db = str(tmp_path / "part.db")
+    st = SQLiteStorage(db)
+    st.prepare(TwoPCParams(number=3), _Writes([("t", b"k", Entry().set(b"v"))]))
+    assert st.pending_numbers() == [3]
+    st.close()
+    st2 = SQLiteStorage(db)  # "restarted process"
+    assert st2.pending_numbers() == [3]
+    assert st2.get_row("t", b"k") is None  # staged, not visible
+    st2.commit(TwoPCParams(number=3))
+    assert st2.get_row("t", b"k").get() == b"v"
+    assert st2.pending_numbers() == []
+    st2.close()
+
+
+def test_armed_recovery_must_not_roll_back_the_block_being_committed():
+    """Regression: a transient outage between prepare(N) and commit(N)
+    arms recovery; the commit(N) that follows must NOT let the recovery
+    pass roll N back (it has no witness yet) — that would commit empty
+    slots and silently lose the block."""
+    backings, svcs, dist = _cluster(3)
+    try:
+        rows = [("t", b"cx%02d" % i, Entry().set(b"v%d" % i)) for i in range(16)]
+        params = TwoPCParams(number=5)
+        dist.prepare(params, _Writes(rows))
+        dist.mark_needs_recovery()  # transient blip after prepare
+        dist.commit(params)
+        for _t, k, e in rows:
+            got = dist.get_row("t", k)
+            assert got is not None and got.get() == e.get(), k
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_witness_rows_are_retired():
+    """Only a bounded number of commit-witness rows may survive: committing
+    N retires N-1's witness, and rollback retires its own."""
+    backings, svcs, dist = _cluster(2)
+    try:
+        for n in (1, 2, 3):
+            dist.prepare(
+                TwoPCParams(number=n), _Writes([("t", b"w%d" % n, Entry().set(b"x"))])
+            )
+            dist.commit(TwoPCParams(number=n))
+        live = [
+            k for k in backings[0].get_primary_keys("s_2pc_witness")
+        ] + [
+            k for k in backings[1].get_primary_keys("s_2pc_witness")
+        ]
+        assert live == [b"commit-3"], live
+        # rollback retires its own witness even after a partial commit
+        dist.prepare(
+            TwoPCParams(number=4), _Writes([("t", b"w4", Entry().set(b"x"))])
+        )
+        backings[0].commit(TwoPCParams(number=4))  # partial: primary only
+        dist.rollback(TwoPCParams(number=4))
+        live = [
+            k for b in backings for k in b.get_primary_keys("s_2pc_witness")
+        ]
+        assert b"commit-4" not in live
+    finally:
+        for s in svcs:
+            s.stop()

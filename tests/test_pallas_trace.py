@@ -1,0 +1,65 @@
+"""Default-on Pallas kernel TRACE smoke — the anti-rot net for kernel paths.
+
+The numeric interpreter tests (test_pallas.py) are opt-in because XLA-CPU
+takes ~20 min to compile each unrolled ladder kernel on this host, and
+eager interpretation is slower still.  But pallas_call traces its kernel
+BODY at bind time, so ``jax.eval_shape`` exercises the whole kernel
+python path — block specs, grid padding, the no-captured-constants
+restriction, every limb-op shape — with NO XLA compile and NO execution.
+A regression in any `_recover_kernel`/`_verify_kernel`/`_sm2_verify_kernel`
+body now fails here, in CI, instead of surfacing at bench time on the
+driver's hardware run (VERDICT r3 #10).
+
+Each trace takes tens of seconds (pure Python tracing of the unrolled
+GLV/comb ladders) — slow for a unit test, but the only default-on
+coverage these kernels can get without TPU hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fisco_bcos_tpu.ops.ec import g_comb_table, g_comb_table_glv
+from fisco_bcos_tpu.ops.pallas_ec import (
+    MIN_TILE,
+    _recover_call,
+    _sm2_verify_call,
+    _verify_call,
+)
+from fisco_bcos_tpu.ops.secp256k1 import SECP256K1_OPS
+from fisco_bcos_tpu.ops.sm2 import SM2_OPS
+
+B = MIN_TILE
+_Z = jnp.zeros((16, B), jnp.uint32)
+_ROW = jnp.zeros((1, B), jnp.int32)
+
+
+def test_recover_kernel_traces():
+    gt = jnp.asarray(g_comb_table_glv(SECP256K1_OPS.name))
+    qx, qy, ok = jax.eval_shape(_recover_call(B, False), _Z, _Z, _Z, _ROW, gt)
+    assert qx.shape == (B, 16) and qy.shape == (B, 16) and ok.shape == (B,)
+
+
+def test_verify_kernel_traces():
+    gt = jnp.asarray(g_comb_table_glv(SECP256K1_OPS.name))
+    ok = jax.eval_shape(_verify_call(B, False), _Z, _Z, _Z, _Z, _Z, gt)
+    assert ok.shape == (B,)
+
+
+def test_sm2_verify_kernel_traces():
+    gt = jnp.asarray(g_comb_table(SM2_OPS.name))
+    ok = jax.eval_shape(_sm2_verify_call(B, False), _Z, _Z, _Z, _Z, _Z, gt)
+    assert ok.shape == (B,)
+
+
+def test_sm2_kernel_traces_with_sparse_field(monkeypatch):
+    """ADVICE r3: the FISCO_SM2_SPARSE opt-in path must trace through the
+    Mosaic kernel wrapper before the flag is ever flipped on hardware.
+    The field singleton binds at import, so exercise the sparse fold
+    directly through the kernel-shaped code path."""
+    from fisco_bcos_tpu.ops import limb
+
+    f = limb.make_sparse_fold_field(SM2_OPS.curve.p)
+    a = jnp.zeros((16, B), jnp.uint32)
+    out = jax.eval_shape(jax.jit(lambda x: f.mul(x, x)), f.from_plain(a))
+    assert out.shape == (16, B)

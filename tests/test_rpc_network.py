@@ -163,3 +163,52 @@ def test_four_nodes_over_tcp():
             rt.stop()
         for gw in gateways:
             gw.stop()
+
+
+def test_dup_test_rpc_floods_pool():
+    """DupTestTxJsonRpcImpl_2_0: one sendTransaction -> dup_count extra
+    pool entries with fresh nonces, re-signed by the bench keypair;
+    deploys are not duplicated."""
+    from fisco_bcos_tpu.codec.abi import ABICodec
+    from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+    from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+    from fisco_bcos_tpu.node import Node, NodeConfig
+    from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+    from fisco_bcos_tpu.rpc import DupTestJsonRpcImpl
+    from fisco_bcos_tpu.utils.bytesutil import to_hex
+
+    suite = ecdsa_suite()
+    codec = ABICodec(suite.hash)
+    kp = suite.signature_impl.generate_keypair(secret=0xD0B)
+    node = Node(
+        NodeConfig(genesis=GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub)])),
+        keypair=kp,
+    )
+    bench_kp = suite.signature_impl.generate_keypair(secret=0xBE7C)
+    rpc = DupTestJsonRpcImpl(node, bench_kp, dup_count=25)
+    sender = suite.signature_impl.generate_keypair(secret=0x5E7D)
+    fac = TransactionFactory(suite)
+    tx = fac.create_signed(
+        sender, chain_id="chain0", group_id="group0", block_limit=500,
+        nonce="dup-seed", to=DAG_TRANSFER_ADDRESS,
+        input=codec.encode_call("userAdd(string,uint256)", "dupuser", 1),
+    )
+    out = rpc.send_transaction("group0", "", to_hex(tx.encode()))
+    assert out["status"] == 0
+    assert out["duplicated"] == 25
+    assert node.txpool.pending_count() == 26  # seed + 25 dups
+    # all copies are admissible and seal into blocks
+    assert node.sealer.seal_and_submit()
+    while node.txpool.pending_count():
+        assert node.sealer.seal_and_submit()
+    assert node.ledger.total_transaction_count() == 26
+
+    # a deploy seed is NOT duplicated (the reference ignores empty-to)
+    deploy = fac.create_signed(
+        sender, chain_id="chain0", group_id="group0", block_limit=500,
+        nonce="dup-deploy", to=b"", input=b"\x00asm\x01\x00\x00\x00",
+    )
+    before = node.txpool.pending_count()
+    out2 = rpc.send_transaction("group0", "", to_hex(deploy.encode()))
+    assert "duplicated" not in out2
+    assert node.txpool.pending_count() == before + 1
