@@ -89,6 +89,7 @@ def _make_curve_ops(c: Curve) -> CurveOps:
     # proven exactness — but its 8 carry-chain fold rounds have not shown
     # a runtime win over REDC yet, so it stays opt-in (FISCO_SM2_SPARSE=1)
     # until profiled on hardware.
+    import logging
     import os
 
     from .limb import _SPARSE_COMPLEMENTS, make_sparse_fold_field
@@ -96,9 +97,23 @@ def _make_curve_ops(c: Curve) -> CurveOps:
     if _R - c.p < 1 << 132:
         F = make_fold_field(c.p)
     elif c.p in _SPARSE_COMPLEMENTS and os.environ.get("FISCO_SM2_SPARSE") == "1":
-        # read once at import (curve ops are module-level singletons)
+        # read once at import (curve ops are module-level singletons).
+        # Plain logging.getLogger: this runs at LIBRARY IMPORT time, and
+        # the project logger helper installs root handlers (basicConfig),
+        # which an importing application must stay free to configure.
+        logging.getLogger("fisco.ec").info(
+            "FISCO_SM2_SPARSE=1: %s uses the Solinas sparse-fold field "
+            "(set BEFORE process start; changing it later has no effect)",
+            c.name,
+        )
         F = make_sparse_fold_field(c.p)
     else:
+        if c.p in _SPARSE_COMPLEMENTS and "FISCO_SM2_SPARSE" in os.environ:
+            logging.getLogger("fisco.ec").warning(
+                "FISCO_SM2_SPARSE=%r ignored for %s (only the exact value "
+                "'1' opts in, and only when set before process start)",
+                os.environ["FISCO_SM2_SPARSE"], c.name,
+            )
         F = make_mont_field(c.p)
     Fn = make_fold_field(c.n) if _R - c.n < 1 << 132 else None
     b3 = 3 * c.b % c.p
