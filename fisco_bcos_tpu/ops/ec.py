@@ -101,18 +101,26 @@ def _make_curve_ops(c: Curve) -> CurveOps:
         # Plain logging.getLogger: this runs at LIBRARY IMPORT time, and
         # the project logger helper installs root handlers (basicConfig),
         # which an importing application must stay free to configure.
-        logging.getLogger("fisco.ec").info(
+        # warning level so the confirmation reaches the default lastResort
+        # handler — at import time the app has not configured logging yet,
+        # and an INFO record would be dropped silently
+        logging.getLogger("fisco.ec").warning(
             "FISCO_SM2_SPARSE=1: %s uses the Solinas sparse-fold field "
             "(set BEFORE process start; changing it later has no effect)",
             c.name,
         )
         F = make_sparse_fold_field(c.p)
     else:
-        if c.p in _SPARSE_COMPLEMENTS and "FISCO_SM2_SPARSE" in os.environ:
+        flag = os.environ.get("FISCO_SM2_SPARSE")
+        if (
+            c.p in _SPARSE_COMPLEMENTS
+            and flag is not None
+            and flag not in ("", "0")  # explicit disables behave as intended
+        ):
             logging.getLogger("fisco.ec").warning(
                 "FISCO_SM2_SPARSE=%r ignored for %s (only the exact value "
                 "'1' opts in, and only when set before process start)",
-                os.environ["FISCO_SM2_SPARSE"], c.name,
+                flag, c.name,
             )
         F = make_mont_field(c.p)
     Fn = make_fold_field(c.n) if _R - c.n < 1 << 132 else None

@@ -47,6 +47,14 @@ for _x in range(5):
         _PI[_dst] = (_x + 5 * _y, _ROT[_x][_y])
 
 
+def _chi1(i: int) -> int:
+    return (i // 5) * 5 + ((i % 5) + 1) % 5
+
+
+def _chi2(i: int) -> int:
+    return (i // 5) * 5 + ((i % 5) + 2) % 5
+
+
 def _rotl64(lo, hi, n: int):
     """Rotate a (lo, hi) uint32 pair left by static n."""
     n %= 64
@@ -67,50 +75,64 @@ def _rotl64(lo, hi, n: int):
 
 
 def _round(state, rc):
-    """One Keccak-f round. state = (lo, hi) each [..., 25]."""
+    """One Keccak-f round, LANE-MAJOR: state = (lo, hi), each a 25-tuple of
+    [...] batch arrays.
+
+    The batch lives in the MINOR axis (the 128-lane vector axis) exactly
+    like the limb-major EC kernels: every theta/rho/pi/chi term is a full
+    VPU-width elementwise op on a [B] vector, and all 25-lane indexing is
+    static Python (unrolled), so XLA never relayouts a 25-wide minor axis
+    — the previous [B, 25] layout wasted ~4/5 of each vector and paid a
+    stack+roll relayout per round."""
     lo, hi = state
     rc_lo, rc_hi = rc
-    shape = lo.shape[:-1]
-    # theta — column parities; lane index = x + 5y, so reshape to [..., y, x]
-    lo5 = lo.reshape(shape + (5, 5))
-    hi5 = hi.reshape(shape + (5, 5))
-    c_lo = lo5[..., 0, :] ^ lo5[..., 1, :] ^ lo5[..., 2, :] ^ lo5[..., 3, :] ^ lo5[..., 4, :]
-    c_hi = hi5[..., 0, :] ^ hi5[..., 1, :] ^ hi5[..., 2, :] ^ hi5[..., 3, :] ^ hi5[..., 4, :]
-    c1_lo, c1_hi = _rotl64(jnp.roll(c_lo, -1, axis=-1), jnp.roll(c_hi, -1, axis=-1), 1)
-    d_lo = jnp.roll(c_lo, 1, axis=-1) ^ c1_lo
-    d_hi = jnp.roll(c_hi, 1, axis=-1) ^ c1_hi
-    lo5 = lo5 ^ d_lo[..., None, :]
-    hi5 = hi5 ^ d_hi[..., None, :]
-    lo = lo5.reshape(shape + (25,))
-    hi = hi5.reshape(shape + (25,))
-    # rho + pi — per-lane static rotations into permuted positions
+    # theta — column parities c[x] = xor over y of lane[x + 5y]
+    c_lo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
+    c_hi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
+    d = []
+    for x in range(5):
+        r_lo, r_hi = _rotl64(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
+        d.append((c_lo[(x + 4) % 5] ^ r_lo, c_hi[(x + 4) % 5] ^ r_hi))
+    lo = [lo[i] ^ d[i % 5][0] for i in range(25)]
+    hi = [hi[i] ^ d[i % 5][1] for i in range(25)]
+    # rho + pi — static per-lane rotations into permuted positions
     b_lo = [None] * 25
     b_hi = [None] * 25
     for dst, (src, rot) in enumerate(_PI):
-        b_lo[dst], b_hi[dst] = _rotl64(lo[..., src], hi[..., src], rot)
-    b_lo = jnp.stack(b_lo, axis=-1).reshape(shape + (5, 5))
-    b_hi = jnp.stack(b_hi, axis=-1).reshape(shape + (5, 5))
-    # chi
-    n1_lo = jnp.roll(b_lo, -1, axis=-1)
-    n2_lo = jnp.roll(b_lo, -2, axis=-1)
-    n1_hi = jnp.roll(b_hi, -1, axis=-1)
-    n2_hi = jnp.roll(b_hi, -2, axis=-1)
-    lo = (b_lo ^ (~n1_lo & n2_lo)).reshape(shape + (25,))
-    hi = (b_hi ^ (~n1_hi & n2_hi)).reshape(shape + (25,))
+        b_lo[dst], b_hi[dst] = _rotl64(lo[src], hi[src], rot)
+    # chi — s[x + 5y] = b[x] ^ (~b[x+1] & b[x+2]) within each row y
+    lo = [
+        b_lo[i] ^ (~b_lo[_chi1(i)] & b_lo[_chi2(i)]) for i in range(25)
+    ]
+    hi = [
+        b_hi[i] ^ (~b_hi[_chi1(i)] & b_hi[_chi2(i)]) for i in range(25)
+    ]
     # iota
-    lo = lo.at[..., 0].set(lo[..., 0] ^ rc_lo)
-    hi = hi.at[..., 0].set(hi[..., 0] ^ rc_hi)
-    return (lo, hi)
+    lo[0] = lo[0] ^ rc_lo
+    hi[0] = hi[0] ^ rc_hi
+    return (tuple(lo), tuple(hi))
 
 
-def keccak_f1600(lo: jax.Array, hi: jax.Array):
-    """Keccak-f[1600] over [..., 25] lane pairs (scan over the 24 rounds)."""
+def keccak_f1600_lanes(lo, hi):
+    """Keccak-f[1600] over lane-major state: 25-tuples of [...] batch
+    arrays (scan over the 24 rounds)."""
 
     def body(state, rc):
         return _round(state, rc), None
 
-    (lo, hi), _ = lax.scan(body, (lo, hi), (jnp.asarray(_RC_LO), jnp.asarray(_RC_HI)))
+    (lo, hi), _ = lax.scan(
+        body, (tuple(lo), tuple(hi)), (jnp.asarray(_RC_LO), jnp.asarray(_RC_HI))
+    )
     return lo, hi
+
+
+def keccak_f1600(lo: jax.Array, hi: jax.Array):
+    """Keccak-f[1600] over [..., 25] lane pairs (compatibility wrapper:
+    unpacks to the lane-major form, permutes, repacks)."""
+    lo_t = tuple(lo[..., i] for i in range(25))
+    hi_t = tuple(hi[..., i] for i in range(25))
+    lo_t, hi_t = keccak_f1600_lanes(lo_t, hi_t)
+    return jnp.stack(lo_t, axis=-1), jnp.stack(hi_t, axis=-1)
 
 
 @jax.jit
@@ -119,30 +141,43 @@ def keccak256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
 
     blocks: [B, M, 17, 2] uint32 (rate lanes as lo/hi), nblocks: [B] int32.
     Returns digests as [B, 8] uint32 little-endian words.
-    """
+
+    Internally lane-major: the state is 25 independent [B] vectors (batch
+    in the VPU's minor axis), so the whole permutation is full-width
+    elementwise work with static lane indexing — the one relayout left is
+    the final 8-word squeeze."""
     bsz, m_max, lanes, _ = blocks.shape
-    lo0 = jnp.zeros((bsz, 25), jnp.uint32)
-    hi0 = jnp.zeros((bsz, 25), jnp.uint32)
+    zeros = jnp.zeros((bsz,), jnp.uint32)
+    lo0 = (zeros,) * 25
+    hi0 = (zeros,) * 25
 
     def absorb(state, xs):
         lo, hi = state
-        blk, idx = xs  # blk [B, 17, 2]
-        alo = lo.at[:, :lanes].set(lo[:, :lanes] ^ blk[..., 0])
-        ahi = hi.at[:, :lanes].set(hi[:, :lanes] ^ blk[..., 1])
-        plo, phi = keccak_f1600(alo, ahi)
-        active = (idx < nblocks)[:, None]
+        blk, idx = xs  # blk [17, 2, B]: lane rows are contiguous [B] slices
+        alo = tuple(
+            lo[l] ^ blk[l, 0] if l < lanes else lo[l] for l in range(25)
+        )
+        ahi = tuple(
+            hi[l] ^ blk[l, 1] if l < lanes else hi[l] for l in range(25)
+        )
+        plo, phi = keccak_f1600_lanes(alo, ahi)
+        active = idx < nblocks
         return (
-            jnp.where(active, plo, lo),
-            jnp.where(active, phi, hi),
+            tuple(jnp.where(active, plo[l], lo[l]) for l in range(25)),
+            tuple(jnp.where(active, phi[l], hi[l]) for l in range(25)),
         ), None
 
+    # one up-front transpose to [M, 17, 2, B] so every absorbed lane is a
+    # contiguous batch row inside the scan
     (lo, hi), _ = lax.scan(
         absorb,
         (lo0, hi0),
-        (jnp.moveaxis(blocks, 1, 0), jnp.arange(m_max, dtype=jnp.int32)),
+        (jnp.moveaxis(blocks, 0, -1), jnp.arange(m_max, dtype=jnp.int32)),
     )
     # squeeze 32 bytes = lanes 0..3 -> words [lo0, hi0, lo1, hi1, ...]
-    out = jnp.stack([lo[:, 0], hi[:, 0], lo[:, 1], hi[:, 1], lo[:, 2], hi[:, 2], lo[:, 3], hi[:, 3]], axis=-1)
+    out = jnp.stack(
+        [lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]], axis=-1
+    )
     return out
 
 

@@ -42,19 +42,17 @@ def _rotr(x, n):
 
 
 def _schedule(block):
-    """Message schedule: block [B, 16] -> W [64, B] via scan over a 16-word window."""
-
-    def step(window, _):
-        # window [B, 16] = W[t-16..t-1]
-        w15 = window[:, 1]
-        w2 = window[:, 14]
+    """Message schedule: block [B, 16] -> W [64, B], unrolled over per-word
+    [B] vectors (batch in the VPU minor axis; the scanned [B, 16] window
+    version paid a minor-axis concat relayout per step)."""
+    words = [block[:, i] for i in range(16)]
+    for t in range(48):
+        w15 = words[t + 1]
+        w2 = words[t + 14]
         s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
         s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
-        wt = window[:, 0] + s0 + window[:, 9] + s1
-        return jnp.concatenate([window[:, 1:], wt[:, None]], axis=1), wt
-
-    window, w_rest = lax.scan(step, block, None, length=48)
-    return jnp.concatenate([jnp.moveaxis(block, 1, 0), w_rest], axis=0)
+        words.append(words[t] + s0 + words[t + 9] + s1)
+    return jnp.stack(words, axis=0)
 
 
 def _compress(state, block):

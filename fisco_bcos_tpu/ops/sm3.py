@@ -47,19 +47,17 @@ def _p1(x):
 
 
 def _schedule(block):
-    """block [B, 16] -> (W [68, B], W1 [64, B])."""
-
-    def step(window, _):
-        # window [B, 16] = W[t-16..t-1]; compute W[t]
-        wt = (
-            _p1(window[:, 0] ^ window[:, 7] ^ _rotl(window[:, 13], 15))
-            ^ _rotl(window[:, 3], 7)
-            ^ window[:, 10]
+    """block [B, 16] -> (W [68, B], W1 [64, B]), unrolled over per-word
+    [B] vectors (batch in the VPU minor axis; the scanned [B, 16] window
+    version paid a minor-axis concat relayout per step)."""
+    words = [block[:, i] for i in range(16)]
+    for t in range(52):
+        words.append(
+            _p1(words[t] ^ words[t + 7] ^ _rotl(words[t + 13], 15))
+            ^ _rotl(words[t + 3], 7)
+            ^ words[t + 10]
         )
-        return jnp.concatenate([window[:, 1:], wt[:, None]], axis=1), wt
-
-    _, w_rest = lax.scan(step, block, None, length=52)
-    w = jnp.concatenate([jnp.moveaxis(block, 1, 0), w_rest], axis=0)  # [68, B]
+    w = jnp.stack(words, axis=0)  # [68, B]
     w1 = w[:64] ^ w[4:68]
     return w, w1
 
