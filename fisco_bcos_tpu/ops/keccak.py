@@ -126,13 +126,6 @@ def keccak_f1600_lanes(lo, hi):
     return lo, hi
 
 
-def keccak_f1600(lo: jax.Array, hi: jax.Array):
-    """Keccak-f[1600] over [..., 25] lane pairs (compatibility wrapper:
-    unpacks to the lane-major form, permutes, repacks)."""
-    lo_t = tuple(lo[..., i] for i in range(25))
-    hi_t = tuple(hi[..., i] for i in range(25))
-    lo_t, hi_t = keccak_f1600_lanes(lo_t, hi_t)
-    return jnp.stack(lo_t, axis=-1), jnp.stack(hi_t, axis=-1)
 
 
 @jax.jit

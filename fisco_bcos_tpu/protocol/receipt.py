@@ -84,8 +84,14 @@ class TransactionReceipt:
     block_number: int = 0
     effective_gas_price: str = ""
     _hash: bytes | None = field(default=None, repr=False)
+    _enc: bytes | None = field(default=None, repr=False)
 
     def encode(self) -> bytes:
+        """Cached after first call (same invariant as ``_hash``: the
+        executor builds a receipt fully before anything encodes it; the
+        block path then encodes twice — receipts root and ledger prewrite)."""
+        if self._enc is not None:
+            return self._enc
         w = FlatWriter()
         w.u32(self.version)
         w.u64(self.gas_used)
@@ -95,7 +101,8 @@ class TransactionReceipt:
         w.seq(self.log_entries, lambda w2, e: e.encode_into(w2))
         w.i64(self.block_number)
         w.str_(self.effective_gas_price)
-        return w.out()
+        self._enc = w.out()
+        return self._enc
 
     @classmethod
     def decode(cls, buf: bytes) -> "TransactionReceipt":
@@ -111,6 +118,7 @@ class TransactionReceipt:
             effective_gas_price=r.str_(),
         )
         r.done()
+        rc._enc = bytes(buf)  # seed the wire-form cache with the exact bytes
         return rc
 
     def hash(self, suite: CryptoSuite) -> bytes:

@@ -47,12 +47,19 @@ class Transaction:
     extra_data: bytes = b""
     # caches
     _hash: bytes | None = field(default=None, repr=False)
+    _data: bytes | None = field(default=None, repr=False)
     sender: bytes = b""  # recovered 20-byte address ("forceSender" cache)
 
     # -- canonical bytes ----------------------------------------------------
 
     def encode_data(self) -> bytes:
-        """The signed payload (TransactionData analog) — the hash preimage."""
+        """The signed payload (TransactionData analog) — the hash preimage.
+
+        Cached: the data fields are immutable once a tx is signed (only the
+        signature/annotation section changes), and the block path encodes
+        every tx three times (admission hash, sealing, ledger prewrite)."""
+        if self._data is not None:
+            return self._data
         w = FlatWriter()
         w.u32(self.version)
         w.str_(self.chain_id)
@@ -62,7 +69,8 @@ class Transaction:
         w.bytes_(self.to)
         w.bytes_(self.input)
         w.str_(self.abi)
-        return w.out()
+        self._data = w.out()
+        return self._data
 
     def encode(self) -> bytes:
         """Full wire form: payload + signature + annotations."""
@@ -79,12 +87,23 @@ class Transaction:
         r = FlatReader(buf)
         data = r.bytes_()
         tx = cls._decode_data(data)
+        # seed the payload cache with the EXACT bytes that were signed —
+        # the first hash is free, and re-encoding canonicality never matters
+        tx._data = data
         tx.signature = r.bytes_()
         tx.attribute = r.u32()
         tx.import_time = r.i64()
         tx.extra_data = r.bytes_()
         r.done()
         return tx
+
+    def invalidate_caches(self) -> None:
+        """Drop the payload/hash caches after mutating a data field (test
+        fixtures forging variants; production txs are immutable once
+        signed). One helper so no site can null one cache but not the
+        other."""
+        self._hash = None
+        self._data = None
 
     @classmethod
     def _decode_data(cls, data: bytes) -> "Transaction":

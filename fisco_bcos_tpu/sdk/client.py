@@ -224,9 +224,13 @@ class Contract:
 
     def deploy(self, bytecode: bytes, abi: str = "", timeout: float = 30.0):
         """Deploy `bytecode` (CREATE); returns (contract_address, receipt)."""
-        tx = self.account.sign_tx(to=b"", data=bytecode, abi=abi)
+        # block_limit must be FINAL before signing — it is part of the
+        # signed payload, so mutating it afterwards would break the
+        # signature (and recover a wrong sender) on any chain past genesis
         block_limit = self.client.get_block_number() + 500
-        tx.block_limit = max(tx.block_limit, block_limit)
+        tx = self.account.sign_tx(
+            to=b"", data=bytecode, abi=abi, block_limit=block_limit
+        )
         res = self.client.send_raw_transaction(tx)
         rc = self.client.wait_for_receipt(res["transactionHash"], timeout=timeout)
         if rc.get("status") != 0:
@@ -237,7 +241,10 @@ class Contract:
     def send(self, signature: str, *args, timeout: float = 30.0) -> dict:
         """State-changing call: sign, submit, wait for the receipt."""
         data = self.codec.encode_call(signature, *args)
-        tx = self.account.sign_tx(to=self.address, data=data)
+        block_limit = self.client.get_block_number() + 500
+        tx = self.account.sign_tx(
+            to=self.address, data=data, block_limit=block_limit
+        )
         res = self.client.send_raw_transaction(tx)
         return self.client.wait_for_receipt(res["transactionHash"], timeout=timeout)
 

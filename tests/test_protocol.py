@@ -77,7 +77,7 @@ def test_transaction_roundtrip_and_verify():
     # tampered payload must change the hash and recover a different sender
     tx3 = fac.decode(buf)
     tx3.input = b"transfer(alice,eve,500)"
-    tx3._hash = None
+    tx3.invalidate_caches()
     assert tx3.hash(suite) != tx.hash(suite)
     assert (not tx3.verify(suite)) or tx3.sender != tx.sender
 

@@ -48,7 +48,7 @@ def test_submit_single_and_duplicates():
     # same nonce, different payload -> rejected by pool nonce checker
     (tx2,) = _txs(suite, 1)
     tx2.input = b"different"
-    tx2._hash = None
+    tx2.invalidate_caches()
     tx2.sign(suite.signature_impl.generate_keypair(secret=0x51515), suite)
     assert pool.submit(tx2).status == ErrorCode.ALREADY_IN_TX_POOL
 
@@ -62,7 +62,7 @@ def test_submit_rejects_wrong_chain_group_and_expired():
     assert pool.submit(bad_group).status == ErrorCode.INVALID_GROUP_ID
     expired = _txs(suite, 1)[0]
     expired.block_limit = 0
-    expired._hash = None
+    expired.invalidate_caches()
     assert pool.submit(expired).status == ErrorCode.BLOCK_LIMIT_CHECK_FAIL
 
 
@@ -80,7 +80,7 @@ def test_batch_admit_parity_with_single(suite_fn):
 
     for i, t in enumerate(txs):
         t2 = copy.deepcopy(t)
-        t2._hash = None
+        t2.invalidate_caches()
         cpu_ok = t2.verify(suite)
         if suite.signature_impl.name == "sm2":
             assert bool(ok[i]) == cpu_ok
