@@ -42,10 +42,19 @@ def _init_jax() -> None:
     never touches a device."""
     import jax
 
+    if os.environ.get("FISCO_BENCH_CPU_FALLBACK"):
+        # tunnel down: measure on CPU XLA instead of emitting zeros — the
+        # axon sitecustomize pins JAX_PLATFORMS, so override post-import
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update(
         "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+_CPU_FALLBACK_NOTE = (
+    "TPU tunnel unreachable; measured on CPU XLA fallback (NOT a TPU number)"
+)
 
 BLOCK_TXS = 10_000
 UNIQUE = 64
@@ -66,8 +75,17 @@ _EMITTED: set[str] = set()
 
 
 def _emit(
-    metric: str, value: float, unit: str, vs_baseline: float, error: str | None = None
+    metric: str,
+    value: float,
+    unit: str,
+    vs_baseline: float,
+    error: str | None = None,
+    measured: bool = True,
 ) -> None:
+    # only MEASURED emissions get the fallback tag — a never-measured
+    # placeholder claiming "measured on CPU XLA" would contradict itself
+    if measured and os.environ.get("FISCO_BENCH_CPU_FALLBACK"):
+        error = f"{_CPU_FALLBACK_NOTE}; {error}" if error else _CPU_FALLBACK_NOTE
     rec = {
         "metric": metric,
         "value": round(value, 2),
@@ -402,15 +420,16 @@ def _probe_backend(timeout_s: int = 240) -> bool:
 def _emit_missing(error: str) -> None:
     for metric, unit in ALL_METRICS:
         if metric not in _EMITTED:
-            _emit(metric, 0.0, unit, 0.0, error=error)
+            _emit(metric, 0.0, unit, 0.0, error=error, measured=False)
 
 
 def main() -> None:
     if not _probe_backend():
-        # still publish all 5 lines (value 0 + error) so the artifact is
-        # parseable even when the axon tunnel is down
-        _emit_missing("TPU backend unreachable (axon tunnel down)")
-        raise SystemExit(2)
+        # tunnel down: measure every metric on CPU XLA instead of emitting
+        # zeros — each line carries an explicit NOT-a-TPU-number error tag,
+        # and the run still exits 2 so the driver records the degradation
+        print(f"# {_CPU_FALLBACK_NOTE}", flush=True)
+        os.environ["FISCO_BENCH_CPU_FALLBACK"] = "1"
     import re
     import subprocess
     import sys
@@ -461,7 +480,10 @@ def main() -> None:
                     _EMITTED.add(m.group(1))
     _emit_missing("bench raised before measuring — see '#' comment lines")
     if rc:
-        raise SystemExit(rc)
+        raise SystemExit(rc)  # a child crashed/timed out: keep that signal
+    if os.environ.get("FISCO_BENCH_CPU_FALLBACK"):
+        raise SystemExit(2)  # complete, but the numbers are NOT TPU numbers
+    raise SystemExit(0)
 
 
 def _main_only(name: str) -> None:
