@@ -198,26 +198,37 @@ class Ed25519Crypto(SignatureCrypto):
     sig_len = 96
 
     def generate_keypair(self, secret: int | None = None) -> KeyPair:
+        from .. import native_bind
+
         if secret is None:
             secret = int.from_bytes(secrets.token_bytes(32), "little")
         seed = (secret % (1 << 256)).to_bytes(32, "little")
-        return KeyPair(
-            int.from_bytes(seed, "little"), ref_ed25519.seed_to_pubkey(seed)
-        )
+        pub = native_bind.ed25519_pubkey(seed) or ref_ed25519.seed_to_pubkey(seed)
+        return KeyPair(int.from_bytes(seed, "little"), pub)
 
     @staticmethod
     def _seed(kp: KeyPair) -> bytes:
         return (kp.secret % (1 << 256)).to_bytes(32, "little")
 
     def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
-        return ref_ed25519.sign(self._seed(kp), msg_hash) + kp.pub
+        from .. import native_bind
+
+        sig = native_bind.ed25519_sign(self._seed(kp), msg_hash)
+        if sig is None:
+            sig = ref_ed25519.sign(self._seed(kp), msg_hash)
+        return sig + kp.pub
 
     def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        from .. import native_bind
+
+        ok = native_bind.ed25519_verify(pub[:32], msg_hash, sig[:64])
+        if ok is not None:
+            return ok
         return ref_ed25519.verify(pub[:32], msg_hash, sig[:64])
 
     def recover(self, msg_hash: bytes, sig: bytes) -> bytes:
         pub = sig[64:96]
-        if not ref_ed25519.verify(pub, msg_hash, sig[:64]):
+        if not self.verify(pub, msg_hash, sig[:64] + pub):
             raise ValueError("ed25519 signature does not verify")
         return pub
 

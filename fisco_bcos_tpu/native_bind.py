@@ -170,6 +170,12 @@ def _bind_symbols(lib: ctypes.CDLL, u8p) -> None:
         ctypes.c_size_t, u8p, u8p, u8p, u8p, u8p,
     ]
     lib.fisco_sm2_verify_batch.restype = None
+    lib.fisco_ed25519_verify.argtypes = [u8p, u8p, ctypes.c_size_t, u8p]
+    lib.fisco_ed25519_verify.restype = ctypes.c_int
+    lib.fisco_ed25519_pubkey.argtypes = [u8p, u8p]
+    lib.fisco_ed25519_pubkey.restype = ctypes.c_int
+    lib.fisco_ed25519_sign.argtypes = [u8p, u8p, ctypes.c_size_t, u8p]
+    lib.fisco_ed25519_sign.restype = ctypes.c_int
 
 
 def _hash_via(name: str, data: bytes) -> bytes | None:
@@ -320,6 +326,37 @@ def secp256k1_recover_batch(zs: bytes, rs: bytes, ss: bytes, vs: bytes, n: int):
         n, _buf(zs), _buf(rs), _buf(ss), _buf(vs), pubs_out, ok_out
     )
     return bytes(pubs_out), [bool(b) for b in ok_out]
+
+
+def ed25519_verify(pub: bytes, msg: bytes, sig: bytes) -> bool | None:
+    lib = load()
+    if lib is None:
+        return None
+    if len(pub) != 32 or len(sig) != 64:
+        return False
+    return bool(
+        lib.fisco_ed25519_verify(
+            _buf(pub), _buf(msg or b"\x00"), len(msg), _buf(sig)
+        )
+    )
+
+
+def ed25519_pubkey(seed: bytes) -> bytes | None:
+    lib = load()
+    if lib is None or len(seed) != 32:
+        return None
+    out = (ctypes.c_uint8 * 32)()
+    lib.fisco_ed25519_pubkey(_buf(seed), out)
+    return bytes(out)
+
+
+def ed25519_sign(seed: bytes, msg: bytes) -> bytes | None:
+    lib = load()
+    if lib is None or len(seed) != 32:
+        return None
+    out = (ctypes.c_uint8 * 64)()
+    lib.fisco_ed25519_sign(_buf(seed), _buf(msg or b"\x00"), len(msg), out)
+    return bytes(out)
 
 
 def sm2_verify_batch(es: bytes, rs: bytes, ss: bytes, pubs: bytes, n: int):

@@ -1064,6 +1064,433 @@ int fisco_ec_pubkey(int curve, const uint8_t d32[32], uint8_t pub_out[64]) {
     return 1;
 }
 
+// ===========================================================================
+// Ed25519 (RFC 8032) — the third signature suite's single-item host path.
+// Reference: bcos-crypto/signature/ed25519/Ed25519Crypto.cpp (wedpr FFI).
+// Bit-identical to fisco_bcos_tpu/crypto/ref/ed25519.py: extended twisted-
+// Edwards coordinates, cofactored verification 8SB == 8R + 8kA, the RFC
+// 8032 §5.1.7 s < L malleability guard.
+// ===========================================================================
+
+namespace {
+
+// ---- SHA-512 (FIPS 180-4) -------------------------------------------------
+
+static const uint64_t SHA512_K[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL,
+};
+
+static inline uint64_t ror64(uint64_t x, int n) {
+    return (x >> n) | (x << (64 - n));
+}
+
+static void sha512_block(uint64_t h[8], const uint8_t* p) {
+    uint64_t w[80];
+    for (int i = 0; i < 16; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | p[8 * i + j];
+        w[i] = v;
+    }
+    for (int i = 16; i < 80; i++) {
+        uint64_t s0 = ror64(w[i - 15], 1) ^ ror64(w[i - 15], 8) ^ (w[i - 15] >> 7);
+        uint64_t s1 = ror64(w[i - 2], 19) ^ ror64(w[i - 2], 61) ^ (w[i - 2] >> 6);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint64_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint64_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 80; i++) {
+        uint64_t S1 = ror64(e, 14) ^ ror64(e, 18) ^ ror64(e, 41);
+        uint64_t ch = (e & f) ^ ((~e) & g);
+        uint64_t t1 = hh + S1 + ch + SHA512_K[i] + w[i];
+        uint64_t S0 = ror64(a, 28) ^ ror64(a, 34) ^ ror64(a, 39);
+        uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint64_t t2 = S0 + maj;
+        hh = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static void sha512(const uint8_t* data, size_t len, uint8_t out[64]) {
+    uint64_t h[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+        0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+    };
+    size_t full = len / 128;
+    for (size_t i = 0; i < full; i++) sha512_block(h, data + 128 * i);
+    uint8_t tail[256];
+    size_t rem = len - 128 * full;
+    std::memcpy(tail, data + 128 * full, rem);
+    tail[rem] = 0x80;
+    size_t tail_len = (rem + 17 <= 128) ? 128 : 256;
+    std::memset(tail + rem + 1, 0, tail_len - rem - 1);
+    uint64_t bits = uint64_t(len) * 8;  // messages < 2^61 bytes
+    for (int i = 0; i < 8; i++)
+        tail[tail_len - 1 - i] = uint8_t(bits >> (8 * i));
+    sha512_block(h, tail);
+    if (tail_len == 256) sha512_block(h, tail + 128);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++)
+            out[8 * i + j] = uint8_t(h[i] >> (8 * (7 - j)));
+}
+
+// ---- edwards25519 ---------------------------------------------------------
+
+struct EdPt {
+    U256 X, Y, Z, T;  // extended coordinates, Montgomery domain
+};
+
+struct EdCtx {
+    Mont fp;        // mod P = 2^255 - 19
+    Mont fl;        // mod L (group order)
+    U256 P, L;      // plain
+    U256 d;         // curve d, Montgomery domain
+    U256 sqrt_m1;   // 2^((P-1)/4), Montgomery domain
+    U256 exp_x;     // (P+3)/8, plain exponent
+    U256 bx, by;    // base point affine, Montgomery domain
+    EdPt B;         // base point, extended
+    EdPt b_tab[16]; // 4-bit window table for B (b_tab[0] = identity)
+};
+
+static EdPt ed_identity(const EdCtx& C);
+static EdPt ed_add(const EdCtx& C, const EdPt& p, const EdPt& q);
+static void ed_build_tab(const EdCtx& C, const EdPt& p, EdPt tab[16]);
+
+static const EdCtx& ed_ctx() {
+    static const EdCtx C = [] {
+        EdCtx c;
+        // P = 2^255 - 19
+        c.P = {{0xffffffffffffffedULL, 0xffffffffffffffffULL,
+                0xffffffffffffffffULL, 0x7fffffffffffffffULL}};
+        // L = 2^252 + 27742317777372353535851937790883648493
+        c.L = {{0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL,
+                0x0000000000000000ULL, 0x1000000000000000ULL}};
+        mont_init(c.fp, c.P);
+        mont_init(c.fl, c.L);
+        // d = -121665/121666 mod P
+        U256 n121665 = {{121665, 0, 0, 0}};
+        U256 n121666 = {{121666, 0, 0, 0}};
+        U256 inv = mont_inv(c.fp, mont_to(c.fp, n121666));
+        U256 dm = mont_mul(c.fp, mont_to(c.fp, n121665), inv);
+        U256 zero = U256_ZERO;
+        c.d = mod_sub(c.fp, zero, dm);  // negate
+        // exponents: (P+3)/8 and sqrt(-1) = 2^((P-1)/4)
+        U256 p3;
+        static const U256 three = {{3, 0, 0, 0}};
+        u256_add(p3, c.P, three);  // no overflow (P < 2^255)
+        for (int i = 0; i < 4; i++)
+            c.exp_x.w[i] = (p3.w[i] >> 3) | (i < 3 ? (p3.w[i + 1] << 61) : 0);
+        U256 p1;
+        static const U256 one_c = {{1, 0, 0, 0}};
+        u256_sub(p1, c.P, one_c);
+        U256 e4;
+        for (int i = 0; i < 4; i++)
+            e4.w[i] = (p1.w[i] >> 2) | (i < 3 ? (p1.w[i + 1] << 62) : 0);
+        U256 two = {{2, 0, 0, 0}};
+        c.sqrt_m1 = mont_pow(c.fp, mont_to(c.fp, two), e4);
+        // base point: y = 4/5, x recovered with sign 0
+        U256 four = {{4, 0, 0, 0}};
+        U256 five = {{5, 0, 0, 0}};
+        c.by = mont_mul(
+            c.fp, mont_to(c.fp, four), mont_inv(c.fp, mont_to(c.fp, five)));
+        // x^2 = (y^2 - 1) / (d y^2 + 1)
+        U256 y2 = mont_sqr(c.fp, c.by);
+        U256 onem = c.fp.one;
+        U256 num = mod_sub(c.fp, y2, onem);
+        U256 den = mod_add(c.fp, mont_mul(c.fp, c.d, y2), onem);
+        U256 x2 = mont_mul(c.fp, num, mont_inv(c.fp, den));
+        U256 x = mont_pow(c.fp, x2, c.exp_x);
+        if (!u256_eq(mont_sqr(c.fp, x), x2))
+            x = mont_mul(c.fp, x, c.sqrt_m1);
+        U256 xp = mont_from(c.fp, x);
+        if (xp.w[0] & 1) {  // base x has sign 0
+            u256_sub(xp, c.P, xp);
+            x = mont_to(c.fp, xp);
+        }
+        c.bx = x;
+        c.B = {c.bx, c.by, c.fp.one, mont_mul(c.fp, c.bx, c.by)};
+        ed_build_tab(c, c.B, c.b_tab);
+        return c;
+    }();
+    return C;
+}
+
+static EdPt ed_identity(const EdCtx& C) {
+    return {U256_ZERO, C.fp.one, C.fp.one, U256_ZERO};
+}
+
+// unified extended addition (matches crypto/ref/ed25519.py:_add)
+static EdPt ed_add(const EdCtx& C, const EdPt& p, const EdPt& q) {
+    const Mont& F = C.fp;
+    U256 a = mont_mul(F, mod_sub(F, p.Y, p.X), mod_sub(F, q.Y, q.X));
+    U256 b = mont_mul(F, mod_add(F, p.Y, p.X), mod_add(F, q.Y, q.X));
+    U256 t2 = mont_mul(F, p.T, q.T);
+    U256 cc = mont_mul(F, mod_add(F, t2, t2), C.d);
+    U256 zz = mont_mul(F, p.Z, q.Z);
+    U256 dd = mod_add(F, zz, zz);
+    U256 e = mod_sub(F, b, a);
+    U256 f = mod_sub(F, dd, cc);
+    U256 g = mod_add(F, dd, cc);
+    U256 h = mod_add(F, b, a);
+    return {
+        mont_mul(F, e, f),
+        mont_mul(F, g, h),
+        mont_mul(F, f, g),
+        mont_mul(F, e, h),
+    };
+}
+
+static void ed_build_tab(const EdCtx& C, const EdPt& p, EdPt tab[16]) {
+    tab[0] = ed_identity(C);
+    tab[1] = p;
+    for (int i = 2; i < 16; i++)
+        tab[i] = (i & 1) ? ed_add(C, tab[i - 1], p)
+                         : ed_add(C, tab[i / 2], tab[i / 2]);
+}
+
+// 4-bit fixed-window scalar mult over a prebuilt table (same shape as the
+// Weierstrass pt_mul_tab; the unified Edwards add needs no special cases)
+static EdPt ed_mul_tab(const EdCtx& C, const U256& s, const EdPt tab[16]) {
+    EdPt q = ed_identity(C);
+    bool started = false;
+    for (int w = 63; w >= 0; w--) {
+        if (started) {
+            q = ed_add(C, q, q);
+            q = ed_add(C, q, q);
+            q = ed_add(C, q, q);
+            q = ed_add(C, q, q);
+        }
+        unsigned dgt = (s.w[w / 16] >> (4 * (w % 16))) & 0xf;
+        if (dgt) {
+            q = ed_add(C, q, tab[dgt]);
+            started = true;
+        }
+    }
+    return q;
+}
+
+static EdPt ed_mul(const EdCtx& C, const U256& s, const EdPt& p) {
+    EdPt tab[16];
+    ed_build_tab(C, p, tab);
+    return ed_mul_tab(C, s, tab);
+}
+
+// decompress 32 LE bytes -> point; false when off-curve/non-canonical
+// (matches crypto/ref/ed25519.py:_recover_x/_decompress)
+static bool ed_decompress(const EdCtx& C, const uint8_t in[32], EdPt& out) {
+    uint8_t le[32];
+    std::memcpy(le, in, 32);
+    int sign = le[31] >> 7;
+    le[31] &= 0x7f;
+    // bytes are little-endian; u256_load_be wants big-endian
+    uint8_t be[32];
+    for (int i = 0; i < 32; i++) be[i] = le[31 - i];
+    U256 y = u256_load_be(be);
+    if (u256_cmp(y, C.P) >= 0) return false;
+    const Mont& F = C.fp;
+    U256 ym = mont_to(F, y);
+    U256 y2 = mont_sqr(F, ym);
+    U256 num = mod_sub(F, y2, F.one);
+    U256 den = mod_add(F, mont_mul(F, C.d, y2), F.one);
+    U256 x2 = mont_mul(F, num, mont_inv(F, den));
+    if (u256_is_zero(x2)) {
+        if (sign != 0) return false;
+        out = {U256_ZERO, ym, F.one, U256_ZERO};
+        return true;
+    }
+    U256 x = mont_pow(F, x2, C.exp_x);
+    if (!u256_eq(mont_sqr(F, x), x2)) x = mont_mul(F, x, C.sqrt_m1);
+    if (!u256_eq(mont_sqr(F, x), x2)) return false;
+    U256 xp = mont_from(F, x);
+    if ((int)(xp.w[0] & 1) != sign) {
+        u256_sub(xp, C.P, xp);
+        x = mont_to(F, xp);
+    }
+    out = {x, ym, F.one, mont_mul(F, x, ym)};
+    return true;
+}
+
+static void ed_compress(const EdCtx& C, const EdPt& p, uint8_t out[32]) {
+    const Mont& F = C.fp;
+    U256 zi = mont_inv(F, p.Z);
+    U256 x = mont_from(F, mont_mul(F, p.X, zi));
+    U256 y = mont_from(F, mont_mul(F, p.Y, zi));
+    uint8_t be[32];
+    u256_store_be(y, be);
+    for (int i = 0; i < 32; i++) out[i] = be[31 - i];
+    out[31] |= uint8_t((x.w[0] & 1) << 7);
+}
+
+static bool ed_eq(const EdCtx& C, const EdPt& p, const EdPt& q) {
+    const Mont& F = C.fp;
+    // x1 z2 == x2 z1 and y1 z2 == y2 z1
+    return u256_eq(mont_mul(F, p.X, q.Z), mont_mul(F, q.X, p.Z)) &&
+           u256_eq(mont_mul(F, p.Y, q.Z), mont_mul(F, q.Y, p.Z));
+}
+
+// 64-byte little-endian hash -> scalar mod L
+static U256 ed_scalar_from_hash64(const EdCtx& C, const uint8_t h[64]) {
+    uint8_t be_lo[32], be_hi[32];
+    for (int i = 0; i < 32; i++) be_lo[i] = h[31 - i];
+    for (int i = 0; i < 32; i++) be_hi[i] = h[63 - i];
+    U256 lo = u256_mod(u256_load_be(be_lo), C.L);
+    U256 hi = u256_mod(u256_load_be(be_hi), C.L);
+    // hi * 2^256 + lo  (mod L);  fl.one == 2^256 mod L
+    const Mont& N = C.fl;
+    U256 hi_shift = mont_from(
+        N, mont_mul(N, mont_to(N, hi), mont_to(N, N.one)));
+    U256 out;
+    uint64_t carry = u256_add(out, hi_shift, lo);
+    if (carry || u256_cmp(out, C.L) >= 0) u256_sub(out, out, C.L);
+    return out;
+}
+
+// multiply a scalar (< L or < 2^253) by small m (8), plain domain, no mod
+static U256 u256_small_mul(const U256& a, uint64_t m) {
+    U256 r;
+    u128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 cur = (u128)a.w[i] * m + carry;
+        r.w[i] = (uint64_t)cur;
+        carry = cur >> 64;
+    }
+    return r;  // callers guarantee no 2^256 overflow (8L < 2^256)
+}
+
+}  // namespace
+
+// verify a 64-byte R‖S signature over msg with a 32-byte compressed pubkey
+// (semantics: crypto/ref/ed25519.py:126-140, cofactored)
+int fisco_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
+                         size_t msg_len, const uint8_t sig[64]) {
+    const EdCtx& C = ed_ctx();
+    EdPt A, R;
+    if (!ed_decompress(C, pub, A) || !ed_decompress(C, sig, R)) return 0;
+    uint8_t s_be[32];
+    for (int i = 0; i < 32; i++) s_be[i] = sig[63 - i];
+    U256 s = u256_load_be(s_be);
+    if (u256_cmp(s, C.L) >= 0) return 0;  // malleability guard
+    // k = SHA512(R ‖ pub ‖ msg) mod L
+    uint8_t buf_stack[4096];
+    uint8_t* buf = buf_stack;
+    size_t total = 64 + msg_len;
+    uint8_t* heap = nullptr;
+    if (total > sizeof(buf_stack)) {
+        heap = new uint8_t[total];
+        buf = heap;
+    }
+    std::memcpy(buf, sig, 32);
+    std::memcpy(buf + 32, pub, 32);
+    if (msg_len) std::memcpy(buf + 64, msg, msg_len);
+    uint8_t kh[64];
+    sha512(buf, total, kh);
+    delete[] heap;
+    U256 k = ed_scalar_from_hash64(C, kh);
+    // 8sB == 8R + (8k)A
+    EdPt lhs = ed_mul_tab(C, u256_small_mul(s, 8), C.b_tab);
+    EdPt r8 = R;
+    for (int i = 0; i < 3; i++) r8 = ed_add(C, r8, r8);
+    EdPt rhs = ed_add(C, r8, ed_mul(C, u256_small_mul(k, 8), A));
+    return ed_eq(C, lhs, rhs) ? 1 : 0;
+}
+
+// seed -> 32-byte compressed pubkey (crypto/ref/ed25519.py:108-111)
+int fisco_ed25519_pubkey(const uint8_t seed[32], uint8_t pub_out[32]) {
+    const EdCtx& C = ed_ctx();
+    uint8_t h[64];
+    sha512(seed, 32, h);
+    h[0] &= 0xf8;
+    h[31] &= 0x7f;
+    h[31] |= 0x40;
+    uint8_t be[32];
+    for (int i = 0; i < 32; i++) be[i] = h[31 - i];
+    U256 a = u256_load_be(be);
+    ed_compress(C, ed_mul_tab(C, a, C.b_tab), pub_out);
+    return 1;
+}
+
+// deterministic RFC 8032 sign (crypto/ref/ed25519.py:114-123)
+int fisco_ed25519_sign(const uint8_t seed[32], const uint8_t* msg,
+                       size_t msg_len, uint8_t sig_out[64]) {
+    const EdCtx& C = ed_ctx();
+    uint8_t h[64];
+    sha512(seed, 32, h);
+    uint8_t a_bytes[32];
+    std::memcpy(a_bytes, h, 32);
+    a_bytes[0] &= 0xf8;
+    a_bytes[31] &= 0x7f;
+    a_bytes[31] |= 0x40;
+    uint8_t be[32];
+    for (int i = 0; i < 32; i++) be[i] = a_bytes[31 - i];
+    U256 a = u256_load_be(be);
+    uint8_t apub[32];
+    ed_compress(C, ed_mul_tab(C, a, C.b_tab), apub);
+    // r = SHA512(prefix ‖ msg) mod L
+    size_t total = 32 + msg_len;
+    uint8_t buf_stack[4096];
+    uint8_t* buf = buf_stack;
+    uint8_t* heap = nullptr;
+    if (total + 32 > sizeof(buf_stack)) {  // reused below with 64-byte head
+        heap = new uint8_t[total + 32];
+        buf = heap;
+    }
+    std::memcpy(buf, h + 32, 32);
+    if (msg_len) std::memcpy(buf + 32, msg, msg_len);
+    uint8_t rh[64];
+    sha512(buf, total, rh);
+    U256 r = ed_scalar_from_hash64(C, rh);
+    uint8_t rpt[32];
+    ed_compress(C, ed_mul_tab(C, r, C.b_tab), rpt);
+    // k = SHA512(R ‖ A ‖ msg) mod L
+    std::memcpy(buf, rpt, 32);
+    std::memcpy(buf + 32, apub, 32);
+    if (msg_len) std::memcpy(buf + 64, msg, msg_len);
+    uint8_t kh[64];
+    sha512(buf, 64 + msg_len, kh);
+    delete[] heap;
+    U256 k = ed_scalar_from_hash64(C, kh);
+    // s = (r + k a) mod L
+    const Mont& N = C.fl;
+    U256 ka = mont_from(
+        N, mont_mul(N, mont_to(N, k), mont_to(N, u256_mod(a, C.L))));
+    U256 s;
+    uint64_t carry = u256_add(s, r, ka);
+    if (carry || u256_cmp(s, C.L) >= 0) u256_sub(s, s, C.L);
+    std::memcpy(sig_out, rpt, 32);
+    uint8_t s_be[32];
+    u256_store_be(s, s_be);
+    for (int i = 0; i < 32; i++) sig_out[32 + i] = s_be[31 - i];
+    return 1;
+}
+
 // batch verify loops — the honest native CPU baselines for bench.py
 // (one call, n items, out[i] = 1/0)
 void fisco_secp256k1_verify_batch(size_t n, const uint8_t* zs,

@@ -148,3 +148,43 @@ def test_native_batch_loops_match_single():
             assert not oks[i]
         else:
             assert oks[i] and pubs_out[64 * i : 64 * i + 64] == _pub_bytes(golden)
+
+
+def test_ed25519_native_identity():
+    import hashlib
+
+    from fisco_bcos_tpu.crypto.ref import ed25519 as ref_ed
+
+    for i in range(4):
+        seed = hashlib.sha256(b"ned %d" % i).digest()
+        msg = b"packet %d" % i
+        pub = ref_ed.seed_to_pubkey(seed)
+        assert native_bind.ed25519_pubkey(seed) == pub
+        sig = ref_ed.sign(seed, msg)
+        assert native_bind.ed25519_sign(seed, msg) == sig
+        assert native_bind.ed25519_verify(pub, msg, sig) is True
+        assert native_bind.ed25519_verify(pub, msg + b"!", sig) is False
+    # RFC 8032 §5.1.7 malleability guard: s >= L rejected
+    s_big = (int.from_bytes(sig[32:], "little") + ref_ed.L).to_bytes(32, "little")
+    assert native_bind.ed25519_verify(pub, msg, sig[:32] + s_big) is False
+    # non-canonical compressed y >= P rejected
+    assert native_bind.ed25519_verify((ref_ed.P + 1).to_bytes(32, "little"), msg, sig) is False
+
+
+def test_ed25519_suite_single_item_uses_native():
+    import hashlib
+
+    from fisco_bcos_tpu.crypto.ref import ed25519 as ref_ed
+
+    impl = suite_mod.Ed25519Crypto()
+    kp = impl.generate_keypair(secret=424242)
+    seed = (424242).to_bytes(32, "little")
+    assert kp.pub == ref_ed.seed_to_pubkey(seed)
+    msg = hashlib.sha256(b"suite-ed").digest()
+    sig = impl.sign(kp, msg)
+    assert sig == ref_ed.sign(seed, msg) + kp.pub
+    assert impl.verify(kp.pub, msg, sig)
+    assert impl.recover(msg, sig) == kp.pub
+    bad = bytearray(sig)
+    bad[5] ^= 1
+    assert not impl.verify(kp.pub, msg, bytes(bad))
