@@ -39,6 +39,7 @@ from ..protocol.transaction import Transaction
 from ..storage.interfaces import StorageInterface, TransactionalStorage, TwoPCParams
 from ..storage.state_storage import StateStorage
 from ..utils.log import get_logger
+from ..utils.ripemd160 import ripemd160
 from .evm import (
     MAX_CALL_DEPTH,
     MAX_CODE_SIZE,
@@ -185,10 +186,16 @@ class TransactionExecutor:
                 gas_left=max(msg.gas - 60 - 12 * ((len(data) + 31) // 32), 0),
             )
         if msg.code_address == _RIPEMD160:
+            # OpenSSL when the host has it, vendored pure-Python otherwise —
+            # BOTH compute real RIPEMD-160 (vector-checked against each other
+            # in tests/test_eth_builtins.py), so differing OpenSSL configs
+            # can no longer fork state roots the way the old sha256-derived
+            # fabricated fallback could (ref Precompiled.cpp:68 links a real
+            # impl unconditionally).
             try:
                 digest = hashlib.new("ripemd160", data).digest()
-            except Exception:  # openssl without legacy provider
-                digest = hashlib.sha256(b"ripemd160-unavailable" + data).digest()[:20]
+            except ValueError:  # OpenSSL 3.x without the legacy provider
+                digest = ripemd160(data)
             return EVMResult(
                 output=b"\x00" * 12 + digest,
                 gas_left=max(msg.gas - 600 - 120 * ((len(data) + 31) // 32), 0),

@@ -89,10 +89,12 @@ def _needs_rebuild() -> bool:
     tag = _isa_tag()
     if tag is not None and tag != _host_isa():
         return True  # -march=native artifact from a different CPU: SIGILL risk
-    if tag is None and os.path.exists(_SRC):
-        return True  # unknown provenance but we CAN rebuild: do it
-    # tag matches, or a source-less prebuilt install (tag absent): trust it —
-    # the stale-symbol guard in load() catches ABI drift
+    if tag is None:
+        # unknown provenance: rebuild when we can; when we can't (source-less
+        # packaged install), load() refuses it — the library was built with
+        # -march=native and a wrong-CPU copy SIGILLs, which no symbol guard
+        # can catch. Packaged installs must ship the .isa tag beside the .so.
+        return True
     return False
 
 
@@ -109,8 +111,9 @@ def load() -> ctypes.CDLL | None:
             if not os.path.exists(_SRC):
                 if os.path.exists(_LIB):
                     _log.warning(
-                        "prebuilt %s was built for a different CPU and no "
-                        "source is available to rebuild; using pure-Python "
+                        "prebuilt %s has no matching .isa tag and no source "
+                        "to rebuild from; refusing to load it (-march=native "
+                        "artifacts SIGILL on other CPUs) — using pure-Python "
                         "crypto instead", _LIB,
                     )
                 return None
@@ -346,7 +349,8 @@ def ed25519_pubkey(seed: bytes) -> bytes | None:
     if lib is None or len(seed) != 32:
         return None
     out = (ctypes.c_uint8 * 32)()
-    lib.fisco_ed25519_pubkey(_buf(seed), out)
+    if not lib.fisco_ed25519_pubkey(_buf(seed), out):
+        return None  # native failure: caller falls back to crypto/ref
     return bytes(out)
 
 
@@ -355,7 +359,8 @@ def ed25519_sign(seed: bytes, msg: bytes) -> bytes | None:
     if lib is None or len(seed) != 32:
         return None
     out = (ctypes.c_uint8 * 64)()
-    lib.fisco_ed25519_sign(_buf(seed), _buf(msg or b"\x00"), len(msg), out)
+    if not lib.fisco_ed25519_sign(_buf(seed), _buf(msg or b"\x00"), len(msg), out):
+        return None  # native failure: caller falls back to crypto/ref
     return bytes(out)
 
 

@@ -121,6 +121,14 @@ class TransactionReceipt:
         rc._enc = bytes(buf)  # seed the wire-form cache with the exact bytes
         return rc
 
+    def invalidate_caches(self) -> None:
+        """Drop the wire-form/hash caches after mutating a field (mirrors
+        Transaction.invalidate_caches so mutation sites have one correct
+        idiom; a stale ``_enc`` would re-serialize pre-mutation bytes into
+        the receipts root)."""
+        self._enc = None
+        self._hash = None
+
     def hash(self, suite: CryptoSuite) -> bytes:
         if self._hash is None:
             self._hash = suite.hash(self.encode())

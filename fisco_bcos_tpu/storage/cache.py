@@ -102,12 +102,11 @@ class CacheStorage(TransactionalStorage):
 
     def rollback(self, params: TwoPCParams) -> None:
         self.inner.rollback(params)
+        with self._lock:
+            self._staged_keys.pop(params.number, None)
 
     def pending_numbers(self) -> list[int]:
         return self.inner.pending_numbers()
-
-        with self._lock:
-            self._staged_keys.pop(params.number, None)
 
     def close(self) -> None:
         close = getattr(self.inner, "close", None)

@@ -100,3 +100,27 @@ def test_cache_storage_hits_writes_and_2pc_invalidation():
     cache.set_row("t", b"k2", Entry(status=EntryStatus.DELETED))
     assert cache.get_row("t", b"k2") is None
     assert inner.get_row("t", b"k2") is None
+
+
+def test_cache_storage_rollback_releases_staged_keys():
+    """A rolled-back 2PC batch must drop its staged-key list (a leak here
+    grows unboundedly on a view-change-heavy chain) and must NOT invalidate
+    cached rows — the backend never applied the writes."""
+    inner = MemoryStorage()
+    cache = CacheStorage(inner)
+    inner.set_row("t", b"k", Entry({"value": b"old"}))
+    assert cache.get_row("t", b"k").get() == b"old"
+
+    writes = MemoryStorage()
+    writes.set_row("t", b"k", Entry({"value": b"never-lands"}))
+    params = TwoPCParams(number=7)
+    cache.prepare(params, writes)
+    assert 7 in cache._staged_keys
+    cache.rollback(params)
+    assert 7 not in cache._staged_keys  # no leak
+    assert cache.get_row("t", b"k").get() == b"old"
+    # a later commit of the same number is a no-op on the cache
+    hits_before = cache.hits
+    cache.commit(TwoPCParams(number=7))
+    assert cache.get_row("t", b"k").get() == b"old"
+    assert cache.hits == hits_before + 1  # still cached: rollback didn't evict

@@ -108,6 +108,49 @@ class TestBn128AddMul:
         assert eb.bn128_mul(b"", 5999)[0] != 0
 
 
+class TestRipemd160:
+    """Vendored RIPEMD-160 (utils/ripemd160.py) against the official
+    Dobbertin/Bosselaers/Preneel vectors, plus agreement with hashlib when
+    the host OpenSSL still ships the algorithm."""
+
+    VECTORS = {
+        b"": "9c1185a5c5e9fc54612808977ee8f548b2258d31",
+        b"a": "0bdc9d2d256b3ee9daae347be6f4dc835a467ffe",
+        b"abc": "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc",
+        b"message digest": "5d0689ef49d2fae572b881b123a85ffa21595f36",
+        b"abcdefghijklmnopqrstuvwxyz": "f71c27109c692c1b56bbdceb5b9d2865b3708dbc",
+        b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq":
+            "12a053384a9c0c88e405a06c27dcf49ada62eb2b",
+        b"1234567890" * 8: "9b752e45573d4b39f4dbd3323cab82bf63326bfb",
+    }
+
+    def test_official_vectors(self):
+        from fisco_bcos_tpu.utils.ripemd160 import ripemd160
+
+        for msg, want in self.VECTORS.items():
+            assert ripemd160(msg).hex() == want, msg[:16]
+
+    def test_million_a(self):
+        from fisco_bcos_tpu.utils.ripemd160 import ripemd160
+
+        assert ripemd160(b"a" * 1_000_000).hex() == (
+            "52783243c1697bdbe16d37f97f68f08325dc1528"
+        )
+
+    def test_agrees_with_hashlib_when_available(self):
+        import hashlib
+
+        from fisco_bcos_tpu.utils.ripemd160 import ripemd160
+
+        try:
+            ref = hashlib.new("ripemd160")
+        except ValueError:
+            pytest.skip("host OpenSSL lacks ripemd160 (vendored path is sole impl)")
+        for msg in (b"", b"x", b"y" * 63, b"z" * 64, b"w" * 65, b"q" * 1000):
+            ref = hashlib.new("ripemd160", msg)
+            assert ripemd160(msg) == ref.digest()
+
+
 def _g2_bytes(q) -> bytes:
     (xr, xi), (yr, yi) = q
     return _w(xi) + _w(xr) + _w(yi) + _w(yr)  # EIP-197: imaginary first
@@ -152,6 +195,58 @@ class TestBn128Pairing:
     def test_ragged_length_rejected(self):
         st, _, gas_left = eb.bn128_pairing(b"\x00" * 191, GAS)
         assert st != 0 and gas_left == 0
+
+    # External EIP-197 known-answer vectors — the public go-ethereum
+    # bn256Pairing test corpus (geth core/vm/contracts_test.go; the
+    # reference vendors the same data at
+    # bcos-executor/test/old/EVMPrecompiledTest.cpp:1242). These pin
+    # wire-level compatibility (twist convention, imaginary-first G2
+    # encoding) that self-consistency checks cannot.
+    _KAT_JEFF1 = (
+        "1c76476f4def4bb94541d57ebba1193381ffa7aa76ada664dd31c16024c43f59"
+        "3034dd2920f673e204fee2811c678745fc819b55d3e9d294e45c9b03a76aef41"
+        "209dd15ebff5d46c4bd888e51a93cf99a7329636c63514396b4a452003a35bf7"
+        "04bf11ca01483bfa8b34b43561848d28905960114c8ac04049af4b6315a41678"
+        "2bb8324af6cfc93537a2ad1a445cfd0ca2a71acd7ac41fadbf933c2a51be344d"
+        "120a2a4cf30c1bf9845f20c6fe39e07ea2cce61f0c9bb048165fe5e4de877550"
+        "111e129f1cf1097710d41c4ac70fcdfa5ba2023c6ff1cbeac322de49d1b6df7c"
+        "2032c61a830e3c17286de9462bf242fca2883585b93870a73853face6a6bf411"
+        "198e9393920d483a7260bfb731fb5d25f1aa493335a9e71297e485b7aef312c2"
+        "1800deef121f1e76426a00665e5c4479674322d4f75edadd46debd5cd992f6ed"
+        "090689d0585ff075ec9e99ad690c3395bc4b313370b38ef355acdadcd122975b"
+        "12c85ea5db8c6deb4aab71808dcb408fe3d1e7690c43d37b4ce6cc0166fa7daa"
+    )
+    _KAT_ONE_POINT = (
+        "0000000000000000000000000000000000000000000000000000000000000001"
+        "0000000000000000000000000000000000000000000000000000000000000002"
+        "198e9393920d483a7260bfb731fb5d25f1aa493335a9e71297e485b7aef312c2"
+        "1800deef121f1e76426a00665e5c4479674322d4f75edadd46debd5cd992f6ed"
+        "090689d0585ff075ec9e99ad690c3395bc4b313370b38ef355acdadcd122975b"
+        "12c85ea5db8c6deb4aab71808dcb408fe3d1e7690c43d37b4ce6cc0166fa7daa"
+    )
+    _KAT_TWO_POINT_MATCH_2 = (
+        _KAT_ONE_POINT
+        + "0000000000000000000000000000000000000000000000000000000000000001"
+        "0000000000000000000000000000000000000000000000000000000000000002"
+        "198e9393920d483a7260bfb731fb5d25f1aa493335a9e71297e485b7aef312c2"
+        "1800deef121f1e76426a00665e5c4479674322d4f75edadd46debd5cd992f6ed"
+        "275dc4a288d1afb3cbb1ac09187524c7db36395df7be3b99e673b13a075a65ec"
+        "1d9befcd05a5323e6da4d435f3b617cdb3af83285c2df711ef39c01571827f9d"
+    )
+
+    @pytest.mark.parametrize(
+        "hex_input,expected",
+        [
+            (_KAT_JEFF1, 1),
+            (_KAT_ONE_POINT, 0),
+            (_KAT_TWO_POINT_MATCH_2, 1),
+        ],
+        ids=["geth_jeff1", "geth_one_point", "geth_two_point_match_2"],
+    )
+    def test_eip197_known_answer(self, hex_input, expected):
+        st, out, _ = eb.bn128_pairing(bytes.fromhex(hex_input), GAS)
+        assert st == 0
+        assert int.from_bytes(out, "big") == expected
 
     def test_g2_subgroup_enforced(self):
         # a point ON the twist curve but OUTSIDE the order-N subgroup (the

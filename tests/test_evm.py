@@ -84,6 +84,18 @@ class TestEVMDeployAndCall:
         rc = executor.execute_transactions([_tx(b"\x99" * 20, b"\x01\x02\x03\x04")])[0]
         assert rc.status == int(TransactionStatus.CALL_ADDRESS_ERROR)
 
+    def test_ripemd160_builtin_uses_vendored_impl(self, executor):
+        """0x03 must produce the REAL RIPEMD-160 digest on every host — the
+        old fallback fabricated a sha256-derived value when OpenSSL lacked
+        the legacy provider, forking state roots between nodes (ref
+        Precompiled.cpp:68). Official test vector pins it."""
+        rc = executor.execute_transactions([_tx((3).to_bytes(20, "big"), b"abc")])[0]
+        assert rc.status == 0
+        assert rc.output.hex() == (
+            "000000000000000000000000"  # left-padded to 32 bytes
+            "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+        )
+
     def test_ecrecover_builtin(self, executor):
         import hashlib
 
