@@ -17,6 +17,7 @@ precompiled/common/Utilities.cpp).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Any
 
 _WORD = 32
@@ -86,7 +87,10 @@ def split_toplevel(s: str, sep: str = ",") -> list[str]:
     return [p.strip() for p in parts if p.strip()]
 
 
+@lru_cache(maxsize=4096)
 def parse_type(s: str) -> AbiType:
+    # memoized: AbiType is frozen, and block execution parses the same few
+    # signatures for every tx (a top host cost in the flood profile)
     s = s.strip()
     if not s:
         raise ValueError("empty type")

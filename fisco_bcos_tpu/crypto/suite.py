@@ -148,6 +148,31 @@ def _make_keypair(curve: ref_ecdsa.Curve, secret: int | None) -> KeyPair:
 # legs (tests/test_native_ec.py pins it).
 _SMALL_BATCH = 256
 
+_BACKEND_IS_CPU: bool | None = None
+
+
+def device_backend_is_cpu() -> bool:
+    """True when the jax device plane is CPU XLA (no accelerator): there the
+    native C loop beats the XLA program at EVERY batch size (~0.3ms/sig vs
+    4-16ms/sig of emulated 256-bit limb arithmetic), so batch dispatchers
+    should prefer the host path regardless of _SMALL_BATCH. Cached: backend
+    identity cannot change within a process."""
+    global _BACKEND_IS_CPU
+    if _BACKEND_IS_CPU is None:
+        try:
+            import jax
+
+            _BACKEND_IS_CPU = jax.default_backend() == "cpu"
+        except Exception:
+            _BACKEND_IS_CPU = True
+    return _BACKEND_IS_CPU
+
+
+def use_native_batch(n: int) -> bool:
+    """Whether an n-item signature batch should ride the native host loop
+    instead of a device program."""
+    return 0 < n and (n < _SMALL_BATCH or device_backend_is_cpu())
+
 
 class SignatureCrypto:
     """Signature interface (reference: Signature.h:31-58) + batch extension.
@@ -331,7 +356,7 @@ class Secp256k1Crypto(SignatureCrypto):
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
         pubs = np.asarray(pubs, dtype=np.uint8)
         n = len(sigs)
-        if 0 < n < _SMALL_BATCH:
+        if use_native_batch(n):
             from .. import native_bind
 
             out = native_bind.secp256k1_verify_batch(
@@ -349,7 +374,7 @@ class Secp256k1Crypto(SignatureCrypto):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
         n = len(sigs)
-        if 0 < n < _SMALL_BATCH:
+        if use_native_batch(n):
             from .. import native_bind
 
             out = native_bind.secp256k1_recover_batch(
@@ -449,7 +474,7 @@ class SM2Crypto(SignatureCrypto):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
         pubs = np.asarray(pubs, dtype=np.uint8)
-        if 0 < len(sigs) < _SMALL_BATCH:
+        if use_native_batch(len(sigs)):
             out = self._native_batch_verify(
                 hashes, pubs, sigs[:, :32], sigs[:, 32:64]
             )
@@ -460,7 +485,7 @@ class SM2Crypto(SignatureCrypto):
     def batch_recover(self, msg_hashes, sigs):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
-        if 0 < len(sigs) < _SMALL_BATCH:
+        if use_native_batch(len(sigs)):
             pubs = sigs[:, 64:128]
             ok = self._native_batch_verify(
                 hashes, pubs, sigs[:, :32], sigs[:, 32:64]

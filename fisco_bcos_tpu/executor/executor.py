@@ -117,16 +117,47 @@ class TransactionExecutor:
         # a wasm chain deploys only wasm modules, an EVM chain only EVM code
         self.is_wasm = is_wasm
         self._block: BlockContext | None = None
+        # live block contexts by height — more than one is outstanding when
+        # the scheduler pre-executes proposal N+1 on N's uncommitted state
+        # (the block pipeline; ref SchedulerInterface.h:76 preExecuteBlock)
+        self._blocks: dict[int, BlockContext] = {}
+
+    # the scheduler may chain block N+1's state onto block N's executed,
+    # uncommitted overlay (ref BlockExecutive keeps the previous block's
+    # storage as its parent); composite/remote executors don't offer this
+    supports_preexec = True
 
     # -- block lifecycle (nextBlockHeader:334 / getHash:1017) ---------------
 
-    def next_block_header(self, header: BlockHeader, gas_limit: int = 3_000_000_000) -> None:
+    def next_block_header(
+        self,
+        header: BlockHeader,
+        gas_limit: int = 3_000_000_000,
+        base: StorageInterface | None = None,
+    ) -> None:
+        """Open the execution context for `header.number`. `base` chains the
+        new overlay on a previous block's post-state instead of the durable
+        backend (speculative pre-execution of N+1 while N commits)."""
         self._block = BlockContext(
             number=header.number,
             timestamp=header.timestamp,
             gas_limit=gas_limit,
-            storage=StateStorage(self.backend),
+            storage=StateStorage(base if base is not None else self.backend),
         )
+        self._blocks[header.number] = self._block
+
+    def block_state(self, number: int) -> StateStorage | None:
+        """Post-state overlay of an executed-but-uncommitted block."""
+        ctx = self._blocks.get(number)
+        return ctx.storage if ctx is not None else None
+
+    def discard_blocks_above(self, number: int) -> None:
+        """Drop speculative contexts built on state that is being replaced
+        (a different proposal re-executed at or below their height)."""
+        for n in [n for n in self._blocks if n > number]:
+            ctx = self._blocks.pop(n)
+            if self._block is ctx:
+                self._block = None
 
     def align_contexts(self, upto: int) -> None:
         """Raise the block's context-id floor (the DMC scheduler aligns every
@@ -362,11 +393,46 @@ class TransactionExecutor:
 
     def extract_criticals(self, tx: Transaction) -> list[bytes] | None:
         """Conflict keys for one tx, namespaced by contract
-        (extractConflictFields:1220). None → must serialize."""
+        (extractConflictFields:1220). None → must serialize. Registry
+        precompiles declare criticals in code; EVM/WASM user contracts
+        declare them as conflictFields in their stored ABI
+        (abi_conflict.py — the dag/Abi.h path)."""
         pre = self.registry.get(tx.to)
-        if pre is None or not pre.parallel:
+        if pre is not None:
+            if not pre.parallel:
+                return None
+            keys = pre.criticals(self.codec, tx.input)
+            if keys is None:
+                return None
+            return [tx.to + k for k in keys]
+        if len(tx.input) < 4 or not tx.to:
             return None
-        keys = pre.criticals(self.codec, tx.input)
+        from . import abi_conflict
+
+        storage = self._block.storage if self._block is not None else None
+        host = EVMHost(
+            storage if storage is not None else StateStorage(self.backend),
+            self.suite.hash, 0, 0, b"", 0,
+        )
+        abi_text = host.get_abi(tx.to)
+        if not abi_text:
+            return None
+        fn = abi_conflict.lookup(
+            abi_text.decode(errors="replace"),
+            self.suite.hash_impl.name,
+            tx.input[:4],
+        )
+        if fn is None:
+            return None
+        blk = self._block
+        keys = abi_conflict.extract_criticals(
+            fn,
+            tx.input,
+            tx.sender or b"",
+            tx.to,
+            blk.timestamp if blk is not None else 0,
+            blk.number if blk is not None else 0,
+        )
         if keys is None:
             return None
         return [tx.to + k for k in keys]
@@ -425,9 +491,10 @@ class TransactionExecutor:
     def prepare(self, params: TwoPCParams, extra_writes: StorageInterface | None = None) -> None:
         """Stage the block's state (plus ledger writes merged by the
         scheduler) into the durable backend."""
-        if self._block is None or self._block.number != params.number:
+        ctx = self._blocks.get(params.number)
+        if ctx is None:
             raise RuntimeError(f"no executed block {params.number} to prepare")
-        writes = self._block.storage
+        writes = ctx.storage
         if extra_writes is not None:
             for t, k, e in extra_writes.traverse():
                 writes.set_row(t, k, e)
@@ -435,11 +502,19 @@ class TransactionExecutor:
 
     def commit(self, params: TwoPCParams) -> None:
         self.backend.commit(params)
-        self._block = None
+        # the committed overlay may still serve as the parent of block N+1's
+        # speculative chain — popping the dict only drops OUR handle
+        ctx = self._blocks.pop(params.number, None)
+        if self._block is ctx:
+            self._block = None
 
     def rollback(self, params: TwoPCParams) -> None:
         self.backend.rollback(params)
-        self._block = None
+        ctx = self._blocks.pop(params.number, None)
+        if self._block is ctx:
+            self._block = None
+        # children chained on the rolled-back state are invalid
+        self.discard_blocks_above(params.number)
 
 
 class _ExecFrame:

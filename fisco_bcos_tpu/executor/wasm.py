@@ -240,7 +240,10 @@ def _decode_body(buf: bytes, pos: int, end: int) -> list:
         elif op == 0x42:  # i64.const
             v, pos = _leb_s(buf, pos)
             out.append((op, v & 0xFFFFFFFFFFFFFFFF))
-        elif op in (0x28, 0x29, 0x2D, 0x36, 0x37, 0x3A):  # load/store: align+offset
+        elif 0x28 <= op <= 0x3E and op not in (0x2A, 0x2B, 0x38, 0x39):
+            # integer load/store family: align+offset immediates. The float
+            # variants (f32/f64 load/store) stay rejected — BCOS-WASM bans
+            # floats outright (nondeterministic NaN payloads fork consensus)
             _a, pos = _leb_u(buf, pos)
             off, pos = _leb_u(buf, pos)
             out.append((op, off))
@@ -316,11 +319,41 @@ class WasmModule:
         self.elems: list[tuple[int, list[int]]] = []  # (offset, func idxs)
         pos = 8
         func_types: list[int] = []
+        # Deploy txs carry `module ‖ SCALE(constructor params)`. The module
+        # ends at the first byte sequence that cannot be a further section:
+        #   * a section id > 12,
+        #   * a non-custom section id that breaks the spec's strictly
+        #     ascending section order (param bytes like 0x01/0x05 would
+        #     otherwise fake a types/table section AFTER code/data),
+        #   * a size field that is truncated or overruns the buffer.
+        # Custom sections (id 0) are order-exempt but must still fit.
+        # (The reference sidesteps the ambiguity by SCALE-wrapping the
+        # module; our convention keeps module bytes raw and relies on these
+        # three structural checks.)
+        self.module_end = len(binary)
+        last_ordered_sec = 0
         while pos < len(binary):
+            at = pos
             sec = binary[pos]
+            # ids 1..11 must ascend; 0 (custom) and 12 (datacount, which the
+            # spec places out of sequence before code) are order-exempt
+            if sec > 12 or (1 <= sec <= 11 and sec <= last_ordered_sec):
+                self.module_end = at
+                break
             pos += 1
-            size, pos = _leb_u(binary, pos)
+            try:
+                size, pos = _leb_u(binary, pos)
+            except Exception:
+                self.module_end = at
+                pos = at
+                break
             body_end = pos + size
+            if body_end > len(binary):
+                self.module_end = at
+                pos = at
+                break
+            if 1 <= sec <= 11:
+                last_ordered_sec = sec
             if sec == 1:  # types
                 n, pos = _leb_u(binary, pos)
                 for _ in range(n):
@@ -805,9 +838,47 @@ class WasmInstance:
                 stack.append(
                     struct.unpack("<Q", self.mread((ptr + imm) & _M32, 8))[0]
                 )
+            elif op == 0x2C:  # i32.load8_s
+                ptr = stack.pop()
+                b = self.mread((ptr + imm) & _M32, 1)[0]
+                stack.append((b - 0x100 if b >= 0x80 else b) & _M32)
             elif op == 0x2D:  # i32.load8_u
                 ptr = stack.pop()
                 stack.append(self.mread((ptr + imm) & _M32, 1)[0])
+            elif op == 0x2E:  # i32.load16_s
+                ptr = stack.pop()
+                v = struct.unpack("<h", self.mread((ptr + imm) & _M32, 2))[0]
+                stack.append(v & _M32)
+            elif op == 0x2F:  # i32.load16_u
+                ptr = stack.pop()
+                stack.append(
+                    struct.unpack("<H", self.mread((ptr + imm) & _M32, 2))[0]
+                )
+            elif op == 0x30:  # i64.load8_s
+                ptr = stack.pop()
+                b = self.mread((ptr + imm) & _M32, 1)[0]
+                stack.append((b - 0x100 if b >= 0x80 else b) & _M64)
+            elif op == 0x31:  # i64.load8_u
+                ptr = stack.pop()
+                stack.append(self.mread((ptr + imm) & _M32, 1)[0])
+            elif op == 0x32:  # i64.load16_s
+                ptr = stack.pop()
+                v = struct.unpack("<h", self.mread((ptr + imm) & _M32, 2))[0]
+                stack.append(v & _M64)
+            elif op == 0x33:  # i64.load16_u
+                ptr = stack.pop()
+                stack.append(
+                    struct.unpack("<H", self.mread((ptr + imm) & _M32, 2))[0]
+                )
+            elif op == 0x34:  # i64.load32_s
+                ptr = stack.pop()
+                v = struct.unpack("<i", self.mread((ptr + imm) & _M32, 4))[0]
+                stack.append(v & _M64)
+            elif op == 0x35:  # i64.load32_u
+                ptr = stack.pop()
+                stack.append(
+                    struct.unpack("<I", self.mread((ptr + imm) & _M32, 4))[0]
+                )
             elif op == 0x36:  # i32.store
                 v, ptr = stack.pop(), stack.pop()
                 self.mwrite((ptr + imm) & _M32, struct.pack("<I", v & _M32))
@@ -817,6 +888,18 @@ class WasmInstance:
             elif op == 0x3A:  # i32.store8
                 v, ptr = stack.pop(), stack.pop()
                 self.mwrite((ptr + imm) & _M32, bytes([v & 0xFF]))
+            elif op == 0x3B:  # i32.store16
+                v, ptr = stack.pop(), stack.pop()
+                self.mwrite((ptr + imm) & _M32, struct.pack("<H", v & 0xFFFF))
+            elif op == 0x3C:  # i64.store8
+                v, ptr = stack.pop(), stack.pop()
+                self.mwrite((ptr + imm) & _M32, bytes([v & 0xFF]))
+            elif op == 0x3D:  # i64.store16
+                v, ptr = stack.pop(), stack.pop()
+                self.mwrite((ptr + imm) & _M32, struct.pack("<H", v & 0xFFFF))
+            elif op == 0x3E:  # i64.store32
+                v, ptr = stack.pop(), stack.pop()
+                self.mwrite((ptr + imm) & _M32, struct.pack("<I", v & _M32))
             elif op == 0x3F:  # memory.size
                 stack.append(len(self.mem) // PAGE)
             elif op == 0x40:  # memory.grow
@@ -1055,12 +1138,23 @@ def wasm_interpret(host, msg: EVMCall, code: bytes, gas_mode: str = "dispatch"):
 def wasm_deploy(
     host, msg: EVMCall, module_bytes: bytes, gas_mode: str = "dispatch"
 ):
-    """Deploy: validates the module, runs its ``deploy`` constructor, and
-    returns the MODULE as the code to store (wasm stores the module itself,
-    unlike EVM init code returning runtime code)."""
-    res = yield from _run_export(host, msg, module_bytes, "deploy", gas_mode)
+    """Deploy: validates the module, runs its ``deploy`` constructor with
+    any trailing SCALE constructor params as its calldata, and returns the
+    MODULE (without the params) as the code to store — wasm stores the
+    module itself, unlike EVM init code returning runtime code."""
+    try:
+        end = WasmModule(module_bytes).module_end
+    except _Trap as t:
+        return EVMResult(status=int(t.status), output=str(t).encode(), gas_left=0)
+    module_only, params = module_bytes[:end], module_bytes[end:]
+    run_msg = EVMCall(
+        kind=msg.kind, sender=msg.sender, to=msg.to,
+        code_address=msg.code_address, data=params, gas=msg.gas,
+        value=msg.value, static=msg.static, depth=msg.depth,
+    )
+    res = yield from _run_export(host, run_msg, module_only, "deploy", gas_mode)
     if not res.ok:
         return res
     return EVMResult(
-        status=0, output=module_bytes, gas_left=res.gas_left, logs=res.logs
+        status=0, output=module_only, gas_left=res.gas_left, logs=res.logs
     )

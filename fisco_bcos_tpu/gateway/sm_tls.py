@@ -1,0 +1,477 @@
+"""SM2 national-secret transport — TLCP-style dual-certificate handshake.
+
+Reference: bcos-boostssl/bcos-boostssl/context/ContextBuilder.cpp:65-74
+builds an SM2 dual-cert (sign + enc) SSL context through tassl (a patched
+OpenSSL) and NodeInfoTools::initMsgHandler wires it under the gateway/ws
+hosts. This image has no tassl, and shelling out to one would be the wrong
+shape for this framework anyway — so the national-secret transport is
+REDESIGNED from the TLCP (GB/T 38636, ECC_SM4_CBC_SM3 suite) message flow
+over the gateway's existing socket layer:
+
+  * dual SM2 certificates per endpoint (signing cert + encryption cert),
+    issued by the chain CA — certs are flat-codec structures signed with
+    SM2/SM3, not X.509 (no OpenSSL dependency);
+  * handshake: ClientHello/ServerHello randoms -> server dual certs ->
+    client dual certs + SM2-encrypted (GB/T 32918.4 C1C3C2) 48-byte
+    premaster against the server's ENC cert + SM2 CertificateVerify over
+    the SM3 transcript -> both Finished under record protection;
+  * key schedule: TLS1.2-shaped PRF built on HMAC-SM3;
+  * records: SM4-CBC + HMAC-SM3, encrypt-then-MAC, per-direction sequence
+    numbers (replay/reorder protection).
+
+The wrapped socket exposes sendall/recv/close/getpeercert like an
+ssl.SSLSocket, so gateway/tcp.py's node-id pinning (SAN URI analog) and
+framing work unchanged on top. Mutual authentication is mandatory — TLCP
+deployments in the reference always run client certs (the consortium-chain
+model).
+"""
+
+from __future__ import annotations
+
+import secrets
+import struct
+from dataclasses import dataclass, field
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..crypto.ref import ecdsa as ref
+from ..crypto.ref.sm3 import sm3
+from ..crypto.ref.sm4 import cbc_decrypt, cbc_encrypt
+
+_CURVE = ref.SM2_CURVE
+
+# ---------------------------------------------------------------------------
+# HMAC-SM3, PRF, and the GB/T 32918.3 KDF
+# ---------------------------------------------------------------------------
+
+
+def hmac_sm3(key: bytes, msg: bytes) -> bytes:
+    if len(key) > 64:
+        key = sm3(key)
+    key = key.ljust(64, b"\x00")
+    ipad = bytes(b ^ 0x36 for b in key)
+    opad = bytes(b ^ 0x5C for b in key)
+    return sm3(opad + sm3(ipad + msg))
+
+
+def prf(secret: bytes, label: bytes, seed: bytes, n: int) -> bytes:
+    """TLS1.2 P_hash shape over HMAC-SM3 (what TLCP specifies for its PRF)."""
+    seed = label + seed
+    out = b""
+    a = seed
+    while len(out) < n:
+        a = hmac_sm3(secret, a)
+        out += hmac_sm3(secret, a + seed)
+    return out[:n]
+
+
+def _kdf(z: bytes, n: int) -> bytes:
+    """GB/T 32918.3 counter KDF over SM3."""
+    out = b""
+    ct = 1
+    while len(out) < n:
+        out += sm3(z + struct.pack(">I", ct))
+        ct += 1
+    return out[:n]
+
+
+# ---------------------------------------------------------------------------
+# SM2 public-key encryption (GB/T 32918.4, C1‖C3‖C2 ordering)
+# ---------------------------------------------------------------------------
+
+
+def sm2_encrypt(pub64: bytes, msg: bytes) -> bytes:
+    px = int.from_bytes(pub64[:32], "big")
+    py = int.from_bytes(pub64[32:], "big")
+    if not ref.on_curve(_CURVE, (px, py)):
+        raise ValueError("SM2 encrypt: public key not on curve")
+    while True:
+        k = secrets.randbelow(_CURVE.n - 1) + 1
+        x1, y1 = ref.point_mul(_CURVE, k, (_CURVE.gx, _CURVE.gy))
+        x2, y2 = ref.point_mul(_CURVE, k, (px, py))
+        x2b = x2.to_bytes(32, "big")
+        y2b = y2.to_bytes(32, "big")
+        t = _kdf(x2b + y2b, len(msg))
+        if any(t):  # all-zero t leaks the plaintext; retry with a new k
+            break
+    c1 = b"\x04" + x1.to_bytes(32, "big") + y1.to_bytes(32, "big")
+    c2 = bytes(m ^ s for m, s in zip(msg, t))
+    c3 = sm3(x2b + msg + y2b)
+    return c1 + c3 + c2
+
+
+def sm2_decrypt(d: int, data: bytes) -> bytes:
+    if len(data) < 65 + 32 or data[0] != 0x04:
+        raise ValueError("SM2 decrypt: malformed ciphertext")
+    x1 = int.from_bytes(data[1:33], "big")
+    y1 = int.from_bytes(data[33:65], "big")
+    if not ref.on_curve(_CURVE, (x1, y1)):
+        raise ValueError("SM2 decrypt: C1 not on curve")
+    c3, c2 = data[65:97], data[97:]
+    x2, y2 = ref.point_mul(_CURVE, d, (x1, y1))
+    x2b = x2.to_bytes(32, "big")
+    y2b = y2.to_bytes(32, "big")
+    t = _kdf(x2b + y2b, len(c2))
+    msg = bytes(c ^ s for c, s in zip(c2, t))
+    if sm3(x2b + msg + y2b) != c3:
+        raise ValueError("SM2 decrypt: C3 integrity check failed")
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# Dual certificates (flat-codec, SM2/SM3-signed — the X.509-free redesign)
+# ---------------------------------------------------------------------------
+
+USAGE_SIGN = 1
+USAGE_ENC = 2
+
+
+@dataclass
+class SMCert:
+    cn: str
+    usage: int  # USAGE_SIGN | USAGE_ENC
+    pubkey: bytes  # 64-byte x‖y
+    uris: tuple = ()  # identity pins, e.g. fbtpu-node://<hex>
+    issuer: str = ""
+    signature: bytes = b""  # CA's SM2 r‖s over sm3(tbs)
+
+    def tbs(self) -> bytes:
+        w = FlatWriter()
+        w.str_(self.cn)
+        w.u8(self.usage)
+        w.bytes_(self.pubkey)
+        w.seq(list(self.uris), lambda w2, u: w2.str_(u))
+        w.str_(self.issuer)
+        return w.out()
+
+    def encode(self) -> bytes:
+        w = FlatWriter()
+        w.bytes_(self.tbs())
+        w.bytes_(self.signature)
+        return w.out()
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "SMCert":
+        r = FlatReader(buf)
+        tbs, sig = r.bytes_(), r.bytes_()
+        r.done()
+        tr = FlatReader(tbs)
+        c = cls(
+            cn=tr.str_(),
+            usage=tr.u8(),
+            pubkey=tr.bytes_(),
+            uris=tuple(tr.seq(lambda r2: r2.str_())),
+            issuer=tr.str_(),
+        )
+        tr.done()
+        c.signature = sig
+        return c
+
+
+@dataclass
+class SMCertAuthority:
+    """Chain CA: an SM2 keypair whose cert is self-signed (the
+    build_chain.sh generate_chain_cert analog for the national suite)."""
+
+    secret: int
+    cert: SMCert = field(default=None)  # type: ignore[assignment]
+
+    @classmethod
+    def create(cls, cn: str = "chain-sm2-ca") -> "SMCertAuthority":
+        d = secrets.randbelow(_CURVE.n - 1) + 1
+        ca = cls(secret=d)
+        pub = ref.privkey_to_pubkey(_CURVE, d)
+        cert = SMCert(cn=cn, usage=USAGE_SIGN, pubkey=_pub_bytes(pub), issuer=cn)
+        ca.cert = ca._sign_cert(cert)
+        return ca
+
+    def _sign_cert(self, cert: SMCert) -> SMCert:
+        r, s = ref.sm2_sign(sm3(cert.tbs()), self.secret)
+        cert.signature = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        return cert
+
+    def issue(self, cn: str, usage: int, pub64: bytes, uris: tuple = ()) -> SMCert:
+        return self._sign_cert(
+            SMCert(cn=cn, usage=usage, pubkey=pub64, uris=uris, issuer=self.cert.cn)
+        )
+
+    def issue_endpoint(self, cn: str, node_id: bytes | None = None):
+        """(sign_cert, sign_key, enc_cert, enc_key) — the TLCP dual pair."""
+        uris = ()
+        if node_id is not None:
+            from .tls import NODE_ID_URI_SCHEME
+
+            uris = (NODE_ID_URI_SCHEME + node_id.hex(),)
+        ds = secrets.randbelow(_CURVE.n - 1) + 1
+        de = secrets.randbelow(_CURVE.n - 1) + 1
+        sign_cert = self.issue(cn, USAGE_SIGN, _pub_of(ds), uris)
+        enc_cert = self.issue(cn, USAGE_ENC, _pub_of(de), uris)
+        return sign_cert, ds, enc_cert, de
+
+
+def _pub_bytes(pub) -> bytes:
+    x, y = pub
+    return x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def _pub_of(d: int) -> bytes:
+    return _pub_bytes(ref.privkey_to_pubkey(_CURVE, d))
+
+
+def verify_cert(cert: SMCert, ca_cert: SMCert) -> bool:
+    if cert.issuer != ca_cert.cn or len(cert.signature) != 64:
+        return False
+    r = int.from_bytes(cert.signature[:32], "big")
+    s = int.from_bytes(cert.signature[32:], "big")
+    px = int.from_bytes(ca_cert.pubkey[:32], "big")
+    py = int.from_bytes(ca_cert.pubkey[32:], "big")
+    return ref.sm2_verify(sm3(cert.tbs()), r, s, (px, py))
+
+
+# ---------------------------------------------------------------------------
+# Handshake + record layer
+# ---------------------------------------------------------------------------
+
+_MAX_HS = 1 << 20
+_MAX_RECORD = 17 * 1024 * 1024  # above the gateway's frame chunking
+
+
+class SMTLSError(OSError):
+    pass
+
+
+def _send_msg(sock, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise SMTLSError("connection closed during SM-TLS exchange")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock, limit: int = _MAX_HS) -> bytes:
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if n > limit:
+        raise SMTLSError(f"SM-TLS message too large: {n}")
+    return _recv_exact(sock, n)
+
+
+class SMTLSContext:
+    """Dual-cert context — the ContextBuilder::buildSslContext(sm=true)
+    analog. wrap_socket() runs the TLCP-style handshake and returns a
+    socket-like record channel."""
+
+    def __init__(
+        self,
+        ca_cert: SMCert,
+        sign_cert: SMCert,
+        sign_key: int,
+        enc_cert: SMCert,
+        enc_key: int,
+    ):
+        if sign_cert.usage != USAGE_SIGN or enc_cert.usage != USAGE_ENC:
+            raise ValueError("dual certs must be one SIGN and one ENC")
+        self.ca_cert = ca_cert
+        self.sign_cert = sign_cert
+        self.sign_key = sign_key
+        self.enc_cert = enc_cert
+        self.enc_key = enc_key
+
+    def wrap_socket(self, sock, server_side: bool = False) -> "SMTLSSocket":
+        return SMTLSSocket(self, sock, server_side)
+
+
+class SMTLSSocket:
+    def __init__(self, ctx: SMTLSContext, sock, server_side: bool):
+        self._sock = sock
+        self._ctx = ctx
+        self._peer_sign_cert: SMCert | None = None
+        self._send_seq = 0
+        self._recv_seq = 0
+        self._rbuf = b""
+        transcript = b""
+
+        def tsend(payload: bytes) -> bytes:
+            _send_msg(sock, payload)
+            return payload
+
+        if server_side:
+            ch = _recv_msg(sock)
+            transcript += ch
+            r = FlatReader(ch)
+            client_random = r.fixed(32)
+            r.done()
+            server_random = secrets.token_bytes(32)
+            w = FlatWriter()
+            w.u32(0)  # protocol version slot
+            w.bytes_(server_random)
+            w.bytes_(ctx.sign_cert.encode())
+            w.bytes_(ctx.enc_cert.encode())
+            transcript += tsend(w.out())
+
+            kx = _recv_msg(sock)
+            r = FlatReader(kx)
+            peer_sign = SMCert.decode(r.bytes_())
+            peer_enc = SMCert.decode(r.bytes_())
+            enc_premaster = r.bytes_()
+            cert_verify = r.bytes_()
+            r.done()
+            self._check_peer_certs(peer_sign, peer_enc)
+            # CertificateVerify covers everything before it — binds the
+            # client's signing key to THIS handshake
+            w = FlatWriter()
+            w.bytes_(peer_sign.encode())
+            w.bytes_(peer_enc.encode())
+            w.bytes_(enc_premaster)
+            signed_part = transcript + w.out()
+            self._check_cert_verify(peer_sign, signed_part, cert_verify)
+            transcript += kx
+            try:
+                premaster = sm2_decrypt(ctx.enc_key, enc_premaster)
+            except ValueError as e:
+                raise SMTLSError(f"premaster decrypt failed: {e}")
+            if len(premaster) != 48:
+                raise SMTLSError("bad premaster length")
+            self._derive(premaster, client_random, server_random, server_side)
+            # client Finished first, then ours — both under record keys
+            self._expect_finished(transcript, b"client finished")
+            self._send_finished(transcript, b"server finished")
+        else:
+            client_random = secrets.token_bytes(32)
+            transcript += tsend(client_random)  # ClientHello: 32-byte random
+
+            sh = _recv_msg(sock)
+            transcript += sh
+            r = FlatReader(sh)
+            r.u32()  # version slot
+            server_random = r.bytes_()
+            peer_sign = SMCert.decode(r.bytes_())
+            peer_enc = SMCert.decode(r.bytes_())
+            r.done()
+            self._check_peer_certs(peer_sign, peer_enc)
+
+            premaster = secrets.token_bytes(48)
+            enc_premaster = sm2_encrypt(peer_enc.pubkey, premaster)
+            w = FlatWriter()
+            w.bytes_(ctx.sign_cert.encode())
+            w.bytes_(ctx.enc_cert.encode())
+            w.bytes_(enc_premaster)
+            signed_part = transcript + w.out()
+            rr, ss = ref.sm2_sign(sm3(signed_part), ctx.sign_key)
+            w.bytes_(rr.to_bytes(32, "big") + ss.to_bytes(32, "big"))
+            transcript += tsend(w.out())
+            self._derive(premaster, client_random, server_random, server_side)
+            self._send_finished(transcript, b"client finished")
+            self._expect_finished(transcript, b"server finished")
+
+    # -- handshake helpers ---------------------------------------------------
+
+    def _check_peer_certs(self, sign_cert: SMCert, enc_cert: SMCert) -> None:
+        if sign_cert.usage != USAGE_SIGN or enc_cert.usage != USAGE_ENC:
+            raise SMTLSError("peer certs must be a SIGN + ENC pair")
+        for c in (sign_cert, enc_cert):
+            if not verify_cert(c, self._ctx.ca_cert):
+                raise SMTLSError(f"peer cert {c.cn!r} not issued by the chain CA")
+        if sign_cert.cn != enc_cert.cn:
+            raise SMTLSError("dual certs name different subjects")
+        self._peer_sign_cert = sign_cert
+
+    def _check_cert_verify(self, cert: SMCert, signed: bytes, sig: bytes) -> None:
+        if len(sig) != 64:
+            raise SMTLSError("malformed CertificateVerify")
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        px = int.from_bytes(cert.pubkey[:32], "big")
+        py = int.from_bytes(cert.pubkey[32:], "big")
+        if not ref.sm2_verify(sm3(signed), r, s, (px, py)):
+            raise SMTLSError("CertificateVerify signature invalid")
+
+    def _derive(
+        self, premaster: bytes, cr: bytes, sr: bytes, server_side: bool
+    ) -> None:
+        self._master = prf(premaster, b"master secret", cr + sr, 48)
+        kb = prf(self._master, b"key expansion", sr + cr, 2 * 32 + 2 * 16)
+        c_mac, s_mac = kb[0:32], kb[32:64]
+        c_key, s_key = kb[64:80], kb[80:96]
+        if server_side:
+            self._send_mac, self._send_key = s_mac, s_key
+            self._recv_mac, self._recv_key = c_mac, c_key
+        else:
+            self._send_mac, self._send_key = c_mac, c_key
+            self._recv_mac, self._recv_key = s_mac, s_key
+
+    def _send_finished(self, transcript: bytes, label: bytes) -> None:
+        vd = prf(self._master, label, sm3(transcript), 12)
+        self.sendall(vd)
+
+    def _expect_finished(self, transcript: bytes, label: bytes) -> None:
+        want = prf(self._master, label, sm3(transcript), 12)
+        got = self._recv_record()
+        if got != want:
+            raise SMTLSError("Finished verification failed — keys disagree")
+
+    # -- record layer (SM4-CBC + HMAC-SM3, encrypt-then-MAC) -----------------
+
+    def _seal(self, plaintext: bytes) -> bytes:
+        iv = secrets.token_bytes(16)
+        ct = cbc_encrypt(self._send_key, iv, plaintext)
+        mac = hmac_sm3(
+            self._send_mac,
+            struct.pack(">QI", self._send_seq, len(ct)) + iv + ct,
+        )
+        self._send_seq += 1
+        return iv + ct + mac
+
+    def _unseal(self, record: bytes) -> bytes:
+        if len(record) < 16 + 16 + 32:
+            raise SMTLSError("record too short")
+        iv, ct, mac = record[:16], record[16:-32], record[-32:]
+        want = hmac_sm3(
+            self._recv_mac,
+            struct.pack(">QI", self._recv_seq, len(ct)) + iv + ct,
+        )
+        if not secrets.compare_digest(mac, want):
+            raise SMTLSError("record MAC invalid")
+        self._recv_seq += 1
+        try:
+            return cbc_decrypt(self._recv_key, iv, ct)
+        except ValueError as e:
+            raise SMTLSError(f"record decrypt failed: {e}")
+
+    def _recv_record(self) -> bytes:
+        return self._unseal(_recv_msg(self._sock, _MAX_RECORD))
+
+    # -- socket-like surface (what gateway/tcp.py uses) ----------------------
+
+    def sendall(self, data: bytes) -> None:
+        _send_msg(self._sock, self._seal(bytes(data)))
+
+    def recv(self, n: int) -> bytes:
+        while not self._rbuf:
+            self._rbuf = self._recv_record()
+        out, self._rbuf = self._rbuf[:n], self._rbuf[n:]
+        return out
+
+    def settimeout(self, t) -> None:
+        self._sock.settimeout(t)
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def getpeername(self):
+        return self._sock.getpeername()
+
+    def getpeercert(self) -> dict:
+        """ssl.SSLSocket-shaped peer info so tcp.py's SAN-URI node-id
+        pinning works unchanged."""
+        c = self._peer_sign_cert
+        if c is None:
+            return {}
+        return {
+            "subject": ((("commonName", c.cn),),),
+            "subjectAltName": tuple(("URI", u) for u in c.uris),
+        }

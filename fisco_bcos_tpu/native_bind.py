@@ -51,14 +51,19 @@ def _host_isa() -> str:
 
 
 def _build() -> bool:
+    # -fopenmp parallelizes the batch loops across host cores; a toolchain
+    # without libgomp still gets the single-threaded library
+    base = ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
+            "-fPIC", "-o", _LIB, _SRC]
     try:
         res = subprocess.run(
-            ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
-             "-fPIC", "-o", _LIB, _SRC],
+            base[:1] + ["-fopenmp"] + base[1:],
             capture_output=True,
             text=True,
             timeout=120,
         )
+        if res.returncode != 0:
+            res = subprocess.run(base, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
         _log.info("native build unavailable: %s", e)
         return False

@@ -40,6 +40,46 @@ _HASHERS: dict[str, HashBatchFn] = {
 }
 
 
+def _host_hash(hasher: str, data: bytes) -> bytes:
+    """Single-item host-side hash (native C when available) — the root
+    binding is one tiny hash; a device batch call for it would cost a full
+    tunnel round trip."""
+    from .. import native_bind
+
+    if hasher == "keccak256":
+        from ..crypto.ref.keccak import keccak256 as ref
+
+        return native_bind.keccak256(data) or ref(data)
+    if hasher == "sm3":
+        from ..crypto.ref.sm3 import sm3 as ref
+
+        return native_bind.sm3(data) or ref(data)
+    from ..crypto.ref.sha2 import sha256 as ref
+
+    return native_bind.sha256(data) or ref(data)
+
+
+def bucket_leaves(n: int) -> int:
+    """Leaf-count bucket: every tree is built over the next power-of-two
+    padded size (zero-digest filler leaves), so the fused device program
+    compiles once per bucket instead of once per distinct block size — a
+    production chain with variable block sizes would otherwise recompile
+    the multi-minute tree program continuously (r3/r4 advisor churn note).
+    ≤16 leaves keep their exact size (single-group trees, host path, no
+    compile)."""
+    if n <= 16:
+        return n
+    return 1 << (n - 1).bit_length()
+
+
+def bind_root(padded_root: bytes, n: int, hasher: str = "keccak256") -> bytes:
+    """Final root = H(padded_root ‖ u64(n)). Binding the REAL leaf count
+    makes trees of different n in the same bucket (whose padded trees could
+    otherwise alias via trailing zero leaves) distinct, and gives single-leaf
+    trees leaf≠root domain separation."""
+    return _host_hash(hasher, bytes(padded_root) + int(n).to_bytes(8, "big"))
+
+
 @dataclass(frozen=True)
 class MerkleProofItem:
     """One level of a wide merkle proof: the child group containing the
@@ -80,16 +120,25 @@ class MerkleTree:
             raise ValueError("width must be >= 2")
         self.width = width
         self.hasher = hasher
+        self.n = len(leaves)
+        b = bucket_leaves(self.n)
+        if b > self.n:  # zero-digest filler up to the bucket (see bucket_leaves)
+            leaves = np.vstack([leaves, np.zeros((b - self.n, 32), np.uint8)])
         self._hash_batch = _HASHERS[hasher]
         self.levels = _levels(leaves, width, self._hash_batch)
 
     @property
-    def root(self) -> bytes:
+    def padded_root(self) -> bytes:
+        """Root of the bucket-padded tree (what the device programs emit)."""
         return bytes(self.levels[-1][0])
+
+    @property
+    def root(self) -> bytes:
+        return bind_root(self.padded_root, self.n, self.hasher)
 
     def proof(self, leaf_index: int) -> list[MerkleProofItem]:
         """Proof for leaf `leaf_index`: one child group per level below root."""
-        if not 0 <= leaf_index < len(self.levels[0]):
+        if not 0 <= leaf_index < self.n:
             raise IndexError("leaf index out of range")
         items: list[MerkleProofItem] = []
         idx = leaf_index
@@ -124,7 +173,9 @@ class MerkleTree:
             return False
         hash_batch = _HASHERS[hasher]
         cur = leaf
-        idx, size = leaf_index, n_leaves
+        # the tree is built over the bucket-padded leaf set; group sizes and
+        # depth follow the PADDED size, the final binding hash pins the REAL n
+        idx, size = leaf_index, bucket_leaves(n_leaves)
         for item in proof:
             if size <= 1:
                 return False  # proof longer than the tree is deep
@@ -145,7 +196,7 @@ class MerkleTree:
             size = -(-size // width)
         if size != 1:
             return False  # proof shorter than the tree is deep
-        return cur == root
+        return bind_root(cur, n_leaves, hasher) == root
 
 
 # ---------------------------------------------------------------------------
@@ -252,11 +303,16 @@ def merkle_root_async(
     if hasher == "keccak256" and len(leaves) >= 256:
         # jax.Array input stays on device — tx/receipt hashes come from the
         # batch hash kernels, so the hot sealing path never round-trips the
-        # leaf tensor through the host
-        dev = _device_root_fn(len(leaves), width)(
-            jnp.asarray(leaves).astype(jnp.uint8)
-        )
-        return lambda: bytes(np.asarray(dev))
+        # leaf tensor through the host. Padding to the leaf-count bucket
+        # happens OUTSIDE the jit so the tree program's input shape (and
+        # hence its compilation) is shared by every block size in the bucket.
+        n = len(leaves)
+        b = bucket_leaves(n)
+        arr = jnp.asarray(leaves).astype(jnp.uint8)
+        if b > n:
+            arr = jnp.concatenate([arr, jnp.zeros((b - n, 32), jnp.uint8)])
+        dev = _device_root_fn(b, width)(arr)
+        return lambda: bind_root(bytes(np.asarray(dev)), n, hasher)
     root = MerkleTree(
         np.asarray(leaves, dtype=np.uint8), width=width, hasher=hasher
     ).root

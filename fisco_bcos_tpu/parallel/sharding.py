@@ -153,6 +153,10 @@ def sharded_merkle_root(mesh: Mesh, width: int = 16, axis_name: str = DATA_AXIS)
     tree node) — the caller picks N = D·width^k; other shapes belong on
     the unsharded path.
 
+    Emits the bucket-PADDED tree root (callers pad N to
+    ops.merkle.bucket_leaves and finish with ops.merkle.bind_root — the
+    count binding is one host hash, not worth a collective).
+
     Returns a jitted fn (leaves [N, 32] uint8) -> [32] uint8."""
     from ..ops.merkle import _device_level
 

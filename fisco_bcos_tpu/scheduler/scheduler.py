@@ -52,6 +52,8 @@ class ExecutedBlock:
     header: BlockHeader
     block: Block
     tx_hashes: tuple[bytes, ...]  # proposal identity (same number ≠ same block)
+    post_state: object = None  # StateStorage chained onto by block N+1's
+    # speculative pre-execution (ref SchedulerInterface.h:76 preExecuteBlock)
 
 
 class Scheduler:
@@ -105,6 +107,9 @@ class Scheduler:
             self.term += 1
             dropped = sorted(self._executed)
             self._executed.clear()
+            discard = getattr(self.executor, "discard_blocks_above", None)
+            if discard is not None:
+                discard(self.ledger.block_number())
         _log.warning(
             "storage switch: term -> %d, dropped in-flight blocks %s",
             self.term,
@@ -132,12 +137,29 @@ class Scheduler:
     ) -> BlockHeader:
         timer = StageTimer(_log, f"ExecuteBlock.{number}")
 
+        # Height gate with block pipelining (preExecuteBlock,
+        # SchedulerInterface.h:76 / StateMachine.cpp:47 asyncPreApply): the
+        # next uncommitted height executes against the durable backend; any
+        # height one past a contiguous executed-but-uncommitted chain
+        # executes SPECULATIVELY against the previous block's post-state
+        # overlay, so proposal N+1 runs while N's commit quorum round-trips.
         expected = self.ledger.block_number() + 1
+        base = None
         if number != expected:
-            raise SchedulerError(
-                ErrorCode.SCHEDULER_INVALID_BLOCK,
-                f"execute out of order: got {number}, expect {expected}",
+            prev = self._executed.get(number - 1)
+            chain_ok = prev is not None and all(
+                k in self._executed for k in range(expected, number)
             )
+            if (
+                not chain_ok
+                or prev.post_state is None
+                or not getattr(self.executor, "supports_preexec", False)
+            ):
+                raise SchedulerError(
+                    ErrorCode.SCHEDULER_INVALID_BLOCK,
+                    f"execute out of order: got {number}, expect {expected}",
+                )
+            base = prev.post_state
 
         txs = block.transactions
         if not txs and block.tx_metadata:
@@ -162,7 +184,10 @@ class Scheduler:
         ]
 
         def run_block():
-            self.executor.next_block_header(block.header)
+            if base is not None:
+                self.executor.next_block_header(block.header, base=base)
+            else:
+                self.executor.next_block_header(block.header)
             receipts = [None] * len(txs)
             if dag_idx:
                 dag_rcs = self.executor.dag_execute_transactions(
@@ -228,7 +253,21 @@ class Scheduler:
         timer.stage("roots", state_root=state_root.hex()[:16])
 
         with self._lock:
-            self._executed[number] = ExecutedBlock(header, block, proposal_ident)
+            # anything executed ABOVE this height was chained on the state
+            # this execution just replaced — drop those speculations
+            for k in [k for k in self._executed if k > number]:
+                self._executed.pop(k)
+            discard = getattr(self.executor, "discard_blocks_above", None)
+            if discard is not None:
+                discard(number)
+            self._executed[number] = ExecutedBlock(
+                header,
+                block,
+                proposal_ident,
+                post_state=getattr(self.executor, "block_state", lambda n: None)(
+                    number
+                ),
+            )
         return header
 
     # -- commitBlock:390 -----------------------------------------------------
@@ -248,6 +287,17 @@ class Scheduler:
 
     def _commit_block_locked(self, header: BlockHeader) -> None:
         number = header.number
+        # commits must land in height order: with the block pipeline, a
+        # SPECULATIVE block N+1 is executed (and preparable) while N is
+        # uncommitted — committing it first would stage only N+1's overlay
+        # deltas, skip N's writes entirely, and advance current_number past
+        # a hole. The execute gate can't enforce this; the commit gate must.
+        expected = self.ledger.block_number() + 1
+        if number != expected:
+            raise SchedulerError(
+                ErrorCode.SCHEDULER_INVALID_BLOCK,
+                f"commit out of order: got {number}, expect {expected}",
+            )
         cached = self._executed.get(number)
         if cached is None:
             raise SchedulerError(

@@ -1,0 +1,207 @@
+"""Offline storage inspection/repair tool.
+
+The ops-side analog of the reference's storageTool
+(bcos-storage/tools/storageTool.cpp: statistic / read / write / iterate /
+stateSize over a stopped node's RocksDB), operating on a node's sqlite
+state file. Adds `verify` — offline chain-integrity checking (header hash
+linkage + number↔hash index agreement + stored tx/receipt presence per
+block), which the reference leaves to a separate reader binary.
+
+Usage (module or CLI):
+    python -m fisco_bcos_tpu.tool.storage_tool state.db stat
+    python -m fisco_bcos_tpu.tool.storage_tool state.db iterate s_config
+    python -m fisco_bcos_tpu.tool.storage_tool state.db read s_current_state current_number
+    python -m fisco_bcos_tpu.tool.storage_tool state.db write t_test 6b6579 value=abc
+    python -m fisco_bcos_tpu.tool.storage_tool state.db verify
+Keys and written values are UTF-8 by default; pass --hex to give them as
+hex (and to print values as hex — the reference's -H flag).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..storage.sqlite_storage import SQLiteStorage
+from ..storage.entry import Entry
+
+
+def _parse_key(raw: str, force_hex: bool) -> bytes:
+    if not force_hex:
+        return raw.encode()
+    try:
+        return bytes.fromhex(raw)
+    except ValueError:
+        raise SystemExit(f"--hex given but {raw!r} is not valid hex")
+
+
+def _fmt(b: bytes, hex_out: bool) -> str:
+    if hex_out:
+        return b.hex()
+    try:
+        s = b.decode()
+        return s if s.isprintable() else b.hex()
+    except UnicodeDecodeError:
+        return b.hex()
+
+
+def cmd_stat(store: SQLiteStorage) -> dict:
+    """Per-table row counts + byte sizes + pending 2PC slots (the
+    reference's --statistic)."""
+    out: dict = {"tables": {}, "pending_2pc": store.pending_numbers()}
+    conn = store._conn
+    for tbl, rows, size in conn.execute(
+        "SELECT tbl, COUNT(*), SUM(LENGTH(k) + LENGTH(v)) FROM kv GROUP BY tbl"
+    ):
+        out["tables"][tbl] = {"rows": rows, "bytes": size}
+    out["total_rows"] = sum(t["rows"] for t in out["tables"].values())
+    out["total_bytes"] = sum(t["bytes"] for t in out["tables"].values())
+    return out
+
+
+def cmd_read(store: SQLiteStorage, table: str, key: bytes, hex_out: bool) -> dict:
+    e = store.get_row(table, key)
+    if e is None:
+        return {"found": False}
+    return {
+        "found": True,
+        "fields": {f: _fmt(v, hex_out) for f, v in sorted(e.fields.items())},
+    }
+
+
+def cmd_write(store: SQLiteStorage, table: str, key: bytes, fields: dict) -> dict:
+    store.set_row(table, key, Entry({f: v for f, v in fields.items()}))
+    return {"written": True, "table": table, "key": key.hex()}
+
+
+def cmd_iterate(store: SQLiteStorage, table: str, limit: int, hex_out: bool) -> list:
+    rows = []
+    for k in store.get_primary_keys(table)[:limit]:
+        e = store.get_row(table, k)
+        rows.append(
+            {
+                "key": _fmt(k, hex_out),
+                "fields": {} if e is None else
+                {f: _fmt(v, hex_out)[:128] for f, v in sorted(e.fields.items())},
+            }
+        )
+    return rows
+
+
+def cmd_verify(store: SQLiteStorage) -> dict:
+    """Offline chain-integrity check: header linkage (parent hash), stored
+    number↔hash index agreement, and per-block tx/receipt presence. The
+    crypto suite is auto-detected from whichever hash matches the genesis
+    header's stored index (the db carries no explicit suite marker)."""
+    from ..crypto.suite import ecdsa_suite, sm_suite
+    from ..ledger.ledger import (
+        KEY_CURRENT_NUMBER,
+        SYS_CURRENT_STATE,
+        SYS_HASH_2_NUMBER,
+        SYS_HASH_2_RECEIPT,
+        SYS_HASH_2_TX,
+        SYS_NUMBER_2_HASH,
+        SYS_NUMBER_2_HEADER,
+        SYS_NUMBER_2_TXS,
+        _decode_hash_list,
+    )
+    from ..protocol.block_header import BlockHeader
+
+    problems: list[str] = []
+    cur = store.get_row(SYS_CURRENT_STATE, KEY_CURRENT_NUMBER)
+    if cur is None:
+        return {"ok": False, "problems": ["no current_number — not a node state db"]}
+    tip = int(cur.get().decode())
+
+    g = store.get_row(SYS_NUMBER_2_HEADER, b"0")
+    gidx = store.get_row(SYS_NUMBER_2_HASH, b"0")
+    if g is None or gidx is None:
+        return {"ok": False, "problems": ["genesis header or index missing"]}
+    gh = BlockHeader.decode(g.get())
+    suite = None
+    for cand in (ecdsa_suite(), sm_suite()):
+        if gh.hash(cand) == gidx.get():
+            suite = cand
+            break
+    if suite is None:
+        return {"ok": False, "problems": ["genesis hash matches no known suite"]}
+
+    prev_hash = None
+    for n in range(0, tip + 1):
+        he = store.get_row(SYS_NUMBER_2_HEADER, str(n).encode())
+        if he is None:
+            problems.append(f"block {n}: header missing")
+            prev_hash = None
+            continue
+        header = BlockHeader.decode(he.get())
+        h = header.hash(suite)
+        idx = store.get_row(SYS_NUMBER_2_HASH, str(n).encode())
+        if idx is None or idx.get() != h:
+            problems.append(f"block {n}: number->hash index mismatch")
+        back = store.get_row(SYS_HASH_2_NUMBER, h)
+        if back is None or back.get() != str(n).encode():
+            problems.append(f"block {n}: hash->number index mismatch")
+        if n > 0 and prev_hash is not None:
+            parents = {p.hash for p in header.parent_info}
+            if prev_hash not in parents:
+                problems.append(f"block {n}: parent hash does not link block {n-1}")
+        prev_hash = h
+        txs = store.get_row(SYS_NUMBER_2_TXS, str(n).encode())
+        if txs is not None:
+            for th in _decode_hash_list(txs.get()):
+                if store.get_row(SYS_HASH_2_TX, th) is None:
+                    problems.append(f"block {n}: tx {th.hex()[:16]} missing")
+                if store.get_row(SYS_HASH_2_RECEIPT, th) is None:
+                    problems.append(f"block {n}: receipt {th.hex()[:16]} missing")
+    return {"ok": not problems, "tip": tip, "suite": suite.hash_impl.name,
+            "problems": problems[:50]}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="storage_tool", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("db", help="path to a node's sqlite state file")
+    ap.add_argument("--hex", action="store_true", help="keys/values as hex")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("stat")
+    p = sub.add_parser("read")
+    p.add_argument("table")
+    p.add_argument("key")
+    p = sub.add_parser("write")
+    p.add_argument("table")
+    p.add_argument("key")
+    p.add_argument("fields", nargs="+", help="field=value ...")
+    p = sub.add_parser("iterate")
+    p.add_argument("table")
+    p.add_argument("--limit", type=int, default=100)
+    sub.add_parser("verify")
+    args = ap.parse_args(argv)
+
+    store = SQLiteStorage(args.db)
+    try:
+        if args.cmd == "stat":
+            out = cmd_stat(store)
+        elif args.cmd == "read":
+            out = cmd_read(store, args.table, _parse_key(args.key, args.hex), args.hex)
+        elif args.cmd == "write":
+            fields = {}
+            for f in args.fields:
+                name, _, val = f.partition("=")
+                fields[name] = bytes.fromhex(val) if args.hex else val.encode()
+            out = cmd_write(store, args.table, _parse_key(args.key, args.hex), fields)
+        elif args.cmd == "iterate":
+            out = cmd_iterate(store, args.table, args.limit, args.hex)
+        else:
+            out = cmd_verify(store)
+    finally:
+        store.close()
+    print(json.dumps(out, indent=2))
+    if isinstance(out, dict) and out.get("ok") is False:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1492,10 +1492,16 @@ int fisco_ed25519_sign(const uint8_t seed[32], const uint8_t* msg,
 }
 
 // batch verify loops — the honest native CPU baselines for bench.py
-// (one call, n items, out[i] = 1/0)
+// (one call, n items, out[i] = 1/0). OpenMP-parallel when built with
+// -fopenmp (every lane is independent and the curve contexts are immutable
+// magic statics); ctypes releases the GIL for the call's duration, so these
+// scale with host cores the way the reference's tbb::parallel_for verify
+// loop does (bcos-txpool/sync/TransactionSync.cpp:521). Single-threaded
+// builds just ignore the pragmas.
 void fisco_secp256k1_verify_batch(size_t n, const uint8_t* zs,
                                   const uint8_t* rs, const uint8_t* ss,
                                   const uint8_t* pubs, uint8_t* out) {
+#pragma omp parallel for schedule(static) if (n > 16)
     for (size_t i = 0; i < n; i++)
         out[i] = (uint8_t)fisco_secp256k1_verify(zs + 32 * i, rs + 32 * i,
                                                  ss + 32 * i, pubs + 64 * i);
@@ -1505,6 +1511,7 @@ void fisco_secp256k1_recover_batch(size_t n, const uint8_t* zs,
                                    const uint8_t* rs, const uint8_t* ss,
                                    const uint8_t* vs, uint8_t* pubs_out,
                                    uint8_t* ok_out) {
+#pragma omp parallel for schedule(static) if (n > 16)
     for (size_t i = 0; i < n; i++)
         ok_out[i] = (uint8_t)fisco_secp256k1_recover(
             zs + 32 * i, rs + 32 * i, ss + 32 * i, vs[i], pubs_out + 64 * i);
@@ -1513,6 +1520,7 @@ void fisco_secp256k1_recover_batch(size_t n, const uint8_t* zs,
 void fisco_sm2_verify_batch(size_t n, const uint8_t* es, const uint8_t* rs,
                             const uint8_t* ss, const uint8_t* pubs,
                             uint8_t* out) {
+#pragma omp parallel for schedule(static) if (n > 16)
     for (size_t i = 0; i < n; i++)
         out[i] = (uint8_t)fisco_sm2_verify(es + 32 * i, rs + 32 * i,
                                            ss + 32 * i, pubs + 64 * i);

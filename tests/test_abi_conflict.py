@@ -1,0 +1,178 @@
+"""ABI conflict-field DAG for user contracts (ref dag/Abi.h:76,
+TransactionExecutor.cpp:1220-1395 extractConflictFields)."""
+
+import json
+
+from fisco_bcos_tpu.codec.abi import ABICodec
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor import TransactionExecutor, abi_conflict
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig, Ledger
+from fisco_bcos_tpu.protocol import Block, BlockHeader, ParentInfo
+from fisco_bcos_tpu.protocol.transaction import TransactionAttribute, TransactionFactory
+from fisco_bcos_tpu.scheduler import Scheduler
+from fisco_bcos_tpu.storage import MemoryStorage
+from fisco_bcos_tpu.txpool import TxPool
+
+from evm_asm import _deployer, asm
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+SETFOR_ABI = [
+    {
+        "type": "function",
+        "name": "setFor",
+        "inputs": [{"type": "uint256"}, {"type": "uint256"}],
+        # parallel by first parameter — disjoint keys never conflict
+        "conflictFields": [{"kind": 3, "value": [0], "slot": 0}],
+    }
+]
+
+
+def _setfor_runtime() -> bytes:
+    sel = int.from_bytes(CODEC.selector("setFor(uint256,uint256)"), "big")
+    return asm(
+        ("PUSH", 0), "CALLDATALOAD", ("PUSH", 224), "SHR",
+        ("PUSH", sel), "EQ", ("ref", "set"), "JUMPI",
+        ("PUSH", 0), ("PUSH", 0), "REVERT",
+        ("label", "set"),
+        ("PUSH", 36), "CALLDATALOAD",  # value
+        ("PUSH", 4), "CALLDATALOAD",   # key
+        "SSTORE", "STOP",
+    )
+
+
+class Env:
+    def __init__(self):
+        self.store = MemoryStorage()
+        self.ledger = Ledger(self.store, SUITE)
+        self.ledger.build_genesis(
+            GenesisConfig(consensus_nodes=[ConsensusNode(b"\x01" * 64)])
+        )
+        self.pool = TxPool(SUITE, self.ledger)
+        self.executor = TransactionExecutor(self.store, SUITE)
+        self.scheduler = Scheduler(self.executor, self.ledger, self.store, SUITE, self.pool)
+        self.fac = TransactionFactory(SUITE)
+        self.kp = SUITE.signature_impl.generate_keypair(secret=9191)
+        self._nonce = 0
+
+    def tx(self, to, data, attribute=0, abi=""):
+        self._nonce += 1
+        return self.fac.create_signed(
+            self.kp, chain_id="chain0", group_id="group0", block_limit=500,
+            nonce=f"ac{self._nonce}", to=to, input=data,
+            attribute=attribute, abi=abi,
+        )
+
+    def run_block(self, txs):
+        for t in txs:
+            r = self.pool.submit(t)
+            assert r.status == 0, r
+        sealed = self.pool.seal_txs(len(txs))
+        parent = self.ledger.header_by_number(self.ledger.block_number())
+        blk = Block(
+            header=BlockHeader(
+                number=parent.number + 1,
+                parent_info=[ParentInfo(parent.number, parent.hash(SUITE))],
+                timestamp=1000,
+            ),
+            transactions=sealed,
+        )
+        self.scheduler.commit_block(self.scheduler.execute_block(blk))
+        return blk
+
+    def deploy_setfor(self) -> bytes:
+        rc = self.run_block(
+            [self.tx(b"", _deployer(_setfor_runtime()), abi=json.dumps(SETFOR_ABI))]
+        ).receipts[0]
+        assert rc.status == 0, rc.output
+        return rc.contract_address
+
+
+# -- unit: kind semantics ----------------------------------------------------
+
+
+def _fn(conflicts):
+    return abi_conflict._Fn("setFor", ["uint256", "uint256"], conflicts)
+
+
+def _call(k, v):
+    return CODEC.encode_call("setFor(uint256,uint256)", k, v)
+
+
+def test_kind_all_serializes():
+    fn = _fn([{"kind": 0, "value": [], "slot": 0}])
+    assert abi_conflict.extract_criticals(fn, _call(1, 2), b"s", b"c", 0, 0) is None
+
+
+def test_kind_len_is_function_level():
+    fn = _fn([{"kind": 1, "value": [], "slot": 3}])
+    a = abi_conflict.extract_criticals(fn, _call(1, 2), b"s", b"c", 0, 0)
+    b = abi_conflict.extract_criticals(fn, _call(9, 9), b"x", b"c", 0, 0)
+    assert a == b == [(3).to_bytes(4, "big")]
+
+
+def test_kind_env_caller_and_params():
+    fn = _fn([{"kind": 2, "value": [0], "slot": 0},
+              {"kind": 3, "value": [0], "slot": 1}])
+    a = abi_conflict.extract_criticals(fn, _call(7, 1), b"alice", b"c", 0, 0)
+    b = abi_conflict.extract_criticals(fn, _call(7, 2), b"bob", b"c", 0, 0)
+    assert a[0] != b[0]      # different caller
+    assert a[1] == b[1]      # same first param -> same key
+    c = abi_conflict.extract_criticals(fn, _call(8, 1), b"alice", b"c", 0, 0)
+    assert a[0] == c[0] and a[1] != c[1]
+
+
+def test_kind_const_and_unannotated():
+    fn = _fn([{"kind": 4, "value": [1, 2, 3], "slot": 0}])
+    assert abi_conflict.extract_criticals(fn, _call(1, 1), b"s", b"c", 0, 0) == [
+        (0).to_bytes(4, "big") + b"\x01\x02\x03"
+    ]
+    assert abi_conflict.extract_criticals(_fn([]), _call(1, 1), b"s", b"c", 0, 0) is None
+
+
+def test_lookup_by_selector():
+    text = json.dumps(SETFOR_ABI)
+    fn = abi_conflict.lookup(text, "keccak256", CODEC.selector("setFor(uint256,uint256)"))
+    assert fn is not None and fn.name == "setFor"
+    assert abi_conflict.lookup(text, "keccak256", b"\x00\x00\x00\x00") is None
+
+
+# -- integration: user-contract txs levelize through the stored ABI ----------
+
+
+def test_user_contract_dag_parallel_levels():
+    env = Env()
+    addr = env.deploy_setfor()
+    dag = TransactionAttribute.DAG
+    txs = [env.tx(addr, _call(i, 100 + i), attribute=dag) for i in range(4)]
+    for t in txs:
+        t.force_sender(b"\x22" * 20)
+    env.executor.next_block_header(BlockHeader(number=2, timestamp=1000))
+    levels = env.executor.dag_levels(txs)
+    assert len(levels) == 1 and levels[0] == [0, 1, 2, 3]  # fewer rounds than txs
+
+    # same first param -> conflict -> must order
+    clash = [env.tx(addr, _call(5, 1), attribute=dag),
+             env.tx(addr, _call(5, 2), attribute=dag)]
+    for t in clash:
+        t.force_sender(b"\x22" * 20)
+    assert len(env.executor.dag_levels(clash)) == 2
+
+
+def test_user_contract_dag_receipts_match_serial():
+    def run(parallel: bool):
+        env = Env()
+        addr = env.deploy_setfor()
+        attr = TransactionAttribute.DAG if parallel else 0
+        blk = env.run_block(
+            [env.tx(addr, _call(i % 3, 50 + i), attribute=attr) for i in range(6)]
+        )
+        assert all(rc.status == 0 for rc in blk.receipts)
+        header = env.ledger.header_by_number(2)
+        return [rc.encode() for rc in blk.receipts], header.state_root
+
+    par_rcs, par_root = run(True)
+    ser_rcs, ser_root = run(False)
+    assert par_rcs == ser_rcs
+    assert par_root == ser_root

@@ -1,6 +1,7 @@
 """Fused admission step + device address derivation + sharded verification."""
 
 import numpy as np
+import pytest
 
 from fisco_bcos_tpu.crypto import admission
 from fisco_bcos_tpu.crypto.ref import ecdsa as ref
@@ -33,7 +34,22 @@ def test_digest_words_to_limbs_roundtrip():
     np.testing.assert_array_equal(got, bigint.bytes_be_to_limbs(digests))
 
 
-def test_admission_matches_cpu_reference():
+# admit_batch dispatches native-vs-device by batch size and backend
+# (crypto.suite.use_native_batch); both legs must satisfy the same contract
+@pytest.fixture(params=["native", "device"])
+def admit_path(request, monkeypatch):
+    if request.param == "device":
+        monkeypatch.setenv("FISCO_FORCE_DEVICE_ADMISSION", "1")
+    else:
+        monkeypatch.delenv("FISCO_FORCE_DEVICE_ADMISSION", raising=False)
+        from fisco_bcos_tpu import native_bind
+
+        if native_bind.load() is None:
+            pytest.skip("native library unavailable; native leg not testable")
+    return request.param
+
+
+def test_admission_matches_cpu_reference(admit_path):
     payloads = [b"tx %d " % i + b"z" * (i * 37 % 200) for i in range(6)]
     sigs, pubs = _signed(payloads)
     addr, ok, pubs_dev, hashes_dev = admission.admit_batch(payloads, sigs)
@@ -43,6 +59,28 @@ def test_admission_matches_cpu_reference():
         assert bytes(pubs_dev[j]) == pub_bytes
         assert bytes(addr[j]) == keccak256(pub_bytes)[12:]
         assert bytes(hashes_dev[j]) == keccak256(payloads[j])
+
+
+def test_admission_native_device_bit_identity(monkeypatch):
+    """The two admit_batch legs must agree bit-for-bit on every output for
+    valid lanes, and on the ok mask everywhere — a divergence would fork
+    consensus between a CPU-routed node and a TPU-routed node."""
+    from fisco_bcos_tpu import native_bind
+
+    if native_bind.load() is None:
+        pytest.skip("native library unavailable")
+    payloads = [b"bit-identity %d" % i for i in range(5)]
+    sigs, _ = _signed(payloads)
+    sigs[3, 32:64] = 0  # one malformed lane
+    monkeypatch.delenv("FISCO_FORCE_DEVICE_ADMISSION", raising=False)
+    nat = admission._admit_batch_native(payloads, sigs)
+    monkeypatch.setenv("FISCO_FORCE_DEVICE_ADMISSION", "1")
+    dev = admission.admit_batch(payloads, sigs)
+    np.testing.assert_array_equal(nat[1], dev[1])  # ok mask
+    for lane in np.flatnonzero(nat[1]):
+        assert bytes(nat[0][lane]) == bytes(dev[0][lane])  # sender
+        assert bytes(nat[2][lane]) == bytes(dev[2][lane])  # pubkey
+        assert bytes(nat[3][lane]) == bytes(dev[3][lane])  # tx hash
 
 
 def test_admission_rejects_corruption():

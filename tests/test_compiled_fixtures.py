@@ -1,0 +1,185 @@
+"""Real compiled-toolchain artifacts end-to-end (tests/fixtures/README.md).
+
+Hand-assembled bytecode (evm_asm/wasm_asm) can't exercise solc's jump-table
+dispatch, free-memory-pointer idioms, Panic(0x22) handlers, or liquid's
+vtable + SCALE ABI — these fixtures do (the reference tests compiled
+artifacts the same way: TestEVMExecutor.cpp:1424 hex codeBin,
+bcos-executor/test/liquid/transfer.wasm)."""
+
+import os
+
+from fisco_bcos_tpu.codec.abi import ABICodec, abi_decode
+from fisco_bcos_tpu.codec.scale import scale_decode, scale_encode
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor import TransactionExecutor
+from fisco_bcos_tpu.protocol.block_header import BlockHeader
+from fisco_bcos_tpu.protocol.transaction import Transaction
+from fisco_bcos_tpu.storage import MemoryStorage
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _fixture(name: str) -> bytes:
+    with open(os.path.join(FIXTURES, name), "rb") as f:
+        return f.read()
+
+
+def _env(is_wasm: bool) -> TransactionExecutor:
+    ex = TransactionExecutor(MemoryStorage(), SUITE, is_wasm=is_wasm)
+    ex.next_block_header(BlockHeader(number=1, timestamp=1_700_000_000))
+    return ex
+
+
+def _tx(to, data, sender=b"\xaa" * 20):
+    t = Transaction(to=to, input=data)
+    t.force_sender(sender)
+    return t
+
+
+def _sel(sig: str) -> bytes:
+    return CODEC.selector(sig)
+
+
+class TestSolcHelloWorld:
+    """solc 0.8.7 HelloWorld: constructor writes a storage string, get/set
+    round-trip dynamic strings through real solc ABI glue."""
+
+    def test_deploy_get_set(self):
+        code = bytes.fromhex(_fixture("hello_world_solc.hex").decode())
+        ex = _env(is_wasm=False)
+        (rc,) = ex.execute_transactions([_tx(b"", code)])
+        assert rc.status == 0, rc.output
+        addr = rc.contract_address
+
+        (rc2,) = ex.execute_transactions([_tx(addr, _sel("get()"))])
+        assert rc2.status == 0
+        assert abi_decode(["string"], rc2.output) == ["Hello, World!"]
+
+        (rc3,) = ex.execute_transactions(
+            [_tx(addr, CODEC.encode_call("set(string)", "tpu native"))]
+        )
+        assert rc3.status == 0 and rc3.gas_used > 0
+
+        (rc4,) = ex.execute_transactions([_tx(addr, _sel("get()"))])
+        assert abi_decode(["string"], rc4.output) == ["tpu native"]
+
+    def test_unknown_selector_reverts(self):
+        code = bytes.fromhex(_fixture("hello_world_solc.hex").decode())
+        ex = _env(is_wasm=False)
+        (rc,) = ex.execute_transactions([_tx(b"", code)])
+        (rc2,) = ex.execute_transactions(
+            [_tx(rc.contract_address, b"\xde\xad\xbe\xef")]
+        )
+        assert rc2.status != 0  # solc fallback: revert
+
+
+class TestLiquidWasm:
+    """liquid (Rust) artifacts: vtable dispatch, SCALE params, storage
+    mappings — through the same executor surface as EVM txs."""
+
+    def test_transfer_lifecycle(self):
+        ex = _env(is_wasm=True)
+        (rc,) = ex.execute_transactions([_tx(b"", _fixture("transfer.wasm"))])
+        assert rc.status == 0, rc.output
+        addr = rc.contract_address
+
+        args = (
+            scale_encode("string", "alice")
+            + scale_encode("string", "bob")
+            + scale_encode("u32", 7)
+        )
+        (rc2,) = ex.execute_transactions(
+            [_tx(addr, _sel("transfer(string,string,uint32)") + args)]
+        )
+        assert rc2.status == 0
+        assert rc2.output == b"\x01"  # SCALE true
+
+        (rc3,) = ex.execute_transactions(
+            [_tx(addr, _sel("query(string)") + scale_encode("string", "bob"))]
+        )
+        assert rc3.status == 0
+        assert scale_decode("u32", rc3.output)[0] == 7
+
+        # overdraw: liquid returns false, state intact
+        over = (
+            scale_encode("string", "bob")
+            + scale_encode("string", "alice")
+            + scale_encode("u32", 100)
+        )
+        (rc4,) = ex.execute_transactions(
+            [_tx(addr, _sel("transfer(string,string,uint32)") + over)]
+        )
+        assert rc4.status == 0 and rc4.output == b"\x00"
+        (rc5,) = ex.execute_transactions(
+            [_tx(addr, _sel("query(string)") + scale_encode("string", "bob"))]
+        )
+        assert scale_decode("u32", rc5.output)[0] == 7
+
+    def test_hello_world_constructor_params(self):
+        """Deploy calldata = module ‖ SCALE(params): the module/params split
+        must hand the constructor its arguments and store ONLY the module."""
+        ex = _env(is_wasm=True)
+        code = _fixture("hello_world.wasm")
+        (rc,) = ex.execute_transactions(
+            [_tx(b"", code + scale_encode("string", "alice"))]
+        )
+        assert rc.status == 0, rc.output
+        addr = rc.contract_address
+        from fisco_bcos_tpu.executor.evm import EVMHost
+
+        host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
+        assert host.get_code(addr) == code  # params stripped from stored code
+
+        (rc2,) = ex.execute_transactions([_tx(addr, _sel("get()"))])
+        assert scale_decode("string", rc2.output)[0] == "alice"
+
+        (rc3,) = ex.execute_transactions(
+            [_tx(addr, _sel("set(string)") + scale_encode("string", "fisco bcos"))]
+        )
+        assert rc3.status == 0
+        (rc4,) = ex.execute_transactions([_tx(addr, _sel("get()"))])
+        assert scale_decode("string", rc4.output)[0] == "fisco bcos"
+
+    def test_gas_determinism(self):
+        ex = _env(is_wasm=True)
+        (rc,) = ex.execute_transactions([_tx(b"", _fixture("transfer.wasm"))])
+        addr = rc.contract_address
+        q = _sel("query(string)") + scale_encode("string", "alice")
+        (a,) = ex.execute_transactions([_tx(addr, q)])
+        (b,) = ex.execute_transactions([_tx(addr, q)])
+        assert a.gas_used == b.gas_used > 0
+
+
+class TestModuleParamSplit:
+    """The module/constructor-param boundary must be found structurally —
+    param blobs whose first byte is a small integer (bool true = 0x01,
+    compact length 0 = 0x00, u8 values <= 12) must not be absorbed as fake
+    wasm sections (they'd fail valid deploys or truncate calldata)."""
+
+    def _end(self, blob: bytes) -> int:
+        from fisco_bcos_tpu.executor.wasm import WasmModule
+
+        return WasmModule(blob).module_end
+
+    def test_small_leading_param_bytes_end_the_module(self):
+        code = _fixture("transfer.wasm")
+        n = self._end(code)
+        assert n == len(code)
+        for params in (b"\x01", b"\x00", b"\x05\x07", b"\x0c" + b"abc",
+                       b"\x01\x01" + b"x" * 64):
+            assert self._end(code + params) == n, params[:4].hex()
+
+    def test_bool_constructor_param_roundtrip(self):
+        # end-to-end: deploy with a 1-byte SCALE bool appended; the split
+        # must hand exactly that byte to the constructor (transfer.new()
+        # ignores calldata, so success + stored-code identity is the check)
+        ex = _env(is_wasm=True)
+        code = _fixture("transfer.wasm")
+        (rc,) = ex.execute_transactions([_tx(b"", code + b"\x01")])
+        assert rc.status == 0, rc.output
+        from fisco_bcos_tpu.executor.evm import EVMHost
+
+        host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
+        assert host.get_code(rc.contract_address) == code

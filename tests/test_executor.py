@@ -262,3 +262,101 @@ def test_commit_rejects_header_mismatch():
         env.scheduler.commit_block(forged)
     env.scheduler.commit_block(header)
     assert env.ledger.block_number() == 1
+
+
+class TestBlockPipeline:
+    """preExecuteBlock analog (ref SchedulerInterface.h:76, StateMachine.cpp:47
+    asyncPreApply): proposal N+1 executes on N's uncommitted post-state while
+    N's commit quorum round-trips; commits then land in order."""
+
+    def _blk(self, env, number, txs, parent_hash=None):
+        parent = env.ledger.header_by_number(number - 1)
+        ph = parent.hash(SUITE) if parent is not None else (parent_hash or b"\x00" * 32)
+        return Block(
+            header=BlockHeader(
+                number=number,
+                parent_info=[ParentInfo(number - 1, ph)],
+                timestamp=1000 + number,
+            ),
+            transactions=txs,
+        )
+
+    def test_speculative_execute_then_ordered_commit(self):
+        env = Env()
+        b1 = self._blk(env, 1, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "ann", 100)])
+        h1 = env.scheduler.execute_block(b1)
+        # block 2 SPENDS state written by uncommitted block 1
+        b2 = self._blk(env, 2, [env.tx(
+            DAG_TRANSFER_ADDRESS, "userTransfer(string,string,uint256)", "ann", "ann", 1
+        )])
+        h2 = env.scheduler.execute_block(b2)  # speculative: ledger still at 0
+        assert env.ledger.block_number() == 0
+        assert all(rc.status == 0 for rc in b2.receipts), [rc.status for rc in b2.receipts]
+        env.scheduler.commit_block(h1)
+        env.scheduler.commit_block(h2)
+        assert env.ledger.block_number() == 2
+        # committed balance reflects both blocks
+        rc = env.scheduler.call(env.tx(DAG_TRANSFER_ADDRESS, "userBalance(string)", "ann"))
+        ok, bal = CODEC.decode_output(["uint256", "uint256"], rc.output)
+        assert (ok, bal) == (0, 100)
+
+    def test_speculation_matches_sequential_roots(self):
+        def run(pipelined: bool):
+            env = Env()
+            b1 = self._blk(env, 1, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "bob", 7)])
+            b2txs = [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "cat", 9)]
+            h1 = env.scheduler.execute_block(b1)
+            if pipelined:
+                b2 = self._blk(env, 2, b2txs, parent_hash=h1.hash(SUITE))
+                h2 = env.scheduler.execute_block(b2)
+                env.scheduler.commit_block(h1)
+                env.scheduler.commit_block(h2)
+            else:
+                env.scheduler.commit_block(h1)
+                b2 = self._blk(env, 2, b2txs)
+                h2 = env.scheduler.execute_block(b2)
+                env.scheduler.commit_block(h2)
+            return h2.state_root, h2.receipts_root
+
+        assert run(True) == run(False)
+
+    def test_reexecution_drops_stale_speculation(self):
+        env = Env()
+        b1 = self._blk(env, 1, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "dee", 5)])
+        env.scheduler.execute_block(b1)
+        b2 = self._blk(env, 2, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "eve", 6)])
+        env.scheduler.execute_block(b2)
+        # view change: a DIFFERENT proposal lands at height 1 — the height-2
+        # speculation was chained on dead state and must vanish
+        b1b = self._blk(env, 1, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "fox", 8)])
+        h1b = env.scheduler.execute_block(b1b)
+        assert 2 not in env.scheduler._executed
+        env.scheduler.commit_block(h1b)
+        assert env.ledger.block_number() == 1
+        # height 2 re-executes cleanly on the new committed state
+        b2b = self._blk(env, 2, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "gus", 3)])
+        h2b = env.scheduler.execute_block(b2b)
+        env.scheduler.commit_block(h2b)
+        assert env.ledger.block_number() == 2
+
+    def test_out_of_order_without_chain_still_rejected(self):
+        env = Env()
+        b3 = self._blk(env, 3, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "hal", 1)],
+                       parent_hash=b"\x11" * 32)
+        with pytest.raises(Exception):
+            env.scheduler.execute_block(b3)
+
+    def test_out_of_order_commit_rejected(self):
+        """A speculative N+1 must NOT be committable before N — it would
+        stage only N+1's overlay deltas and leave a durable hole at N."""
+        env = Env()
+        b1 = self._blk(env, 1, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "ida", 4)])
+        h1 = env.scheduler.execute_block(b1)
+        b2 = self._blk(env, 2, [env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "joe", 5)],
+                       parent_hash=h1.hash(SUITE))
+        h2 = env.scheduler.execute_block(b2)
+        with pytest.raises(Exception, match="out of order"):
+            env.scheduler.commit_block(h2)
+        env.scheduler.commit_block(h1)
+        env.scheduler.commit_block(h2)
+        assert env.ledger.block_number() == 2

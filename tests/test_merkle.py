@@ -15,11 +15,17 @@ _REF_HASH = {"keccak256": keccak256, "sm3": sm3}
 
 
 def _host_root(leaves, width, hasher):
+    """Independent reimplementation of the padded-bucket root definition:
+    zero-pad to the next power-of-two bucket (>16 leaves), fold the wide
+    tree, then bind the REAL leaf count with one more hash."""
     h = _REF_HASH[hasher]
+    n = len(leaves)
     cur = [bytes(x) for x in leaves]
+    bucket = n if n <= 16 else 1 << (n - 1).bit_length()
+    cur += [b"\x00" * 32] * (bucket - n)
     while len(cur) > 1:
         cur = [h(b"".join(cur[i : i + width])) for i in range(0, len(cur), width)]
-    return cur[0]
+    return h(cur[0] + n.to_bytes(8, "big"))
 
 
 @pytest.mark.parametrize("n", [1, 2, 15, 16, 17, 100])
@@ -112,3 +118,24 @@ def test_fused_device_root_input_validation():
         merkle_root(leaves, width=1)  # would never shrink
     with pytest.raises(ValueError):
         merkle_root(np.zeros((300, 64), dtype=np.uint8))
+
+
+def test_bucket_padding_reuses_device_program():
+    """Block sizes within one bucket must hit the SAME compiled tree program
+    (the per-leaf-count recompile churn fix): 257..512 leaves all map to the
+    512 bucket."""
+    from fisco_bcos_tpu.ops.merkle import _device_root_fn, bucket_leaves, merkle_root
+
+    assert bucket_leaves(10) == 10          # tiny trees stay exact
+    assert bucket_leaves(17) == 32
+    assert bucket_leaves(256) == 256
+    assert bucket_leaves(257) == 512
+    assert bucket_leaves(512) == 512
+    assert bucket_leaves(10_000) == 16_384
+
+    before = _device_root_fn.cache_info().currsize
+    rng = np.random.default_rng(3)
+    for n in (300, 400, 500, 512):
+        merkle_root(rng.integers(0, 256, (n, 32), dtype=np.uint8))
+    added = _device_root_fn.cache_info().currsize - before
+    assert added <= 1  # one program for the whole bucket

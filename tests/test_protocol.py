@@ -108,6 +108,15 @@ def test_receipt_and_header_roundtrip():
     )
     assert TransactionReceipt.decode(rc.encode()).encode() == rc.encode()
 
+    # decode seeds the wire-form cache; a mutation WITHOUT invalidation would
+    # silently re-serialize the stale pre-mutation bytes into the receipts
+    # root — invalidate_caches is the one correct idiom (mirrors Transaction)
+    rc2 = TransactionReceipt.decode(rc.encode())
+    rc2.block_number = 8
+    rc2.invalidate_caches()
+    assert TransactionReceipt.decode(rc2.encode()).block_number == 8
+    assert rc2.encode() != rc.encode()
+
     suite = ecdsa_suite()
     h = BlockHeader(
         version=3,

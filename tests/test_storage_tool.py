@@ -1,0 +1,96 @@
+"""Offline storage inspection tool (ref bcos-storage/tools/storageTool.cpp)."""
+
+import json
+
+from fisco_bcos_tpu.codec.abi import ABICodec
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor import TransactionExecutor
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig, Ledger
+from fisco_bcos_tpu.protocol import Block, BlockHeader, ParentInfo
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+from fisco_bcos_tpu.scheduler import Scheduler
+from fisco_bcos_tpu.storage.sqlite_storage import SQLiteStorage
+from fisco_bcos_tpu.tool import storage_tool
+from fisco_bcos_tpu.txpool import TxPool
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+def _build_chain(db_path: str, blocks: int = 2) -> None:
+    store = SQLiteStorage(db_path)
+    ledger = Ledger(store, SUITE)
+    ledger.build_genesis(GenesisConfig(consensus_nodes=[ConsensusNode(b"\x01" * 64)]))
+    pool = TxPool(SUITE, ledger)
+    executor = TransactionExecutor(store, SUITE)
+    sched = Scheduler(executor, ledger, store, SUITE, pool)
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=777)
+    for b in range(1, blocks + 1):
+        tx = fac.create_signed(
+            kp, chain_id="chain0", group_id="group0", block_limit=500,
+            nonce=f"st-{b}",
+            to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call("userAdd(string,uint256)", f"u{b}", b),
+        )
+        assert pool.submit(tx).status == 0
+        parent = ledger.header_by_number(b - 1)
+        blk = Block(
+            header=BlockHeader(
+                number=b,
+                parent_info=[ParentInfo(b - 1, parent.hash(SUITE))],
+                timestamp=1000 + b,
+            ),
+            transactions=pool.seal_txs(1),
+        )
+        sched.commit_block(sched.execute_block(blk))
+    sched.stop()
+    store.close()
+
+
+def test_stat_read_iterate_verify(tmp_path, capsys):
+    db = str(tmp_path / "state.db")
+    _build_chain(db)
+
+    assert storage_tool.main([db, "stat"]) == 0
+    stat = json.loads(capsys.readouterr().out)
+    assert stat["tables"]["s_number_2_header"]["rows"] == 3  # genesis + 2
+    assert stat["pending_2pc"] == []
+
+    assert storage_tool.main([db, "read", "s_current_state", "current_number"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["found"] and out["fields"]["value"] == "2"
+
+    assert storage_tool.main([db, "iterate", "s_config"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert any(r["key"] == "tx_count_limit" for r in rows)
+
+    assert storage_tool.main([db, "verify"]) == 0
+    v = json.loads(capsys.readouterr().out)
+    assert v["ok"] and v["tip"] == 2 and v["suite"] == "keccak256"
+
+
+def test_verify_detects_corruption(tmp_path, capsys):
+    db = str(tmp_path / "state.db")
+    _build_chain(db)
+    # corrupt: overwrite block 1's header with block 2's
+    store = SQLiteStorage(db)
+    h2 = store.get_row("s_number_2_header", b"2")
+    store.set_row("s_number_2_header", b"1", h2)
+    store.close()
+
+    assert storage_tool.main([db, "verify"]) == 1
+    v = json.loads(capsys.readouterr().out)
+    assert not v["ok"]
+    assert any("block 1" in p for p in v["problems"])
+
+
+def test_write_then_read_roundtrip(tmp_path, capsys):
+    db = str(tmp_path / "state.db")
+    SQLiteStorage(db).close()
+    assert storage_tool.main([db, "write", "t_ops", "k1", "value=hello"]) == 0
+    capsys.readouterr()
+    assert storage_tool.main([db, "read", "t_ops", "k1"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["fields"]["value"] == "hello"
