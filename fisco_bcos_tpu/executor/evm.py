@@ -255,11 +255,82 @@ def _signed(v: int) -> int:
     return v - MOD if v >= SIGN_BIT else v
 
 
+def _native_evm_enabled() -> bool:
+    import os
+
+    return not os.environ.get("FISCO_NO_NATIVE_EVM")
+
+
+# keccak256(b"") — the native engine hardcodes keccak for SHA3, so it may
+# only run for suites whose hash IS keccak (an SM chain computes sm3 storage
+# slots; running the native engine there would fork state roots between
+# nodes with and without the library)
+_KECCAK_EMPTY = bytes.fromhex(
+    "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+)
+
+
+def _native_prefix(host: EVMHost, msg: EVMCall, code: bytes, f: "_Frame"):
+    """Run the frame's straight-line prefix on the native engine
+    (native/fisco_native.cpp fisco_evm_run — the evmone analog). Returns an
+    EVMResult when the whole frame finished natively; None when the frame
+    should (continue to) run in Python — either the library is unavailable
+    or the engine escaped at an unmodeled opcode, in which case `f` has
+    been seeded with the escaped pc/gas/stack/memory and Python resumes
+    bit-identically (gas schedule and edge semantics are kept in lockstep;
+    differential-tested by tests/test_native_evm.py)."""
+    if host.hash_fn(b"") != _KECCAK_EMPTY:
+        return None  # non-keccak suite (sm3): Python interpreter only
+
+    from .. import native_bind
+
+    def sload(slot: bytes) -> bytes:
+        return host.get_storage(msg.to, int.from_bytes(slot, "big")).to_bytes(
+            32, "big"
+        )
+
+    def sstore(slot: bytes, val: bytes) -> None:
+        host.set_storage(
+            msg.to, int.from_bytes(slot, "big"), int.from_bytes(val, "big")
+        )
+
+    def log(topics: list, data: bytes) -> None:
+        f.logs.append(LogEntry(address=msg.to, topics=topics, data=data))
+
+    out = native_bind.evm_run(
+        code, msg.data, msg.to, msg.sender, host.tx_origin, msg.value,
+        msg.gas, host.block_number, host.timestamp, host.gas_limit,
+        msg.static, sload, sstore, log,
+    )
+    if out is None:
+        return None
+    if out[0] == "done":
+        _, status, gas_left, output = out
+        if status in (0, int(TransactionStatus.REVERT_INSTRUCTION)):
+            return EVMResult(
+                status=status, output=output, gas_left=gas_left, logs=f.logs
+            )
+        # error statuses drop logs and zero gas, like the _VMError path
+        return EVMResult(status=status, output=b"", gas_left=0, logs=[])
+    _, pc, gas_left, stack, memory = out
+    f.pc = pc
+    f.gas = gas_left
+    f.stack = list(stack)
+    f.memory = bytearray(memory)
+    return None
+
+
 def interpret(host: EVMHost, msg: EVMCall, code: bytes):
     """Generator: runs `code` under `msg`; yields EVMCall for external calls
     and expects an EVMResult back; returns the frame's EVMResult."""
     f = _Frame(msg.gas)
     code_len = len(code)
+
+    if _native_evm_enabled():
+        nat = _native_prefix(host, msg, code, f)
+        if nat is not None:
+            return nat
+
     # JUMPDEST analysis (skip PUSH immediates)
     jumpdests = set()
     i = 0

@@ -352,6 +352,21 @@ class WasmModule:
                 self.module_end = at
                 pos = at
                 break
+            if sec == 0:
+                # a real custom section carries a name: <leb name_len><name>…
+                # — SCALE param bytes like b"\x00\x00" (empty vec / compact
+                # zero pairs) would otherwise parse as empty custom sections
+                # and be absorbed into the module
+                try:
+                    nlen, npos = _leb_u(binary, pos)
+                except Exception:
+                    self.module_end = at
+                    pos = at
+                    break
+                if npos + nlen > body_end:
+                    self.module_end = at
+                    pos = at
+                    break
             if 1 <= sec <= 11:
                 last_ordered_sec = sec
             if sec == 1:  # types

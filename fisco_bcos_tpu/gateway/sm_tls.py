@@ -91,8 +91,8 @@ def sm2_encrypt(pub64: bytes, msg: bytes) -> bytes:
         x2b = x2.to_bytes(32, "big")
         y2b = y2.to_bytes(32, "big")
         t = _kdf(x2b + y2b, len(msg))
-        if any(t):  # all-zero t leaks the plaintext; retry with a new k
-            break
+        if not msg or any(t):  # all-zero t leaks the plaintext; retry with
+            break              # a new k (empty msg has no t to check)
     c1 = b"\x04" + x1.to_bytes(32, "big") + y1.to_bytes(32, "big")
     c2 = bytes(m ^ s for m, s in zip(msg, t))
     c3 = sm3(x2b + msg + y2b)

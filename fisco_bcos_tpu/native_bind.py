@@ -143,6 +143,102 @@ def load() -> ctypes.CDLL | None:
         return _lib
 
 
+# EVM fast-prefix engine callback signatures (native/fisco_native.cpp)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+EVM_SLOAD_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, _U8P, _U8P)
+EVM_SSTORE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, _U8P, _U8P)
+EVM_LOG_FN = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, _U8P, ctypes.c_int, _U8P, ctypes.c_size_t
+)
+EVM_RESULT_FN = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+    ctypes.c_int64, _U8P, ctypes.c_size_t, _U8P, ctypes.c_size_t, _U8P,
+    ctypes.c_size_t,
+)
+
+
+def evm_run(code: bytes, calldata: bytes, self_addr: bytes, caller: bytes,
+            origin: bytes, value: int, gas: int, block_number: int,
+            timestamp: int, gas_limit: int, static_flag: bool,
+            sload, sstore, log):
+    """Run the native EVM fast-prefix engine. Returns
+    ("done", status, gas_left, output) or
+    ("escape", pc, gas_left, [stack ints bottom-first], memory bytes),
+    or None when the native library is unavailable.
+
+    sload(slot32)->bytes32, sstore(slot32, val32), log(topics list, data)
+    are plain-Python callbacks (closures over the host overlay)."""
+    lib = load()
+    if lib is None:
+        return None
+    result: list = []
+    cb_err: list = []
+
+    def _sload(_ctx, slot_p, out_p):
+        try:
+            v = sload(bytes(slot_p[i] for i in range(32)))
+            for i in range(32):
+                out_p[i] = v[i]
+        except Exception as e:  # ctypes swallows callback exceptions
+            cb_err.append(e)
+            for i in range(32):
+                out_p[i] = 0
+
+    def _sstore(_ctx, slot_p, val_p):
+        try:
+            sstore(
+                bytes(slot_p[i] for i in range(32)),
+                bytes(val_p[i] for i in range(32)),
+            )
+        except Exception as e:
+            cb_err.append(e)
+
+    def _log(_ctx, topics_p, ntopics, data_p, dlen):
+        try:
+            topics = [
+                bytes(topics_p[32 * t + i] for i in range(32))
+                for t in range(ntopics)
+            ]
+            log(topics, ctypes.string_at(data_p, dlen) if dlen else b"")
+        except Exception as e:
+            cb_err.append(e)
+
+    def _result(_ctx, kind, status, pc, gas_left, stack_p, n_stack, mem_p,
+                mem_len, out_p, out_len):
+        try:
+            if kind == 0:
+                result.append(
+                    ("done", status, gas_left,
+                     ctypes.string_at(out_p, out_len) if out_len else b"")
+                )
+            else:
+                raw = ctypes.string_at(stack_p, n_stack * 32) if n_stack else b""
+                stack = [
+                    int.from_bytes(raw[i * 32 : i * 32 + 32], "big")
+                    for i in range(n_stack)
+                ]
+                memory = ctypes.string_at(mem_p, mem_len) if mem_len else b""
+                result.append(("escape", pc, gas_left, stack, memory))
+        except Exception as e:
+            cb_err.append(e)
+
+    lib.fisco_evm_run(
+        _buf(code or b"\x00"), len(code),
+        _buf(calldata or b"\x00"), len(calldata),
+        _buf(self_addr.rjust(20, b"\x00")[:20]),
+        _buf(caller.rjust(20, b"\x00")[:20]),
+        _buf(origin.rjust(20, b"\x00")[:20]),
+        _buf(value.to_bytes(32, "big")),
+        gas, block_number, timestamp, gas_limit,
+        1 if static_flag else 0, None,
+        EVM_SLOAD_FN(_sload), EVM_SSTORE_FN(_sstore), EVM_LOG_FN(_log),
+        EVM_RESULT_FN(_result),
+    )
+    if cb_err:
+        raise cb_err[0]
+    return result[0] if result else None
+
+
 def _bind_symbols(lib: ctypes.CDLL, u8p) -> None:
     for name in ("fisco_keccak256", "fisco_sha256", "fisco_sm3"):
         fn = getattr(lib, name)
@@ -184,6 +280,18 @@ def _bind_symbols(lib: ctypes.CDLL, u8p) -> None:
     lib.fisco_ed25519_pubkey.restype = ctypes.c_int
     lib.fisco_ed25519_sign.argtypes = [u8p, u8p, ctypes.c_size_t, u8p]
     lib.fisco_ed25519_sign.restype = ctypes.c_int
+    lib.fisco_evm_run.argtypes = [
+        u8p, ctypes.c_size_t,  # code
+        u8p, ctypes.c_size_t,  # calldata
+        u8p, u8p, u8p,         # self, caller, origin
+        u8p,                   # value (32B be)
+        ctypes.c_int64,        # gas
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,  # number/ts/limit
+        ctypes.c_int,          # static flag
+        ctypes.c_void_p,       # ctx (unused; callbacks close over state)
+        EVM_SLOAD_FN, EVM_SSTORE_FN, EVM_LOG_FN, EVM_RESULT_FN,
+    ]
+    lib.fisco_evm_run.restype = ctypes.c_int
 
 
 def _hash_via(name: str, data: bytes) -> bytes | None:

@@ -60,16 +60,21 @@ def _host_hash(hasher: str, data: bytes) -> bytes:
 
 
 def bucket_leaves(n: int) -> int:
-    """Leaf-count bucket: every tree is built over the next power-of-two
-    padded size (zero-digest filler leaves), so the fused device program
-    compiles once per bucket instead of once per distinct block size — a
-    production chain with variable block sizes would otherwise recompile
-    the multi-minute tree program continuously (r3/r4 advisor churn note).
-    ≤16 leaves keep their exact size (single-group trees, host path, no
-    compile)."""
+    """Leaf-count bucket: every tree is built over a padded size (zero-digest
+    filler leaves) so the fused device program compiles once per bucket
+    instead of once per distinct block size — a production chain with
+    variable block sizes would otherwise recompile the multi-minute tree
+    program continuously (r3/r4 advisor churn note).
+
+    Buckets are 5-bit-mantissa floats: the smallest m·2^j ≥ n with
+    16 ≤ m ≤ 32. Padding overhead is ≤ 1/16 (vs up to 2× for plain
+    power-of-two buckets — the 10k-leaf headline tree pads to 10,240, not
+    16,384) while a whole octave of block sizes still shares ≤ 16 compiled
+    programs. ≤16 leaves keep their exact size (single-group trees)."""
     if n <= 16:
         return n
-    return 1 << (n - 1).bit_length()
+    j = n.bit_length() - 5
+    return -(-n // (1 << j)) << j
 
 
 def bind_root(padded_root: bytes, n: int, hasher: str = "keccak256") -> bytes:

@@ -166,15 +166,45 @@ def recover_core(z, r, s, v, g_table):
 # ---------------------------------------------------------------------------
 
 
+_PALLAS_BROKEN = False
+
+
 def _use_pallas() -> bool:
     """Pallas on real TPU unless FISCO_NO_PALLAS forces the XLA path — the
     escape hatch for benching/diagnosing when the Mosaic kernel misbehaves
     on hardware the CPU interpreter can't reproduce."""
     import os
 
-    if os.environ.get("FISCO_NO_PALLAS"):
+    if _PALLAS_BROKEN or os.environ.get("FISCO_NO_PALLAS"):
         return False
     return jax.default_backend() == "tpu"
+
+
+def pallas_or_xla(fn_pallas, fn_xla, *args):
+    """Run the Pallas kernel; on a KERNEL failure (Mosaic rejects constructs
+    the CPU interpreter accepts — the kernels' first hardware compile happens
+    in the field) degrade PERMANENTLY to the bit-identical XLA path instead
+    of killing the caller (a bench run or a live node).
+
+    The latch only sticks when the XLA retry of the SAME args succeeds —
+    proving the kernel, not the data, was at fault. A data error (bad
+    shape/dtype) re-raises from the XLA path WITHOUT latching, so one
+    malformed batch can't silently demote a healthy TPU to the slow path."""
+    global _PALLAS_BROKEN
+    try:
+        return fn_pallas(*args)
+    except Exception as e:  # Mosaic/lowering/compile failures have no
+        # common base class
+        out = fn_xla(*args)  # data errors raise here, latch untouched
+        _PALLAS_BROKEN = True
+        from ..utils.log import get_logger
+
+        get_logger("ops").warning(
+            "Pallas kernel failed on this backend (%s: %s) but the XLA path "
+            "succeeded; using XLA for the rest of this process",
+            type(e).__name__, str(e)[:300],
+        )
+        return out
 
 
 @jax.jit
@@ -195,7 +225,7 @@ def verify_device(z, r, s, qx, qy):
     if _use_pallas():
         from .pallas_ec import verify_pallas
 
-        return verify_pallas(z, r, s, qx, qy)
+        return pallas_or_xla(verify_pallas, _verify_xla, z, r, s, qx, qy)
     return _verify_xla(z, r, s, qx, qy)
 
 
@@ -205,7 +235,7 @@ def recover_device(z, r, s, v):
     if _use_pallas():
         from .pallas_ec import recover_pallas
 
-        return recover_pallas(z, r, s, v)
+        return pallas_or_xla(recover_pallas, _recover_xla, z, r, s, v)
     return _recover_xla(z, r, s, v)
 
 

@@ -99,12 +99,12 @@ def _verify_xla(e, r, s, qx, qy):
 
 def verify_device(e, r, s, qx, qy):
     """Batch SM2 verify. All inputs [B, 16] plain-domain batch-major limbs."""
-    from .secp256k1 import _use_pallas
+    from .secp256k1 import _use_pallas, pallas_or_xla
 
     if _use_pallas():
         from .pallas_ec import sm2_verify_pallas
 
-        return sm2_verify_pallas(e, r, s, qx, qy)
+        return pallas_or_xla(sm2_verify_pallas, _verify_xla, e, r, s, qx, qy)
     return _verify_xla(e, r, s, qx, qy)
 
 

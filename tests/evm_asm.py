@@ -5,16 +5,24 @@ fixtures for bcos-executor's unit tests)."""
 
 OPS = {
     "STOP": 0x00, "ADD": 0x01, "MUL": 0x02, "SUB": 0x03, "DIV": 0x04,
-    "EXP": 0x0A, "INVALID": 0xFE,
-    "LT": 0x10, "GT": 0x11, "EQ": 0x14, "ISZERO": 0x15, "AND": 0x16,
-    "OR": 0x17, "NOT": 0x19, "SHL": 0x1B, "SHR": 0x1C,
-    "SHA3": 0x20, "ADDRESS": 0x30, "CALLER": 0x33, "CALLVALUE": 0x34,
+    "SDIV": 0x05, "MOD": 0x06, "SMOD": 0x07, "ADDMOD": 0x08, "MULMOD": 0x09,
+    "EXP": 0x0A, "SIGNEXTEND": 0x0B, "INVALID": 0xFE,
+    "LT": 0x10, "GT": 0x11, "SLT": 0x12, "SGT": 0x13, "EQ": 0x14,
+    "ISZERO": 0x15, "AND": 0x16,
+    "OR": 0x17, "XOR": 0x18, "NOT": 0x19, "BYTE": 0x1A,
+    "SHL": 0x1B, "SHR": 0x1C, "SAR": 0x1D,
+    "SHA3": 0x20, "ADDRESS": 0x30, "BALANCE": 0x31, "ORIGIN": 0x32,
+    "CALLER": 0x33, "CALLVALUE": 0x34,
     "CALLDATALOAD": 0x35, "CALLDATASIZE": 0x36, "CALLDATACOPY": 0x37,
     "CODESIZE": 0x38, "CODECOPY": 0x39, "RETURNDATASIZE": 0x3D,
     "RETURNDATACOPY": 0x3E, "NUMBER": 0x43, "TIMESTAMP": 0x42,
-    "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52, "SLOAD": 0x54,
-    "SSTORE": 0x55, "JUMP": 0x56, "JUMPI": 0x57, "GAS": 0x5A,
-    "JUMPDEST": 0x5B, "LOG1": 0xA1,
+    "GASLIMIT": 0x45, "CHAINID": 0x46,
+    "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52, "MSTORE8": 0x53,
+    "SLOAD": 0x54,
+    "SSTORE": 0x55, "JUMP": 0x56, "JUMPI": 0x57, "PC": 0x58, "MSIZE": 0x59,
+    "GAS": 0x5A,
+    "JUMPDEST": 0x5B, "LOG0": 0xA0, "LOG1": 0xA1, "LOG2": 0xA2,
+    "LOG3": 0xA3, "LOG4": 0xA4,
     "CREATE": 0xF0, "CALL": 0xF1, "RETURN": 0xF3, "DELEGATECALL": 0xF4,
     "STATICCALL": 0xFA, "REVERT": 0xFD,
 }

@@ -183,3 +183,11 @@ class TestModuleParamSplit:
 
         host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
         assert host.get_code(rc.contract_address) == code
+
+    def test_zero_byte_params_not_absorbed_as_custom_sections(self):
+        # b"\x00\x00" (two SCALE-compact zeros / empty vecs) must be params,
+        # not a run of empty custom sections swallowed into the module
+        code = _fixture("transfer.wasm")
+        n = self._end(code)
+        for params in (b"\x00\x00", b"\x00\x00\x00", b"\x00\x01\x41"):
+            assert self._end(code + params) == n, params.hex()

@@ -16,12 +16,17 @@ _REF_HASH = {"keccak256": keccak256, "sm3": sm3}
 
 def _host_root(leaves, width, hasher):
     """Independent reimplementation of the padded-bucket root definition:
-    zero-pad to the next power-of-two bucket (>16 leaves), fold the wide
-    tree, then bind the REAL leaf count with one more hash."""
+    zero-pad to the 5-bit-mantissa bucket (smallest m*2^j >= n, 16<=m<=32,
+    for >16 leaves), fold the wide tree, then bind the REAL leaf count with
+    one more hash."""
     h = _REF_HASH[hasher]
     n = len(leaves)
     cur = [bytes(x) for x in leaves]
-    bucket = n if n <= 16 else 1 << (n - 1).bit_length()
+    if n > 16:
+        j = n.bit_length() - 5
+        bucket = -(-n // (1 << j)) << j
+    else:
+        bucket = n
     cur += [b"\x00" * 32] * (bucket - n)
     while len(cur) > 1:
         cur = [h(b"".join(cur[i : i + width])) for i in range(0, len(cur), width)]
@@ -122,20 +127,24 @@ def test_fused_device_root_input_validation():
 
 def test_bucket_padding_reuses_device_program():
     """Block sizes within one bucket must hit the SAME compiled tree program
-    (the per-leaf-count recompile churn fix): 257..512 leaves all map to the
-    512 bucket."""
+    (the per-leaf-count recompile churn fix), with padding overhead bounded
+    by the 5-bit mantissa (<= 1/16)."""
     from fisco_bcos_tpu.ops.merkle import _device_root_fn, bucket_leaves, merkle_root
 
     assert bucket_leaves(10) == 10          # tiny trees stay exact
-    assert bucket_leaves(17) == 32
     assert bucket_leaves(256) == 256
-    assert bucket_leaves(257) == 512
+    assert bucket_leaves(257) == 272
+    assert bucket_leaves(500) == 512
     assert bucket_leaves(512) == 512
-    assert bucket_leaves(10_000) == 16_384
+    assert bucket_leaves(10_000) == 10_240  # headline tree: +2.4%, not +64%
+    for n in (17, 300, 999, 4097, 12_345, 100_000):
+        b = bucket_leaves(n)
+        assert n <= b <= n + (n >> 4) + 16   # overhead bound
+        assert bucket_leaves(b) == b         # buckets are fixed points
 
     before = _device_root_fn.cache_info().currsize
     rng = np.random.default_rng(3)
-    for n in (300, 400, 500, 512):
+    for n in (497, 500, 505, 512):           # one bucket: 512
         merkle_root(rng.integers(0, 256, (n, 32), dtype=np.uint8))
     added = _device_root_fn.cache_info().currsize - before
     assert added <= 1  # one program for the whole bucket

@@ -63,3 +63,24 @@ def test_sm2_kernel_traces_with_sparse_field(monkeypatch):
     a = jnp.zeros((16, B), jnp.uint32)
     out = jax.eval_shape(jax.jit(lambda x: f.mul(x, x)), f.from_plain(a))
     assert out.shape == (16, B)
+
+
+def test_mosaic_failure_degrades_to_xla(monkeypatch):
+    """VERDICT r4 #1b: a Mosaic compile failure on hardware must degrade the
+    process to the XLA path (with the flag latched), never kill the run."""
+    from fisco_bcos_tpu.ops import secp256k1 as s
+
+    calls = []
+
+    def broken(*a):
+        raise RuntimeError("Mosaic: unsupported lowering")
+
+    def xla(*a):
+        calls.append(a)
+        return "xla-result"
+
+    monkeypatch.setattr(s, "_PALLAS_BROKEN", False)
+    assert s.pallas_or_xla(broken, xla, 1, 2) == "xla-result"
+    assert calls == [(1, 2)]
+    assert s._PALLAS_BROKEN is True
+    assert s._use_pallas() is False  # latched for the whole process
