@@ -129,7 +129,10 @@ def extract_criticals(
     keys: list[bytes] = []
     for cf in fn.conflicts:
         kind = cf.get("kind")
-        value = cf.get("value") or []
+        # solidity ABIs carry the selector list as "value" (dag/Abi.cpp:166);
+        # liquid-generated ABIs name the same field "path" (the reference's
+        # wasm test fixtures) — accept both
+        value = cf.get("value") or cf.get("path") or []
         slot = cf.get("slot")
         key = b"" if slot is None else int(slot).to_bytes(4, "big")
         if kind == ALL:

@@ -176,29 +176,22 @@ def evm_run(code: bytes, calldata: bytes, self_addr: bytes, caller: bytes,
 
     def _sload(_ctx, slot_p, out_p):
         try:
-            v = sload(bytes(slot_p[i] for i in range(32)))
-            for i in range(32):
-                out_p[i] = v[i]
+            v = sload(ctypes.string_at(slot_p, 32))
+            ctypes.memmove(out_p, v, 32)
         except Exception as e:  # ctypes swallows callback exceptions
             cb_err.append(e)
-            for i in range(32):
-                out_p[i] = 0
+            ctypes.memmove(out_p, b"\x00" * 32, 32)
 
     def _sstore(_ctx, slot_p, val_p):
         try:
-            sstore(
-                bytes(slot_p[i] for i in range(32)),
-                bytes(val_p[i] for i in range(32)),
-            )
+            sstore(ctypes.string_at(slot_p, 32), ctypes.string_at(val_p, 32))
         except Exception as e:
             cb_err.append(e)
 
     def _log(_ctx, topics_p, ntopics, data_p, dlen):
         try:
-            topics = [
-                bytes(topics_p[32 * t + i] for i in range(32))
-                for t in range(ntopics)
-            ]
+            raw = ctypes.string_at(topics_p, 32 * ntopics) if ntopics else b""
+            topics = [raw[32 * t : 32 * t + 32] for t in range(ntopics)]
             log(topics, ctypes.string_at(data_p, dlen) if dlen else b"")
         except Exception as e:
             cb_err.append(e)

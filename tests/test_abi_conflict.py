@@ -176,3 +176,14 @@ def test_user_contract_dag_receipts_match_serial():
     ser_rcs, ser_root = run(False)
     assert par_rcs == ser_rcs
     assert par_root == ser_root
+
+
+def test_liquid_path_key_accepted():
+    """liquid-generated ABIs spell the component selector "path" (the
+    reference's transfer.wasm fixture ABI); solidity ABIs spell it "value"
+    — both must produce the same criticals."""
+    a = _fn([{"kind": 3, "value": [0], "slot": 0}])
+    b = _fn([{"kind": 3, "path": [0], "slot": 0}])
+    ka = abi_conflict.extract_criticals(a, _call(7, 1), b"s", b"c", 0, 0)
+    kb = abi_conflict.extract_criticals(b, _call(7, 1), b"s", b"c", 0, 0)
+    assert ka == kb and ka is not None
