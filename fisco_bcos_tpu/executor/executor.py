@@ -295,11 +295,20 @@ class TransactionExecutor:
 
     def _execute_one(
         self, tx: Transaction, block: BlockContext, static_call: bool = False,
-        context_id: int = 0,
+        context_id: int = 0, access_out: list | None = None,
     ) -> TransactionReceipt:
         """One tx frame on its own overlay; merge on success, drop on revert
-        (the reference's TransactionExecutive + revert semantics)."""
+        (the reference's TransactionExecutive + revert semantics).
+
+        With `access_out`, the tx overlay is appended to it and tracks the
+        tx's external read-set (overlay.read_track) and, on success, its
+        write-set (overlay.last_writes) — the DAG runner's runtime conflict
+        validation inputs."""
         overlay = StateStorage(block.storage)
+        if access_out is not None:
+            overlay.read_track = set()
+            overlay.last_writes = set()
+            access_out.append(overlay)
         rc = TransactionReceipt(version=tx.version, block_number=block.number)
         is_create = not tx.to
         if not is_create and not self.known_callee(tx.to, overlay):
@@ -365,6 +374,8 @@ class TransactionExecutor:
                 from .precompiled.auth import bind_admin
 
                 bind_admin(overlay, res.create_address, tx.sender)
+            if access_out is not None:
+                overlay.last_writes = set(overlay._data)
             overlay.merge_into_prev()
         return rc
 
@@ -466,18 +477,104 @@ class TransactionExecutor:
     def dag_execute_transactions(
         self, txs: list[Transaction]
     ) -> list[TransactionReceipt]:
-        """Conflict-DAG execution: level-by-level, deterministic order within
-        a level (matches serial results bit-exactly; the parallelism contract
-        is what the reference's TxDAG2 gives tbb)."""
+        """Conflict-DAG execution: level-by-level; txs WITHIN a level run on
+        a thread pool (the reference's TxDAG2 + tbb::parallel_for axis,
+        SURVEY §2.8 row 5), VALIDATED at runtime. Real parallelism comes
+        from the native EVM engine and native crypto calls releasing the
+        GIL; pure-Python precompile frames interleave under the GIL.
+
+        Determinism contract: context ids are pre-reserved per tx index and
+        each tx runs on its own overlay, so for txs whose declared conflict
+        sets are HONEST (disjoint state), any schedule produces serial-
+        identical results. Because a lying conflictFields declaration must
+        not let host core count leak into the state root (one node pools,
+        another doesn't), every pooled level's actual read/write sets are
+        checked pairwise after it completes; ANY overlap discards the whole
+        attempt and re-executes the block serially — the same deterministic
+        outcome every node computes. The whole DAG run happens on a shadow
+        overlay so the discard is clean. FISCO_DAG_SERIAL=1 pins serial."""
         if self._block is None:
             raise RuntimeError("call next_block_header first")
-        receipts: list[TransactionReceipt | None] = [None] * len(txs)
         base = self.reserve_contexts(len(txs))
-        for level in self.dag_levels(txs):
-            for i in level:
-                receipts[i] = self._execute_one(
-                    txs[i], self._block, context_id=base + i
-                )
+        import os as _os
+
+        try:
+            workers = int(_os.environ.get("FISCO_DAG_WORKERS", "0"))
+        except ValueError:
+            workers = 0
+        if workers <= 0:
+            workers = min(8, _os.cpu_count() or 1)
+        use_pool = workers > 1 and not _os.environ.get("FISCO_DAG_SERIAL")
+        levels = self.dag_levels(txs)
+
+        def shadow_ctx() -> BlockContext:
+            return BlockContext(
+                number=self._block.number,
+                timestamp=self._block.timestamp,
+                gas_limit=self._block.gas_limit,
+                storage=StateStorage(self._block.storage),
+            )
+
+        def run_serial(block: BlockContext) -> list:
+            return [
+                self._execute_one(txs[i], block, context_id=base + i)
+                for level in levels
+                for i in level
+            ]
+
+        receipts: list[TransactionReceipt | None] = [None] * len(txs)
+        shadow = shadow_ctx()
+        conflict = False
+        if use_pool:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(workers) as pool:
+                for level in levels:
+                    accesses: dict[int, list] = {i: [] for i in level}
+                    if len(level) > 1:
+                        futs = {
+                            i: pool.submit(
+                                self._execute_one, txs[i], shadow,
+                                context_id=base + i,
+                                access_out=accesses[i],
+                            )
+                            for i in level
+                        }
+                        for i, fut in futs.items():
+                            receipts[i] = fut.result()
+                        # runtime validation: every key written by a level
+                        # member must be untouched (read OR written) by its
+                        # peers, else the declarations lied and schedule
+                        # order would decide the state
+                        touched: dict[tuple, int] = {}
+                        for i in level:
+                            ov = accesses[i][0]
+                            for k in ov.last_writes | ov.read_track:
+                                owner = touched.setdefault(k, i)
+                                if owner != i and (
+                                    k in ov.last_writes
+                                    or k in accesses[owner][0].last_writes
+                                ):
+                                    conflict = True
+                        if conflict:
+                            _log.warning(
+                                "DAG level of %d txs touched overlapping "
+                                "state its conflict declarations called "
+                                "disjoint; re-executing the block serially",
+                                len(level),
+                            )
+                            break
+                    else:
+                        for i in level:
+                            receipts[i] = self._execute_one(
+                                txs[i], shadow, context_id=base + i
+                            )
+        else:
+            receipts = run_serial(shadow)
+        if conflict:
+            shadow = shadow_ctx()
+            receipts = run_serial(shadow)
+        shadow.storage.merge_into_prev()
         return receipts  # type: ignore[return-value]
 
     # -- read-only call (call:672) ------------------------------------------

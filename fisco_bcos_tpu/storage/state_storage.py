@@ -33,6 +33,10 @@ class StateStorage(TraversableStorage):
         self.prev = prev
         self._data: dict[tuple[str, bytes], Entry] = {}
         self._lock = threading.RLock()
+        # when set to a set(), fall-through reads (keys this layer depends on
+        # from BELOW) are recorded — the DAG runner's read-set for runtime
+        # conflict validation (executor.dag_execute_transactions)
+        self.read_track: set | None = None
 
     # -- reads --------------------------------------------------------------
 
@@ -42,6 +46,8 @@ class StateStorage(TraversableStorage):
             e = self._data.get((table, key))
         if e is not None:
             return None if e.deleted else e.copy()
+        if self.read_track is not None:
+            self.read_track.add((table, key))
         return self.prev.get_row(table, key) if self.prev else None
 
     def get_primary_keys(self, table: str) -> list[bytes]:

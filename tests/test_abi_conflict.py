@@ -187,3 +187,82 @@ def test_liquid_path_key_accepted():
     ka = abi_conflict.extract_criticals(a, _call(7, 1), b"s", b"c", 0, 0)
     kb = abi_conflict.extract_criticals(b, _call(7, 1), b"s", b"c", 0, 0)
     assert ka == kb and ka is not None
+
+
+def test_dag_pool_matches_serial(monkeypatch):
+    """The threaded level runner must be bit-identical to the serial loop
+    (pre-reserved context ids + per-tx overlays + disjoint criticals make
+    the schedule irrelevant) — forced on even on a 1-core host."""
+    def run(pooled: bool):
+        if pooled:
+            monkeypatch.setenv("FISCO_DAG_WORKERS", "4")
+            monkeypatch.delenv("FISCO_DAG_SERIAL", raising=False)
+        else:
+            monkeypatch.setenv("FISCO_DAG_SERIAL", "1")
+        env = Env()
+        addr = env.deploy_setfor()
+        blk = env.run_block([
+            env.tx(addr, _call(i, 900 + i), attribute=TransactionAttribute.DAG)
+            for i in range(8)
+        ])
+        assert all(rc.status == 0 for rc in blk.receipts)
+        return ([rc.encode() for rc in blk.receipts],
+                env.ledger.header_by_number(2).state_root)
+
+    assert run(True) == run(False)
+
+
+def test_lying_declaration_detected_and_serialized(monkeypatch, caplog):
+    """Two txs whose conflictFields claim disjoint state but whose code
+    writes the SAME storage slot: the pooled runner must detect the overlap
+    at runtime and re-execute serially, producing the serial result — a
+    lying annotation must never let host core count decide the state root
+    (review finding r5)."""
+    import json as _json
+
+    monkeypatch.setenv("FISCO_DAG_WORKERS", "4")
+    monkeypatch.delenv("FISCO_DAG_SERIAL", raising=False)
+
+    # setFixed(uint256,uint256) IGNORES param 0 and always writes slot 7 —
+    # but its ABI (dishonestly) declares parallelism by param 0
+    sel = int.from_bytes(CODEC.selector("setFixed(uint256,uint256)"), "big")
+    runtime = asm(
+        ("PUSH", 0), "CALLDATALOAD", ("PUSH", 224), "SHR",
+        ("PUSH", sel), "EQ", ("ref", "go"), "JUMPI",
+        ("PUSH", 0), ("PUSH", 0), "REVERT",
+        ("label", "go"),
+        ("PUSH", 7), "SLOAD", ("PUSH", 36), "CALLDATALOAD", "ADD",
+        ("PUSH", 7), "SSTORE", "STOP",
+    )
+    lying_abi = [{
+        "type": "function", "name": "setFixed",
+        "inputs": [{"type": "uint256"}, {"type": "uint256"}],
+        "conflictFields": [{"kind": 3, "value": [0], "slot": 0}],
+    }]
+
+    def run(pooled: bool):
+        if pooled:
+            monkeypatch.setenv("FISCO_DAG_WORKERS", "4")
+            monkeypatch.delenv("FISCO_DAG_SERIAL", raising=False)
+        else:
+            monkeypatch.setenv("FISCO_DAG_SERIAL", "1")
+        env = Env()
+        rc = env.run_block(
+            [env.tx(b"", _deployer(runtime), abi=_json.dumps(lying_abi))]
+        ).receipts[0]
+        assert rc.status == 0
+        addr = rc.contract_address
+        blk = env.run_block([
+            env.tx(addr, CODEC.encode_call("setFixed(uint256,uint256)", i, 10 + i),
+                   attribute=TransactionAttribute.DAG)
+            for i in range(4)
+        ])
+        assert all(r.status == 0 for r in blk.receipts)
+        return ([r.encode() for r in blk.receipts],
+                env.ledger.header_by_number(2).state_root)
+
+    # levelization puts all 4 in one level (disjoint declared keys)...
+    pooled = run(True)
+    serial = run(False)
+    # ...but the runtime validation must force the serial outcome anyway
+    assert pooled == serial
