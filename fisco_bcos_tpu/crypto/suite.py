@@ -260,14 +260,27 @@ class Ed25519Crypto(SignatureCrypto):
     def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
         """One fused device program for the whole batch: all curve math
         (decompression, dual ladder, cofactored identity check) on device;
-        SHA-512 challenges on host (ops/ed25519.py module docstring)."""
+        SHA-512 challenges on host (ops/ed25519.py module docstring).
+        Small batches and CPU-only backends ride the native host loop like
+        the other curves (use_native_batch) — a QC list of 4 signatures
+        must never pay a tunnel round trip or emulated-XLA limb math."""
+        hashes = [bytes(h) for h in msg_hashes]
+        pub_list = [bytes(p) for p in pubs]
+        sig_list = [bytes(s) for s in sigs]
+        if use_native_batch(len(sig_list)):
+            from .. import native_bind
+
+            if native_bind.load() is not None:
+                return np.array(
+                    [
+                        native_bind.ed25519_verify(p[:32], h, s[:64])
+                        for h, p, s in zip(hashes, pub_list, sig_list)
+                    ],
+                    dtype=bool,
+                )
         from ..ops import ed25519 as ed_ops
 
-        return ed_ops.verify_batch(
-            [bytes(h) for h in msg_hashes],
-            [bytes(p) for p in pubs],
-            [bytes(s) for s in sigs],
-        )
+        return ed_ops.verify_batch(hashes, pub_list, sig_list)
 
     def batch_recover(self, msg_hashes, sigs):
         """Parse the appended key, then device-batch-verify (ed25519 has no

@@ -125,6 +125,27 @@ def extract_criticals(
     extractConflictFields:1220 faithfully, including the None fallbacks."""
     if not fn.conflicts:
         return None
+    try:
+        return _extract_criticals_checked(
+            fn, calldata, sender, contract, timestamp, block_number
+        )
+    except Exception:
+        # the ABI JSON is USER-SUPPLIED at deploy: malformed annotations
+        # (slot='abc', slot=2**40, value=5, non-int path entries, ...) must
+        # degrade to "serialize" like every other malformed case — an
+        # exception here would propagate through dag_levels into
+        # execute_block and halt the chain on that proposal
+        return None
+
+
+def _extract_criticals_checked(
+    fn: _Fn,
+    calldata: bytes,
+    sender: bytes,
+    contract: bytes,
+    timestamp: int,
+    block_number: int,
+) -> list[bytes] | None:
     decoded = None
     keys: list[bytes] = []
     for cf in fn.conflicts:

@@ -516,11 +516,16 @@ class TransactionExecutor:
             )
 
         def run_serial(block: BlockContext) -> list:
-            return [
-                self._execute_one(txs[i], block, context_id=base + i)
-                for level in levels
-                for i in level
-            ]
+            # receipts land at their TX INDEX (execution walks level order) —
+            # a flattened comprehension here once misassigned receipts
+            # whenever levelization reordered txs (review r5: consensus fork
+            # between pooled and serial nodes; see
+            # tests/test_abi_conflict.py::test_reordering_levels_keep_receipt_identity)
+            out: list = [None] * len(txs)
+            for level in levels:
+                for i in level:
+                    out[i] = self._execute_one(txs[i], block, context_id=base + i)
+            return out
 
         receipts: list[TransactionReceipt | None] = [None] * len(txs)
         shadow = shadow_ctx()

@@ -335,9 +335,15 @@ class WasmModule:
         while pos < len(binary):
             at = pos
             sec = binary[pos]
-            # ids 1..11 must ascend; 0 (custom) and 12 (datacount, which the
-            # spec places out of sequence before code) are order-exempt
-            if sec > 12 or (1 <= sec <= 11 and sec <= last_ordered_sec):
+            # ids 1..11 must ascend; 0 (custom) is order-exempt; 12
+            # (datacount) sits out of sequence but STRICTLY BEFORE the code
+            # section — after code/data it can only be param bytes (0x0C is
+            # a common SCALE compact/u8 value)
+            if (
+                sec > 12
+                or (1 <= sec <= 11 and sec <= last_ordered_sec)
+                or (sec == 12 and last_ordered_sec >= 10)
+            ):
                 self.module_end = at
                 break
             pos += 1
@@ -1103,15 +1109,19 @@ def _bcos_host(inst_ref: list, host, msg: EVMCall, logs: list, ret_data: list):
 
 
 def _run_export(
-    host, msg: EVMCall, code: bytes, entry: str, gas_mode: str = "dispatch"
+    host, msg: EVMCall, code: bytes, entry: str, gas_mode: str = "dispatch",
+    module: "WasmModule | None" = None,
 ):
     """Generator: run one exported entry point to an EVMResult (yielding
-    EVMCalls for cross-contract requests, like executor/evm.py interpret)."""
+    EVMCalls for cross-contract requests, like executor/evm.py interpret).
+    `module` skips re-parsing when the caller already decoded the bytes
+    (wasm_deploy parses once for the module/param split)."""
     logs: list[LogEntry] = []
     ret_data = [b""]
     inst_ref: list = [None]
     try:
-        module = WasmModule(code)
+        if module is None:
+            module = WasmModule(code)
         funcs = _bcos_host(inst_ref, host, msg, logs, ret_data)
         inst = WasmInstance(module, funcs, msg.gas, gas_mode=gas_mode)
         inst_ref[0] = inst
@@ -1158,16 +1168,19 @@ def wasm_deploy(
     MODULE (without the params) as the code to store — wasm stores the
     module itself, unlike EVM init code returning runtime code."""
     try:
-        end = WasmModule(module_bytes).module_end
+        module = WasmModule(module_bytes)
     except _Trap as t:
         return EVMResult(status=int(t.status), output=str(t).encode(), gas_left=0)
+    end = module.module_end
     module_only, params = module_bytes[:end], module_bytes[end:]
     run_msg = EVMCall(
         kind=msg.kind, sender=msg.sender, to=msg.to,
         code_address=msg.code_address, data=params, gas=msg.gas,
         value=msg.value, static=msg.static, depth=msg.depth,
     )
-    res = yield from _run_export(host, run_msg, module_only, "deploy", gas_mode)
+    res = yield from _run_export(
+        host, run_msg, module_only, "deploy", gas_mode, module=module
+    )
     if not res.ok:
         return res
     return EVMResult(

@@ -266,3 +266,81 @@ def test_lying_declaration_detected_and_serialized(monkeypatch, caplog):
     serial = run(False)
     # ...but the runtime validation must force the serial outcome anyway
     assert pooled == serial
+
+
+def test_reordering_levels_keep_receipt_identity(monkeypatch):
+    """Levelization that REORDERS txs (conflicting tx sinks to level 1 while
+    a later tx stays in level 0) must still put every receipt at its tx
+    index — on the serial path, the pooled path, and the conflict-fallback
+    path (review r5: a flattened serial loop swapped receipts and forked
+    the receipts root between 1-core and multicore nodes)."""
+    def run(mode: str):
+        if mode == "serial":
+            monkeypatch.setenv("FISCO_DAG_SERIAL", "1")
+        else:
+            monkeypatch.delenv("FISCO_DAG_SERIAL", raising=False)
+            monkeypatch.setenv("FISCO_DAG_WORKERS", "4")
+        env = Env()
+        addr = env.deploy_setfor()
+        dag = TransactionAttribute.DAG
+        # levels: [tx0(k0), tx2(k1)], [tx1(k0)]
+        blk = env.run_block([
+            env.tx(addr, _call(0, 100), attribute=dag),
+            env.tx(addr, _call(0, 200), attribute=dag),
+            env.tx(addr, _call(1, 300), attribute=dag),
+        ])
+        assert all(rc.status == 0 for rc in blk.receipts)
+        return blk.receipts, env.ledger.header_by_number(2).state_root
+
+    for mode in ("serial", "pooled"):
+        receipts, root = run(mode)
+        # tx1 re-writes slot 0 (SSTORE reset, 5k); tx0/tx2 first-write their
+        # slots (SSTORE set, 20k) — a receipt swap inverts this relation
+        assert receipts[1].gas_used < receipts[0].gas_used, mode
+        assert receipts[1].gas_used < receipts[2].gas_used, mode
+        assert receipts[0].gas_used == receipts[2].gas_used, mode
+    assert run("serial") == run("pooled")
+
+
+def test_malformed_conflictfields_serialize_not_crash():
+    """Attacker-deployed ABIs with malformed conflictFields (slot='abc',
+    slot=2**40, value=5, non-int path entries) must degrade to 'serialize',
+    never raise through execute_block (review r5: deterministic chain halt)."""
+    import json as _json
+
+    bad_abis = [
+        [{"type": "function", "name": "setFor",
+          "inputs": [{"type": "uint256"}, {"type": "uint256"}],
+          "conflictFields": [{"kind": 3, "value": [0], "slot": "abc"}]}],
+        [{"type": "function", "name": "setFor",
+          "inputs": [{"type": "uint256"}, {"type": "uint256"}],
+          "conflictFields": [{"kind": 3, "value": [0], "slot": 2**40}]}],
+        [{"type": "function", "name": "setFor",
+          "inputs": [{"type": "uint256"}, {"type": "uint256"}],
+          "conflictFields": [{"kind": 2, "value": 5, "slot": 0}]}],
+        [{"type": "function", "name": "setFor",
+          "inputs": [{"type": "uint256"}, {"type": "uint256"}],
+          "conflictFields": [{"kind": 3, "value": ["x"], "slot": 0}]}],
+        [{"type": "function", "name": "setFor",
+          "inputs": [{"type": "uint256"}, {"type": "uint256"}],
+          "conflictFields": [{"kind": 4, "value": [None], "slot": 0}]}],
+    ]
+    for bad in bad_abis:
+        env = Env()
+        rc = env.run_block(
+            [env.tx(b"", _deployer(_setfor_runtime()), abi=_json.dumps(bad))]
+        ).receipts[0]
+        assert rc.status == 0
+        blk = env.run_block([
+            env.tx(rc.contract_address, _call(i, i),
+                   attribute=TransactionAttribute.DAG)
+            for i in range(2)
+        ])
+        assert all(r.status == 0 for r in blk.receipts), bad
+        # and the levels serialized (None criticals -> one tx per level)
+        env.executor.next_block_header(__import__("fisco_bcos_tpu.protocol.block_header", fromlist=["BlockHeader"]).BlockHeader(number=3, timestamp=1))
+        t = [env.tx(rc.contract_address, _call(9, 9), attribute=TransactionAttribute.DAG),
+             env.tx(rc.contract_address, _call(8, 8), attribute=TransactionAttribute.DAG)]
+        for x in t:
+            x.force_sender(b"\x33" * 20)
+        assert len(env.executor.dag_levels(t)) == 2

@@ -191,3 +191,11 @@ class TestModuleParamSplit:
         n = self._end(code)
         for params in (b"\x00\x00", b"\x00\x00\x00", b"\x00\x01\x41"):
             assert self._end(code + params) == n, params.hex()
+
+    def test_datacount_id_after_code_is_params(self):
+        # 0x0C (SCALE compact 3 / u8 12) after a complete module must be
+        # PARAMS: datacount sections only occur BEFORE the code section
+        code = _fixture("transfer.wasm")
+        n = self._end(code)
+        assert self._end(code + b"\x0c\x00") == n
+        assert self._end(code + b"\x0c") == n

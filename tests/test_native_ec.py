@@ -188,3 +188,31 @@ def test_ed25519_suite_single_item_uses_native():
     bad = bytearray(sig)
     bad[5] ^= 1
     assert not impl.verify(kp.pub, msg, bytes(bad))
+
+
+def test_ed25519_batch_routes_native_and_agrees():
+    """QC-sized ed25519 batches must ride the native host loop on CPU
+    backends (use_native_batch — review r5: the XLA program re-introduced
+    per-block latency the routing was built to remove) and agree with the
+    device-path semantics."""
+    import numpy as np
+
+    from fisco_bcos_tpu import native_bind
+    from fisco_bcos_tpu.crypto.suite import Ed25519Crypto
+
+    if native_bind.load() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    impl = Ed25519Crypto()
+    kps = [impl.generate_keypair(secret=0xED25 + i) for i in range(4)]
+    hashes = [bytes([i]) * 32 for i in range(4)]
+    sigs = [impl.sign(kp, h) for kp, h in zip(kps, hashes)]
+    pubs = [kp.pub[:32] for kp in kps]
+    ok = impl.batch_verify(hashes, pubs, sigs)
+    assert bool(np.asarray(ok).all())
+    # one corrupted lane lowers only its bit
+    bad = list(sigs)
+    bad[2] = bytes([bad[2][0] ^ 1]) + bad[2][1:]
+    ok2 = np.asarray(impl.batch_verify(hashes, pubs, bad))
+    assert list(ok2) == [True, True, False, True]
