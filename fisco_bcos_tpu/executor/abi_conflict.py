@@ -129,12 +129,20 @@ def extract_criticals(
         return _extract_criticals_checked(
             fn, calldata, sender, contract, timestamp, block_number
         )
-    except Exception:
+    except Exception as e:
         # the ABI JSON is USER-SUPPLIED at deploy: malformed annotations
         # (slot='abc', slot=2**40, value=5, non-int path entries, ...) must
         # degrade to "serialize" like every other malformed case — an
         # exception here would propagate through dag_levels into
-        # execute_block and halt the chain on that proposal
+        # execute_block and halt the chain on that proposal. Logged so a
+        # popular contract silently collapsing DAG parallelism (or a bug in
+        # the checked extractor) leaves an operator-visible trail.
+        from ..utils.log import get_logger
+
+        get_logger("executor").warning(
+            "conflictFields for %s unusable (%s: %s); tx will serialize",
+            fn.name, type(e).__name__, e,
+        )
         return None
 
 

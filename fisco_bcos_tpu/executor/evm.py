@@ -37,7 +37,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..protocol.receipt import LogEntry, TransactionStatus
-from ..storage.entry import Entry
+from ..storage.entry import Entry, EntryStatus
 from ..storage.interfaces import StorageInterface
 
 MOD = 1 << 256
@@ -686,8 +686,23 @@ def interpret(host: EVMHost, msg: EVMCall, code: bytes):
                 return ret(TransactionStatus.REVERT_INSTRUCTION, f.mread(off, size))
             elif op == 0xFE:  # INVALID
                 raise _VMError(TransactionStatus.BAD_INSTRUCTION)
-            elif op == 0xFF:  # SELFDESTRUCT — not supported on this chain
-                raise _VMError(TransactionStatus.BAD_INSTRUCTION)
+            elif op == 0xFF:  # SELFDESTRUCT
+                # FISCO semantics (EVMHostInterface.cpp:145-152,
+                # HostContext.h:152 suicide): the beneficiary is IGNORED (no
+                # balance model) and the contract's account is registered
+                # for deletion — here the #account row is tomb-stoned in
+                # the tx overlay, so the code vanishes when the frame
+                # commits and later calls see a codeless address. Orphaned
+                # storage slots remain, like the reference's table remnants.
+                if msg.static:
+                    raise _VMError(TransactionStatus.BAD_INSTRUCTION)
+                f.use_gas(5000)
+                f.pop()  # beneficiary, ignored
+                host.storage.set_row(
+                    contract_table(msg.to), b"#account",
+                    Entry(status=EntryStatus.DELETED),
+                )
+                return ret(0)
             else:
                 raise _VMError(TransactionStatus.BAD_INSTRUCTION)
         return ret(0)

@@ -46,6 +46,7 @@ from .evm import (
     EVMCall,
     EVMHost,
     EVMResult,
+    contract_table,
     interpret,
 )
 from . import eth_builtins
@@ -760,9 +761,25 @@ class Executive:
                         if len(res.output) > MAX_CODE_SIZE:
                             res = EVMResult(status=int(TransactionStatus.OUT_OF_GAS))
                         else:
-                            self._host(fr.overlay).set_code(
-                                fr.create_addr, res.output, fr.abi
+                            # init code that SELFDESTRUCTED tomb-stoned its
+                            # own #account row — storing code now would
+                            # resurrect it as a live empty account (burning
+                            # the address for future CREATE2); keep the
+                            # tombstone instead (review r5)
+                            row = fr.overlay.get_row(
+                                contract_table(fr.create_addr), b"#account"
                             )
+                            destroyed = (
+                                row is None
+                                and fr.overlay._data.get(
+                                    (contract_table(fr.create_addr), b"#account")
+                                )
+                                is not None
+                            )
+                            if not destroyed:
+                                self._host(fr.overlay).set_code(
+                                    fr.create_addr, res.output, fr.abi
+                                )
                             res = EVMResult(
                                 status=0, output=b"", gas_left=res.gas_left,
                                 logs=res.logs, create_address=fr.create_addr,

@@ -2155,8 +2155,8 @@ int fisco_evm_run(const uint8_t* code, size_t code_len, const uint8_t* calldata,
             return 0; }
         case 0xFE:  // INVALID
             FAIL(EVM_BAD_INSTRUCTION);
-        case 0xFF:  // SELFDESTRUCT — unsupported on this chain (evm.py)
-            FAIL(EVM_BAD_INSTRUCTION);
+        case 0xFF:  // SELFDESTRUCT: account-deletion semantics live in the
+                    // Python host (evm.py suicide analog) — escape
         default:
             // CALL/CREATE family, EXTCODE*, RETURNDATA-after-call, and
             // anything unknown: hand the frame to Python AT this opcode

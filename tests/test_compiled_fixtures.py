@@ -199,3 +199,98 @@ class TestModuleParamSplit:
         n = self._end(code)
         assert self._end(code + b"\x0c\x00") == n
         assert self._end(code + b"\x0c") == n
+
+
+class TestSelfdestruct:
+    """FISCO suicide semantics (EVMHostInterface.cpp:145-152: beneficiary
+    ignored, contract registered for deletion) — via the real solc fixture's
+    selfdestructTest() and both engines."""
+
+    def _deployed(self):
+        ex = _env(is_wasm=False)
+        code = bytes.fromhex(_fixture("hello_world_solc.hex").decode())
+        (rc,) = ex.execute_transactions([_tx(b"", code)])
+        assert rc.status == 0
+        return ex, rc.contract_address
+
+    def test_solc_selfdestruct_removes_code(self):
+        ex, addr = self._deployed()
+        (rc,) = ex.execute_transactions([_tx(addr, _sel("selfdestructTest()"))])
+        assert rc.status == 0, rc.output
+        from fisco_bcos_tpu.executor.evm import EVMHost
+
+        host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
+        assert host.get_code(addr) == b""
+        # later top-level calls see an unknown address
+        from fisco_bcos_tpu.protocol.receipt import TransactionStatus
+
+        (rc2,) = ex.execute_transactions([_tx(addr, _sel("get()"))])
+        assert rc2.status == int(TransactionStatus.CALL_ADDRESS_ERROR)
+
+    def test_both_engines_agree(self):
+        import os
+
+        import pytest
+
+        from fisco_bcos_tpu import native_bind
+
+        if native_bind.load() is None:
+            pytest.skip("native library unavailable; lockstep not testable")
+        for native in (True, False):
+            old = os.environ.pop("FISCO_NO_NATIVE_EVM", None)
+            if not native:
+                os.environ["FISCO_NO_NATIVE_EVM"] = "1"
+            try:
+                ex, addr = self._deployed()
+                (rc,) = ex.execute_transactions(
+                    [_tx(addr, _sel("selfdestructTest()"))]
+                )
+                assert rc.status == 0
+                if native:
+                    gas_native = rc.gas_used
+                else:
+                    assert rc.gas_used == gas_native  # engines in lockstep
+            finally:
+                if old is not None:
+                    os.environ["FISCO_NO_NATIVE_EVM"] = old
+                else:
+                    os.environ.pop("FISCO_NO_NATIVE_EVM", None)
+
+    def test_reverted_selfdestruct_rolls_back(self):
+        # inner frame selfdestructs then the OUTER caller reverts: the
+        # deletion must vanish with the frame overlay
+        from evm_asm import asm
+
+        ex, addr = self._deployed()
+        caller = asm(
+            ("PUSH", int.from_bytes(CODEC.selector("selfdestructTest()"), "big")),
+            ("PUSH", 224), "SHL", ("PUSH", 0), "MSTORE",
+            ("PUSH", 0), ("PUSH", 0), ("PUSH", 4), ("PUSH", 0), ("PUSH", 0),
+            ("PUSH", int.from_bytes(addr, "big")), "GAS", "CALL",
+            "POP", ("PUSH", 0), ("PUSH", 0), "REVERT",
+        )
+        from fisco_bcos_tpu.executor.evm import EVMHost
+
+        (rc2,) = ex.execute_transactions([_tx(b"", __import__("evm_asm")._deployer(caller))])
+        assert rc2.status == 0
+        (rc3,) = ex.execute_transactions([_tx(rc2.contract_address, b"\x00")])
+        assert rc3.status != 0  # outer reverted
+        host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
+        assert host.get_code(addr) != b""  # selfdestruct rolled back
+
+    def test_constructor_selfdestruct_leaves_no_account(self):
+        """Init code that SELFDESTRUCTs must NOT leave a live empty-code
+        account behind (the create handler's set_code would resurrect the
+        tombstone and burn the address — review r5)."""
+        from evm_asm import asm
+
+        ex = _env(is_wasm=False)
+        init = asm(("PUSH", 0), "SELFDESTRUCT")
+        (rc,) = ex.execute_transactions([_tx(b"", init)])
+        assert rc.status == 0
+        addr = rc.contract_address
+        from fisco_bcos_tpu.executor.evm import EVMHost
+
+        host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
+        assert host.get_code(addr) == b""
+        assert not host.account_exists(addr)
