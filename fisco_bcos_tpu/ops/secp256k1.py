@@ -170,14 +170,20 @@ _PALLAS_BROKEN = False
 
 
 def _use_pallas() -> bool:
-    """Pallas on real TPU unless FISCO_NO_PALLAS forces the XLA path — the
-    escape hatch for benching/diagnosing when the Mosaic kernel misbehaves
-    on hardware the CPU interpreter can't reproduce."""
+    """Pallas is OPT-IN (FISCO_FORCE_PALLAS=1, TPU only): the round-5
+    hardware qualification (tool/tpu_probe.py, v5e, 2026-08-01) measured the
+    plain-XLA paths FASTER than the Mosaic kernels everywhere — secp verify
+    0.14 ms vs 3.77 ms at B=256, sm2 verify 0.31 ms vs 6.07 ms — because XLA
+    already keeps the [16, T] limb chains vreg-resident and fuses them; the
+    hand-tiled kernel only adds scheduling overhead. The kernels stay (they
+    compile clean on hardware and are the bit-identity cross-check) but the
+    hot path is XLA on every backend. FISCO_NO_PALLAS still wins over the
+    force flag so one switch can pin the XLA leg in any process."""
     import os
 
     if _PALLAS_BROKEN or os.environ.get("FISCO_NO_PALLAS"):
         return False
-    return jax.default_backend() == "tpu"
+    return os.environ.get("FISCO_FORCE_PALLAS") == "1" and jax.default_backend() == "tpu"
 
 
 def pallas_or_xla(fn_pallas, fn_xla, *args):
