@@ -37,7 +37,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..protocol.receipt import LogEntry, TransactionStatus
-from ..storage.entry import Entry, EntryStatus
+from ..storage.entry import Entry
 from ..storage.interfaces import StorageInterface
 
 MOD = 1 << 256
@@ -98,13 +98,23 @@ class EVMHost:
     """
 
     def __init__(self, storage: StorageInterface, hash_fn, block_number: int,
-                 timestamp: int, tx_origin: bytes, gas_limit: int):
+                 timestamp: int, tx_origin: bytes, gas_limit: int,
+                 suicide_sink=None):
         self.storage = storage
         self.hash_fn = hash_fn
         self.block_number = block_number
         self.timestamp = timestamp
         self.tx_origin = tx_origin
         self.gas_limit = gas_limit
+        # block-scoped suicide registry (BlockContext::suicide,
+        # bcos-executor/src/executive/BlockContext.cpp:94-105): registration
+        # is immediate and is NOT unwound by frame reverts — the reference
+        # keeps one std::set per block with no revert hook
+        self.suicide_sink = suicide_sink
+
+    def register_suicide(self, addr: bytes) -> None:
+        if self.suicide_sink is not None:
+            self.suicide_sink(addr)
 
     # -- EVM storage (slot rows in the contract table) ----------------------
 
@@ -689,19 +699,20 @@ def interpret(host: EVMHost, msg: EVMCall, code: bytes):
             elif op == 0xFF:  # SELFDESTRUCT
                 # FISCO semantics (EVMHostInterface.cpp:145-152,
                 # HostContext.h:152 suicide): the beneficiary is IGNORED (no
-                # balance model) and the contract's account is registered
-                # for deletion — here the #account row is tomb-stoned in
-                # the tx overlay, so the code vanishes when the frame
-                # commits and later calls see a codeless address. Orphaned
-                # storage slots remain, like the reference's table remnants.
+                # balance model) and the contract is added to the BLOCK's
+                # suicide set. The kill itself — code and codeHash emptied,
+                # account row KEPT so the address is burned for any future
+                # CREATE2 — happens at end of block (killSuicides,
+                # BlockContext.cpp:107-137, run from getHash
+                # TransactionExecutor.cpp:1054). Like the reference, the
+                # registration is immediate and survives a later revert of
+                # this frame's ancestors (m_suicides has no unwind path),
+                # and later txs in the SAME block still see the old code.
                 if msg.static:
                     raise _VMError(TransactionStatus.BAD_INSTRUCTION)
                 f.use_gas(5000)
                 f.pop()  # beneficiary, ignored
-                host.storage.set_row(
-                    contract_table(msg.to), b"#account",
-                    Entry(status=EntryStatus.DELETED),
-                )
+                host.register_suicide(msg.to)
                 return ret(0)
             else:
                 raise _VMError(TransactionStatus.BAD_INSTRUCTION)

@@ -41,6 +41,8 @@ from ..storage.state_storage import StateStorage
 from ..utils.log import get_logger
 from ..utils.ripemd160 import ripemd160
 from .evm import (
+    F_CODE,
+    F_CODE_HASH,
     MAX_CALL_DEPTH,
     MAX_CODE_SIZE,
     EVMCall,
@@ -96,6 +98,11 @@ class BlockContext:
     # block txs once; CREATE addresses hash (number, contextID, seq) —
     # ChecksumAddress.h:83-97 — so ids must never repeat within a block)
     next_ctx: int = 0
+    # addresses registered by SELFDESTRUCT this block
+    # (BlockContext::m_suicides, BlockContext.h:147); applied by
+    # killSuicides at getHash time. set.add is GIL-atomic, so DAG-level
+    # worker threads can register concurrently.
+    suicides: set = field(default_factory=set)
 
 
 class TransactionExecutor:
@@ -185,10 +192,26 @@ class TransactionExecutor:
         self._block.next_ctx += n
         return base
 
+    def _apply_suicides(self, ctx: BlockContext) -> None:
+        """killSuicides (BlockContext.cpp:107-137): for every address the
+        block's SELFDESTRUCTs registered, empty the code and codeHash but
+        KEEP the account row — the address stays used forever, so a CREATE2
+        redeploy still fails with CONTRACT_ADDRESS_ALREADY_USED and the
+        contract's orphaned storage slots are unreachable through code.
+        Idempotent; sorted for a deterministic write order."""
+        for addr in sorted(ctx.suicides):
+            row = ctx.storage.get_row(contract_table(addr), b"#account")
+            if row is None:
+                continue
+            row.set(F_CODE, b"")
+            row.set(F_CODE_HASH, self.suite.hash(b""))
+            ctx.storage.set_row(contract_table(addr), b"#account", row)
+
     def get_hash_async(self):
         """Dispatch the state-root batch, defer the sync: () -> bytes."""
         if self._block is None:
             raise RuntimeError("no block in progress")
+        self._apply_suicides(self._block)
         return self._block.storage.hash_async(self.suite)
 
     def get_hash(self) -> bytes:
@@ -578,9 +601,13 @@ class TransactionExecutor:
         else:
             receipts = run_serial(shadow)
         if conflict:
+            # the discarded attempt's suicide registrations die with its
+            # shadow context; the serial rerun regenerates them — the same
+            # deterministic outcome on every node
             shadow = shadow_ctx()
             receipts = run_serial(shadow)
         shadow.storage.merge_into_prev()
+        self._block.suicides |= shadow.suicides
         return receipts  # type: ignore[return-value]
 
     # -- read-only call (call:672) ------------------------------------------
@@ -597,6 +624,7 @@ class TransactionExecutor:
         ctx = self._blocks.get(params.number)
         if ctx is None:
             raise RuntimeError(f"no executed block {params.number} to prepare")
+        self._apply_suicides(ctx)  # idempotent; getHash normally ran already
         writes = ctx.storage
         if extra_writes is not None:
             for t, k, e in extra_writes.traverse():
@@ -667,6 +695,7 @@ class Executive:
         return EVMHost(
             overlay, self.ex.suite.hash, self.block.number,
             self.block.timestamp, self.origin, self.block.gas_limit,
+            suicide_sink=self.block.suicides.add,
         )
 
     def _open(self, msg: EVMCall, parent: StorageInterface,
@@ -761,25 +790,14 @@ class Executive:
                         if len(res.output) > MAX_CODE_SIZE:
                             res = EVMResult(status=int(TransactionStatus.OUT_OF_GAS))
                         else:
-                            # init code that SELFDESTRUCTED tomb-stoned its
-                            # own #account row — storing code now would
-                            # resurrect it as a live empty account (burning
-                            # the address for future CREATE2); keep the
-                            # tombstone instead (review r5)
-                            row = fr.overlay.get_row(
-                                contract_table(fr.create_addr), b"#account"
+                            # init code that SELFDESTRUCTed still stores its
+                            # runtime code here; the block-end killSuicides
+                            # pass empties it (account row kept, address
+                            # burned) — matching the reference, where the
+                            # deploy completes and m_suicides wins at getHash
+                            self._host(fr.overlay).set_code(
+                                fr.create_addr, res.output, fr.abi
                             )
-                            destroyed = (
-                                row is None
-                                and fr.overlay._data.get(
-                                    (contract_table(fr.create_addr), b"#account")
-                                )
-                                is not None
-                            )
-                            if not destroyed:
-                                self._host(fr.overlay).set_code(
-                                    fr.create_addr, res.output, fr.abi
-                                )
                             res = EVMResult(
                                 status=0, output=b"", gas_left=res.gas_left,
                                 logs=res.logs, create_address=fr.create_addr,

@@ -24,7 +24,8 @@ OPS = {
     "JUMPDEST": 0x5B, "LOG0": 0xA0, "LOG1": 0xA1, "LOG2": 0xA2,
     "LOG3": 0xA3, "LOG4": 0xA4,
     "CREATE": 0xF0, "CALL": 0xF1, "RETURN": 0xF3, "DELEGATECALL": 0xF4,
-    "STATICCALL": 0xFA, "REVERT": 0xFD, "SELFDESTRUCT": 0xFF,
+    "CREATE2": 0xF5, "STATICCALL": 0xFA, "REVERT": 0xFD,
+    "SELFDESTRUCT": 0xFF,
 }
 for _i in range(1, 17):
     OPS[f"DUP{_i}"] = 0x7F + _i

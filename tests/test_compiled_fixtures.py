@@ -202,9 +202,12 @@ class TestModuleParamSplit:
 
 
 class TestSelfdestruct:
-    """FISCO suicide semantics (EVMHostInterface.cpp:145-152: beneficiary
-    ignored, contract registered for deletion) — via the real solc fixture's
-    selfdestructTest() and both engines."""
+    """FISCO suicide semantics — beneficiary ignored
+    (EVMHostInterface.cpp:145-152), contract registered in a BLOCK-scoped
+    suicide set (BlockContext.cpp:94-105) and killed at getHash
+    (killSuicides, BlockContext.cpp:107-137: code + codeHash emptied, the
+    account row KEPT so the address is burned forever) — via the real solc
+    fixture's selfdestructTest() and both engines."""
 
     def _deployed(self):
         ex = _env(is_wasm=False)
@@ -220,8 +223,15 @@ class TestSelfdestruct:
         from fisco_bcos_tpu.executor.evm import EVMHost
 
         host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
+        # kill is DEFERRED to end of block: a later tx in the same block
+        # still sees the code (the reference applies m_suicides at getHash)
+        assert host.get_code(addr) != b""
+        (rc_same_block,) = ex.execute_transactions([_tx(addr, _sel("get()"))])
+        assert rc_same_block.status == 0
+        ex.get_hash()  # end of block: killSuicides runs
         assert host.get_code(addr) == b""
-        # later top-level calls see an unknown address
+        assert host.account_exists(addr)  # account row kept, address burned
+        # later top-level calls see an unknown (codeless) address
         from fisco_bcos_tpu.protocol.receipt import TransactionStatus
 
         (rc2,) = ex.execute_transactions([_tx(addr, _sel("get()"))])
@@ -256,9 +266,11 @@ class TestSelfdestruct:
                 else:
                     os.environ.pop("FISCO_NO_NATIVE_EVM", None)
 
-    def test_reverted_selfdestruct_rolls_back(self):
-        # inner frame selfdestructs then the OUTER caller reverts: the
-        # deletion must vanish with the frame overlay
+    def test_reverted_selfdestruct_still_kills(self):
+        # inner frame selfdestructs then the OUTER caller reverts: like the
+        # reference, the registration is block-scoped with NO unwind path
+        # (BlockContext::suicide only ever emplaces; nothing removes on
+        # revert), so the kill still lands at end of block
         from evm_asm import asm
 
         ex, addr = self._deployed()
@@ -275,13 +287,14 @@ class TestSelfdestruct:
         assert rc2.status == 0
         (rc3,) = ex.execute_transactions([_tx(rc2.contract_address, b"\x00")])
         assert rc3.status != 0  # outer reverted
+        ex.get_hash()
         host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
-        assert host.get_code(addr) != b""  # selfdestruct rolled back
+        assert host.get_code(addr) == b""  # suicide survives the revert
 
-    def test_constructor_selfdestruct_leaves_no_account(self):
-        """Init code that SELFDESTRUCTs must NOT leave a live empty-code
-        account behind (the create handler's set_code would resurrect the
-        tombstone and burn the address — review r5)."""
+    def test_constructor_selfdestruct_burns_address(self):
+        """Init code that SELFDESTRUCTs completes the deploy (code stored),
+        then killSuicides empties it at block end — leaving a live codeless
+        account that burns the address, exactly the reference's outcome."""
         from evm_asm import asm
 
         ex = _env(is_wasm=False)
@@ -289,8 +302,66 @@ class TestSelfdestruct:
         (rc,) = ex.execute_transactions([_tx(b"", init)])
         assert rc.status == 0
         addr = rc.contract_address
+        ex.get_hash()
         from fisco_bcos_tpu.executor.evm import EVMHost
 
         host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
         assert host.get_code(addr) == b""
-        assert not host.account_exists(addr)
+        assert host.account_exists(addr)  # address can never be reused
+
+    def test_create2_redeploy_after_selfdestruct_fails(self):
+        """The review-r5 attack: CREATE2 redeploy at a selfdestructed
+        address must NOT resurrect the contract over its orphaned storage —
+        the kept account row makes it CONTRACT_ADDRESS_ALREADY_USED, like
+        the reference where the contract table persists after killSuicides."""
+        from evm_asm import asm
+
+        ex = _env(is_wasm=False)
+        # child init: SSTORE(0, 0xBEEF) then return the 3-byte runtime
+        # 6000FF (PUSH 0; SELFDESTRUCT)
+        child_runtime = asm(("PUSH", 0), "SELFDESTRUCT")
+        child_init = asm(
+            ("PUSH", 0xBEEF), ("PUSH", 0), "SSTORE",
+            ("PUSH", int.from_bytes(child_runtime, "big")), ("PUSH", 0), "MSTORE",
+            ("PUSH", len(child_runtime)), ("PUSH", 32 - len(child_runtime)),
+            "RETURN",
+        )
+        # factory runtime: mstore child_init, CREATE2(value=0, mem, salt=7),
+        # return the created address (0 on failure)
+        assert len(child_init) <= 32
+        factory_runtime = asm(
+            ("PUSH", child_init), ("PUSH", 0), "MSTORE",
+            ("PUSH", 7),                      # salt
+            ("PUSH", len(child_init)),        # size
+            ("PUSH", 32 - len(child_init)),   # offset (right-aligned)
+            ("PUSH", 0),                      # value
+            "CREATE2",
+            ("PUSH", 0), "MSTORE", ("PUSH", 32), ("PUSH", 0), "RETURN",
+        )
+        from evm_asm import _deployer
+
+        (rc_f,) = ex.execute_transactions([_tx(b"", _deployer(factory_runtime))])
+        assert rc_f.status == 0
+        factory = rc_f.contract_address
+
+        (rc1,) = ex.execute_transactions([_tx(factory, b"\x00")])
+        assert rc1.status == 0
+        child = rc1.output[12:]
+        assert child != bytes(20)
+        from fisco_bcos_tpu.executor.evm import EVMHost
+
+        host = EVMHost(ex._block.storage, SUITE.hash, 0, 0, b"", 0)
+        assert host.get_storage(child, 0) == 0xBEEF
+
+        (rc2,) = ex.execute_transactions([_tx(child, b"\x00")])  # selfdestruct
+        assert rc2.status == 0
+        ex.get_hash()  # killSuicides
+        assert host.get_code(child) == b""
+        assert host.account_exists(child)
+        assert host.get_storage(child, 0) == 0xBEEF  # orphaned, unreachable
+
+        # redeploy attempt at the same (sender, salt, init) address: the
+        # factory's inner CREATE2 must fail -> returned address is zero
+        (rc3,) = ex.execute_transactions([_tx(factory, b"\x00")])
+        assert rc3.status == 0
+        assert rc3.output == bytes(32)  # ADDRESS_ALREADY_USED -> push 0

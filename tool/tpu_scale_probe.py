@@ -47,7 +47,7 @@ def main() -> int:
     jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     _log(f"backend={jax.default_backend()}")
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from fisco_bcos_tpu.crypto import suite as cs
     from fisco_bcos_tpu.ops import secp256k1 as k1
     from fisco_bcos_tpu.ops.bigint import bytes_be_to_limbs
