@@ -203,6 +203,8 @@ class TransactionExecutor:
             row = ctx.storage.get_row(contract_table(addr), b"#account")
             if row is None:
                 continue
+            # only code + codeHash are emptied — the reference's kill leaves
+            # every other account field (incl. the ABI) untouched
             row.set(F_CODE, b"")
             row.set(F_CODE_HASH, self.suite.hash(b""))
             ctx.storage.set_row(contract_table(addr), b"#account", row)
