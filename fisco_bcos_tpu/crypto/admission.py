@@ -124,8 +124,11 @@ def admit_batch(
             out = _admit_batch_native(payloads, np.asarray(sigs65, dtype=np.uint8))
             if out is not None:
                 return out
+    # pad_keccak buckets the batch dim itself (empty-message pad rows);
+    # r/s/v pad to the same bucket (bucket_batch IS pad_keccak's schedule)
     bb = bucket_batch(bsz)
-    blocks, nblocks = pad_keccak(list(payloads) + [b""] * (bb - bsz))
+    blocks, nblocks = pad_keccak(list(payloads))
+    assert blocks.shape[0] == bb, (blocks.shape, bb)
     sigs65 = np.asarray(sigs65, dtype=np.uint8)
     r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
     s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)

@@ -43,7 +43,11 @@ def signed_payload_vectors(
 def admission_tensors(payloads, sigs65):
     """Host-padded device tensors for crypto.admission.admission_step:
     (blocks, nblocks, r, s, v) as numpy arrays."""
+    n = len(payloads)
+    # pad_keccak buckets the batch dim; this helper's contract is
+    # exact-size tensors (mesh dryruns shard on the true batch), so slice
     blocks, nblocks = pad_keccak(payloads)
+    blocks, nblocks = blocks[:n], nblocks[:n]
     sigs65 = np.asarray(sigs65, dtype=np.uint8)
     r = bytes_be_to_limbs(sigs65[:, :32])
     s = bytes_be_to_limbs(sigs65[:, 32:64])

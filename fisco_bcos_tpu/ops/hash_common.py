@@ -53,19 +53,29 @@ def pad_keccak(
 ) -> tuple[np.ndarray, np.ndarray]:
     """Keccak multi-rate padding (0x01 … 0x80 legacy domain).
 
-    Returns (blocks [B, M, rate//8, 2] uint32 little-endian lo/hi lane halves,
-    nblocks [B] int32).
+    Returns (blocks [B', M, rate//8, 2] uint32 little-endian lo/hi lane
+    halves, nblocks [B'] int32), where B' = _bucket(len(msgs)): BOTH dims
+    are bucketed so one compiled program serves a whole octave of batch
+    sizes — the state-root/tx-hash paths otherwise recompile per distinct
+    dirty-set size (r5 flood profile). Padding rows are empty messages;
+    callers that need exactly len(msgs) digests slice the result (the
+    *_batch_async resolvers do).
     """
-    nblocks = np.array([len(m) // rate + 1 for m in msgs], dtype=np.int32)
-    m_max = _bucket(int(nblocks.max()) if len(msgs) else 1)
+    b_pad = _bucket(max(len(msgs), 1))
+    nblocks = np.array(
+        [len(m) // rate + 1 for m in msgs] + [1] * (b_pad - len(msgs)),
+        dtype=np.int32,
+    )
+    m_max = _bucket(int(nblocks.max()))
     lanes = rate // 8
-    buf = np.zeros((len(msgs), m_max * rate), dtype=np.uint8)
-    for i, m in enumerate(msgs):
+    buf = np.zeros((b_pad, m_max * rate), dtype=np.uint8)
+    for i in range(b_pad):
+        m = msgs[i] if i < len(msgs) else b""
         buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
         end = nblocks[i] * rate
         buf[i, len(m)] ^= 0x01
         buf[i, end - 1] ^= 0x80
-    words = buf.view("<u4").reshape(len(msgs), m_max, lanes, 2)
+    words = buf.view("<u4").reshape(b_pad, m_max, lanes, 2)
     return words.astype(np.uint32), nblocks
 
 
@@ -73,19 +83,25 @@ def pad_md64(
     msgs: Sequence[bytes],
 ) -> tuple[np.ndarray, np.ndarray]:
     """Merkle–Damgård padding with 64-bit big-endian length (SHA-256 and SM3
-    share it): 0x80, zeros, bitlen. Returns (blocks [B, M, 16] uint32
-    big-endian words, nblocks [B] int32)."""
-    nblocks = np.array([(len(m) + 8) // 64 + 1 for m in msgs], dtype=np.int32)
-    m_max = _bucket(int(nblocks.max()) if len(msgs) else 1)
-    buf = np.zeros((len(msgs), m_max * 64), dtype=np.uint8)
-    for i, m in enumerate(msgs):
+    share it): 0x80, zeros, bitlen. Returns (blocks [B', M, 16] uint32
+    big-endian words, nblocks [B'] int32); B' = _bucket(len(msgs)) with
+    empty-message padding rows, exactly like :func:`pad_keccak`."""
+    b_pad = _bucket(max(len(msgs), 1))
+    nblocks = np.array(
+        [(len(m) + 8) // 64 + 1 for m in msgs] + [1] * (b_pad - len(msgs)),
+        dtype=np.int32,
+    )
+    m_max = _bucket(int(nblocks.max()))
+    buf = np.zeros((b_pad, m_max * 64), dtype=np.uint8)
+    for i in range(b_pad):
+        m = msgs[i] if i < len(msgs) else b""
         buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
         buf[i, len(m)] = 0x80
         end = nblocks[i] * 64
         buf[i, end - 8 : end] = np.frombuffer(
             (len(m) * 8).to_bytes(8, "big"), dtype=np.uint8
         )
-    words = buf.view(">u4").reshape(len(msgs), m_max, 16)
+    words = buf.view(">u4").reshape(b_pad, m_max, 16)
     return words.astype(np.uint32), nblocks
 
 

@@ -184,6 +184,7 @@ def keccak256_batch_async(msgs):
     () -> [B, 32] uint8. Lets callers queue several hash programs (tx
     root, receipts root, state root) before paying any device round
     trip."""
-    blocks, nblocks = pad_keccak(msgs)
+    n = len(msgs)
+    blocks, nblocks = pad_keccak(msgs)  # batch dim bucketed; slice below
     words = keccak256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
-    return lambda: digest_words_to_bytes_le(np.asarray(words))
+    return lambda: digest_words_to_bytes_le(np.asarray(words))[:n]

@@ -46,6 +46,11 @@ def _host_hash(hasher: str, data: bytes) -> bytes:
     tunnel round trip."""
     from .. import native_bind
 
+    if hasher not in _HASHERS:
+        # same rejection the device route gets from its dict lookup — an
+        # unknown name must never silently fall through to sha256 (one
+        # node raising while another silently hashes is a divergence)
+        raise KeyError(hasher)
     if hasher == "keccak256":
         from ..crypto.ref.keccak import keccak256 as ref
 
