@@ -25,7 +25,7 @@ import numpy as np
 from ..ops import keccak, secp256k1
 from ..ops.address import sender_address_device
 from ..ops.bigint import bytes_be_to_limbs, digest_words_le_to_limbs
-from ..ops.hash_common import bucket_batch, pad_keccak, pad_rows
+from ..ops.hash_common import pad_keccak, pad_rows
 
 
 def admission_core(blocks, nblocks, r, s, v):
@@ -125,10 +125,9 @@ def admit_batch(
             if out is not None:
                 return out
     # pad_keccak buckets the batch dim itself (empty-message pad rows);
-    # r/s/v pad to the same bucket (bucket_batch IS pad_keccak's schedule)
-    bb = bucket_batch(bsz)
+    # r/s/v follow the blocks tensor's bucket by construction
     blocks, nblocks = pad_keccak(list(payloads))
-    assert blocks.shape[0] == bb, (blocks.shape, bb)
+    bb = blocks.shape[0]
     sigs65 = np.asarray(sigs65, dtype=np.uint8)
     r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
     s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)

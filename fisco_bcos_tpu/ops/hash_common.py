@@ -69,12 +69,14 @@ def pad_keccak(
     m_max = _bucket(int(nblocks.max()))
     lanes = rate // 8
     buf = np.zeros((b_pad, m_max * rate), dtype=np.uint8)
-    for i in range(b_pad):
-        m = msgs[i] if i < len(msgs) else b""
+    for i, m in enumerate(msgs):
         buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
         end = nblocks[i] * rate
         buf[i, len(m)] ^= 0x01
         buf[i, end - 1] ^= 0x80
+    if b_pad > len(msgs):  # all pad rows are the padded empty message
+        buf[len(msgs):, 0] = 0x01
+        buf[len(msgs):, rate - 1] = 0x80
     words = buf.view("<u4").reshape(b_pad, m_max, lanes, 2)
     return words.astype(np.uint32), nblocks
 
@@ -93,14 +95,15 @@ def pad_md64(
     )
     m_max = _bucket(int(nblocks.max()))
     buf = np.zeros((b_pad, m_max * 64), dtype=np.uint8)
-    for i in range(b_pad):
-        m = msgs[i] if i < len(msgs) else b""
+    for i, m in enumerate(msgs):
         buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
         buf[i, len(m)] = 0x80
         end = nblocks[i] * 64
         buf[i, end - 8 : end] = np.frombuffer(
             (len(m) * 8).to_bytes(8, "big"), dtype=np.uint8
         )
+    if b_pad > len(msgs):  # pad rows: empty message = 0x80 + zero bitlen
+        buf[len(msgs):, 0] = 0x80
     words = buf.view(">u4").reshape(b_pad, m_max, 16)
     return words.astype(np.uint32), nblocks
 

@@ -99,20 +99,24 @@ def test_truncated_proof_cannot_certify_internal_node():
 
 
 @pytest.mark.parametrize("n", [256, 271, 400, 1000])
-def test_fused_device_root_matches_host_path(n):
+def test_fused_device_root_matches_host_path(n, monkeypatch):
     """merkle_root's >= 256-leaf fused single-program device path must be
     bit-identical to the generic MerkleTree levels (consensus-critical:
     tx/receipt roots) — including short last groups at every level, and for
-    device-resident (jax.Array) leaf input."""
+    device-resident (jax.Array) leaf input. The device route is FORCED here:
+    on CPU+native hosts merkle_root prefers the host tree (backend-aware
+    routing, r5), which would silently drop this cross-route identity
+    coverage."""
     import jax.numpy as jnp
 
-    from fisco_bcos_tpu.ops.merkle import merkle_root
+    from fisco_bcos_tpu.ops import merkle as M
 
     rng = np.random.default_rng(n)
     leaves = rng.integers(0, 256, (n, 32), dtype=np.uint8)
-    want = MerkleTree(leaves, width=16).root
-    assert merkle_root(leaves) == want
-    assert merkle_root(jnp.asarray(leaves)) == want
+    want = MerkleTree(leaves, width=16).root  # host (native or XLA) route
+    monkeypatch.setattr(M, "_prefer_host_tree", lambda: False)
+    assert M.merkle_root(leaves) == want
+    assert M.merkle_root(jnp.asarray(leaves)) == want
 
 
 def test_fused_device_root_input_validation():
@@ -125,11 +129,14 @@ def test_fused_device_root_input_validation():
         merkle_root(np.zeros((300, 64), dtype=np.uint8))
 
 
-def test_bucket_padding_reuses_device_program():
+def test_bucket_padding_reuses_device_program(monkeypatch):
     """Block sizes within one bucket must hit the SAME compiled tree program
     (the per-leaf-count recompile churn fix), with padding overhead bounded
-    by the 5-bit mantissa (<= 1/16)."""
+    by the 5-bit mantissa (<= 1/16). Device route forced (see above)."""
+    import fisco_bcos_tpu.ops.merkle as M
     from fisco_bcos_tpu.ops.merkle import _device_root_fn, bucket_leaves, merkle_root
+
+    monkeypatch.setattr(M, "_prefer_host_tree", lambda: False)
 
     assert bucket_leaves(10) == 10          # tiny trees stay exact
     assert bucket_leaves(256) == 256
