@@ -360,3 +360,56 @@ class TestBlockPipeline:
         env.scheduler.commit_block(h1)
         env.scheduler.commit_block(h2)
         assert env.ledger.block_number() == 2
+
+
+class TestSelfdestructPipeline:
+    """SELFDESTRUCT's block-end kill (killSuicides at getHash) must be
+    visible to a speculatively pre-executed N+1: the scheduler publishes
+    N's post-state only after getHash, so the pipelined and sequential
+    chains must produce identical roots and receipts when N kills a
+    contract N+1 then calls."""
+
+    def _deploy_tx(self, env, init):
+        env._nonce += 1
+        return env.fac.create_signed(
+            env.kp, chain_id="chain0", group_id="group0", block_limit=500,
+            nonce=f"sd{env._nonce}", to=b"", input=init,
+        )
+
+    _blk = TestBlockPipeline._blk
+
+    def test_pipelined_call_sees_block_end_kill(self):
+        from evm_asm import _deployer, asm
+
+        from fisco_bcos_tpu.protocol.receipt import TransactionStatus
+
+        victim_init = _deployer(asm(("PUSH", 0), "SELFDESTRUCT"))
+
+        def run(pipelined: bool):
+            env = Env()
+            # block 1: deploy the victim; commit so its address is known
+            b1 = self._blk(env, 1, [self._deploy_tx(env, victim_init)])
+            h1 = env.scheduler.execute_block(b1)
+            env.scheduler.commit_block(h1)
+            victim = b1.receipts[0].contract_address
+            assert victim
+            # block 2 selfdestructs it; block 3 calls it
+            b2 = self._blk(env, 2, [env.tx(victim, "any()")])
+            call_tx = env.tx(victim, "any()")
+            h2 = env.scheduler.execute_block(b2)
+            if pipelined:
+                b3 = self._blk(env, 3, [call_tx], parent_hash=h2.hash(SUITE))
+                h3 = env.scheduler.execute_block(b3)  # speculative on b2 state
+                env.scheduler.commit_block(h2)
+                env.scheduler.commit_block(h3)
+            else:
+                env.scheduler.commit_block(h2)
+                b3 = self._blk(env, 3, [call_tx])
+                h3 = env.scheduler.execute_block(b3)
+                env.scheduler.commit_block(h3)
+            assert b2.receipts[0].status == 0
+            # the killed contract is codeless -> unknown callee
+            assert b3.receipts[0].status == int(TransactionStatus.CALL_ADDRESS_ERROR)
+            return h3.state_root, h3.receipts_root
+
+        assert run(True) == run(False)
