@@ -86,13 +86,31 @@ class StateStorage(TraversableStorage):
             return len(self._data)
 
     def merge_into_prev(self) -> None:
-        """Push local writes down one layer (scheduler commit path)."""
-        if self.prev is None:
+        """Push local writes down one layer (scheduler commit path).
+
+        Entries MOVE rather than copy when the parent is a plain
+        StateStorage: this layer is cleared in the same step and the
+        copy-in/copy-out discipline of set_row/get_row means no alias to
+        a stored Entry can exist outside, so ownership transfer is safe —
+        this halves the per-merge Entry traffic on the block hot path
+        (tx overlay -> shadow -> block merges dominated the flood's
+        Python tail). Subclasses that override set_row keep the copying
+        path so their hooks still see every row."""
+        prev = self.prev
+        if prev is None:
             raise ValueError("no previous layer to merge into")
-        for t, k, e in self.traverse():
-            self.prev.set_row(t, k, e)
+        if type(prev) is StateStorage:
+            with self._lock:
+                items = list(self._data.items())
+                self._data.clear()
+            with prev._lock:
+                prev._data.update(items)
+            return
         with self._lock:
+            items = list(self._data.items())
             self._data.clear()
+        for (t, k), e in items:
+            prev.set_row(t, k, e)  # set_row copies; traverse() would too
 
     # -- state root (hot spot #3) -------------------------------------------
 
