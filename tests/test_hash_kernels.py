@@ -45,3 +45,23 @@ def test_large_uniform_batch():
     got = keccak256_batch(msgs)
     for i, m in enumerate(msgs):
         assert bytes(got[i]) == keccak256(m)
+
+
+def test_batch_dim_bucketing_shares_programs():
+    """Distinct batch sizes within one bucket must produce IDENTICAL padded
+    tensor shapes (so the jitted hash program is reused — the state-root /
+    tx-hash paths otherwise recompile per dirty-set size; r5 flood churn),
+    while digests stay exact-count and correct."""
+    from fisco_bcos_tpu.ops.hash_common import bucket_batch, pad_keccak, pad_md64
+
+    msgs_a = [b"x" * 40] * 3
+    msgs_b = [b"y" * 40] * (bucket_batch(3))
+    for pad in (pad_keccak, pad_md64):
+        blocks_a, n_a = pad(msgs_a)
+        blocks_b, n_b = pad(msgs_b)
+        assert blocks_a.shape == blocks_b.shape, pad.__name__
+        assert n_a.shape == n_b.shape
+    # sliced output contract: exactly len(msgs) digests
+    got = keccak256_batch(msgs_a)
+    assert got.shape == (3, 32)
+    assert all(bytes(got[i]) == keccak256(m) for i, m in enumerate(msgs_a))
