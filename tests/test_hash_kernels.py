@@ -54,6 +54,10 @@ def test_batch_dim_bucketing_shares_programs():
     while digests stay exact-count and correct."""
     from fisco_bcos_tpu.ops.hash_common import bucket_batch, pad_keccak, pad_md64
 
+    if bucket_batch(3) <= 3:  # caller-set FISCO_TEST_BUCKET<=3 disables
+        import pytest  # bucketing; the sharing property is then vacuous
+
+        pytest.skip("batch bucketing quantum too small to test sharing")
     msgs_a = [b"x" * 40] * 3
     msgs_b = [b"y" * 40] * (bucket_batch(3))
     for pad in (pad_keccak, pad_md64):
