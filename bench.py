@@ -506,13 +506,24 @@ def _child_budget_s() -> float | None:
 def _probe_backend(timeout_s: int = 240) -> bool:
     """The axon TPU tunnel sometimes goes UNAVAILABLE and hangs even
     `jax.devices()` indefinitely; probe in a killable subprocess so a dead
-    tunnel costs minutes, not the whole bench budget."""
+    tunnel costs minutes, not the whole bench budget.
+
+    The probe must EXECUTE an op, not just enumerate devices: the tunnel
+    has a half-up failure mode (seen r5) where `jax.devices()` returns
+    instantly but the first dispatch hangs forever — a device-list probe
+    would pass and then every bench child would hang through its whole
+    budget slice."""
     import subprocess
     import sys
 
     try:
         res = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp; "
+                "jnp.arange(4).sum().block_until_ready()",
+            ],
             timeout=timeout_s,
             capture_output=True,
         )
@@ -593,6 +604,27 @@ def main() -> None:
             out, err = _text(e.stdout), _text(e.stderr)
             print(f"# bench {name} timed out after {budget_s}s", flush=True)
             failed = True
+            # a TPU child that timed out may mean the tunnel dropped
+            # mid-bench (r5: flaps every few hours). Re-probe cheaply; if
+            # it is gone, flip the REMAINING children to CPU fallback so
+            # they measure something instead of hanging through their
+            # slices too.
+            # generous timeout (startup probe allows 240 s: cold jax import
+            # + remote init + compile can near a minute on a HEALTHY
+            # tunnel); skip entirely when the leftover budget can't afford
+            # it — a spurious flip would mislabel the rest of the artifact
+            avail_s = total_s - (time.monotonic() - t_start) - 30
+            if (
+                not os.environ.get("FISCO_BENCH_CPU_FALLBACK")
+                and avail_s >= 120
+                and not _probe_backend(timeout_s=int(min(240, avail_s)))
+            ):
+                print(
+                    "# tunnel lost mid-bench; remaining metrics fall back "
+                    "to CPU",
+                    flush=True,
+                )
+                os.environ["FISCO_BENCH_CPU_FALLBACK"] = "1"
         except Exception as e:  # exec failure etc. — artifact must survive
             print(f"# bench {name} could not run: {e}", flush=True)
             failed = True
