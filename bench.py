@@ -613,11 +613,15 @@ def main() -> None:
             # + remote init + compile can near a minute on a HEALTHY
             # tunnel); skip entirely when the leftover budget can't afford
             # it — a spurious flip would mislabel the rest of the artifact
+            # the probe itself may HANG for its whole timeout (that is the
+            # failure mode being detected), so it must never consume the
+            # runway the fallback children need: cap it well below what is
+            # left, and skip when too little remains for probe + children
             avail_s = total_s - (time.monotonic() - t_start) - 30
             if (
                 not os.environ.get("FISCO_BENCH_CPU_FALLBACK")
-                and avail_s >= 120
-                and not _probe_backend(timeout_s=int(min(240, avail_s)))
+                and avail_s >= 180
+                and not _probe_backend(timeout_s=int(min(240, avail_s - 120)))
             ):
                 print(
                     "# tunnel lost mid-bench; remaining metrics fall back "

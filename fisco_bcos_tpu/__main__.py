@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 import threading
@@ -53,8 +54,33 @@ def build_node(opts: ChainOptions):
     if opts.enable_ssl:
         from .gateway.tls import make_client_context, make_server_context
 
-        srv_ssl = make_server_context(opts.ca_cert, opts.node_cert, opts.node_key)
-        cli_ssl = make_client_context(opts.ca_cert, opts.node_cert, opts.node_key)
+        if opts.node.sm_crypto:
+            if not os.path.exists(opts.sm_node_cert):
+                # a silent downgrade to standard TLS would leave this node
+                # unable to handshake with its SM peers, with nothing in
+                # the logs naming the cause — fail loudly at boot instead
+                raise FileNotFoundError(
+                    f"sm_crypto chain with enable_ssl requires the SM dual "
+                    f"certs; missing {opts.sm_node_cert!r} (build_chain "
+                    f"--sm --ssl writes them)"
+                )
+            # national-secret transport on the P2P plane: the TLCP-style
+            # dual-cert handshake (gateway/sm_tls — the smCertConfig path,
+            # ContextBuilder.cpp:65-74). SMTLSContext is wrap_socket/
+            # getpeercert duck-compatible, so the gateway code is shared.
+            from .gateway import sm_tls
+
+            srv_ssl = cli_ssl = sm_tls.load_context(
+                opts.sm_ca_cert,
+                opts.sm_node_cert,
+                opts.sm_node_key,
+                opts.sm_ennode_cert,
+                opts.sm_ennode_key,
+            )
+        else:
+            srv_ssl = make_server_context(opts.ca_cert, opts.node_cert, opts.node_key)
+            cli_ssl = make_client_context(opts.ca_cert, opts.node_cert, opts.node_key)
+        # RPC stays standard server-TLS (SDK clients speak stdlib ssl)
         rpc_ssl = make_server_context(
             opts.ca_cert, opts.node_cert, opts.node_key, require_client_cert=False
         )

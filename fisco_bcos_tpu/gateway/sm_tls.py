@@ -28,6 +28,7 @@ model).
 
 from __future__ import annotations
 
+import os
 import secrets
 import struct
 from dataclasses import dataclass, field
@@ -459,6 +460,12 @@ class SMTLSSocket:
     def settimeout(self, t) -> None:
         self._sock.settimeout(t)
 
+    def setsockopt(self, *args) -> None:
+        self._sock.setsockopt(*args)
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
     def close(self) -> None:
         self._sock.close()
 
@@ -475,3 +482,79 @@ class SMTLSSocket:
             "subject": ((("commonName", c.cn),),),
             "subjectAltName": tuple(("URI", u) for u in c.uris),
         }
+
+
+# ---------------------------------------------------------------------------
+# File persistence + deployment wiring (GatewayConfig.cpp:304-345 SMCertConfig:
+# sm_ca.crt + sm_ssl.crt/key sign pair + sm_enssl.crt/key enc pair)
+# ---------------------------------------------------------------------------
+
+
+def save_cert(path: str, cert: SMCert) -> None:
+    with open(path, "wb") as f:
+        f.write(cert.encode())
+
+
+def load_cert(path: str) -> SMCert:
+    with open(path, "rb") as f:
+        return SMCert.decode(f.read())
+
+
+def save_key(path: str, d: int) -> None:
+    with open(path, "wb") as f:
+        f.write(d.to_bytes(32, "big"))
+    os.chmod(path, 0o600)
+
+
+def load_key(path: str) -> int:
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) != 32:
+        # a wrong path (PEM file, truncated copy) must fail HERE with the
+        # file named, not later as an opaque handshake signature failure
+        raise ValueError(f"SM key file {path!r}: expected 32 bytes, got {len(raw)}")
+    d = int.from_bytes(raw, "big")
+    if not 0 < d < _CURVE.n:
+        raise ValueError(f"SM key file {path!r}: scalar out of range")
+    return d
+
+
+def generate_sm_chain_ca(out_dir: str) -> "SMCertAuthority":
+    """Write sm_ca.crt + sm_ca.key under out_dir (build_chain.sh
+    generate_chain_cert analog for the national suite) and return the CA."""
+    os.makedirs(out_dir, exist_ok=True)
+    ca = SMCertAuthority.create()
+    save_cert(os.path.join(out_dir, "sm_ca.crt"), ca.cert)
+    save_key(os.path.join(out_dir, "sm_ca.key"), ca.secret)
+    return ca
+
+
+def issue_sm_node_certs(
+    ca: "SMCertAuthority", conf_dir: str, cn: str, node_id: bytes | None = None
+) -> None:
+    """Write the TLCP dual pair + CA cert into a node's conf dir using the
+    reference's file names (sm_ssl.crt/key, sm_enssl.crt/key, sm_ca.crt)."""
+    sign_cert, ds, enc_cert, de = ca.issue_endpoint(cn, node_id=node_id)
+    save_cert(os.path.join(conf_dir, "sm_ssl.crt"), sign_cert)
+    save_key(os.path.join(conf_dir, "sm_ssl.key"), ds)
+    save_cert(os.path.join(conf_dir, "sm_enssl.crt"), enc_cert)
+    save_key(os.path.join(conf_dir, "sm_enssl.key"), de)
+    save_cert(os.path.join(conf_dir, "sm_ca.crt"), ca.cert)
+
+
+def load_context(
+    sm_ca_cert: str,
+    sm_node_cert: str,
+    sm_node_key: str,
+    sm_ennode_cert: str,
+    sm_ennode_key: str,
+) -> SMTLSContext:
+    """Build the dual-cert context from config.ini [cert] sm_* paths —
+    the ContextBuilder::buildSslContext(sm=true) entry point."""
+    return SMTLSContext(
+        load_cert(sm_ca_cert),
+        load_cert(sm_node_cert),
+        load_key(sm_node_key),
+        load_cert(sm_ennode_cert),
+        load_key(sm_ennode_key),
+    )

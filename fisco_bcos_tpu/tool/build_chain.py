@@ -73,6 +73,11 @@ def _config_text(
     ca_cert=conf/ca.crt
     node_cert=conf/ssl.crt
     node_key=conf/ssl.key
+    sm_ca_cert=conf/sm_ca.crt
+    sm_node_cert=conf/sm_ssl.crt
+    sm_node_key=conf/sm_ssl.key
+    sm_ennode_cert=conf/sm_enssl.crt
+    sm_ennode_key=conf/sm_enssl.key
 
 [rpc]
     listen_ip={host}
@@ -153,11 +158,18 @@ def build_chain(
     genesis = _genesis_text(nodeids, chain_id, group_id)
     peers = [(host, p[0]) for p in ports]
 
-    ca_crt = ca_key = None
+    ca_crt = ca_key = sm_ca = None
     if ssl:
         from ..gateway.tls import generate_chain_ca
 
         ca_crt, ca_key = generate_chain_ca(os.path.join(out_dir, "ca"))
+        if sm:
+            # national-secret transport: a second, SM2 chain CA issuing the
+            # TLCP dual pairs (reference build_chain.sh generates the sm_*
+            # cert tree alongside the RSA/EC one when -s is set)
+            from ..gateway.sm_tls import generate_sm_chain_ca
+
+            sm_ca = generate_sm_chain_ca(os.path.join(out_dir, "ca"))
 
     node_dirs = []
     for i in range(count):
@@ -178,6 +190,12 @@ def build_chain(
                 node_id=keypairs[i].pub,
             )
             shutil.copy(ca_crt, os.path.join(conf, "ca.crt"))
+            if sm_ca is not None:
+                from ..gateway.sm_tls import issue_sm_node_certs
+
+                issue_sm_node_certs(
+                    sm_ca, conf, f"node{i}", node_id=keypairs[i].pub
+                )
         _write_exec(
             os.path.join(ndir, "start.sh"), _START_SH.format(python=sys.executable)
         )

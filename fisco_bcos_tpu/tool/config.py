@@ -54,6 +54,13 @@ class ChainOptions:
     ca_cert: str = "conf/ca.crt"
     node_cert: str = "conf/ssl.crt"
     node_key: str = "conf/ssl.key"
+    # [cert] sm_* — national-secret transport dual pair
+    # (GatewayConfig.cpp:304-345 SMCertConfig; used when sm_crypto=true)
+    sm_ca_cert: str = "conf/sm_ca.crt"
+    sm_node_cert: str = "conf/sm_ssl.crt"
+    sm_node_key: str = "conf/sm_ssl.key"
+    sm_ennode_cert: str = "conf/sm_enssl.crt"
+    sm_ennode_key: str = "conf/sm_enssl.key"
     # [consensus] runtime knobs (engine limits come from genesis)
     consensus_timeout: float = 3.0
     sealer_interval: float = 0.05
@@ -168,6 +175,14 @@ def load_chain_options(config_path: str, genesis_path: str) -> ChainOptions:
         opts.ca_cert = respath(cp.get("cert", "ca_cert", fallback=opts.ca_cert))
         opts.node_cert = respath(cp.get("cert", "node_cert", fallback=opts.node_cert))
         opts.node_key = respath(cp.get("cert", "node_key", fallback=opts.node_key))
+        for f in (
+            "sm_ca_cert",
+            "sm_node_cert",
+            "sm_node_key",
+            "sm_ennode_cert",
+            "sm_ennode_key",
+        ):
+            setattr(opts, f, respath(cp.get("cert", f, fallback=getattr(opts, f))))
     if cp.has_section("consensus"):
         opts.consensus_timeout = cp.getfloat(
             "consensus", "consensus_timeout", fallback=opts.consensus_timeout
