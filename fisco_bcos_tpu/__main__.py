@@ -55,14 +55,25 @@ def build_node(opts: ChainOptions):
         from .gateway.tls import make_client_context, make_server_context
 
         if opts.node.sm_crypto:
-            if not os.path.exists(opts.sm_node_cert):
+            missing = [
+                p
+                for p in (
+                    opts.sm_ca_cert,
+                    opts.sm_node_cert,
+                    opts.sm_node_key,
+                    opts.sm_ennode_cert,
+                    opts.sm_ennode_key,
+                )
+                if not os.path.exists(p)
+            ]
+            if missing:
                 # a silent downgrade to standard TLS would leave this node
                 # unable to handshake with its SM peers, with nothing in
                 # the logs naming the cause — fail loudly at boot instead
                 raise FileNotFoundError(
                     f"sm_crypto chain with enable_ssl requires the SM dual "
-                    f"certs; missing {opts.sm_node_cert!r} (build_chain "
-                    f"--sm --ssl writes them)"
+                    f"certs; missing {missing} (build_chain --sm --ssl "
+                    f"writes them)"
                 )
             # national-secret transport on the P2P plane: the TLCP-style
             # dual-cert handshake (gateway/sm_tls — the smCertConfig path,

@@ -90,7 +90,10 @@ class RpcHttpServer:
         _log.info("json-rpc listening on %d", self.port)
 
     def stop(self) -> None:
-        self._server.shutdown()
+        if self._thread is not None:
+            # shutdown() blocks until serve_forever acknowledges — calling
+            # it on a never-started server waits forever
+            self._server.shutdown()
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=5)

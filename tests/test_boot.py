@@ -311,3 +311,30 @@ def test_four_process_chain(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_build_node_selects_sm_transport(tmp_path):
+    """An sm_crypto + enable_ssl chain must boot its gateway on the
+    SMTLSContext (never the stdlib ssl context), and a missing SM cert is
+    a hard boot error, not a silent downgrade to standard TLS."""
+    from fisco_bcos_tpu.__main__ import build_node
+    from fisco_bcos_tpu.gateway.sm_tls import SMTLSContext
+
+    dirs = build_chain(out_dir=str(tmp_path), count=1, sm=True, ssl=True,
+                       ports=[(0, 0, 0)])
+    opts = load_chain_options(
+        os.path.join(dirs[0], "config.ini"), os.path.join(dirs[0], "config.genesis")
+    )
+    opts.rpc_listen_port = 0
+    node, gw, server, ws, runtime, stop = build_node(opts)
+    try:
+        assert isinstance(gw._ssl, SMTLSContext)
+        assert gw._cli_ssl is gw._ssl
+    finally:
+        gw.stop()
+        server.stop()
+
+    # hard-fail leg: delete the sign cert and boot again
+    os.remove(opts.sm_node_cert)
+    with pytest.raises(FileNotFoundError, match="SM dual"):
+        build_node(opts)
