@@ -491,6 +491,26 @@ def bench_flood() -> None:
     _emit(M_FLOOD[0], tps, M_FLOOD[1], tps / 10_000.0, error=err)  # vs README.md:10
 
 
+def _dump_telemetry(tag: str) -> None:
+    """--telemetry mode: write the metrics snapshot + trace next to the
+    bench JSON lines (per-child files — each --only child is its own
+    process), so every perf claim ships an inspectable artifact (load the
+    trace in ui.perfetto.dev)."""
+    if not os.environ.get("FISCO_BENCH_TELEMETRY"):
+        return
+    from fisco_bcos_tpu.observability import TRACER
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    base = os.path.dirname(os.path.abspath(__file__))
+    mpath = os.path.join(base, f"bench_telemetry.{tag}.metrics.txt")
+    tpath = os.path.join(base, f"bench_telemetry.{tag}.trace.json")
+    with open(mpath, "w") as f:
+        f.write(REGISTRY.render())
+    with open(tpath, "w") as f:
+        f.write(TRACER.export_json())
+    print(f"# telemetry metrics={mpath} trace={tpath}", flush=True)
+
+
 def _child_budget_s() -> float | None:
     """Wall-clock budget handed to this --only child by the parent's
     deadline scheduler (None when run standalone)."""
@@ -663,6 +683,7 @@ def _main_only(name: str) -> None:
     _init_jax()
     try:
         fns[name]()
+        _dump_telemetry(name)
     except Exception as e:
         print(f"# bench bench_{name} failed: {e}", flush=True)
         raise SystemExit(1)
@@ -671,9 +692,17 @@ def _main_only(name: str) -> None:
 if __name__ == "__main__":
     import sys as _sys
 
+    if "--telemetry" in _sys.argv:
+        # dump the metrics snapshot + per-block trace alongside the JSON
+        # lines (propagates to --only children through the environment)
+        _sys.argv.remove("--telemetry")
+        os.environ["FISCO_BENCH_TELEMETRY"] = "1"
     if len(_sys.argv) >= 2 and _sys.argv[1] == "--only":
         if len(_sys.argv) < 3:
-            print("usage: bench.py [--only admission|sm2|merkle|flood]")
+            print(
+                "usage: bench.py [--telemetry] "
+                "[--only admission|sm2|merkle|flood]"
+            )
             raise SystemExit(2)
         _main_only(_sys.argv[2])
     else:

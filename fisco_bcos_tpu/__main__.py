@@ -103,6 +103,7 @@ def build_node(opts: ChainOptions):
         client_ssl_context=cli_ssl,
     )
     gw.connect(node.front)
+    from .observability import TRACER
     from .rpc.group_manager import GroupManager, MultiGroupRpc
     from .utils.metrics import bind_node_metrics
 
@@ -116,6 +117,7 @@ def build_node(opts: ChainOptions):
         port=opts.rpc_listen_port,
         ssl_context=rpc_ssl,
         metrics=bind_node_metrics(node),
+        tracer=TRACER,
     )
     ws = None
     if opts.ws_listen_port:

@@ -27,10 +27,13 @@ Differences kept deliberate and documented:
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 from ..front.front import FrontService, ModuleID
 from ..ledger import Ledger
+from ..observability import TRACER
+from ..utils.metrics import REGISTRY
 from ..protocol.block import Block
 from ..protocol.block_header import SignatureTuple
 from ..scheduler.scheduler import Scheduler, SchedulerError
@@ -67,6 +70,11 @@ class ProposalCache:
     prepared: bool = False  # prepare quorum reached
     committed: bool = False  # commit quorum reached (executed)
     stable: bool = False  # checkpoint quorum reached (ledger-committed)
+    # phase timestamps (perf_counter) feeding the per-phase latency
+    # histograms and the retroactive pbft.* trace spans
+    t_accept: float = 0.0
+    t_prepared: float = 0.0
+    t_committed: float = 0.0
 
 
 class PBFTEngine:
@@ -212,6 +220,12 @@ class PBFTEngine:
                 for votes in (cache.prepares, cache.commits, cache.checkpoints):
                     if my in votes:
                         msgs.append(votes[my])
+        if msgs:
+            REGISTRY.counter_add(
+                "fisco_pbft_rebroadcast_total",
+                float(len(msgs)),
+                help="in-flight proposal/vote re-broadcasts (liveness resend)",
+            )
         for m in msgs:
             self._broadcast(m)
 
@@ -285,6 +299,7 @@ class PBFTEngine:
         return True
 
     def _handle_pre_prepare(self, msg: PBFTMessage, from_self: bool = False) -> None:
+        t_gate0 = time.perf_counter()
         with self._lock:
             if not self._pre_prepare_gate(msg):
                 return
@@ -330,6 +345,21 @@ class PBFTEngine:
             cache.pre_prepare = msg
             cache.block = block
             cache.block_data = block.encode()  # accept-time snapshot
+            cache.t_accept = time.perf_counter()
+            # pre-prepare gate latency: message arrival -> accepted (covers
+            # decode, proposal verify, tx fill/straggler fetch)
+            REGISTRY.observe(
+                "fisco_pbft_preprepare_gate_latency_ms",
+                (cache.t_accept - t_gate0) * 1e3,
+                help="pre-prepare arrival to acceptance (decode+verify+fill)",
+            )
+            TRACER.record(
+                "pbft.pre_prepare",
+                t_gate0,
+                cache.t_accept - t_gate0,
+                block=msg.number,
+                view=msg.view,
+            )
             prepare = PBFTMessage(
                 packet_type=PacketType.PREPARE,
                 view=self.view,
@@ -427,6 +457,19 @@ class PBFTEngine:
         if self._weight(agreeing) < self.config.quorum:
             return
         cache.prepared = True
+        cache.t_prepared = time.perf_counter()
+        if cache.t_accept:
+            REGISTRY.observe(
+                "fisco_pbft_prepare_latency_ms",
+                (cache.t_prepared - cache.t_accept) * 1e3,
+                help="pre-prepare accept to prepare quorum",
+            )
+            TRACER.record(
+                "pbft.prepare",
+                cache.t_accept,
+                cache.t_prepared - cache.t_accept,
+                block=number,
+            )
         if self.cstore is not None and cache.block_data:
             # write-ahead of the COMMIT broadcast: after a crash this node
             # can still prove (and re-offer) the prepared proposal — from
@@ -455,6 +498,19 @@ class PBFTEngine:
         if self._weight(agreeing) < self.config.quorum:
             return
         cache.committed = True
+        cache.t_committed = time.perf_counter()
+        if cache.t_prepared:
+            REGISTRY.observe(
+                "fisco_pbft_commit_latency_ms",
+                (cache.t_committed - cache.t_prepared) * 1e3,
+                help="prepare quorum to commit quorum",
+            )
+            TRACER.record(
+                "pbft.commit",
+                cache.t_prepared,
+                cache.t_committed - cache.t_prepared,
+                block=number,
+            )
         self._execute_and_checkpoint(number, cache)
 
     def _execute_and_checkpoint(self, number: int, cache: ProposalCache) -> None:
@@ -462,10 +518,19 @@ class PBFTEngine:
         asyncApply) and distribute a checkpoint over the *executed* header."""
         assert cache.block is not None
         try:
-            header = self.scheduler.execute_block(cache.block)
+            with TRACER.span(
+                "pbft.execute_and_checkpoint", block=number
+            ):  # nests scheduler.execute_block
+                header = self.scheduler.execute_block(cache.block)
         except SchedulerError as e:
             _log.error("execute block %d failed: %s", number, e)
             return
+        if cache.t_committed:
+            REGISTRY.observe(
+                "fisco_pbft_execute_latency_ms",
+                (time.perf_counter() - cache.t_committed) * 1e3,
+                help="commit quorum to executed header (incl. preexec cache hits)",
+            )
         cache.executed_header = header
         header_hash = header.hash(self.suite)
         ckpt = PBFTMessage(
@@ -513,11 +578,27 @@ class PBFTEngine:
             ]
             header.clear_hash_cache()
             try:
-                self.scheduler.commit_block(header)
+                with TRACER.span(
+                    "pbft.checkpoint_commit", block=msg.number
+                ):  # nests scheduler.commit_block
+                    self.scheduler.commit_block(header)
             except SchedulerError as e:
                 _log.error("commit block %d failed: %s", msg.number, e)
                 cache.stable = False
                 return
+            now = time.perf_counter()
+            if cache.t_committed:
+                REGISTRY.observe(
+                    "fisco_pbft_checkpoint_latency_ms",
+                    (now - cache.t_committed) * 1e3,
+                    help="executed to checkpoint quorum + ledger commit",
+                )
+                TRACER.record(
+                    "pbft.checkpoint",
+                    cache.t_committed,
+                    now - cache.t_committed,
+                    block=msg.number,
+                )
             self.committed_number = msg.number
             self.timeout_state = False
             stale = [n for n in self._caches if n <= msg.number]
@@ -549,6 +630,10 @@ class PBFTEngine:
         with self._lock:
             self.timeout_state = True
             self.to_view = max(self.to_view, self.view) + 1
+            REGISTRY.counter_add(
+                "fisco_pbft_view_change_total",
+                help="view changes initiated (consensus timeouts + catch-ups)",
+            )
             self._send_view_change()
 
     def _send_view_change(self) -> None:

@@ -13,10 +13,12 @@ from __future__ import annotations
 import time
 
 from ..ledger import Ledger
+from ..observability import TRACER
 from ..protocol.block import Block
 from ..protocol.block_header import BlockHeader, ParentInfo
 from ..txpool import TxPool
 from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
 from .config import PBFTConfig
 from .engine import PBFTEngine
 
@@ -48,6 +50,7 @@ class Sealer:
             # device merkle) every tick just to be rejected by the engine's
             # self-equivocation guard is pure waste
             return None
+        t0 = time.perf_counter()
         txs = self.txpool.seal_txs(cfg.tx_count_limit)
         if len(txs) < self.min_seal_txs:
             return None
@@ -66,6 +69,16 @@ class Sealer:
         block = Block(header=header, tx_metadata=hashes)
         header.txs_root = block.calculate_txs_root(suite)
         header.clear_hash_cache()
+        dur = time.perf_counter() - t0
+        REGISTRY.observe(
+            "fisco_sealer_seal_latency_ms",
+            dur * 1e3,
+            help="proposal generation wall latency (fetch + tx-root merkle)",
+        )
+        REGISTRY.counter_add(
+            "fisco_sealer_proposals_total", help="block proposals generated"
+        )
+        TRACER.record("seal", t0, dur, block=number, txs=len(txs))
         return block
 
     def seal_and_submit(self) -> bool:

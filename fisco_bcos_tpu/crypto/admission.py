@@ -116,26 +116,34 @@ def admit_batch(
     crypto.suite.use_native_batch holds the policy).
     FISCO_FORCE_DEVICE_ADMISSION=1 pins the device program (tests use it to
     cover the device path on CPU hosts)."""
+    from ..observability.device import device_span
+
     bsz = len(payloads)
     if not os.environ.get("FISCO_FORCE_DEVICE_ADMISSION"):
         from .suite import use_native_batch
 
         if use_native_batch(bsz):
-            out = _admit_batch_native(payloads, np.asarray(sigs65, dtype=np.uint8))
+            # native host loop — shape_key pinned so it never reads as
+            # a compile; the op label keeps the dispatch split visible
+            with device_span("admission_native", bsz, shape_key="native"):
+                out = _admit_batch_native(
+                    payloads, np.asarray(sigs65, dtype=np.uint8)
+                )
             if out is not None:
                 return out
     # pad_keccak buckets the batch dim itself (empty-message pad rows);
     # r/s/v follow the blocks tensor's bucket by construction
     blocks, nblocks = pad_keccak(list(payloads))
     bb = blocks.shape[0]
-    sigs65 = np.asarray(sigs65, dtype=np.uint8)
-    r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
-    s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
-    v = pad_rows(sigs65[:, 64].astype(np.int32), bb)
-    packed = np.asarray(admission_step_packed(blocks, nblocks, r, s, v))[:bsz]
-    return (
-        packed[:, :20],
-        packed[:, 20] != 0,
-        packed[:, 21:85],
-        packed[:, 85:117],
-    )
+    with device_span("admission", bsz, shape_key=(bb, blocks.shape[1])):
+        sigs65 = np.asarray(sigs65, dtype=np.uint8)
+        r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
+        s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
+        v = pad_rows(sigs65[:, 64].astype(np.int32), bb)
+        packed = np.asarray(admission_step_packed(blocks, nblocks, r, s, v))[:bsz]
+        return (
+            packed[:, :20],
+            packed[:, 20] != 0,
+            packed[:, 21:85],
+            packed[:, 85:117],
+        )

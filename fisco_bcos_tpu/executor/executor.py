@@ -29,16 +29,19 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import time
 from dataclasses import dataclass, field
 
 from ..codec.abi import ABICodec
 from ..crypto.suite import CryptoSuite
+from ..observability import BATCH_BUCKETS, TRACER
 from ..protocol.block_header import BlockHeader
 from ..protocol.receipt import TransactionReceipt, TransactionStatus
 from ..protocol.transaction import Transaction
 from ..storage.interfaces import StorageInterface, TransactionalStorage, TwoPCParams
 from ..storage.state_storage import StateStorage
 from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
 from ..utils.ripemd160 import ripemd160
 from .evm import (
     F_CODE,
@@ -421,10 +424,29 @@ class TransactionExecutor:
         if self._block is None:
             raise RuntimeError("call next_block_header first")
         base = self.reserve_contexts(len(txs))
-        return [
-            self._execute_one(tx, self._block, context_id=base + i)
-            for i, tx in enumerate(txs)
-        ]
+        with TRACER.span("executor.execute", mode="serial", txs=len(txs)):
+            t0 = time.perf_counter()
+            out = [
+                self._execute_one(tx, self._block, context_id=base + i)
+                for i, tx in enumerate(txs)
+            ]
+        self._record_batch("serial", len(txs), time.perf_counter() - t0)
+        return out
+
+    def _record_batch(self, mode: str, n: int, dur: float) -> None:
+        REGISTRY.observe(
+            "fisco_executor_batch_latency_ms",
+            dur * 1e3,
+            help="per-block tx-batch execution wall latency by mode",
+            mode=mode,
+        )
+        REGISTRY.observe(
+            "fisco_executor_batch_txs",
+            n,
+            buckets=BATCH_BUCKETS,
+            help="txs per execution batch by mode",
+            mode=mode,
+        )
 
     # -- DAG parallel (dagExecuteTransactions:1063) -------------------------
 
@@ -521,6 +543,7 @@ class TransactionExecutor:
         overlay so the discard is clean. FISCO_DAG_SERIAL=1 pins serial."""
         if self._block is None:
             raise RuntimeError("call next_block_header first")
+        t_dag0 = time.perf_counter()
         base = self.reserve_contexts(len(txs))
         import os as _os
 
@@ -610,6 +633,17 @@ class TransactionExecutor:
             receipts = run_serial(shadow)
         shadow.storage.merge_into_prev()
         self._block.suicides |= shadow.suicides
+        dur = time.perf_counter() - t_dag0
+        self._record_batch("dag", len(txs), dur)
+        if conflict:
+            REGISTRY.counter_add(
+                "fisco_executor_dag_conflict_reruns_total",
+                help="DAG levels whose conflict declarations lied "
+                "(block re-executed serially)",
+            )
+        TRACER.record(
+            "executor.execute", t_dag0, dur, mode="dag", txs=len(txs)
+        )
         return receipts  # type: ignore[return-value]
 
     # -- read-only call (call:672) ------------------------------------------
@@ -631,10 +665,22 @@ class TransactionExecutor:
         if extra_writes is not None:
             for t, k, e in extra_writes.traverse():
                 writes.set_row(t, k, e)
+        t0 = time.perf_counter()
         self.backend.prepare(params, writes)
+        REGISTRY.observe(
+            "fisco_storage_prepare_latency_ms",
+            (time.perf_counter() - t0) * 1e3,
+            help="2PC prepare (durable staging) wall latency",
+        )
 
     def commit(self, params: TwoPCParams) -> None:
+        t0 = time.perf_counter()
         self.backend.commit(params)
+        REGISTRY.observe(
+            "fisco_storage_commit_latency_ms",
+            (time.perf_counter() - t0) * 1e3,
+            help="2PC commit (backend apply) wall latency",
+        )
         # the committed overlay may still serve as the parent of block N+1's
         # speculative chain — popping the dict only drops OUR handle
         ctx = self._blocks.pop(params.number, None)

@@ -13,6 +13,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ..utils import metrics as _metrics
+
 
 class TokenBucketRateLimiter:
     """Classic token bucket: `rate` tokens/sec, burst up to `burst` tokens.
@@ -56,6 +58,7 @@ class RateLimiterManager:
         self,
         total_rate_bytes: float | None = None,
         module_rates: dict[int, float] | None = None,
+        registry=None,
     ):
         self.total = (
             TokenBucketRateLimiter(total_rate_bytes) if total_rate_bytes else None
@@ -64,20 +67,35 @@ class RateLimiterManager:
             m: TokenBucketRateLimiter(r) for m, r in (module_rates or {}).items()
         }
         self.dropped = 0
+        # None -> the process default registry, resolved at drop time (so a
+        # manager built before the registry is enabled still exports)
+        self._registry = registry
         self._lock = threading.Lock()
+
+    def _count_drop(self, scope: str, nbytes: int) -> None:
+        with self._lock:
+            self.dropped += 1
+        reg = self._registry if self._registry is not None else _metrics.REGISTRY
+        reg.counter_add(
+            f'fisco_gateway_ratelimit_dropped_total{{scope="{scope}"}}',
+            help="frames dropped by outbound bandwidth policing",
+        )
+        reg.counter_add(
+            f'fisco_gateway_ratelimit_dropped_bytes_total{{scope="{scope}"}}',
+            float(nbytes),
+            help="payload bytes dropped by outbound bandwidth policing",
+        )
 
     def check(self, module_id: int, nbytes: int) -> bool:
         # charge the TOTAL budget first: if it rejects, the module budget is
         # untouched (charging module-then-total double-charged dropped frames
         # against the module, throttling it below its configured rate)
         if self.total is not None and not self.total.try_acquire(nbytes):
-            with self._lock:
-                self.dropped += 1
+            self._count_drop("total", nbytes)
             return False
         lim = self.by_module.get(int(module_id))
         if lim is not None and not lim.try_acquire(nbytes):
-            with self._lock:
-                self.dropped += 1
+            self._count_drop("module", nbytes)
             return False
         return True
 
@@ -222,6 +240,10 @@ class DistributedRateLimiter:
             granted = self._remote_acquire(want)
         except Exception:
             self.coordinator_failures += 1
+            _metrics.REGISTRY.counter_add(
+                "fisco_gateway_ratelimit_coordinator_failures_total",
+                help="quota-coordinator RPC failures (degraded to local bucket)",
+            )
             # coordinator down: degrade to the local bucket for THIS
             # request only; the next call retries the coordinator
             return self._fallback.try_acquire(tokens)

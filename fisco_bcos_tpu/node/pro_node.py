@@ -111,7 +111,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.warmup:
         node.warmup(batch_sizes=(args.warmup,))
 
-    facade = RpcFacade(JsonRpcImpl(node), port=args.facade_port)
+    # split-mode telemetry: the node core binds its metrics + tracer into
+    # the facade; the RPC process serves them at GET /metrics and /trace
+    from ..observability import TRACER
+    from ..utils.metrics import bind_node_metrics
+
+    facade = RpcFacade(
+        JsonRpcImpl(node),
+        port=args.facade_port,
+        metrics=bind_node_metrics(node),
+        tracer=TRACER,
+    )
     facade.start()
 
     runtime = NodeRuntime(node, sealer_interval=args.sealer_interval)

@@ -1,0 +1,44 @@
+"""Observability subsystem: labeled histograms, span tracing, device-op
+instrumentation.
+
+Three pieces (ISSUE 1 tentpole):
+
+- :mod:`.histogram` — the Prometheus histogram model (``_bucket``/``_sum``/
+  ``_count`` exposition) with the reference's 0/50/100/150 ms mtail latency
+  buckets and power-of-two batch buckets. ``utils.metrics.MetricsRegistry``
+  composes it; modules observe through the process ``REGISTRY``.
+- :mod:`.tracer` — thread-safe span tracing (``TRACER.span(...)`` context
+  managers, nesting, bounded ring) exported as Chrome trace-event JSON at
+  ``GET /trace``.
+- :mod:`.device` — the per-op device-crypto signal bundle (batch sizes,
+  latency, items/sec, compile-vs-cached counters). Imported directly as
+  ``from ..observability.device import device_span`` by the ops wrappers
+  (kept out of this namespace so importing the package never drags in the
+  metrics registry mid-import).
+
+``set_enabled(False)`` (or env ``FISCO_TELEMETRY=0`` before import) turns
+the whole layer into no-ops — the switch the bench overhead A/B uses.
+"""
+
+from __future__ import annotations
+
+from .histogram import (  # noqa: F401
+    BATCH_BUCKETS,
+    LATENCY_BUCKETS_MS,
+    Histogram,
+)
+from .tracer import TRACER, SpanRecord, Tracer  # noqa: F401
+
+
+def set_enabled(flag: bool) -> None:
+    """Enable/disable the whole telemetry layer (registry + tracer)."""
+    from ..utils.metrics import REGISTRY
+
+    REGISTRY.enabled = bool(flag)
+    TRACER.enabled = bool(flag)
+
+
+def telemetry_enabled() -> bool:
+    from ..utils.metrics import REGISTRY
+
+    return REGISTRY.enabled or TRACER.enabled

@@ -1,0 +1,104 @@
+"""Device-crypto instrumentation: batch sizes, latency, compile-vs-cached.
+
+Reference: bcos-crypto/demo/perf_demo.cpp prints per-algorithm signs/verifies
+per second; here the equivalent signals are first-class metrics emitted by
+the ops host wrappers (ops/secp256k1, ops/sm2, ops/keccak, ops/merkle,
+crypto/admission):
+
+- ``fisco_device_batch_size{op=...}``      power-of-two batch histogram
+- ``fisco_device_op_latency_ms{op=...}``   wall latency per host call
+- ``fisco_device_items_total{op=...}``     items processed (rate = items/sec)
+- ``fisco_device_op_seconds_total{op=...}`` wall seconds (rate vs items =
+  effective verifies/sec without histogram math)
+- ``fisco_device_compile_total{op=...}`` / ``fisco_device_cached_call_total``
+  first-call-per-bucketed-shape vs repeat-shape calls. Batch shapes are
+  bucketed before compilation (ops/hash_common._bucket), so "first time this
+  op saw this bucket" is exactly "XLA compiled (or loaded from the persistent
+  cache) a new program" — a recompile regression (shape churn) shows up as a
+  climbing compile counter instead of a silent latency cliff.
+
+The :class:`device_span` context manager bundles all of it plus a
+``device.<op>`` trace span, so each ops wrapper adds one ``with`` line.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import metrics as _metrics
+from .histogram import BATCH_BUCKETS, LATENCY_BUCKETS_MS
+from .tracer import TRACER
+
+_seen_lock = threading.Lock()
+_seen_shapes: dict[str, set] = {}
+
+
+def _count_shape(op: str, key) -> None:
+    with _seen_lock:
+        shapes = _seen_shapes.setdefault(op, set())
+        fresh = key not in shapes
+        if fresh:
+            shapes.add(key)
+    name = "fisco_device_compile_total" if fresh else "fisco_device_cached_call_total"
+    _metrics.REGISTRY.counter_add(
+        f'{name}{{op="{op}"}}',
+        1.0,
+        help="device program calls split by first-shape (compile) vs repeat",
+    )
+
+
+class device_span:
+    """Time one host-level device-batch call and emit the full signal set.
+
+    ``shape_key`` should be the bucketed shape the op compiles for (the
+    batch bucket, plus any other shape-determining dims); it defaults to the
+    raw batch size, which over-counts compiles when callers skip bucketing.
+    """
+
+    __slots__ = ("op", "batch", "key", "_t0", "_span")
+
+    def __init__(self, op: str, batch: int, shape_key=None):
+        self.op = op
+        self.batch = int(batch)
+        self.key = shape_key if shape_key is not None else int(batch)
+
+    def __enter__(self):
+        reg = _metrics.REGISTRY
+        if reg.enabled:
+            reg.observe(
+                "fisco_device_batch_size",
+                self.batch,
+                buckets=BATCH_BUCKETS,
+                help="device-crypto batch sizes per op (power-of-two buckets)",
+                op=self.op,
+            )
+            _count_shape(self.op, self.key)
+        self._span = TRACER.span(f"device.{self.op}", batch=self.batch)
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        self._span.__exit__(exc_type, exc, tb)
+        reg = _metrics.REGISTRY
+        if reg.enabled and exc_type is None:
+            reg.observe(
+                "fisco_device_op_latency_ms",
+                dt * 1e3,
+                buckets=LATENCY_BUCKETS_MS,
+                help="device-crypto host-call wall latency per op",
+                op=self.op,
+            )
+            reg.counter_add(
+                f'fisco_device_items_total{{op="{self.op}"}}',
+                float(self.batch),
+                help="items processed by device-crypto ops",
+            )
+            reg.counter_add(
+                f'fisco_device_op_seconds_total{{op="{self.op}"}}',
+                dt,
+                help="wall seconds spent in device-crypto host calls",
+            )
+        return False

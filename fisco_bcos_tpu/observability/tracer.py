@@ -1,0 +1,175 @@
+"""Span tracing over ``time.perf_counter`` with Chrome trace-event export.
+
+Reference: the reference's per-stage BlockTrace logs (DMCExecute.0..6 in
+bcos-scheduler BlockExecutive.cpp:849-1010) answer "where did this block's
+wall time go?" by grepping; here the same stages are first-class spans in a
+bounded in-memory ring, exported as Chrome trace-event JSON (the format
+Perfetto / chrome://tracing load directly) from ``GET /trace`` next to
+``/metrics``.
+
+Threading model: each thread keeps its own span stack (thread-local), so
+``span()`` context managers nest naturally and record parent/depth without
+cross-thread locking; only the ring append takes the shared lock. Completed
+spans from other timelines (e.g. PBFT phase gaps measured between message
+arrivals) are added retroactively via :meth:`Tracer.record`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SpanRecord:
+    name: str
+    ts: float  # perf_counter at span start (seconds)
+    dur: float  # seconds
+    tid: int
+    depth: int = 0
+    parent: str | None = None
+    attrs: dict = field(default_factory=dict)
+
+
+class _NoopSpan:
+    """Shared do-nothing span for a disabled tracer. `attrs` hands out a
+    fresh throwaway dict per access so caller writes (``sp.attrs[k] = v``)
+    are discarded instead of accumulating on the shared singleton."""
+
+    __slots__ = ()
+
+    @property
+    def attrs(self) -> dict:
+        return {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "depth", "parent")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        self.parent = stack[-1].name if stack else None
+        self.depth = len(stack)
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        self._tracer.record(
+            self.name,
+            t0=self._t0,
+            dur=dur,
+            depth=self.depth,
+            parent=self.parent,
+            **self.attrs,
+        )
+        return False
+
+
+class Tracer:
+    """Bounded ring of completed spans; thread-safe, cheap when disabled."""
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True):
+        self.capacity = int(capacity)
+        self.enabled = enabled
+        self._buf: deque[SpanRecord] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def span(self, name: str, **attrs):
+        """Context manager timing a region; yields the span so callers can
+        add attrs (``sp.attrs["txs"] = n``) before it closes."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name, attrs)
+
+    def record(
+        self,
+        name: str,
+        t0: float,
+        dur: float,
+        depth: int = 0,
+        parent: str | None = None,
+        **attrs,
+    ) -> None:
+        """Append a COMPLETED span with explicit timing — the retroactive
+        path for phase gaps measured between events (PBFT quorum waits)."""
+        if not self.enabled:
+            return
+        rec = SpanRecord(
+            name, t0, max(dur, 0.0), threading.get_ident(), depth, parent, attrs
+        )
+        with self._lock:
+            self._buf.append(rec)
+
+    def spans(self) -> list[SpanRecord]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    # -- export ---------------------------------------------------------------
+
+    def export_chrome(self) -> dict:
+        """Chrome trace-event JSON object (Perfetto/chrome://tracing load it
+        directly): complete ("X") events, timestamps in microseconds."""
+        pid = os.getpid()
+        events = []
+        for rec in self.spans():
+            args = {k: v for k, v in rec.attrs.items()}
+            if rec.parent is not None:
+                args["parent"] = rec.parent
+            events.append(
+                {
+                    "ph": "X",
+                    "name": rec.name,
+                    "cat": "fisco",
+                    "pid": pid,
+                    "tid": rec.tid,
+                    "ts": round(rec.ts * 1e6, 3),
+                    "dur": round(rec.dur * 1e6, 3),
+                    "args": args,
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_json(self) -> str:
+        return json.dumps(self.export_chrome(), default=str)
+
+
+# process-wide default tracer (modules import and use directly, like
+# utils.metrics.REGISTRY); FISCO_TELEMETRY=0 starts it disabled
+TRACER = Tracer(
+    capacity=int(os.environ.get("FISCO_TRACE_CAPACITY", "8192")),
+    enabled=os.environ.get("FISCO_TELEMETRY", "1") != "0",
+)

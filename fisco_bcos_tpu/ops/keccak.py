@@ -176,7 +176,14 @@ def keccak256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
 
 def keccak256_batch(msgs) -> np.ndarray:
     """Host convenience: list of bytes -> [B, 32] uint8 digests (device batch)."""
-    return keccak256_batch_async(msgs)()
+    from ..observability.device import device_span
+    from .hash_common import bucket_batch
+
+    n = len(msgs)
+    # shape key approximates the compiled program (batch bucket only — the
+    # message-block dim also shapes it, so compile counts are a lower bound)
+    with device_span("keccak256", n, shape_key=bucket_batch(n)):
+        return keccak256_batch_async(msgs)()
 
 
 def keccak256_batch_async(msgs):

@@ -362,4 +362,9 @@ def merkle_root(
     leaves: np.ndarray, width: int = 16, hasher: str = "keccak256"
 ) -> bytes:
     """Root only (the hot path for block sealing: tx/receipt roots)."""
-    return merkle_root_async(leaves, width=width, hasher=hasher)()
+    from ..observability.device import device_span
+
+    n = len(leaves)
+    key = (hasher, width, bucket_leaves(max(n, 1)))
+    with device_span("merkle_root", n, shape_key=key):
+        return merkle_root_async(leaves, width=width, hasher=hasher)()

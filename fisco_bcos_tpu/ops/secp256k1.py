@@ -255,18 +255,22 @@ def verify_batch(
 ) -> np.ndarray:
     """Host API: [B,32] hash, [B,32] r, [B,32] s, [B,64] uncompressed pubkey
     (all uint8 big-endian) -> bool[B]."""
+    from ..observability.device import device_span
+
     bsz = len(msg_hashes)
     bb = _bucket(bsz)
-    z = _pad_rows(bytes_be_to_limbs(msg_hashes), bb)
-    r = _pad_rows(bytes_be_to_limbs(rs), bb)
-    s = _pad_rows(bytes_be_to_limbs(ss), bb)
-    pubkeys = np.asarray(pubkeys, dtype=np.uint8)
-    qx = _pad_rows(bytes_be_to_limbs(pubkeys[:, :32]), bb)
-    qy = _pad_rows(bytes_be_to_limbs(pubkeys[:, 32:]), bb)
-    out = verify_device(
-        jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(qx), jnp.asarray(qy)
-    )
-    return np.asarray(out)[:bsz]
+    with device_span("secp256k1_verify", bsz, shape_key=bb):
+        z = _pad_rows(bytes_be_to_limbs(msg_hashes), bb)
+        r = _pad_rows(bytes_be_to_limbs(rs), bb)
+        s = _pad_rows(bytes_be_to_limbs(ss), bb)
+        pubkeys = np.asarray(pubkeys, dtype=np.uint8)
+        qx = _pad_rows(bytes_be_to_limbs(pubkeys[:, :32]), bb)
+        qy = _pad_rows(bytes_be_to_limbs(pubkeys[:, 32:]), bb)
+        out = verify_device(
+            jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(qx),
+            jnp.asarray(qy),
+        )
+        return np.asarray(out)[:bsz]
 
 
 def recover_batch(
@@ -274,17 +278,21 @@ def recover_batch(
 ) -> tuple[np.ndarray, np.ndarray]:
     """Host API: [B,32] hash + [B,65] r‖s‖v signatures (uint8) ->
     (pubkeys [B,64] uint8, ok bool[B])."""
+    from ..observability.device import device_span
+
     bsz = len(msg_hashes)
     bb = _bucket(bsz)
-    sigs65 = np.asarray(sigs65, dtype=np.uint8)
-    z = _pad_rows(bytes_be_to_limbs(msg_hashes), bb)
-    r = _pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
-    s = _pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
-    v = _pad_rows(sigs65[:, 64].astype(np.int32), bb)
-    qx, qy, ok = recover_device(
-        jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v)
-    )
-    pubs = np.concatenate(
-        [limbs_to_bytes_be(np.asarray(qx)), limbs_to_bytes_be(np.asarray(qy))], axis=-1
-    )
-    return pubs[:bsz], np.asarray(ok)[:bsz]
+    with device_span("secp256k1_recover", bsz, shape_key=bb):
+        sigs65 = np.asarray(sigs65, dtype=np.uint8)
+        z = _pad_rows(bytes_be_to_limbs(msg_hashes), bb)
+        r = _pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
+        s = _pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
+        v = _pad_rows(sigs65[:, 64].astype(np.int32), bb)
+        qx, qy, ok = recover_device(
+            jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v)
+        )
+        pubs = np.concatenate(
+            [limbs_to_bytes_be(np.asarray(qx)), limbs_to_bytes_be(np.asarray(qy))],
+            axis=-1,
+        )
+        return pubs[:bsz], np.asarray(ok)[:bsz]

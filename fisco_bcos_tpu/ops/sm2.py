@@ -149,18 +149,24 @@ def verify_batch(
     user_id: bytes = SM2_DEFAULT_ID,
 ) -> np.ndarray:
     """Host API: [B,32] tx hash, [B,32] r, [B,32] s, [B,64] pubkey -> bool[B]."""
+    from ..observability.device import device_span
+
     bsz = len(msg_hashes)
     bb = _bucket(bsz)
-    e = _pad_rows(bytes_be_to_limbs(sm2_e_batch(msg_hashes, pubkeys, user_id)), bb)
-    r = _pad_rows(bytes_be_to_limbs(rs), bb)
-    s = _pad_rows(bytes_be_to_limbs(ss), bb)
-    pubkeys = np.asarray(pubkeys, dtype=np.uint8)
-    qx = _pad_rows(bytes_be_to_limbs(pubkeys[:, :32]), bb)
-    qy = _pad_rows(bytes_be_to_limbs(pubkeys[:, 32:]), bb)
-    out = verify_device(
-        jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(qx), jnp.asarray(qy)
-    )
-    return np.asarray(out)[:bsz]
+    with device_span("sm2_verify", bsz, shape_key=bb):
+        e = _pad_rows(
+            bytes_be_to_limbs(sm2_e_batch(msg_hashes, pubkeys, user_id)), bb
+        )
+        r = _pad_rows(bytes_be_to_limbs(rs), bb)
+        s = _pad_rows(bytes_be_to_limbs(ss), bb)
+        pubkeys = np.asarray(pubkeys, dtype=np.uint8)
+        qx = _pad_rows(bytes_be_to_limbs(pubkeys[:, :32]), bb)
+        qy = _pad_rows(bytes_be_to_limbs(pubkeys[:, 32:]), bb)
+        out = verify_device(
+            jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(qx),
+            jnp.asarray(qy),
+        )
+        return np.asarray(out)[:bsz]
 
 
 def recover_batch(

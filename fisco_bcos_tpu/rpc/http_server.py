@@ -28,9 +28,14 @@ class RpcHttpServer:
         port: int = 20200,
         ssl_context=None,
         metrics=None,
+        tracer=None,
     ):
         self.impl = impl
+        # `metrics` needs .render() -> str; `tracer` needs .export_json() ->
+        # str — satisfied by MetricsRegistry/Tracer in-process and by the
+        # RemoteTelemetry proxy in the split (Pro/Max) deployment
         self.metrics = metrics
+        self.tracer = tracer
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,14 +64,20 @@ class RpcHttpServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def do_GET(self) -> None:  # noqa: N802 — Prometheus scrape
-                if self.path != "/metrics" or outer.metrics is None:
+            def do_GET(self) -> None:  # noqa: N802 — telemetry scrape
+                if self.path == "/metrics" and outer.metrics is not None:
+                    data = outer.metrics.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/trace" and outer.tracer is not None:
+                    # Chrome trace-event JSON — load in Perfetto as-is
+                    data = outer.tracer.export_json().encode()
+                    ctype = "application/json"
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                data = outer.metrics.render().encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

@@ -33,16 +33,19 @@ reference's parallelism inventory (SURVEY.md §2.8). The live path:
 from __future__ import annotations
 
 import hashlib
+import time
 from dataclasses import dataclass, field
 from enum import IntEnum
 
 from ..codec.flat import FlatReader, FlatWriter
 from ..executor.evm import EVMCall, EVMResult
+from ..observability import BATCH_BUCKETS, TRACER
 from ..protocol.receipt import LogEntry, TransactionReceipt, TransactionStatus
 from ..protocol.transaction import Transaction
 from ..storage.entry import Entry
 from ..storage.state_storage import StateStorage
 from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
 from .key_locks import GraphKeyLocks
 
 _log = get_logger("dmc")
@@ -453,6 +456,9 @@ class DMCScheduler:
             d.pool = [m for m in d.pool if m.context_id != ctx]
 
     def execute(self, txs: list[Transaction]) -> list[TransactionReceipt]:
+        t_exec0 = time.perf_counter()
+        start_round = self.recorder.round
+        msg_total = 0
         dmc: dict[bytes, DmcExecutor] = {}
 
         def executor_for(contract: bytes) -> DmcExecutor:
@@ -516,6 +522,13 @@ class DMCScheduler:
             round_results: list[ExecutionMessage] = []
             for d in sorted(pending, key=lambda d: d.contract):
                 round_results.extend(d.go(self.recorder))
+            msg_total += len(round_results)
+            REGISTRY.observe(
+                "fisco_dmc_messages_per_round",
+                len(round_results),
+                buckets=BATCH_BUCKETS,
+                help="execution messages exchanged per DMC round",
+            )
             # phase 1 — claims. The scheduler owns the lock graph: every
             # result (pause request or successful completion) carries the
             # rows its shard reported touched; claim them ALL before any
@@ -589,4 +602,30 @@ class DMCScheduler:
                 status=int(TransactionStatus.UNKNOWN),
                 output=b"unfinished after max DMC rounds",
             )
+        rounds = self.recorder.round - start_round
+        REGISTRY.observe(
+            "fisco_dmc_rounds_per_block",
+            rounds,
+            buckets=BATCH_BUCKETS,
+            help="DMC scheduling rounds per executed block",
+        )
+        REGISTRY.counter_add(
+            "fisco_dmc_messages_total",
+            float(msg_total),
+            help="execution messages exchanged across all DMC rounds",
+        )
+        if reverted:
+            REGISTRY.counter_add(
+                "fisco_dmc_deadlock_reverts_total",
+                float(len(reverted)),
+                help="contexts reverted as deadlock victims",
+            )
+        TRACER.record(
+            "dmc.execute",
+            t_exec0,
+            time.perf_counter() - t_exec0,
+            txs=len(txs),
+            rounds=rounds,
+            messages=msg_total,
+        )
         return receipts  # type: ignore[return-value]

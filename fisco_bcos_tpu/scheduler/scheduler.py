@@ -16,11 +16,13 @@ executed state into one 2PC against the durable backend.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 
 from ..crypto.suite import CryptoSuite
 from ..executor.executor import TransactionExecutor
 from ..ledger import Ledger
+from ..observability import TRACER
 from ..protocol.block import Block
 from ..protocol.block_header import BlockHeader
 from ..protocol.transaction import TransactionAttribute
@@ -28,6 +30,7 @@ from ..storage.interfaces import TransactionalStorage, TwoPCParams
 from ..storage.state_storage import StateStorage
 from ..utils.error import ErrorCode
 from ..utils.log import StageTimer, get_logger
+from ..utils.metrics import REGISTRY
 from ..utils.worker import Worker
 
 _log = get_logger("scheduler")
@@ -126,11 +129,34 @@ class Scheduler:
         # the lock covers the whole execution: the executor's block context is
         # shared state, and two interleaved same-height executions would
         # corrupt each other's state layer
-        with self._lock:
-            cached = self._executed.get(number)
-            if cached is not None and cached.tx_hashes == proposal_ident and not verify:
-                return cached.header  # same proposal re-executed (preExecute cache)
-            return self._execute_block_locked(block, verify, number, proposal_ident)
+        with TRACER.span("scheduler.execute_block", block=number) as sp:
+            with self._lock:
+                cached = self._executed.get(number)
+                if (
+                    cached is not None
+                    and cached.tx_hashes == proposal_ident
+                    and not verify
+                ):
+                    # same proposal re-executed (preExecute cache)
+                    sp.attrs["cache"] = "hit"
+                    REGISTRY.counter_add(
+                        "fisco_scheduler_preexec_hits_total",
+                        help="commit-quorum executions served by the "
+                        "pre-execution cache",
+                    )
+                    return cached.header
+                t0 = time.perf_counter()
+                header = self._execute_block_locked(
+                    block, verify, number, proposal_ident
+                )
+                REGISTRY.observe(
+                    "fisco_block_execute_latency_ms",
+                    (time.perf_counter() - t0) * 1e3,
+                    help="block execution wall latency (mtail block-exec "
+                    "buckets)",
+                )
+                sp.attrs["txs"] = len(block.transactions)
+                return header
 
     def _execute_block_locked(
         self, block: Block, verify: bool, number: int, proposal_ident
@@ -273,17 +299,25 @@ class Scheduler:
     # -- commitBlock:390 -----------------------------------------------------
 
     def commit_block(self, header: BlockHeader) -> None:
-        with self._lock:
-            committed = self._commit_block_locked(header)
-            # listeners run on the notify worker, never on the caller's
-            # thread: the caller is the PBFT engine holding its own RLock,
-            # so a blocking sendall to a stalled ws client here would freeze
-            # consensus. Posting stays INSIDE the lock (post never blocks)
-            # so two concurrent committers cannot enqueue out of order.
-            if committed is not None:
-                number, block = committed
-                for cb in list(self.on_committed):
-                    self._notify.post(lambda cb=cb: cb(number, block))
+        with TRACER.span("scheduler.commit_block", block=header.number):
+            t0 = time.perf_counter()
+            with self._lock:
+                committed = self._commit_block_locked(header)
+                # listeners run on the notify worker, never on the caller's
+                # thread: the caller is the PBFT engine holding its own RLock,
+                # so a blocking sendall to a stalled ws client here would
+                # freeze consensus. Posting stays INSIDE the lock (post never
+                # blocks) so two concurrent committers cannot enqueue out of
+                # order.
+                if committed is not None:
+                    number, block = committed
+                    for cb in list(self.on_committed):
+                        self._notify.post(lambda cb=cb: cb(number, block))
+            REGISTRY.observe(
+                "fisco_block_commit_latency_ms",
+                (time.perf_counter() - t0) * 1e3,
+                help="block commit wall latency (mtail block-commit buckets)",
+            )
 
     def _commit_block_locked(self, header: BlockHeader) -> None:
         number = header.number

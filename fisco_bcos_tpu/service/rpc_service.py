@@ -23,12 +23,23 @@ _log = get_logger("rpc-svc")
 
 
 class RpcFacade:
-    """Node-side server exposing JsonRpcImpl.handle over service RPC."""
+    """Node-side server exposing JsonRpcImpl.handle over service RPC, plus
+    the node's telemetry surface (`metrics`/`trace` methods) so the RPC
+    process can serve `GET /metrics` and `GET /trace` for the whole split
+    deployment — the node core owns the registry and tracer, the RPC
+    process only forwards."""
 
-    def __init__(self, impl, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, impl, host: str = "127.0.0.1", port: int = 0, metrics=None,
+        tracer=None,
+    ):
         self.impl = impl
+        self.metrics = metrics
+        self.tracer = tracer
         self.server = ServiceServer("rpc-facade", host, port)
         self.server.register("handle", self._handle)
+        self.server.register("metrics", self._metrics)
+        self.server.register("trace", self._trace)
         self.host, self.port = self.server.host, self.server.port
 
     def start(self) -> None:
@@ -40,6 +51,14 @@ class RpcFacade:
     def _handle(self, payload: bytes) -> bytes:
         req = json.loads(payload)
         return json.dumps(self.impl.handle(req)).encode()
+
+    def _metrics(self, _payload: bytes) -> bytes:
+        return (self.metrics.render() if self.metrics is not None else "").encode()
+
+    def _trace(self, _payload: bytes) -> bytes:
+        if self.tracer is None:
+            return b'{"traceEvents": []}'
+        return self.tracer.export_json().encode()
 
 
 class RemoteJsonRpc:
@@ -66,9 +85,39 @@ class RemoteJsonRpc:
         self.client.close()
 
 
+class RemoteTelemetry:
+    """RPC-process-side metrics/trace proxy over the node facade — duck-
+    compatible with MetricsRegistry.render / Tracer.export_json where
+    RpcHttpServer needs them. A facade without the telemetry methods (or an
+    unreachable node) degrades to empty output, never a 500. Owns its OWN
+    ServiceClient (short timeout): ServiceClient serializes calls on one
+    connection lock, so a scrape against a stalled node core must never
+    queue JSON-RPC requests behind it (nor the reverse)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.client = ServiceClient(host, port, timeout)
+
+    def render(self) -> str:
+        try:
+            return self.client.call("metrics").decode()
+        except Exception:
+            return ""
+
+    def export_json(self) -> str:
+        try:
+            return self.client.call("trace").decode()
+        except Exception:
+            return '{"traceEvents": []}'
+
+    def close(self) -> None:
+        self.client.close()
+
+
 class RpcService:
     """The RPC process: HTTP JSON-RPC listener over a remote node facade
-    (RpcServiceServer's process shape)."""
+    (RpcServiceServer's process shape). `/metrics` and `/trace` forward to
+    the node core's registry/tracer by default (split-mode deployments used
+    to serve an empty `/metrics` because nothing bound node metrics here)."""
 
     def __init__(
         self,
@@ -78,13 +127,16 @@ class RpcService:
         port: int = 0,
         ssl_context=None,
         metrics=None,
+        tracer=None,
     ):
         from ..rpc.http_server import RpcHttpServer
 
         self.remote = RemoteJsonRpc(facade_host, facade_port)
+        self.telemetry = RemoteTelemetry(facade_host, facade_port)
         self.http = RpcHttpServer(
             self.remote, host=host, port=port, ssl_context=ssl_context,
-            metrics=metrics,
+            metrics=metrics if metrics is not None else self.telemetry,
+            tracer=tracer if tracer is not None else self.telemetry,
         )
         self.port = self.http.port
 
@@ -94,3 +146,4 @@ class RpcService:
     def stop(self) -> None:
         self.http.stop()
         self.remote.close()
+        self.telemetry.close()

@@ -11,13 +11,16 @@ tbb-parallel verify at TransactionSync.cpp:521-553 → here one device batch).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 
 from ..crypto.suite import CryptoSuite
 from ..ledger import Ledger
+from ..observability import BATCH_BUCKETS, TRACER
 from ..protocol.transaction import Transaction, hash_transactions_batch
 from ..utils.error import ErrorCode
 from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
 from .validator import (
     LedgerNonceChecker,
     TxPoolNonceChecker,
@@ -26,6 +29,16 @@ from .validator import (
 )
 
 _log = get_logger("txpool")
+
+# admission rejection reasons for the labeled drop counter (one label value
+# per family of ErrorCode — keeps the metric cardinality fixed)
+_REJECT_REASON = {
+    ErrorCode.ALREADY_IN_TX_POOL: "dup",
+    ErrorCode.TX_ALREADY_IN_CHAIN: "replay",
+    ErrorCode.TX_POOL_FULL: "full",
+    ErrorCode.INVALID_SIGNATURE: "sig",
+    ErrorCode.BLOCK_LIMIT_CHECK_FAIL: "expired",
+}
 
 
 @dataclass
@@ -102,6 +115,7 @@ class TxPool:
         equal nonce), so no pre-verification hash pass is needed — the
         fused program's digests fill the hash caches of verified lanes,
         and only rejected lanes pay a host hash for their result row."""
+        t0 = time.perf_counter()
         results: list[TxSubmitResult | None] = [None] * len(txs)
         to_verify: list[int] = []
         with self._lock:
@@ -143,7 +157,48 @@ class TxPool:
                     self.PERSIST_TABLE,
                     [(h, Entry({"value": t.encode()})) for h, t in persisted],
                 )
+        self._record_admission(txs, results, t0)
         return results  # type: ignore[return-value]
+
+    def _record_admission(self, txs, results, t0: float) -> None:
+        """Batch-level admission telemetry (one observation per batch, never
+        per tx — the hot loop above stays untouched)."""
+        if not REGISTRY.enabled and not TRACER.enabled:
+            return
+        dur = time.perf_counter() - t0
+        admitted = 0
+        rejects: dict[str, int] = {}
+        for r in results:
+            if r is not None and r.status == ErrorCode.SUCCESS:
+                admitted += 1
+            elif r is not None:
+                reason = _REJECT_REASON.get(r.status, "static")
+                rejects[reason] = rejects.get(reason, 0) + 1
+        REGISTRY.observe(
+            "fisco_txpool_admission_latency_ms",
+            dur * 1e3,
+            help="submit_batch wall latency (static gates + device verify)",
+        )
+        REGISTRY.observe(
+            "fisco_txpool_batch_size",
+            len(txs),
+            buckets=BATCH_BUCKETS,
+            help="admission batch sizes",
+        )
+        REGISTRY.counter_add(
+            "fisco_txpool_admitted_total",
+            float(admitted),
+            help="transactions admitted to the pool",
+        )
+        for reason, n in rejects.items():
+            REGISTRY.counter_add(
+                f'fisco_txpool_rejected_total{{reason="{reason}"}}',
+                float(n),
+                help="transactions rejected at admission by reason",
+            )
+        TRACER.record(
+            "txpool.submit_batch", t0, dur, batch=len(txs), admitted=admitted
+        )
 
     def _insert(self, tx: Transaction, h: bytes, persist: bool = True) -> None:
         with self._lock:
@@ -257,6 +312,24 @@ class TxPool:
         batchVerifyProposal). Unknown txs are fetched via `fetch_missing`
         (sync-from-peers hook) and batch-verified on device before import.
         Returns (all known/valid, missing hashes)."""
+        with TRACER.span("txpool.verify_block", txs=len(tx_hashes)) as sp:
+            ok, missing = self._verify_block_inner(tx_hashes, fetch_missing)
+            REGISTRY.counter_add(
+                "fisco_txpool_proposal_verify_total",
+                help="proposal hash-presence verifications",
+            )
+            if missing:
+                sp.attrs["missing"] = len(missing)
+                REGISTRY.counter_add(
+                    "fisco_txpool_proposal_missing_total",
+                    float(len(missing)),
+                    help="proposal txs absent from the pool (straggler fetches)",
+                )
+            return ok, missing
+
+    def _verify_block_inner(
+        self, tx_hashes: list[bytes], fetch_missing=None
+    ) -> tuple[bool, list[bytes]]:
         with self._lock:
             missing = [h for h in tx_hashes if h not in self._txs]
         if not missing:

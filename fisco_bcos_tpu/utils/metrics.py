@@ -2,78 +2,141 @@
 
 Reference: the reference exports node metrics by tailing METRIC log lines
 with mtail into Prometheus (tools/BcosAirBuilder/build_chain.sh:891-946
-generates the mtail config).  Here the same signals are first-class: modules
-register counters/gauges, and the RPC HTTP server exposes ``GET /metrics``
-in Prometheus text format — no sidecar required (the mtail-compatible METRIC
-log lines from utils/log.py remain for log-based pipelines).
+generates the mtail config, including the 0/50/100/150 ms latency histograms
+for block execution and commit at :920-935).  Here the same signals are
+first-class: modules register counters/gauges/histograms, and the RPC HTTP
+server exposes ``GET /metrics`` in Prometheus text format — no sidecar
+required (the mtail-compatible METRIC log lines from utils/log.py remain for
+log-based pipelines).
+
+Exposition follows format 0.0.4: ONE ``# HELP``/``# TYPE`` header per metric
+family regardless of how many labeled samples it has, escaped help text, and
+histogram families rendered as ``_bucket``/``_sum``/``_count``.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable
 
+from ..observability.histogram import (
+    LATENCY_BUCKETS_MS,
+    Histogram,
+    escape_help,
+)
+
 
 class MetricsRegistry:
-    def __init__(self):
+    def __init__(self, enabled: bool = True):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, Callable[[], float] | float] = {}
+        self._histograms: dict[str, Histogram] = {}
         self._help: dict[str, str] = {}
+        # master switch (observability.set_enabled): when off, every write
+        # is a cheap early return — the bench overhead A/B baseline
+        self.enabled = enabled
 
     def counter_add(self, name: str, value: float = 1.0, help: str = "") -> None:
+        if not self.enabled:
+            return
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + value
             if help:
-                self._help.setdefault(name, help)
+                self._help.setdefault(name.split("{")[0], help)
 
     def gauge_set(self, name: str, value: float, help: str = "") -> None:
+        if not self.enabled:
+            return
         with self._lock:
             self._gauges[name] = value
             if help:
-                self._help.setdefault(name, help)
+                self._help.setdefault(name.split("{")[0], help)
 
     def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "") -> None:
         """Register a pull-time gauge (evaluated at scrape)."""
+        if not self.enabled:
+            return
         with self._lock:
             self._gauges[name] = fn
             if help:
-                self._help.setdefault(name, help)
+                self._help.setdefault(name.split("{")[0], help)
+
+    # -- histograms ----------------------------------------------------------
+
+    def histogram(
+        self, name: str, buckets=LATENCY_BUCKETS_MS, help: str = ""
+    ) -> Histogram:
+        """Get-or-create the histogram family `name` (buckets/help only
+        apply on first registration)."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, buckets, help)
+            return h
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        buckets=LATENCY_BUCKETS_MS,
+        help: str = "",
+        **labels,
+    ) -> None:
+        """One-call histogram observation (labels as kwargs)."""
+        if not self.enabled:
+            return
+        self.histogram(name, buckets, help).observe(value, labels or None)
+
+    # -- exposition ----------------------------------------------------------
 
     def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4 — each family's
+        ``# HELP``/``# TYPE`` emitted exactly once, help text escaped."""
         lines: list[str] = []
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            histograms = list(self._histograms.values())
             helps = dict(self._help)
-        for name, val in sorted(counters.items()):
-            base = name.split("{")[0]
-            if base in helps:
-                lines.append(f"# HELP {base} {helps[base]}")
-            lines.append(f"# TYPE {base} counter")
-            lines.append(f"{name} {val:g}")
-        for name, val in sorted(gauges.items()):
-            base = name.split("{")[0]
+
+        def emit_family(samples: dict[str, float], mtype: str) -> None:
+            by_base: dict[str, list[str]] = {}
+            for name in samples:
+                by_base.setdefault(name.split("{")[0], []).append(name)
+            for base in sorted(by_base):
+                if base in helps:
+                    lines.append(f"# HELP {base} {escape_help(helps[base])}")
+                lines.append(f"# TYPE {base} {mtype}")
+                for name in sorted(by_base[base]):
+                    lines.append(f"{name} {samples[name]:g}")
+
+        emit_family(counters, "counter")
+        gauge_vals: dict[str, float] = {}
+        for name, val in gauges.items():
             if callable(val):
                 try:
                     val = float(val())
                 except Exception:
                     continue
-            if base in helps:
-                lines.append(f"# HELP {base} {helps[base]}")
-            lines.append(f"# TYPE {base} gauge")
-            lines.append(f"{name} {val:g}")
+            gauge_vals[name] = val
+        emit_family(gauge_vals, "gauge")
+        for h in sorted(histograms, key=lambda h: h.name):
+            h.render_into(lines)
         return "\n".join(lines) + "\n"
 
 
-# process-wide default registry (modules import and use directly)
-REGISTRY = MetricsRegistry()
+# process-wide default registry (modules import and use directly);
+# FISCO_TELEMETRY=0 starts it disabled (observability.set_enabled flips it)
+REGISTRY = MetricsRegistry(enabled=os.environ.get("FISCO_TELEMETRY", "1") != "0")
 
 
 def bind_node_metrics(node, registry: MetricsRegistry | None = None) -> MetricsRegistry:
     """Register the standard node gauges (block height, pool size, view —
-    the signals the reference's mtail config extracts)."""
+    the signals the reference's mtail config extracts) and pre-register the
+    mtail-contract block latency histograms so an idle node's scrape already
+    shows the families."""
     reg = registry or REGISTRY
     reg.gauge_fn(
         "fisco_block_number", lambda: float(node.block_number()),
@@ -90,5 +153,15 @@ def bind_node_metrics(node, registry: MetricsRegistry | None = None) -> MetricsR
         "fisco_committee_size",
         lambda: float(node.pbft_config.committee_size),
         help="consensus committee size",
+    )
+    # the two mtail-bucket histograms (build_chain.sh:920-935); the
+    # scheduler observes into the SAME process REGISTRY families
+    reg.histogram(
+        "fisco_block_execute_latency_ms",
+        help="block execution wall latency (mtail block-exec buckets)",
+    )
+    reg.histogram(
+        "fisco_block_commit_latency_ms",
+        help="block commit wall latency (mtail block-commit buckets)",
     )
     return reg

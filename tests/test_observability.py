@@ -1,0 +1,381 @@
+"""Observability layer: histograms, exposition format, tracer, endpoints.
+
+References: Prometheus text exposition format 0.0.4 (one HELP/TYPE per
+family, cumulative le buckets), the reference's mtail latency histograms
+(tools/BcosAirBuilder/build_chain.sh:920-935 — 0/50/100/150 ms buckets for
+block execution/commit), Chrome trace-event JSON (Perfetto-loadable).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from fisco_bcos_tpu.observability import (
+    BATCH_BUCKETS,
+    LATENCY_BUCKETS_MS,
+    Histogram,
+    Tracer,
+)
+from fisco_bcos_tpu.rpc.http_server import RpcHttpServer
+from fisco_bcos_tpu.utils.metrics import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# tiny exposition-format parser (the round-trip oracle)
+# ---------------------------------------------------------------------------
+
+
+def parse_prom(text):
+    """Parse exposition text into {family: {"type", "help", "samples"}};
+    asserts no family emits HELP/TYPE more than once."""
+    families = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind = line[2:6]
+            _, _, rest = line.partition(f"# {kind} ")
+            name, _, value = rest.partition(" ")
+            fam = families.setdefault(
+                name, {"type": None, "help": None, "samples": {}}
+            )
+            key = kind.lower()
+            assert fam[key] is None, f"duplicate # {kind} for {name}"
+            fam[key] = value
+        else:
+            sample, _, value = line.rpartition(" ")
+            base = sample.split("{")[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix) and base[: -len(suffix)] in families:
+                    base = base[: -len(suffix)]
+                    break
+            fam = families.setdefault(
+                base, {"type": None, "help": None, "samples": {}}
+            )
+            assert sample not in fam["samples"], f"duplicate sample {sample}"
+            fam["samples"][sample] = float(value)
+    return families
+
+
+# ---------------------------------------------------------------------------
+# histogram semantics
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_edges_are_le_inclusive():
+    h = Histogram("lat", buckets=LATENCY_BUCKETS_MS)
+    for v in (0.0, 50.0, 50.0001, 100.0, 149.9, 150.0, 151.0, 9999.0):
+        h.observe(v)
+    ((cum, total, count),) = [h.snapshot()[()]]
+    # cumulative counts per le bucket: 0 -> 1 sample, 50 -> +1, 100 -> +2
+    # (50.0001 and 100.0), 150 -> +2 (149.9, 150.0); 151 and 9999 only +Inf
+    assert cum == (1, 2, 4, 6)
+    assert count == 8
+    assert total == pytest.approx(sum((0.0, 50.0, 50.0001, 100.0, 149.9, 150.0, 151.0, 9999.0)))
+
+
+def test_histogram_labels_make_independent_children():
+    h = Histogram("ops", buckets=BATCH_BUCKETS)
+    h.observe(1, {"op": "a"})
+    h.observe(1024, {"op": "b"})
+    h.observe(2, {"op": "a"})
+    snap = h.snapshot()
+    assert snap[(("op", "a"),)][2] == 2
+    assert snap[(("op", "b"),)][2] == 1
+
+
+def test_histogram_render_shape():
+    h = Histogram("x", buckets=(1.0, 2.0), help="two buckets")
+    h.observe(1.5, {"op": "z"})
+    lines = []
+    h.render_into(lines)
+    text = "\n".join(lines)
+    assert '# HELP x two buckets' in text
+    assert "# TYPE x histogram" in text
+    assert 'x_bucket{op="z",le="1"} 0' in text
+    assert 'x_bucket{op="z",le="2"} 1' in text
+    assert 'x_bucket{op="z",le="+Inf"} 1' in text
+    assert 'x_sum{op="z"} 1.5' in text
+    assert 'x_count{op="z"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# registry exposition round-trip (the render() satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_labeled_counters_emit_one_family_header():
+    reg = MetricsRegistry()
+    reg.counter_add('foo{a="1"}', 3, help="labeled family")
+    reg.counter_add('foo{a="2"}', 4, help="labeled family")
+    reg.counter_add("bar", 1, help="plain family")
+    reg.gauge_set('g{x="1"}', 0.5, help="labeled gauge")
+    reg.gauge_set('g{x="2"}', 1.5)
+    text = reg.render()
+    # the pre-fix renderer emitted one TYPE line per labeled sample —
+    # parse_prom asserts each family's HELP/TYPE appears exactly once
+    fams = parse_prom(text)
+    assert fams["foo"]["type"] == "counter"
+    assert fams["foo"]["samples"] == {'foo{a="1"}': 3.0, 'foo{a="2"}': 4.0}
+    assert fams["g"]["type"] == "gauge"
+    assert len(fams["g"]["samples"]) == 2
+
+
+def test_registry_escapes_help_text():
+    reg = MetricsRegistry()
+    reg.counter_add("esc", 1, help="line1\nline2 back\\slash")
+    text = reg.render()
+    assert "# HELP esc line1\\nline2 back\\\\slash" in text
+    assert "\nline2" not in text.replace("\\n", "")
+
+
+def test_registry_histogram_round_trip():
+    reg = MetricsRegistry()
+    reg.observe("lat_ms", 42.0, help="latency")
+    reg.observe("lat_ms", 200.0)
+    reg.observe("dev", 8, buckets=BATCH_BUCKETS, op="verify")
+    fams = parse_prom(reg.render())
+    lat = fams["lat_ms"]
+    assert lat["type"] == "histogram"
+    assert lat["samples"]['lat_ms_bucket{le="50"}'] == 1.0
+    assert lat["samples"]['lat_ms_bucket{le="+Inf"}'] == 2.0
+    assert lat["samples"]["lat_ms_count"] == 2.0
+    assert lat["samples"]["lat_ms_sum"] == pytest.approx(242.0)
+    dev = fams["dev"]
+    assert dev["samples"]['dev_bucket{op="verify",le="+Inf"}'] == 1.0
+
+
+def test_registry_disabled_is_a_noop():
+    reg = MetricsRegistry(enabled=False)
+    reg.counter_add("c", 1)
+    reg.observe("h", 1.0)
+    reg.gauge_set("g", 1.0)
+    assert reg.render() == "\n"
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_nesting_records_parent_and_depth():
+    tr = Tracer(capacity=16)
+    with tr.span("outer", block=7):
+        with tr.span("inner"):
+            pass
+    recs = {r.name: r for r in tr.spans()}
+    assert recs["inner"].parent == "outer" and recs["inner"].depth == 1
+    assert recs["outer"].parent is None and recs["outer"].depth == 0
+    assert recs["outer"].attrs == {"block": 7}
+    # inner completes first and nests inside outer's window
+    assert recs["outer"].ts <= recs["inner"].ts
+    assert recs["inner"].ts + recs["inner"].dur <= (
+        recs["outer"].ts + recs["outer"].dur + 1e-6
+    )
+
+
+def test_tracer_ring_buffer_bounds_memory():
+    tr = Tracer(capacity=8)
+    for i in range(50):
+        with tr.span(f"s{i}"):
+            pass
+    spans = tr.spans()
+    assert len(spans) == 8
+    assert spans[-1].name == "s49"  # keeps the newest
+
+
+def test_tracer_disabled_records_nothing():
+    tr = Tracer(capacity=8, enabled=False)
+    with tr.span("x"):
+        pass
+    tr.record("y", 0.0, 1.0)
+    assert tr.spans() == []
+
+
+def test_chrome_trace_export_schema():
+    tr = Tracer(capacity=16)
+    with tr.span("a", block=1):
+        with tr.span("b"):
+            pass
+    tr.record("phase", 1.0, 0.5, block=1)
+    doc = json.loads(tr.export_json())
+    events = doc["traceEvents"]
+    assert len(events) == 3
+    for e in events:
+        assert e["ph"] == "X"
+        assert isinstance(e["name"], str)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e["args"], dict)
+    b = next(e for e in events if e["name"] == "b")
+    assert b["args"]["parent"] == "a"
+
+
+# ---------------------------------------------------------------------------
+# ratelimit -> registry wiring (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_ratelimit_drops_export_to_registry():
+    from fisco_bcos_tpu.gateway.ratelimit import RateLimiterManager
+
+    reg = MetricsRegistry()
+    mgr = RateLimiterManager(module_rates={1000: 100.0}, registry=reg)
+    assert mgr.check(1000, 100)
+    assert not mgr.check(1000, 100)  # module budget exhausted
+    assert mgr.dropped == 1
+    text = reg.render()
+    assert 'fisco_gateway_ratelimit_dropped_total{scope="module"} 1' in text
+    assert (
+        'fisco_gateway_ratelimit_dropped_bytes_total{scope="module"} 100'
+        in text
+    )
+
+
+# ---------------------------------------------------------------------------
+# live endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_http_serves_metrics_and_trace():
+    reg = MetricsRegistry()
+    reg.observe("fisco_block_execute_latency_ms", 12.0, help="exec")
+    tr = Tracer(capacity=16)
+    with tr.span("scheduler.execute_block", block=1):
+        pass
+    server = RpcHttpServer(impl=None, port=0, metrics=reg, tracer=tr)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert 'fisco_block_execute_latency_ms_bucket{le="50"} 1' in text
+        assert 'fisco_block_execute_latency_ms_bucket{le="+Inf"} 1' in text
+        with urllib.request.urlopen(f"{base}/trace", timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert resp.headers["Content-Type"].startswith("application/json")
+        assert doc["traceEvents"][0]["name"] == "scheduler.execute_block"
+    finally:
+        server.stop()
+
+
+def test_http_trace_404_without_tracer():
+    reg = MetricsRegistry()
+    server = RpcHttpServer(impl=None, port=0, metrics=reg)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/trace", timeout=5
+            )
+        assert exc.value.code == 404
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# end to end: one committed block populates the whole layer
+# ---------------------------------------------------------------------------
+
+
+def test_block_pipeline_populates_histograms_and_trace():
+    """Drive one block through a 4-node in-process chain and assert the
+    mtail-contract histograms fill and the trace shows the nested pipeline
+    (the ISSUE acceptance path, small enough for tier-1)."""
+    from fisco_bcos_tpu.codec.abi import ABICodec
+    from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+    from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+    from fisco_bcos_tpu.front import InprocGateway
+    from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+    from fisco_bcos_tpu.node import Node, NodeConfig
+    from fisco_bcos_tpu.observability import TRACER
+    from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    exec_before = REGISTRY.histogram("fisco_block_execute_latency_ms")
+    commit_before = REGISTRY.histogram("fisco_block_commit_latency_ms")
+
+    def total_count(h):
+        return sum(c for _, _, c in h.snapshot().values())
+
+    exec0, commit0 = total_count(exec_before), total_count(commit_before)
+
+    suite = ecdsa_suite()
+    codec = ABICodec(suite.hash)
+    keypairs = [
+        suite.signature_impl.generate_keypair(secret=0x0B5E + i)
+        for i in range(4)
+    ]
+    cons = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gw = InprocGateway(auto=True)
+    nodes = []
+    for kp in keypairs:
+        node = Node(
+            NodeConfig(genesis=GenesisConfig(consensus_nodes=list(cons))),
+            keypair=kp,
+        )
+        gw.connect(node.front)
+        nodes.append(node)
+
+    fac = TransactionFactory(suite)
+    sender = suite.signature_impl.generate_keypair(secret=0x0B5E99)
+    txs = [
+        fac.create_signed(
+            sender,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500,
+            nonce=f"obs-{i}",
+            to=DAG_TRANSFER_ADDRESS,
+            input=codec.encode_call("userAdd(string,uint256)", f"o{i}", 1),
+        )
+        for i in range(8)
+    ]
+    entry = nodes[0]
+    results = entry.txpool.submit_batch(txs)
+    assert all(r.status == 0 for r in results)
+    entry.tx_sync.maintain()
+    idx = nodes[0].pbft_config.leader_index(1, 0)
+    leader = next(
+        nd
+        for nd in nodes
+        if nd.node_id == nodes[0].pbft_config.nodes[idx].node_id
+    )
+    assert leader.sealer.seal_and_submit()
+    assert all(nd.block_number() == 1 for nd in nodes)
+
+    # histograms moved (every node executes + commits, so >= 4 each)
+    assert total_count(exec_before) >= exec0 + 4
+    assert total_count(commit_before) >= commit0 + 4
+    # mtail bucket contract on the rendered exposition
+    text = REGISTRY.render()
+    for family in (
+        "fisco_block_execute_latency_ms",
+        "fisco_block_commit_latency_ms",
+    ):
+        for edge in ("0", "50", "100", "150", "+Inf"):
+            assert f'{family}_bucket{{le="{edge}"}}' in text
+
+    # the trace shows the pipeline: admission -> seal -> PBFT phases ->
+    # execute -> commit, with the ledger commit nested in the checkpoint
+    names = {r.name for r in TRACER.spans()}
+    assert {
+        "txpool.submit_batch",
+        "seal",
+        "pbft.pre_prepare",
+        "pbft.prepare",
+        "pbft.commit",
+        "pbft.checkpoint",
+        "scheduler.execute_block",
+        "scheduler.commit_block",
+    } <= names
+    nested = [
+        r
+        for r in TRACER.spans()
+        if r.name == "scheduler.commit_block"
+        and r.parent == "pbft.checkpoint_commit"
+    ]
+    assert nested, "ledger commit should nest under the checkpoint span"
