@@ -104,6 +104,7 @@ def build_node(opts: ChainOptions):
     )
     gw.connect(node.front)
     from .observability import TRACER
+    from .resilience import HEALTH
     from .rpc.group_manager import GroupManager, MultiGroupRpc
     from .utils.metrics import bind_node_metrics
 
@@ -118,6 +119,7 @@ def build_node(opts: ChainOptions):
         ssl_context=rpc_ssl,
         metrics=bind_node_metrics(node),
         tracer=TRACER,
+        health=HEALTH,
     )
     ws = None
     if opts.ws_listen_port:

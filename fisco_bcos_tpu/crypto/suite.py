@@ -174,6 +174,55 @@ def use_native_batch(n: int) -> bool:
     return 0 < n and (n < _SMALL_BATCH or device_backend_is_cpu())
 
 
+# -- device-path circuit breaker (resilience/) -------------------------------
+
+_DEVICE_BREAKER = None
+
+
+def _device_breaker():
+    """Breaker over the compiled device batch plane. It can fail in the
+    field — accelerator tunnel loss, device OOM on an oversized trace, a
+    driver hiccup — and consensus must keep verifying: each failure falls
+    back to the host loop for THAT batch, and repeated failures trip the
+    breaker so admission stops paying a doomed device dispatch before every
+    fallback. /health reports `device-crypto` degraded while tripped; a
+    half-open probe re-closes it when the device plane answers again."""
+    global _DEVICE_BREAKER
+    if _DEVICE_BREAKER is None:
+        from ..resilience import CircuitBreaker
+
+        _DEVICE_BREAKER = CircuitBreaker(
+            "device-crypto", failure_threshold=2, reset_timeout=60.0,
+            critical=False,  # the host loop keeps serving: slower, not down
+        )
+    return _DEVICE_BREAKER
+
+
+def _device_or_host(device_fn, host_fn, *args):
+    """Run the compiled device path under the breaker, degrading to the
+    bit-identical host loop. The failure only counts against the breaker
+    when the host retry of the SAME args succeeds — a data error (bad
+    shape/dtype) re-raises from the host path without tripping anything,
+    so one malformed batch cannot demote a healthy device plane."""
+    breaker = _device_breaker()
+    if not breaker.allow():
+        return host_fn(*args)
+    try:
+        out = device_fn(*args)
+    except Exception as e:
+        try:
+            out = host_fn(*args)
+        except BaseException:
+            # both paths failed: a data error, not a device verdict — free
+            # the half-open probe slot or the breaker wedges
+            breaker.release_probe()
+            raise
+        breaker.record_failure(f"{type(e).__name__}: {str(e)[:200]}")
+        return out
+    breaker.record_success()
+    return out
+
+
 class SignatureCrypto:
     """Signature interface (reference: Signature.h:31-58) + batch extension.
 
@@ -381,7 +430,38 @@ class Secp256k1Crypto(SignatureCrypto):
             )
             if out is not None:
                 return np.asarray(out, dtype=bool)
-        return secp_ops.verify_batch(hashes, sigs[:, :32], sigs[:, 32:64], pubs)
+        return _device_or_host(
+            secp_ops.verify_batch, self._host_verify_loop,
+            hashes, sigs[:, :32], sigs[:, 32:64], pubs,
+        )
+
+    def _host_verify_loop(self, hashes, rs, ss, pubs) -> np.ndarray:
+        """Degraded-mode fallback: per-item verify on the host (native C or
+        pure-Python ref) — slow but bit-identical in outcome."""
+        return np.array(
+            [
+                self.verify(
+                    bytes(pubs[i]),
+                    bytes(hashes[i]),
+                    bytes(rs[i]) + bytes(ss[i]) + b"\x00",
+                )
+                for i in range(len(hashes))
+            ],
+            dtype=bool,
+        )
+
+    def _host_recover_loop(self, hashes, sigs):
+        n = len(sigs)
+        pubs = np.zeros((n, 64), dtype=np.uint8)
+        ok = np.zeros(n, dtype=bool)
+        for i in range(n):
+            try:
+                pub = self.recover(bytes(hashes[i]), bytes(sigs[i]))
+            except ValueError:
+                continue
+            pubs[i] = np.frombuffer(pub, dtype=np.uint8)
+            ok[i] = True
+        return pubs, ok
 
     def batch_recover(self, msg_hashes, sigs):
         sigs = np.asarray(sigs, dtype=np.uint8)
@@ -403,7 +483,9 @@ class Secp256k1Crypto(SignatureCrypto):
                 ok = np.asarray(oks, dtype=bool)
                 pubs[~ok] = 0
                 return pubs, ok
-        return secp_ops.recover_batch(hashes, sigs)
+        return _device_or_host(
+            secp_ops.recover_batch, self._host_recover_loop, hashes, sigs
+        )
 
 
 class SM2Crypto(SignatureCrypto):
@@ -493,7 +575,24 @@ class SM2Crypto(SignatureCrypto):
             )
             if out is not None:
                 return out
-        return sm2_ops.verify_batch(hashes, sigs[:, :32], sigs[:, 32:64], pubs)
+        return _device_or_host(
+            sm2_ops.verify_batch, self._host_verify_loop,
+            hashes, sigs[:, :32], sigs[:, 32:64], pubs,
+        )
+
+    def _host_verify_loop(self, hashes, rs, ss, pubs) -> np.ndarray:
+        """Degraded-mode fallback: per-item SM2 verify on the host."""
+        return np.array(
+            [
+                self.verify(
+                    bytes(pubs[i]),
+                    bytes(hashes[i]),
+                    bytes(rs[i]) + bytes(ss[i]) + bytes(pubs[i]),
+                )
+                for i in range(len(hashes))
+            ],
+            dtype=bool,
+        )
 
     def batch_recover(self, msg_hashes, sigs):
         sigs = np.asarray(sigs, dtype=np.uint8)
@@ -506,7 +605,15 @@ class SM2Crypto(SignatureCrypto):
             if ok is not None:
                 out = np.where(ok[:, None], pubs, np.zeros_like(pubs))
                 return out, ok
-        return sm2_ops.recover_batch(hashes, sigs)
+
+        def _host_recover(hashes_, sigs_):
+            pubs_ = sigs_[:, 64:128]
+            ok_ = self._host_verify_loop(
+                hashes_, sigs_[:, :32], sigs_[:, 32:64], pubs_
+            )
+            return np.where(ok_[:, None], pubs_, np.zeros_like(pubs_)), ok_
+
+        return _device_or_host(sm2_ops.recover_batch, _host_recover, hashes, sigs)
 
 
 # ---------------------------------------------------------------------------

@@ -47,11 +47,14 @@ import time
 import zlib
 
 from ..front.front import FrontService, GatewayInterface
+from ..resilience import faults
 from ..utils.log import get_logger
 from .router import MAX_DISTANCE, RouterTable
 from .tls import NODE_ID_URI_SCHEME
 
 _log = get_logger("gateway")
+
+faults.ensure_env_plan()
 
 _COMPRESS_THRESHOLD = 1024
 _MAX_FRAME = 128 * 1024 * 1024
@@ -111,6 +114,8 @@ class _Peer:
     def __init__(self, sock: socket.socket, addr):
         self.sock = sock
         self.addr = addr
+        # fault-plan scope: rules target a peer link by remote endpoint
+        self.scope = f"gw:{addr[0]}:{addr[1]}"
         self.node_id: bytes | None = None
         self.wlock = threading.Lock()
         # failure detection (Service::heartBeat analog)
@@ -130,7 +135,16 @@ class _Peer:
             pass
 
     def send(self, frame: bytes) -> bool:
+        plan = faults._PLAN
         try:
+            if plan is not None:
+                chunks, kill = plan.on_send(self.scope, frame)
+                with self.wlock:
+                    for c in chunks:
+                        self.sock.sendall(c)
+                if kill:
+                    raise faults.InjectedFault(f"injected kill at {self.scope}")
+                return True
             with self.wlock:
                 self.sock.sendall(frame)
             return True
@@ -251,6 +265,9 @@ class TcpGateway(GatewayInterface):
     def connect_peer(self, host: str, port: int) -> bool:
         """Dial a peer (the static nodes list of config.ini [p2p])."""
         try:
+            plan = faults._PLAN
+            if plan is not None:
+                plan.on_connect(f"gw:{host}:{port}")
             sock = socket.create_connection((host, port), timeout=5)
             if self._cli_ssl is not None:
                 sock = self._cli_ssl.wrap_socket(sock)  # mutual-TLS handshake
@@ -428,8 +445,20 @@ class TcpGateway(GatewayInterface):
                 break
             (length,) = struct.unpack("<I", head)
             if not 0 < length <= _MAX_FRAME:
+                _log.warning(
+                    "bad frame header (%d bytes) from %s — dropping peer",
+                    length, peer.scope,
+                )
                 break
             body = self._recv_exact(peer.sock, length)
+            plan = faults._PLAN
+            if plan is not None and body is not None:
+                try:
+                    body = plan.on_recv(peer.scope, body)
+                except faults.InjectedFault:
+                    break
+                if body is None:
+                    continue  # injected frame drop
             if body is None or len(body) < _HDR_LEN + 128:
                 break
             kind, module_id, flags, ttl = struct.unpack(_HDR, body[:_HDR_LEN])

@@ -110,6 +110,12 @@ class Node:
             block_limit=config.block_limit,
             persistent_store=self.storage if durable else None,
         )
+        # degraded-mode registry: seed the components this node owns so
+        # GET /health lists them from boot (unknown != ok for an operator)
+        from ..resilience import HEALTH
+
+        if config.storage_endpoints:
+            HEALTH.ok("storage", "distributed backend mounted")
         self.executor_manager = None
         if config.executor_registry:
             # Max form: stateless executor fleet over the shared storage

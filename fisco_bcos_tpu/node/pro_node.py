@@ -111,9 +111,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.warmup:
         node.warmup(batch_sizes=(args.warmup,))
 
-    # split-mode telemetry: the node core binds its metrics + tracer into
-    # the facade; the RPC process serves them at GET /metrics and /trace
+    # split-mode telemetry: the node core binds its metrics + tracer +
+    # degraded-mode registry into the facade; the RPC process serves them
+    # at GET /metrics, /trace and /health
     from ..observability import TRACER
+    from ..resilience import HEALTH
     from ..utils.metrics import bind_node_metrics
 
     facade = RpcFacade(
@@ -121,6 +123,7 @@ def main(argv: list[str] | None = None) -> int:
         port=args.facade_port,
         metrics=bind_node_metrics(node),
         tracer=TRACER,
+        health=HEALTH,
     )
     facade.start()
 

@@ -203,12 +203,20 @@ def pallas_or_xla(fn_pallas, fn_xla, *args):
         # common base class
         out = fn_xla(*args)  # data errors raise here, latch untouched
         _PALLAS_BROKEN = True
+        from ..resilience import HEALTH
         from ..utils.log import get_logger
 
         get_logger("ops").warning(
             "Pallas kernel failed on this backend (%s: %s) but the XLA path "
             "succeeded; using XLA for the rest of this process",
             type(e).__name__, str(e)[:300],
+        )
+        # the latch is permanent for this process: report it so /health
+        # shows the node running on the (slower) XLA leg — informational
+        # (critical=False), the node serves correctly throughout
+        HEALTH.degrade(
+            "device-pallas", f"kernel latched off ({type(e).__name__})",
+            critical=False,
         )
         return out
 

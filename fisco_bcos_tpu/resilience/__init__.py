@@ -1,0 +1,42 @@
+"""Resilience subsystem: fault injection, retry/deadline semantics, circuit
+breakers and degraded-mode reporting (ISSUE 2 tentpole).
+
+Three pieces:
+
+- :mod:`.faults` — a deterministic, seedable fault-injection layer
+  (``FaultPlan``: drop/delay/duplicate/truncate frames, refuse connects,
+  kill a connection after N messages) hooked into the service-RPC socket
+  layer and the gateway TCP transport. Enabled only via the
+  ``FISCO_FAULT_PLAN`` env spec or explicit ``install_fault_plan`` — one
+  global pointer read per frame when disabled.
+- :mod:`.retry` — ``RetryPolicy`` (capped exponential backoff +
+  deterministic jitter), ``Deadline`` (per-call budgets,
+  ``DeadlineExceeded``) and the idempotency classification per service-RPC
+  method that gates automatic retries.
+- :mod:`.breaker` — ``CircuitBreaker`` (closed/open/half-open) and the
+  process-wide ``HEALTH`` :class:`~.breaker.HealthRegistry` served at
+  ``GET /health`` and exported as ``fisco_component_health`` gauges.
+
+The reference analogs are tars heartbeat/reconnect loops, the
+TarsRemoteExecutorManager reaper and TiKVStorage's switch handler — see
+docs/resilience.md for the knob-by-knob mapping.
+"""
+
+from __future__ import annotations
+
+from .breaker import HEALTH, CircuitBreaker, HealthRegistry  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    active_plan,
+    clear_fault_plan,
+    install_fault_plan,
+)
+from .retry import (  # noqa: F401
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    is_idempotent,
+    mark_idempotent,
+)

@@ -1,0 +1,279 @@
+"""Deterministic fault injection for the service-RPC / gateway transports.
+
+Reference role: the chaos harness the reference project exercises with
+external tooling (killing tars servants, dropping TCP links between
+microservices) — here it is a first-class, *seedable* layer so the test
+suite can provoke executor loss, shard loss and network flaps on demand and
+get the same failure sequence on every run.
+
+A :class:`FaultPlan` is a list of rules. Each rule names an *action*
+(``drop``/``delay``/``duplicate``/``truncate``/``refuse``/``kill``), a
+*site* (``connect``/``send``/``recv``/``*``) and a *target* substring
+matched against the transport's scope string (service clients use
+``"host:port"``, servers ``"svc:<name>"``, the gateway ``"gw:<port>"``), so
+one plan can flap a single storage shard while everything else runs clean.
+
+Determinism: probabilistic rules (``p < 1``) draw from one
+``random.Random(seed)`` owned by the plan, and counters (``after``/
+``count``) are per-rule — the same plan replayed over the same traffic
+produces the same fault sequence. (Under multi-threaded traffic the
+*interleaving* is the scheduler's; tests that need strict determinism keep
+the faulted path single-threaded, which all the RPC client paths are.)
+
+Activation: transports check :data:`_PLAN` (one global read per frame —
+zero overhead when ``None``). It is set either explicitly
+(:func:`install_fault_plan`, tests) or from the ``FISCO_FAULT_PLAN``
+environment spec parsed once at transport import (:func:`ensure_env_plan`):
+
+    FISCO_FAULT_PLAN="seed=7;drop@recv:42001,p=0.5,count=3;refuse@connect:executor"
+
+Spec grammar: ``;``-separated clauses; ``seed=N`` may appear once; every
+other clause is ``action@site:target[,key=val...]`` with keys ``p`` (float
+probability), ``count`` (max firings), ``after`` (pass N matching events
+first), ``ms`` (delay milliseconds), ``keep`` (truncate: bytes kept).
+
+Injected failures surface as :class:`InjectedFault`, an ``OSError``
+subclass — every transport already treats ``OSError`` as connection loss,
+so the fault layer needs no special-casing in the error paths it tests.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from ..utils.log import get_logger
+
+_log = get_logger("faults")
+
+
+class InjectedFault(OSError):
+    """A deliberately injected transport failure (subclasses OSError so the
+    existing connection-loss handling absorbs it unchanged)."""
+
+
+_ACTIONS = ("drop", "delay", "duplicate", "truncate", "refuse", "kill")
+_SITES = ("connect", "send", "recv", "*")
+
+
+class FaultRule:
+    """One match-and-act rule. See module docstring for the fields."""
+
+    __slots__ = (
+        "action", "site", "target", "p", "count", "after",
+        "delay_ms", "keep", "fired", "seen",
+    )
+
+    def __init__(
+        self,
+        action: str,
+        site: str = "*",
+        target: str = "*",
+        p: float = 1.0,
+        count: int | None = None,
+        after: int = 0,
+        delay_ms: float = 10.0,
+        keep: int = 2,
+    ):
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}")
+        if site not in _SITES:
+            raise ValueError(f"unknown fault site {site!r}")
+        self.action = action
+        self.site = site
+        self.target = target
+        self.p = float(p)
+        self.count = count  # None = unlimited firings
+        self.after = int(after)  # pass this many matching events first
+        self.delay_ms = float(delay_ms)
+        self.keep = int(keep)  # truncate: wire bytes that still go out
+        self.fired = 0
+        self.seen = 0
+
+    def matches(self, site: str, scope: str) -> bool:
+        if self.site != "*" and self.site != site:
+            return False
+        return self.target == "*" or self.target in scope
+
+    def __repr__(self) -> str:  # debuggability of CI failures
+        return (
+            f"FaultRule({self.action}@{self.site}:{self.target}"
+            f" p={self.p} count={self.count} after={self.after})"
+        )
+
+
+class FaultPlan:
+    """A seeded set of fault rules plus the firing state.
+
+    Hook surface (called by the transports):
+
+    - :meth:`on_connect` — may raise (refuse).
+    - :meth:`on_send` — returns ``(chunks, kill)``: the wire chunks to
+      actually send (empty = drop, two = duplicate, truncated prefix =
+      torn write) and whether to kill the connection afterwards.
+    - :meth:`on_recv` — returns the (possibly truncated) body, ``None``
+      to drop it, or raises to kill the connection.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rules: list[FaultRule] = []
+        self._lock = threading.Lock()
+        self.injected = 0  # total faults fired (assertable in tests)
+
+    # -- building ------------------------------------------------------------
+
+    def add(self, rule: FaultRule) -> "FaultPlan":
+        self._rules.append(rule)
+        return self
+
+    def rule(self, action: str, site: str = "*", target: str = "*", **kw) -> "FaultPlan":
+        return self.add(FaultRule(action, site, target, **kw))
+
+    def drop(self, site: str = "*", target: str = "*", **kw):
+        return self.rule("drop", site, target, **kw)
+
+    def delay(self, site: str = "*", target: str = "*", **kw):
+        return self.rule("delay", site, target, **kw)
+
+    def duplicate(self, site: str = "*", target: str = "*", **kw):
+        return self.rule("duplicate", site, target, **kw)
+
+    def truncate(self, site: str = "*", target: str = "*", **kw):
+        return self.rule("truncate", site, target, **kw)
+
+    def refuse_connect(self, target: str = "*", **kw):
+        return self.rule("refuse", "connect", target, **kw)
+
+    def kill_after(self, n: int, site: str = "*", target: str = "*", **kw):
+        """Let n matching messages through, then kill the connection."""
+        return self.rule("kill", site, target, after=n, **kw)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse the ``FISCO_FAULT_PLAN`` environment grammar."""
+        plan = cls()
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if clause.startswith("seed="):
+                plan.seed = int(clause[5:])
+                plan._rng = random.Random(plan.seed)
+                continue
+            head, _, tail = clause.partition(",")
+            action, _, rest = head.partition("@")
+            site, _, target = rest.partition(":")
+            kw: dict = {}
+            if tail:
+                for pair in tail.split(","):
+                    k, _, v = pair.partition("=")
+                    k = k.strip()
+                    if k == "p":
+                        kw["p"] = float(v)
+                    elif k in ("count", "after", "keep"):
+                        kw[k] = int(v)
+                    elif k == "ms":
+                        kw["delay_ms"] = float(v)
+                    else:
+                        raise ValueError(f"unknown fault key {k!r} in {clause!r}")
+            plan.add(FaultRule(action.strip(), site.strip() or "*", target or "*", **kw))
+        return plan
+
+    # -- firing --------------------------------------------------------------
+
+    def _fire(self, site: str, scope: str) -> FaultRule | None:
+        """The first rule that matches AND fires for this event."""
+        with self._lock:
+            for r in self._rules:
+                if not r.matches(site, scope):
+                    continue
+                r.seen += 1
+                if r.seen <= r.after:
+                    continue
+                if r.count is not None and r.fired >= r.count:
+                    continue
+                if r.p < 1.0 and self._rng.random() >= r.p:
+                    continue
+                r.fired += 1
+                self.injected += 1
+                _log.info("fault %s fired at %s:%s", r, site, scope)
+                return r
+        return None
+
+    def on_connect(self, scope: str) -> None:
+        r = self._fire("connect", scope)
+        if r is not None and r.action in ("refuse", "kill", "drop"):
+            raise InjectedFault(f"injected connect refusal to {scope}")
+        if r is not None and r.action == "delay":
+            time.sleep(r.delay_ms / 1e3)
+
+    def on_send(self, scope: str, wire: bytes) -> tuple[list[bytes], bool]:
+        r = self._fire("send", scope)
+        if r is None:
+            return [wire], False
+        if r.action == "drop":
+            return [], False
+        if r.action == "delay":
+            time.sleep(r.delay_ms / 1e3)
+            return [wire], False
+        if r.action == "duplicate":
+            return [wire, wire], False
+        if r.action == "truncate":
+            # a torn write: part of the frame goes out, then the link dies —
+            # what a crashed peer mid-sendall looks like from the other end
+            return [wire[: r.keep]], True
+        # kill / refuse at the send site: connection dies before the write
+        return [], True
+
+    def on_recv(self, scope: str, body: bytes) -> bytes | None:
+        r = self._fire("recv", scope)
+        if r is None:
+            return body
+        if r.action == "drop":
+            return None
+        if r.action == "delay":
+            time.sleep(r.delay_ms / 1e3)
+            return body
+        if r.action == "truncate":
+            return body[: r.keep]
+        if r.action == "duplicate":
+            return body  # duplication is a send-side concept; pass through
+        raise InjectedFault(f"injected {r.action} on recv at {scope}")
+
+
+# -- global activation (one pointer read on the transport hot paths) ---------
+
+_PLAN: FaultPlan | None = None
+_env_checked = False
+
+
+def install_fault_plan(plan: FaultPlan | None) -> None:
+    """Explicit injection (tests / tools). ``None`` clears."""
+    global _PLAN
+    _PLAN = plan
+
+
+def clear_fault_plan() -> None:
+    install_fault_plan(None)
+
+
+def active_plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def ensure_env_plan() -> None:
+    """Install the ``FISCO_FAULT_PLAN`` plan once, if the env asks for one.
+    Called at transport import; a missing/empty var costs one getenv per
+    process lifetime and the hot path stays a single global read."""
+    global _env_checked, _PLAN
+    if _env_checked:
+        return
+    _env_checked = True
+    spec = os.environ.get("FISCO_FAULT_PLAN")
+    if spec:
+        _PLAN = FaultPlan.from_spec(spec)
+        _log.warning("fault plan active from FISCO_FAULT_PLAN: %s", spec)

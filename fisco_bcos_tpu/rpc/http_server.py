@@ -29,13 +29,16 @@ class RpcHttpServer:
         ssl_context=None,
         metrics=None,
         tracer=None,
+        health=None,
     ):
         self.impl = impl
         # `metrics` needs .render() -> str; `tracer` needs .export_json() ->
-        # str — satisfied by MetricsRegistry/Tracer in-process and by the
+        # str; `health` needs .to_json() -> str — satisfied by
+        # MetricsRegistry/Tracer/HealthRegistry in-process and by the
         # RemoteTelemetry proxy in the split (Pro/Max) deployment
         self.metrics = metrics
         self.tracer = tracer
+        self.health = health
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -65,6 +68,7 @@ class RpcHttpServer:
                 self.wfile.write(data)
 
             def do_GET(self) -> None:  # noqa: N802 — telemetry scrape
+                code = 200
                 if self.path == "/metrics" and outer.metrics is not None:
                     data = outer.metrics.render().encode()
                     ctype = "text/plain; version=0.0.4"
@@ -72,11 +76,24 @@ class RpcHttpServer:
                     # Chrome trace-event JSON — load in Perfetto as-is
                     data = outer.tracer.export_json().encode()
                     ctype = "application/json"
+                elif self.path == "/health" and outer.health is not None:
+                    # degraded-mode registry (resilience.HEALTH or the
+                    # split-mode RemoteTelemetry proxy). 503 ONLY on
+                    # "critical" (not ready: probes should pull the node);
+                    # "degraded" still answers 200 — the node is serving
+                    # through fallbacks and the JSON body carries the detail
+                    data = outer.health.to_json().encode()
+                    ctype = "application/json"
+                    try:
+                        if json.loads(data).get("status") == "critical":
+                            code = 503
+                    except ValueError:
+                        code = 503
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

@@ -28,12 +28,44 @@ from ..codec.flat import FlatReader, FlatWriter
 from ..protocol.block_header import BlockHeader
 from ..protocol.receipt import TransactionReceipt
 from ..protocol.transaction import Transaction
+from ..resilience import HEALTH, Deadline, RetryPolicy
 from ..storage.interfaces import TwoPCParams
 from ..utils.log import get_logger
 from .executor_service import RemoteExecutor, RemoteShard
-from .rpc import ServiceClient, ServiceRemoteError, ServiceServer
+from .rpc import (
+    ServiceClient,
+    ServiceConnectionError,
+    ServiceRemoteError,
+    ServiceServer,
+)
 
 _log = get_logger("remote-exec-manager")
+
+# health-registry component for the whole fleet (GET /health)
+_FLEET = "executor-fleet"
+
+# one quick in-place retry for idempotent calls: a transient connection blip
+# (GC pause, accept-queue hiccup) heals by redial without nuking the term;
+# a genuinely dead executor still fails in <1s and falls through to
+# mark_dead. Non-idempotent calls (execute/DMC) NEVER retry in place — the
+# request may have half-applied, so the only safe recovery is the term
+# switch + full re-execution the scheduler already drives.
+_READ_RETRY = RetryPolicy(max_attempts=2, base_delay=0.05, max_delay=0.25)
+
+
+def _guarded(manager: "RemoteExecutorManager", member: "_Member", fn, *args,
+             idempotent: bool = False):
+    """THE executor-RPC failure contract (replaces four copies of the same
+    ad-hoc except block): classified retry for idempotent calls, then
+    mark-dead + typed re-raise so the block driver re-executes against the
+    survivors (SchedulerManager::asyncSwitchTerm analog)."""
+    try:
+        if idempotent:
+            return _READ_RETRY.run(fn, *args, retry_on=(ServiceConnectionError,))
+        return fn(*args)
+    except (ServiceRemoteError, OSError) as e:
+        manager.mark_dead(member.name)
+        raise ServiceRemoteError(f"executor {member.name} failed: {e}") from e
 
 
 class _Member:
@@ -163,6 +195,8 @@ class RemoteExecutorManager:
                 "executor %s registered at %s:%d seq=%d (%d live)",
                 name, host, port, seq, len(self._members),
             )
+        # a (re)joined executor ends the fleet's degraded episode
+        HEALTH.ok(_FLEET, f"{name} joined")
         self._bump()
 
     # -- liveness ------------------------------------------------------------
@@ -179,7 +213,15 @@ class RemoteExecutorManager:
             for n in stale:
                 _log.warning("executor %s heartbeat stale: dropping", n)
                 self._members.pop(n).close()
+            left = len(self._members)
         if stale:
+            # with survivors the fleet keeps executing (critical=False —
+            # reduced capacity, still serving); an EMPTY fleet cannot, and
+            # /health must answer 503 until an executor registers
+            HEALTH.degrade(
+                _FLEET, f"heartbeat lost: {','.join(stale)} ({left} live)",
+                critical=(left == 0),
+            )
             self._bump()
         return bool(stale)
 
@@ -191,7 +233,12 @@ class RemoteExecutorManager:
             if m is not None:
                 _log.warning("executor %s marked dead after RPC failure", name)
                 m.close()
+            left = len(self._members)
         if m is not None:
+            HEALTH.degrade(
+                _FLEET, f"{name} failed an RPC ({left} live)",
+                critical=(left == 0),
+            )
             self._bump()
 
     def _bump(self) -> None:
@@ -231,9 +278,9 @@ class RemoteExecutorManager:
     def wait_for_executors(self, n: int = 1, timeout: float = 30.0) -> None:
         """Block until at least n executors registered
         (TarsRemoteExecutorManager::waitForExecutorConnection)."""
-        deadline = time.monotonic() + timeout
+        deadline = Deadline.after(timeout)
         while self.size < n:
-            if time.monotonic() > deadline:
+            if deadline.expired():
                 raise RuntimeError(
                     f"only {self.size}/{n} executors connected after {timeout}s"
                 )
@@ -259,13 +306,9 @@ class _ShardGuard:
         member, manager = self._member, self._manager
 
         def wrapped(*a, **kw):
-            try:
-                return attr(*a, **kw)
-            except (ServiceRemoteError, OSError) as e:
-                manager.mark_dead(member.name)
-                raise ServiceRemoteError(
-                    f"executor {member.name} failed: {e}"
-                ) from e
+            # DMC traffic is never idempotent (messages move state between
+            # shards) — fail fast into the term switch
+            return _guarded(manager, member, lambda: attr(*a, **kw))
 
         return wrapped
 
@@ -291,29 +334,26 @@ class CompositeRemoteExecutor:
 
     # -- helpers -------------------------------------------------------------
 
-    def _fanout(self, fn, *args):
+    def _fanout(self, fn, *args, idempotent: bool = False):
         out = []
         for m in self.manager.members():
-            try:
-                out.append((m, fn(m, *args)))
-            except (ServiceRemoteError, OSError) as e:
-                self.manager.mark_dead(m.name)
-                raise ServiceRemoteError(f"executor {m.name} failed: {e}") from e
+            out.append(
+                (m, _guarded(self.manager, m, fn, m, *args, idempotent=idempotent))
+            )
         return out
 
-    def _on_member(self, m: _Member, fn, *args):
-        try:
-            return fn(*args)
-        except (ServiceRemoteError, OSError) as e:
-            self.manager.mark_dead(m.name)
-            raise ServiceRemoteError(f"executor {m.name} failed: {e}") from e
+    def _on_member(self, m: _Member, fn, *args, idempotent: bool = False):
+        return _guarded(self.manager, m, fn, *args, idempotent=idempotent)
 
     # -- executor surface ----------------------------------------------------
 
     def next_block_header(self, header: BlockHeader, gas_limit: int = 3_000_000_000) -> None:
         self._header = header
         self._gas_limit = gas_limit
-        self._fanout(lambda m: m.executor.next_block_header(header, gas_limit))
+        self._fanout(
+            lambda m: m.executor.next_block_header(header, gas_limit),
+            idempotent=True,  # re-opening the same header is a reset, not a mutation
+        )
 
     def replay_block_header(self) -> None:
         """Re-open the current block on the (possibly changed) fleet after a
@@ -356,7 +396,7 @@ class CompositeRemoteExecutor:
         """XOR of per-executor dirty-set roots — ownership partitions are
         disjoint, so the combined root is order-independent (the same
         combiner the single-process state root uses across shards)."""
-        roots = self._fanout(lambda m: m.executor.get_hash())
+        roots = self._fanout(lambda m: m.executor.get_hash(), idempotent=True)
         out = bytes(32)
         for _m, r in roots:
             out = bytes(a ^ b for a, b in zip(out, r))
@@ -368,21 +408,21 @@ class CompositeRemoteExecutor:
 
     def call(self, tx: Transaction) -> TransactionReceipt:
         m = self.manager._member_of(tx.to)
-        return self._on_member(m, m.executor.call, tx)
+        return self._on_member(m, m.executor.call, tx, idempotent=True)
 
     def get_code(self, addr: bytes) -> bytes:
         m = self.manager._member_of(addr)
-        return self._on_member(m, m.executor.get_code, addr)
+        return self._on_member(m, m.executor.get_code, addr, idempotent=True)
 
     def get_abi(self, addr: bytes) -> bytes:
         m = self.manager._member_of(addr)
-        return self._on_member(m, m.executor.get_abi, addr)
+        return self._on_member(m, m.executor.get_abi, addr, idempotent=True)
 
     def known_callee(self, addr: bytes, storage=None) -> bool:
         """The owner executor answers (registry precompiles, EVM builtins,
         deployed code) — same admission semantics as the in-process form."""
         m = self.manager._member_of(addr)
-        return self._on_member(m, m.executor.known_callee, addr)
+        return self._on_member(m, m.executor.known_callee, addr, idempotent=True)
 
     # -- 2PC -----------------------------------------------------------------
 
@@ -392,15 +432,16 @@ class CompositeRemoteExecutor:
         # rows from every member would double-write the 2PC slot
         first = True
         for m in self.manager.members():
-            try:
-                m.executor.prepare(params, extra_writes if first else None)
-            except (ServiceRemoteError, OSError) as e:
-                self.manager.mark_dead(m.name)
-                raise ServiceRemoteError(f"executor {m.name} failed: {e}") from e
+            # 2PC verbs are idempotent by design (keyed on block number)
+            _guarded(
+                self.manager, m,
+                m.executor.prepare, params, extra_writes if first else None,
+                idempotent=True,
+            )
             first = False
 
     def commit(self, params: TwoPCParams) -> None:
-        self._fanout(lambda m: m.executor.commit(params))
+        self._fanout(lambda m: m.executor.commit(params), idempotent=True)
 
     def rollback(self, params: TwoPCParams) -> None:
-        self._fanout(lambda m: m.executor.rollback(params))
+        self._fanout(lambda m: m.executor.rollback(params), idempotent=True)

@@ -11,6 +11,16 @@ the framework's flat codec:
 Servers dispatch method -> handler(payload bytes) -> payload bytes; the
 client is synchronous (one in-flight pipeline per connection, matching how
 the scheduler drives an executor).
+
+Resilience contract (resilience/): protocol violations raise TYPED errors
+(:class:`BadFrame`/:class:`FrameTooLarge`) instead of surfacing as silent
+``None`` frames; the client separates connect and recv timeouts, honors
+per-call :class:`~fisco_bcos_tpu.resilience.retry.Deadline` budgets, and —
+when built with a :class:`~fisco_bcos_tpu.resilience.retry.RetryPolicy` —
+auto-retries *classified-idempotent* methods across redials with capped
+exponential backoff. The fault-injection layer
+(:mod:`fisco_bcos_tpu.resilience.faults`) hooks the connect/send/recv
+seams; with no plan installed each hook is one global pointer read.
 """
 
 from __future__ import annotations
@@ -22,11 +32,36 @@ import threading
 from typing import Callable
 
 from ..codec.flat import FlatReader, FlatWriter
+from ..resilience import faults
+from ..resilience.retry import Deadline, RetryPolicy, is_idempotent
 from ..utils.log import get_logger
 
 _log = get_logger("service-rpc")
 
+faults.ensure_env_plan()
+
 _MAX_FRAME = 256 * 1024 * 1024
+
+
+class ServiceRemoteError(RuntimeError):
+    pass
+
+
+class ServiceConnectionError(ServiceRemoteError):
+    """Transport-level loss (dial failed / connection dropped) as a TYPE:
+    failover seams (storage switch handler, limiter fallback) key on this
+    class, never on message text — a remote handler error whose text happens
+    to mention connections must not trip a term switch."""
+
+
+class BadFrame(ServiceRemoteError):
+    """A wire-protocol violation (undecodable frame, desynced reply id) —
+    the connection is poisoned and gets dropped, but the caller learns WHY
+    instead of seeing a silent ``None``."""
+
+
+class FrameTooLarge(BadFrame):
+    """A frame header larger than ``_MAX_FRAME`` (corruption or abuse)."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -42,18 +77,43 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
     return buf
 
 
-def _send_frame(sock: socket.socket, body: bytes) -> None:
-    sock.sendall(struct.pack("<I", len(body)) + body)
+def _send_frame(sock: socket.socket, body: bytes, scope: str = "") -> None:
+    wire = struct.pack("<I", len(body)) + body
+    plan = faults._PLAN
+    if plan is not None:
+        chunks, kill = plan.on_send(scope, wire)
+        for c in chunks:
+            sock.sendall(c)
+        if kill:
+            raise faults.InjectedFault(f"injected connection kill at {scope}")
+        return
+    sock.sendall(wire)
 
 
-def _recv_frame(sock: socket.socket) -> bytes | None:
+def _recv_frame(sock: socket.socket, scope: str = "") -> bytes | None:
+    """One framed body; ``None`` on orderly/connection loss; raises
+    :class:`FrameTooLarge` on an over-limit header and :class:`BadFrame` on
+    a zero-length one (both logged — the old behavior silently returned
+    ``None`` and the caller could not tell corruption from a peer close)."""
     head = _recv_exact(sock, 4)
     if head is None:
         return None
     (n,) = struct.unpack("<I", head)
-    if not 0 < n <= _MAX_FRAME:
+    if n > _MAX_FRAME:
+        _log.warning("frame header %d exceeds cap %d at %s", n, _MAX_FRAME, scope)
+        raise FrameTooLarge(f"frame of {n} bytes exceeds {_MAX_FRAME} cap")
+    if n == 0:
+        _log.warning("zero-length frame at %s", scope)
+        raise BadFrame("zero-length frame")
+    body = _recv_exact(sock, n)
+    if body is None:
         return None
-    return _recv_exact(sock, n)
+    plan = faults._PLAN
+    if plan is not None:
+        body = plan.on_recv(scope, body)  # may drop/truncate/raise
+        if body is None:
+            return None
+    return body
 
 
 class ServiceServer:
@@ -68,6 +128,8 @@ class ServiceServer:
         self._listener.listen(16)
         self.host, self.port = self._listener.getsockname()
         self._stop = threading.Event()
+        # fault-plan scope: rules target a servant by name or by port
+        self._scope = f"svc:{name}:{self.port}"
         # one lock: service handlers mutate shared state (executor block
         # context, storage), and tars servants are effectively serialized too
         self._dispatch_lock = threading.Lock()
@@ -127,14 +189,30 @@ class ServiceServer:
     def _serve(self, sock: socket.socket) -> None:
         self._conns.add(sock)
         while not self._stop.is_set():
-            body = _recv_frame(sock)
+            try:
+                body = _recv_frame(sock, self._scope)
+            except BadFrame as e:
+                # poisoned stream: drop the connection, the client redials
+                _log.warning("service %s: %s — dropping connection", self.name, e)
+                break
+            except OSError:
+                break
             if body is None:
                 break
-            r = FlatReader(body)
-            req_id = r.u64()
-            method = r.str_()
-            payload = r.bytes_()
-            r.done()
+            try:
+                r = FlatReader(body)
+                req_id = r.u64()
+                method = r.str_()
+                payload = r.bytes_()
+                r.done()
+            except Exception as e:
+                # an undecodable REQUEST frame desyncs the pipeline: typed
+                # log + connection drop (was: thread death with no trace)
+                _log.warning(
+                    "service %s: bad request frame (%s) — dropping connection",
+                    self.name, e,
+                )
+                break
             w = FlatWriter()
             w.u64(req_id)
             fn = self._methods.get(method)
@@ -150,7 +228,7 @@ class ServiceServer:
                 w.u8(0)
                 w.bytes_(str(e).encode())
             try:
-                _send_frame(sock, w.out())
+                _send_frame(sock, w.out(), self._scope)
             except OSError:
                 break
         self._conns.discard(sock)
@@ -160,26 +238,36 @@ class ServiceServer:
             pass
 
 
-class ServiceRemoteError(RuntimeError):
-    pass
-
-
-class ServiceConnectionError(ServiceRemoteError):
-    """Transport-level loss (dial failed / connection dropped) as a TYPE:
-    failover seams (storage switch handler, limiter fallback) key on this
-    class, never on message text — a remote handler error whose text happens
-    to mention connections must not trip a term switch."""
-
-
 class ServiceClient:
     """Self-healing: a transport failure poisons only the CURRENT call —
     the broken socket is discarded and the next call redials, so a service
     restart (gateway/rpc/executor process bounce) heals without restarting
-    every client process (tars proxies reconnect the same way)."""
+    every client process (tars proxies reconnect the same way).
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+    ``timeout`` bounds each recv (a hung servant surfaces as a typed
+    connection error instead of a wedged caller); ``connect_timeout``
+    bounds the dial separately (a dead endpoint refuses in milliseconds, a
+    blackholed one in seconds — not the full IO budget). With ``retry``
+    set, calls to classified-idempotent methods (resilience.retry) survive
+    transient connection loss via redial + capped exponential backoff; a
+    per-call ``deadline`` caps the whole retry loop AND the socket waits.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 60.0,
+        connect_timeout: float = 5.0,
+        retry: RetryPolicy | None = None,
+        scope: str | None = None,
+    ):
         self._addr = (host, port)
         self._timeout = timeout
+        self._connect_timeout = min(connect_timeout, timeout)
+        self._retry = retry
+        # fault-plan scope: rules target a client by endpoint
+        self._scope = scope or f"{host}:{port}"
         # LAZY dial: the first call connects. Constructing a client of a
         # not-yet-/currently-down service must not crash the mounting
         # process — every caller with a failover path (gateway limiter,
@@ -196,36 +284,90 @@ class ServiceClient:
                 pass
             self.sock = None
 
-    def call(self, method: str, payload: bytes = b"") -> bytes:
+    def call(
+        self,
+        method: str,
+        payload: bytes = b"",
+        deadline: Deadline | None = None,
+    ) -> bytes:
+        """One request/response exchange. Auto-retries connection loss for
+        idempotent methods when the client has a RetryPolicy; every attempt
+        (and every backoff sleep) stays inside ``deadline`` when given."""
+        policy = self._retry
+        if policy is None or not is_idempotent(method):
+            return self._call_once(method, payload, deadline)
+        # BadFrame retries too: a corrupt/desynced stream was already
+        # dropped, so the re-attempt starts from a clean redial
+        return policy.run(
+            self._call_once,
+            method,
+            payload,
+            deadline,
+            retry_on=(ServiceConnectionError, BadFrame),
+            deadline=deadline,
+        )
+
+    def _call_once(
+        self, method: str, payload: bytes, deadline: Deadline | None = None
+    ) -> bytes:
+        scope = f"{self._scope}/{method}"
+        if deadline is not None:
+            deadline.check(method)
         with self._lock:
             if self.sock is None:
                 try:
-                    self.sock = socket.create_connection(
-                        self._addr, timeout=self._timeout
-                    )
+                    plan = faults._PLAN
+                    if plan is not None:
+                        plan.on_connect(self._scope)
+                    dial = self._connect_timeout
+                    if deadline is not None:
+                        dial = deadline.clamp(dial)
+                    self.sock = socket.create_connection(self._addr, timeout=dial)
+                    # the dial timeout must not linger as the IO timeout
+                    self.sock.settimeout(self._timeout)
                 except OSError as e:
                     raise ServiceConnectionError(f"{method}: reconnect failed: {e}")
+            if deadline is not None:
+                # bound this exchange by what is left of the call budget
+                self.sock.settimeout(deadline.clamp(self._timeout))
             req_id = next(self._ids)
             w = FlatWriter()
             w.u64(req_id)
             w.str_(method)
             w.bytes_(payload)
+            bad: BadFrame | None = None
             try:
-                _send_frame(self.sock, w.out())
-                body = _recv_frame(self.sock)
+                _send_frame(self.sock, w.out(), scope)
+                body = _recv_frame(self.sock, scope)
+            except BadFrame as e:
+                body, bad = None, e
             except OSError:
                 body = None
             if body is None:
                 self._drop_sock()
+            elif deadline is not None:
+                self.sock.settimeout(self._timeout)  # restore for next call
+        if bad is not None:
+            raise bad
         if body is None:
             raise ServiceConnectionError(f"{method}: connection lost")
-        r = FlatReader(body)
-        got_id = r.u64()
-        ok = r.u8()
-        out = r.bytes_()
-        r.done()
+        try:
+            r = FlatReader(body)
+            got_id = r.u64()
+            ok = r.u8()
+            out = r.bytes_()
+            r.done()
+        except Exception as e:
+            with self._lock:
+                self._drop_sock()  # reply stream is garbage: resync by redial
+            raise BadFrame(f"{method}: undecodable reply ({e})")
         if got_id != req_id:
-            raise ServiceRemoteError(f"{method}: response id mismatch")
+            # a stale reply (e.g. a duplicated request's second answer) has
+            # desynced the pipeline; drop the socket so the next call starts
+            # from a clean stream instead of shifting every reply by one
+            with self._lock:
+                self._drop_sock()
+            raise BadFrame(f"{method}: response id mismatch")
         if not ok:
             raise ServiceRemoteError(f"{method}: {out.decode(errors='replace')}")
         return out

@@ -31,15 +31,19 @@ class RpcFacade:
 
     def __init__(
         self, impl, host: str = "127.0.0.1", port: int = 0, metrics=None,
-        tracer=None,
+        tracer=None, health=None,
     ):
         self.impl = impl
         self.metrics = metrics
         self.tracer = tracer
+        # degraded-mode registry (resilience.HEALTH shape: .to_json());
+        # served to the RPC process for GET /health
+        self.health = health
         self.server = ServiceServer("rpc-facade", host, port)
         self.server.register("handle", self._handle)
         self.server.register("metrics", self._metrics)
         self.server.register("trace", self._trace)
+        self.server.register("health", self._health)
         self.host, self.port = self.server.host, self.server.port
 
     def start(self) -> None:
@@ -59,6 +63,11 @@ class RpcFacade:
         if self.tracer is None:
             return b'{"traceEvents": []}'
         return self.tracer.export_json().encode()
+
+    def _health(self, _payload: bytes) -> bytes:
+        if self.health is None:
+            return b'{"status": "ok", "components": {}}'
+        return self.health.to_json().encode()
 
 
 class RemoteJsonRpc:
@@ -109,6 +118,25 @@ class RemoteTelemetry:
         except Exception:
             return '{"traceEvents": []}'
 
+    def to_json(self) -> str:
+        """Health JSON for GET /health. An unreachable node core IS a
+        degraded deployment — report it as such instead of erroring."""
+        try:
+            return self.client.call("health").decode()
+        except Exception as e:
+            return json.dumps(
+                {
+                    "status": "critical",  # no node core = not serving
+                    "components": {
+                        "node-core": {
+                            "status": "degraded",
+                            "reason": f"facade unreachable: {e}",
+                            "critical": True,
+                        }
+                    },
+                }
+            )
+
     def close(self) -> None:
         self.client.close()
 
@@ -128,6 +156,7 @@ class RpcService:
         ssl_context=None,
         metrics=None,
         tracer=None,
+        health=None,
     ):
         from ..rpc.http_server import RpcHttpServer
 
@@ -137,6 +166,7 @@ class RpcService:
             self.remote, host=host, port=port, ssl_context=ssl_context,
             metrics=metrics if metrics is not None else self.telemetry,
             tracer=tracer if tracer is not None else self.telemetry,
+            health=health if health is not None else self.telemetry,
         )
         self.port = self.http.port
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Iterator
 
 from ..codec.flat import FlatReader, FlatWriter
+from ..resilience import RetryPolicy
 from ..storage.entry import Entry
 from ..storage.interfaces import (
     TransactionalStorage,
@@ -20,6 +21,11 @@ from ..storage.interfaces import (
     TwoPCParams,
 )
 from .rpc import ServiceClient, ServiceConnectionError, ServiceServer
+
+# every storage verb is idempotent (blind puts + number-keyed 2PC), so a
+# transient shard blip heals inside the call instead of surfacing as a term
+# switch; a genuinely dead shard exhausts ~0.2s of backoff and still raises
+_STORAGE_RETRY = dict(max_attempts=3, base_delay=0.05, max_delay=0.5)
 
 
 class StorageService:
@@ -129,12 +135,34 @@ class RemoteStorage(TransactionalStorage):
     """
 
     def __init__(self, host: str, port: int, timeout: float = 60.0):
-        self.client = ServiceClient(host, port, timeout)
+        self.client = ServiceClient(
+            host,
+            port,
+            timeout,
+            connect_timeout=min(3.0, timeout),
+            retry=RetryPolicy(**_STORAGE_RETRY),
+        )
         self.switch_handler = None  # callable() | None
+        self.heal_handler = None  # callable() | None — outage-episode END
         self._outage = False
 
     def set_switch_handler(self, fn) -> None:
         self.switch_handler = fn
+
+    def set_heal_handler(self, fn) -> None:
+        """Fires once per outage episode, on the first successful call after
+        the loss — the degraded→ok edge (tars reconnect's 'alive again')."""
+        self.heal_handler = fn
+
+    def _healed(self) -> None:
+        if self._outage:
+            self._outage = False
+            handler = self.heal_handler
+            if handler is not None:
+                try:
+                    handler()
+                except Exception:
+                    pass  # reporting must never break the storage path
 
     def _call(self, method: str, payload: bytes = b"") -> bytes:
         try:
@@ -153,9 +181,9 @@ class RemoteStorage(TransactionalStorage):
             # a reply frame arrived — the transport healed, so the outage
             # episode is over even though the HANDLER failed; otherwise the
             # next real outage would be silently swallowed
-            self._outage = False
+            self._healed()
             raise
-        self._outage = False
+        self._healed()
         return out
 
     def get_row(self, table: str, key: bytes) -> Entry | None:

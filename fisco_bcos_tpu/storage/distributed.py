@@ -32,7 +32,8 @@ from __future__ import annotations
 import hashlib
 from typing import Iterator
 
-from ..service.rpc import ServiceConnectionError
+from ..resilience import HEALTH
+from ..service.rpc import ServiceConnectionError, ServiceRemoteError
 from ..service.storage_service import RemoteStorage
 from ..storage.entry import Entry
 from ..storage.interfaces import (
@@ -56,26 +57,48 @@ class _RowsView(TraversableStorage):
 class DistributedStorage(TransactionalStorage):
     """TransactionalStorage over N sharded StorageService endpoints."""
 
+    # health-registry component for the whole backend (GET /health)
+    _COMPONENT = "storage"
+
     def __init__(self, endpoints: list[tuple[str, int]], timeout: float = 60.0):
         if not endpoints:
             raise ValueError("DistributedStorage needs at least one endpoint")
         self.shards = [RemoteStorage(h, p, timeout) for h, p in endpoints]
         self.switch_handler = None
-        for sh in self.shards:
+        self._down: set[int] = set()  # shard idxs in a live outage episode
+        # rollback re-drive ledger: number -> task idxs that could not be
+        # reached when the number was declared dead (shard idx, or -1 for
+        # the primary's witness retirement). A revived shard must re-run
+        # these before any witness-based roll-forward, or it could
+        # resurrect a dead block number.
+        self._rolled_back: dict[int, set[int]] = {}
+        for i, sh in enumerate(self.shards):
             # every shard loss funnels into ONE switch seam; RemoteStorage
-            # dedups per-shard episodes, this layer just forwards
-            sh.set_switch_handler(self._on_shard_loss)
+            # dedups per-shard episodes, this layer scopes them by index
+            sh.set_switch_handler(lambda i=i: self._on_shard_loss(i))
+            sh.set_heal_handler(lambda i=i: self._on_shard_heal(i))
 
     def set_switch_handler(self, fn) -> None:
         self.switch_handler = fn
 
-    def _on_shard_loss(self) -> None:
+    def _on_shard_loss(self, idx: int) -> None:
+        self._down.add(idx)
+        HEALTH.degrade(
+            self._COMPONENT,
+            f"shard {idx} unreachable ({len(self.shards) - len(self._down)}"
+            f"/{len(self.shards)} up)",
+        )
         # an outage can strand prepared-but-unresolved slots: arm the
         # recovery pass so the next 2PC op resolves them before new work
         self.mark_needs_recovery()
         handler = self.switch_handler
         if handler is not None:
             handler()
+
+    def _on_shard_heal(self, idx: int) -> None:
+        self._down.discard(idx)
+        if not self._down:
+            HEALTH.ok(self._COMPONENT, f"shard {idx} back, all shards up")
 
     # -- routing ------------------------------------------------------------
 
@@ -125,6 +148,10 @@ class DistributedStorage(TransactionalStorage):
         # recovery may freely resolve params.number here: we are about to
         # RE-stage it, so an abandoned old slot rolling back is the point
         self.recover_in_flight_if_needed()
+        # a re-prepare supersedes an earlier dead-number declaration: the
+        # slot (and witness) about to be staged belong to the NEW decision,
+        # so a leftover re-drive task must not kill them later
+        self._rolled_back.pop(params.number, None)
         parts: dict[int, list] = {i: [] for i in range(len(self.shards))}
         for t, k, e in writes.traverse():
             parts[self.shard_of(t, k)].append((t, k, e))
@@ -177,18 +204,29 @@ class DistributedStorage(TransactionalStorage):
                 # a shard is still down: stay armed, retry on next 2PC op
                 self._needs_recovery = True
                 raise
+            if self._rolled_back:
+                # some dead-number re-drives still face unreachable shards:
+                # stay armed so the next 2PC op tries again
+                self._needs_recovery = True
 
     def recover_in_flight(self, exclude: int | None = None) -> None:
         """Resolve prepared-but-unresolved slots left by a crash/outage
         between phases: a slot whose number has the primary's commit
         witness rolls FORWARD (the coordinator had passed the point of no
         return), anything else rolls back — then consensus re-drives the
-        block (TiKVStorage.cpp:582's switch handler + lock resolution)."""
+        block (TiKVStorage.cpp:582's switch handler + lock resolution).
+
+        Numbers explicitly declared dead by :meth:`rollback` while some
+        shards were unreachable are re-driven FIRST and never roll forward
+        off a stale witness — a revived shard cannot resurrect them."""
+        self._retry_unresolved_rollbacks(exclude=exclude)
         pending: set[int] = set()
         for sh in self.shards:
             pending.update(sh.pending_numbers())
         pending.discard(exclude)  # the caller owns that number's decision
         for n in sorted(pending):
+            if n in self._rolled_back:
+                continue  # declared dead; its re-drive is still unreachable
             witness = self.shards[0].get_row(
                 self._WITNESS_TABLE, self._witness_key(n)
             )
@@ -202,28 +240,65 @@ class DistributedStorage(TransactionalStorage):
                 for sh in self.shards:
                     sh.rollback(params)
 
-    def rollback(self, params: TwoPCParams) -> None:
-        errs = 0
-        for sh in self.shards:
-            try:
-                sh.rollback(params)
-            except ServiceConnectionError:
-                errs += 1  # a dead shard has nothing durable to roll back
-        # an explicit rollback declares the number DEAD: retire any witness
-        # a partial commit attempt may have left, or a later crash would
-        # roll a never-decided re-prepare forward off the stale marker
-        try:
-            from .entry import EntryStatus
+    def _retry_unresolved_rollbacks(self, exclude: int | None = None) -> None:
+        """Re-drive rollbacks that skipped unreachable shards (the recorded
+        skip set), so a revived shard's stale slot/witness dies before it
+        can influence witness-based recovery."""
+        for n in sorted(self._rolled_back):
+            if n == exclude:
+                continue  # the caller is re-deciding this number right now
+            _log.warning("re-driving rollback of block %d on revived shards", n)
+            self.rollback(TwoPCParams(number=n))
 
-            self.shards[0].set_row(
-                self._WITNESS_TABLE,
-                self._witness_key(params.number),
-                Entry(status=EntryStatus.DELETED),
+    def rollback(self, params: TwoPCParams) -> None:
+        number = params.number
+        # resume from the recorded skip set when this is a re-drive; task
+        # -1 is the primary's witness retirement, ordered FIRST so the
+        # number loses roll-forward eligibility before anything else. The
+        # record is only REPLACED at the end, never popped up front: an
+        # unexpected exception mid-loop must not lose the dead-number
+        # declaration (the whole point of recording it)
+        todo = self._rolled_back.get(number)
+        if todo is None:
+            todo = {-1} | set(range(len(self.shards)))
+        failed: set[int] = set()
+        for idx in sorted(todo):
+            try:
+                if idx < 0:
+                    # an explicit rollback declares the number DEAD: retire
+                    # any witness a partial commit attempt may have left, or
+                    # a later crash would roll a never-decided re-prepare
+                    # forward off the stale marker
+                    from .entry import EntryStatus
+
+                    self.shards[0].set_row(
+                        self._WITNESS_TABLE,
+                        self._witness_key(number),
+                        Entry(status=EntryStatus.DELETED),
+                    )
+                else:
+                    self.shards[idx].rollback(params)
+            except (ServiceRemoteError, OSError):
+                # unreachable OR erroring shard (handler error, corrupt
+                # reply): either way the task did not land — keep it
+                failed.add(idx)
+        if failed:
+            # remember the skip set (was: logged and forgotten — a revived
+            # shard could then resurrect the dead number via its stale
+            # witness/slot) and arm recovery to re-drive it
+            self._rolled_back[number] = failed
+            self.mark_needs_recovery()
+            _log.warning(
+                "rollback of block %d skipped unreachable shard tasks %s — "
+                "recorded for re-drive on recovery", number, sorted(failed),
             )
-        except ServiceConnectionError:
-            errs += 1
-        if errs:
-            _log.warning("rollback skipped %d unreachable shards", errs)
+        else:
+            self._rolled_back.pop(number, None)
+
+    def unresolved_rollbacks(self) -> dict[int, set[int]]:
+        """Observability/test surface: numbers declared dead whose rollback
+        has not yet reached every shard (task -1 = witness retirement)."""
+        return {n: set(s) for n, s in self._rolled_back.items()}
 
     def pending_numbers(self) -> list[int]:
         out: set[int] = set()

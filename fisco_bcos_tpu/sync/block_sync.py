@@ -30,6 +30,13 @@ _log = get_logger("block-sync")
 
 MAX_BLOCKS_PER_REQUEST = 32
 
+# a peer that times out this many requests in a row is demoted: the best-peer
+# choice skips it until it answers again (or every candidate is demoted, in
+# which case the strike board resets — degraded progress beats a stall).
+# Reference: bcos-sync's SyncPeerStatus drops idle peers from the download
+# queue choice rather than re-asking the same silent one forever.
+MAX_PEER_STRIKES = 3
+
 
 class SyncPacket(IntEnum):
     STATUS = 0
@@ -89,7 +96,16 @@ class BlockSync:
         self._peers: dict[bytes, SyncStatus] = {}
         self._requested_to: int = 0
         self._requested_at: float = 0.0
-        self.request_timeout: float = 10.0
+        self._requested_peer: bytes | None = None
+        # ADAPTIVE request timeout (was: fixed 10 s — one slow peer stalled
+        # the download queue for the whole window): per-peer response-time
+        # EWMA drives the decay window, clamped to
+        # [request_timeout_floor, request_timeout]
+        self.request_timeout: float = 10.0  # cap / no-sample default ceiling
+        self.request_timeout_floor: float = 0.5
+        self.request_timeout_initial: float = 2.0  # before any RTT sample
+        self._rtt_ewma: dict[bytes, float] = {}
+        self._strikes: dict[bytes, int] = {}
         # median peer clock tracking (bcos-tool NodeTimeMaintenance)
         from ..utils.time_sync import NodeTimeMaintenance
 
@@ -126,29 +142,76 @@ class BlockSync:
         self.broadcast_status()
         self._request_missing()
 
+    def _timeout_for(self, nid: bytes | None) -> float:
+        """The decay window for an outstanding request to this peer:
+        4x its response-time EWMA, clamped — a fast peer's loss is noticed
+        in under a second instead of the old fixed 10 s."""
+        ewma = self._rtt_ewma.get(nid) if nid is not None else None
+        if ewma is None:
+            return min(self.request_timeout_initial, self.request_timeout)
+        return max(
+            self.request_timeout_floor, min(self.request_timeout, 4.0 * ewma)
+        )
+
     def _request_missing(self) -> None:
         import time as _time
 
         my_number = self.ledger.block_number()
         with self._lock:
-            best = None
-            for nid, st in self._peers.items():
-                if st.genesis_hash != self._genesis_hash:
-                    continue
-                if st.number > my_number and (best is None or st.number > best[1].number):
-                    best = (nid, st)
-            if best is None:
-                return
-            nid, st = best
-            start = my_number + 1
             now = _time.monotonic()
-            if self._requested_to >= start:
-                # an unanswered request must not stall sync forever: decay it
-                if now - self._requested_at < self.request_timeout:
+            if self._requested_to >= my_number + 1:
+                # an unanswered request must not stall sync forever: decay
+                # it on the ADAPTIVE window and demote the silent peer
+                if now - self._requested_at < self._timeout_for(self._requested_peer):
                     return
+                # ABANDON the request before anything else: one lost
+                # request strikes exactly once — idle ticks with no better
+                # peer must not keep re-striking (and re-counting) it
+                lag = self._requested_peer
+                window = self._timeout_for(lag)
+                self._requested_to = 0
+                self._requested_at = 0.0
+                self._requested_peer = None
+                if lag is not None and lag in self._peers:
+                    strikes = self._strikes.get(lag, 0) + 1
+                    self._strikes[lag] = strikes
+                    _log.warning(
+                        "peer %s missed a block request (%.2fs window, "
+                        "strike %d/%d)", lag.hex()[:8],
+                        window, strikes, MAX_PEER_STRIKES,
+                    )
+                    from ..utils.metrics import REGISTRY
+
+                    REGISTRY.counter_add(
+                        "fisco_sync_request_timeouts_total", 1.0,
+                        help="block requests abandoned on the adaptive window",
+                    )
+            candidates = [
+                (nid, st)
+                for nid, st in self._peers.items()
+                if st.genesis_hash == self._genesis_hash and st.number > my_number
+            ]
+            if not candidates:
+                return
+            healthy = [
+                c for c in candidates
+                if self._strikes.get(c[0], 0) < MAX_PEER_STRIKES
+            ]
+            if not healthy:
+                # every candidate is demoted: reset the board and take the
+                # whole set again — degraded progress beats a stall
+                _log.warning(
+                    "all %d sync candidates demoted — resetting strikes",
+                    len(candidates),
+                )
+                self._strikes.clear()
+                healthy = candidates
+            nid, st = max(healthy, key=lambda c: c[1].number)
+            start = my_number + 1
             count = min(st.number - my_number, MAX_BLOCKS_PER_REQUEST)
             self._requested_to = start + count - 1
             self._requested_at = now
+            self._requested_peer = nid
         _log.info("requesting blocks [%d, %d) from %s", start, start + count, nid.hex()[:8])
         self.front.send_message(ModuleID.BLOCK_SYNC, nid, _encode_request(start, count))
 
@@ -181,6 +244,8 @@ class BlockSync:
             dead = [nid for nid in self._peers if nid not in live]
             for nid in dead:
                 del self._peers[nid]
+                self._strikes.pop(nid, None)
+                self._rtt_ewma.pop(nid, None)
         for nid in dead:
             self.time_maintenance.remove_peer(nid)
 
@@ -204,6 +269,21 @@ class BlockSync:
             self.front.send_message(ModuleID.BLOCK_SYNC, src, _encode_response(blocks))
 
     def _on_response(self, src: bytes, raw_blocks: list[bytes]) -> None:
+        import time as _time
+
+        with self._lock:
+            # an answer redeems the peer and feeds the adaptive window; the
+            # outstanding-request markers are consumed HERE so a duplicate
+            # or late second response cannot record a bogus RTT sample
+            if src == self._requested_peer and self._requested_at:
+                rtt = max(1e-3, _time.monotonic() - self._requested_at)
+                prev = self._rtt_ewma.get(src)
+                self._rtt_ewma[src] = (
+                    rtt if prev is None else 0.7 * prev + 0.3 * rtt
+                )
+                self._requested_peer = None
+                self._requested_at = 0.0
+                self._strikes.pop(src, None)
         applied = 0
         for raw in raw_blocks:
             try:

@@ -1,0 +1,233 @@
+"""2PC recovery under deterministic, injected shard loss (ISSUE 2).
+
+The fault plan (resilience/faults.py) provokes the failure modes the
+reference survives via TiKV lock resolution (TiKVStorage.cpp 2PC + switch
+handler): a shard killed mid-prepare, mid-commit, and a rollback racing an
+unreachable shard — all previously unreachable by the test suite because
+nothing could make a shard fail at a CHOSEN point in the protocol.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from fisco_bcos_tpu.resilience import FaultPlan, clear_fault_plan, install_fault_plan  # noqa: E402
+from fisco_bcos_tpu.service import StorageService  # noqa: E402
+from fisco_bcos_tpu.service.rpc import ServiceRemoteError  # noqa: E402
+from fisco_bcos_tpu.storage import MemoryStorage  # noqa: E402
+from fisco_bcos_tpu.storage.distributed import DistributedStorage  # noqa: E402
+from fisco_bcos_tpu.storage.entry import Entry  # noqa: E402
+from fisco_bcos_tpu.storage.interfaces import TwoPCParams  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    clear_fault_plan()
+    yield
+    clear_fault_plan()
+
+
+@pytest.fixture()
+def cluster():
+    backings = [MemoryStorage() for _ in range(3)]
+    svcs = [StorageService(b) for b in backings]
+    for s in svcs:
+        s.start()
+    dist = DistributedStorage([(s.host, s.port) for s in svcs], timeout=3.0)
+    yield backings, svcs, dist
+    clear_fault_plan()
+    for s in svcs:
+        s.stop()
+
+
+class _Writes:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def traverse(self):
+        yield from self.rows
+
+
+def _rows(tag, n=24):
+    return [("t", b"%s%02d" % (tag, i), Entry().set(b"v%d" % i)) for i in range(n)]
+
+
+def test_prepare_then_kill_rolls_back(cluster):
+    """A shard dies DURING the prepare fan-out: no witness ever lands, so
+    recovery rolls every prepared slot back and nothing becomes visible."""
+    backings, svcs, dist = cluster
+    rows = _rows(b"pk")
+    # kill every frame to shard 2's prepare servant (retry attempts
+    # included: count is unlimited), leaving shards 0/1 prepared
+    plan = FaultPlan(seed=11).rule("kill", "send", f"{svcs[2].port}/prepare")
+    install_fault_plan(plan)
+    with pytest.raises(ServiceRemoteError):
+        dist.prepare(TwoPCParams(number=5), _Writes(rows))
+    assert plan.injected >= 1
+    assert backings[0].pending_numbers() == [5]  # primary staged + witness slot
+    clear_fault_plan()
+
+    # the shard-loss switch armed recovery; the next 2PC op resolves it
+    dist.recover_in_flight_if_needed()
+    for _t, k, _e in rows:
+        assert dist.get_row("t", k) is None
+    for b in backings:
+        assert b.pending_numbers() == []
+
+
+def test_commit_then_kill_rolls_forward(cluster):
+    """A shard dies DURING the commit fan-out, after the primary committed
+    (witness durable): recovery must roll the straggler FORWARD."""
+    backings, svcs, dist = cluster
+    rows = _rows(b"ck")
+    params = TwoPCParams(number=7)
+    dist.prepare(params, _Writes(rows))
+    install_fault_plan(
+        FaultPlan(seed=12).rule("kill", "send", f"{svcs[2].port}/commit")
+    )
+    with pytest.raises(ServiceRemoteError):
+        dist.commit(params)
+    clear_fault_plan()
+    assert backings[2].pending_numbers() == [7]  # the straggler
+
+    dist.recover_in_flight_if_needed()
+    for _t, k, e in rows:
+        got = dist.get_row("t", k)
+        assert got is not None and got.get() == e.get(), k
+    for b in backings:
+        assert b.pending_numbers() == []
+
+
+def test_rollback_with_unreachable_shard_cannot_resurrect(cluster):
+    """The satellite scenario: an explicit rollback that cannot reach the
+    primary (whose stale commit witness survives) must RECORD the skipped
+    work and re-drive it on recovery — a revived shard, or a later
+    recovery pass, must not roll the dead number forward off the stale
+    witness."""
+    backings, svcs, dist = cluster
+    rows = _rows(b"rs")
+    params = TwoPCParams(number=9)
+    dist.prepare(params, _Writes(rows))
+    # partial commit: ONLY the primary (witness becomes durable) — the
+    # coordinator then abandons the number and rolls it back
+    backings[0].commit(params)
+
+    # the primary is unreachable for the whole rollback fan-out
+    install_fault_plan(FaultPlan(seed=13).rule("kill", "send", f":{svcs[0].port}/"))
+    dist.rollback(params)
+    clear_fault_plan()
+    # the skipped work was recorded, not forgotten: witness retirement (-1)
+    # and the primary's own rollback (shard 0)
+    assert dist.unresolved_rollbacks() == {9: {-1, 0}}
+    # the stale witness is still durable on the primary
+    assert backings[0].get_row("s_2pc_witness", b"commit-9") is not None
+
+    # shard 0 "revives" (plan cleared); recovery re-drives the rollback
+    # FIRST, so the stale witness dies before it can roll anything forward
+    dist.mark_needs_recovery()
+    dist.recover_in_flight_if_needed()
+    assert dist.unresolved_rollbacks() == {}
+    assert backings[0].get_row("s_2pc_witness", b"commit-9") is None
+    for b in backings:
+        assert b.pending_numbers() == []
+
+
+def test_stale_witness_cannot_commit_a_reprepared_block(cluster):
+    """The full resurrect chain the fix prevents: dead number 9's witness
+    survives an unreachable-primary rollback; the chain re-prepares height
+    9; a crash before the new commit must roll the NEW slot BACK (the old
+    witness belongs to the dead decision, not the new one)."""
+    backings, svcs, dist = cluster
+    params = TwoPCParams(number=9)
+    dist.prepare(params, _Writes(_rows(b"w1")))
+    backings[0].commit(params)  # witness durable
+    install_fault_plan(FaultPlan(seed=14).rule("kill", "send", f":{svcs[0].port}/"))
+    dist.rollback(params)  # primary unreachable: witness survives, recorded
+    clear_fault_plan()
+
+    # chain re-drives height 9 (prepare re-runs the recorded rollback first)
+    new_rows = _rows(b"w2")
+    dist.prepare(params, _Writes(new_rows))
+    assert dist.unresolved_rollbacks() == {}
+    # crash before commit: recovery must NOT find the stale witness
+    dist.mark_needs_recovery()
+    dist.recover_in_flight_if_needed()
+    for _t, k, _e in new_rows:
+        assert dist.get_row("t", k) is None  # rolled BACK, not resurrected
+    for b in backings:
+        assert b.pending_numbers() == []
+
+
+def test_rolled_back_record_survives_handler_errors(cluster):
+    """Regression: a re-drive that hits a non-connection shard error (an
+    error REPLY, not a transport loss) must keep the dead-number record —
+    popping it up front would silently drop the witness-retirement task."""
+    backings, svcs, dist = cluster
+    params = TwoPCParams(number=4)
+    dist.prepare(params, _Writes(_rows(b"he")))
+    backings[0].commit(params)  # witness durable
+    install_fault_plan(FaultPlan(seed=21).rule("kill", "send", f":{svcs[0].port}/"))
+    dist.rollback(params)  # primary unreachable: {-1, 0} recorded
+    clear_fault_plan()
+    assert dist.unresolved_rollbacks() == {4: {-1, 0}}
+
+    # the re-drive now hits an ERROR REPLY (truncate the request so the
+    # servant drops the connection — surfaces as a remote/transport error
+    # that is NOT a clean success) — the record must survive, not vanish
+    install_fault_plan(
+        FaultPlan(seed=22).truncate("send", f":{svcs[0].port}/", keep=2)
+    )
+    dist.rollback(params)
+    clear_fault_plan()
+    assert 4 in dist.unresolved_rollbacks()
+
+    # once the shard truly heals, the re-drive completes and clears it
+    dist.rollback(params)
+    assert dist.unresolved_rollbacks() == {}
+    assert backings[0].get_row("s_2pc_witness", b"commit-4") is None
+
+
+def test_injected_faults_are_deterministic_across_runs():
+    """ISSUE 2 acceptance: the same seeded plan over the same traffic fires
+    the same faults — two full scenario runs produce identical injection
+    counts and per-rule firing sequences."""
+
+    def run_once():
+        backings = [MemoryStorage() for _ in range(3)]
+        svcs = [StorageService(b) for b in backings]
+        for s in svcs:
+            s.start()
+        dist = DistributedStorage([(s.host, s.port) for s in svcs], timeout=3.0)
+        plan = FaultPlan(seed=99)
+        # a flaky (p=0.5) reply-drop on shard 1 plus a hard kill on shard
+        # 2's commit: both seeded, both counted
+        plan.drop("recv", f"{svcs[1].port}/get_row", p=0.5)
+        plan.rule("kill", "send", f"{svcs[2].port}/commit", count=2)
+        install_fault_plan(plan)
+        outcomes = []
+        for i in range(12):
+            try:
+                dist.get_row("t", b"k%02d" % i)
+                outcomes.append("ok")
+            except ServiceRemoteError:
+                outcomes.append("err")
+        params = TwoPCParams(number=3)
+        try:
+            dist.prepare(params, _Writes(_rows(b"dt", 6)))
+            dist.commit(params)
+            outcomes.append("commit-ok")
+        except ServiceRemoteError:
+            outcomes.append("commit-err")
+        clear_fault_plan()
+        fired = [(r.action, r.fired) for r in plan._rules]
+        injected = plan.injected
+        for s in svcs:
+            s.stop()
+        return outcomes, fired, injected
+
+    a = run_once()
+    b = run_once()
+    assert a == b
+    assert a[2] >= 1  # the plan actually fired
