@@ -95,7 +95,10 @@ M_LATENCY = ("block_verify_latency_ms_10k", "ms")
 M_SM2 = ("sm2_batch_verify_per_s_10k", "sig/s")
 M_MERKLE = ("merkle_root_10k_leaves_ms", "ms")
 M_FLOOD = ("e2e_flood_tps", "tx/s")
-ALL_METRICS = [M_SECP, M_LATENCY, M_SM2, M_MERKLE, M_FLOOD]
+# requests per merged device dispatch during the flood (1.0 = no coalescing
+# won; baseline is the plane-less per-caller dispatch, i.e. exactly 1.0)
+M_COALESCE = ("device_plane_coalesce_ratio", "reqs/dispatch")
+ALL_METRICS = [M_SECP, M_LATENCY, M_SM2, M_MERKLE, M_FLOOD, M_COALESCE]
 
 
 _EMITTED: set[str] = set()
@@ -488,7 +491,33 @@ def bench_flood() -> None:
     if len(tips) != 1 or len(roots) != 1:
         err = err or "replicas diverged during measured round"
     tps = committed / dt
+    # recompile counts ride along so the next BENCH round can attribute the
+    # e2e gap: with the plane on, a ragged flood must stay within the bucket
+    # ladder instead of compiling one program per batch size
+    from fisco_bcos_tpu.device.plane import get_plane, plane_enabled
+    from fisco_bcos_tpu.observability.device import compile_counts
+
+    print(
+        "# flood device compiles per op (distinct bucketed shapes): "
+        + json.dumps(compile_counts()),
+        flush=True,
+    )
     _emit(M_FLOOD[0], tps, M_FLOOD[1], tps / 10_000.0, error=err)  # vs README.md:10
+    if plane_enabled():
+        plane = get_plane()
+        plane.drain(10.0)
+        ratio = plane.coalesce_ratio()
+        print(
+            f"# device plane: {plane.stats()} wait_p99_ms="
+            f"{plane.wait_p99_ms():.2f}",
+            flush=True,
+        )
+        _emit(M_COALESCE[0], ratio, M_COALESCE[1], ratio, error=err)
+    else:
+        _emit(
+            M_COALESCE[0], 1.0, M_COALESCE[1], 1.0,
+            error="device plane disabled (FISCO_DEVICE_PLANE=0)",
+        )
 
 
 def _dump_telemetry(tag: str) -> None:

@@ -59,7 +59,11 @@ class BlockValidator:
         sigs = np.frombuffer(
             b"".join(s.signature for s in header.signature_list), dtype=np.uint8
         ).reshape(-1, sig_len)
-        ok = self.suite.signature_impl.batch_verify(hashes, pubs, sigs)  # device
+        from ..device.plane import device_lane
+
+        # QC checks gate block sync/commit: consensus lane of the plane
+        with device_lane("consensus"):
+            ok = self.suite.signature_impl.batch_verify(hashes, pubs, sigs)
         if not bool(np.asarray(ok).all()):
             _log.warning("block %d: QC signature verify failed", header.number)
             return False

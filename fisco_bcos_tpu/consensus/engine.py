@@ -401,6 +401,8 @@ class PBFTEngine:
         txs_root recomputed against the device merkle — binding votes to tx
         *content*, not just the hash list.
         """
+        from ..device.plane import device_lane
+
         if block.tx_metadata and not block.transactions:
             fetch = None
             if self.fetch_missing_fn is not None and leader_id is not None:
@@ -414,17 +416,21 @@ class PBFTEngine:
                 return False
             block.transactions = txs  # fill in metadata order
         elif block.transactions and not from_self:
-            # full-tx proposal: device batch admission of carried signatures
-            ok = batch_admit(block.transactions, self.suite)
+            # full-tx proposal: device batch admission of carried signatures,
+            # on the plane's consensus lane (ahead of admission/sync batches)
+            with device_lane("consensus"):
+                ok = batch_admit(block.transactions, self.suite)
             if not bool(ok.all()):
                 return False
             for t in block.transactions:
                 code = self.txpool.validator.check_static(t)
                 if code not in (ErrorCode.SUCCESS, ErrorCode.ALREADY_IN_TX_POOL):
                     return False
-        if block.transactions and block.header.txs_root != block.calculate_txs_root(
-            self.suite
-        ):
+        with device_lane("consensus"):
+            root_ok = not block.transactions or (
+                block.header.txs_root == block.calculate_txs_root(self.suite)
+            )
+        if not root_ok:
             _log.warning("proposal txs_root mismatch at %d", block.header.number)
             return False
         return True

@@ -51,14 +51,14 @@ def admission_core(blocks, nblocks, r, s, v):
 admission_step = jax.jit(admission_core)
 
 
-def _admission_packed(blocks, nblocks, r, s, v):
-    """admission_core with every output PACKED into one uint8 tensor
+def pack_admission_device(addr, ok, qx, qy, z):
+    """Pack the admission outputs into one uint8 tensor
     [B, 117] = addr(20) ‖ ok(1) ‖ pubkey(64) ‖ tx_hash(32): on a tunneled
     device each host fetch is a round trip, so the whole admission result
-    crosses once instead of five times."""
+    crosses once instead of five times. Shared by the single-chip jit and
+    the sharded wrapper (parallel.sharding.sharded_admission_packed)."""
     from ..ops.bigint import limbs_to_bytes_device
 
-    addr, ok, qx, qy, z = admission_core(blocks, nblocks, r, s, v)
     return jnp.concatenate(
         [
             addr.astype(jnp.uint8),
@@ -69,6 +69,10 @@ def _admission_packed(blocks, nblocks, r, s, v):
         ],
         axis=1,
     )
+
+
+def _admission_packed(blocks, nblocks, r, s, v):
+    return pack_admission_device(*admission_core(blocks, nblocks, r, s, v))
 
 
 admission_step_packed = jax.jit(_admission_packed)
@@ -106,6 +110,151 @@ def _admit_batch_native(payloads, sigs65):
     return senders, ok, pubs, digests
 
 
+# -- multi-device fan-out -----------------------------------------------------
+
+_SHARD_CACHE: dict[int, object] = {}
+
+
+def _shard_min() -> int:
+    """Bucketed-batch floor for multi-device fan-out; merged plane batches
+    at/above it shard over the local mesh (parallel/sharding.py). High by
+    default: below ~thousands of lanes one chip is faster than paying the
+    all_gather + an extra compiled program."""
+    try:
+        return int(os.environ.get("FISCO_DEVICE_SHARD_MIN", "4096"))
+    except ValueError:
+        return 4096
+
+
+def _maybe_sharded_step(bb: int):
+    """The cached sharded admission program when the bucketed batch `bb`
+    clears the fan-out threshold on a multi-device mesh; None otherwise
+    (single-chip jit). Mesh construction or compile failure falls back to
+    the single-chip path — fan-out is an optimization, never a liveness
+    dependency."""
+    try:
+        ndev = len(jax.devices())
+        if ndev <= 1 or bb < max(_shard_min(), ndev) or bb % ndev:
+            return None
+        step = _SHARD_CACHE.get(ndev)
+        if step is None:
+            from ..parallel.sharding import make_mesh, sharded_admission_packed
+
+            step = sharded_admission_packed(make_mesh(ndev))
+            _SHARD_CACHE[ndev] = step
+        return step
+    except Exception:
+        return None
+
+
+def _admit_batch_device(
+    payloads, sigs65, allow_shard: bool = False
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The fused device program (keccak → recover → address), one result
+    transfer. `allow_shard=True` (plane dispatches only) fans the bucketed
+    batch out over the local device mesh when it clears _shard_min."""
+    from ..observability.device import device_span
+
+    bsz = len(payloads)
+    # pad_keccak buckets the batch dim itself (empty-message pad rows);
+    # r/s/v follow the blocks tensor's bucket by construction
+    blocks, nblocks = pad_keccak(list(payloads))
+    bb = blocks.shape[0]
+    step = _maybe_sharded_step(bb) if allow_shard else None
+    op = "admission" if step is None else "admission_sharded"
+    with device_span(op, bsz, shape_key=(bb, blocks.shape[1])):
+        sigs65 = np.asarray(sigs65, dtype=np.uint8)
+        r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
+        s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
+        v = pad_rows(sigs65[:, 64].astype(np.int32), bb)
+        if step is None:
+            step = admission_step_packed
+        packed = np.asarray(step(blocks, nblocks, r, s, v))[:bsz]
+        return (
+            packed[:, :20],
+            packed[:, 20] != 0,
+            packed[:, 21:85],
+            packed[:, 85:117],
+        )
+
+
+def _try_native(payloads, sigs65):
+    """The native-host-loop leg when policy picks it; None to use device."""
+    from ..observability.device import device_span
+    from .suite import use_native_batch
+
+    if os.environ.get("FISCO_FORCE_DEVICE_ADMISSION"):
+        return None
+    if not use_native_batch(len(payloads)):
+        return None
+    # native host loop — shape_key pinned so it never reads as
+    # a compile; the op label keeps the dispatch split visible
+    with device_span("admission_native", len(payloads), shape_key="native"):
+        return _admit_batch_native(payloads, np.asarray(sigs65, dtype=np.uint8))
+
+
+def _admit_direct(payloads, sigs65):
+    """Pre-plane per-caller dispatch (the FISCO_DEVICE_PLANE=0 path):
+    native-vs-device decided for THIS call alone — no coalescing, no
+    fan-out, no breaker."""
+    from .suite import _note_dispatch_path
+
+    out = _try_native(payloads, sigs65)
+    if out is not None:
+        _note_dispatch_path("admission", "native")
+        return out
+    _note_dispatch_path("admission", "device")
+    return _admit_batch_device(payloads, sigs65, allow_shard=False)
+
+
+def _admit_merged(payloads, sigs65):
+    """Plane-executor body: the same native-vs-device policy applied to the
+    MERGED batch, with multi-device fan-out allowed and the device leg under
+    the resilience breaker (host-loop fallback keeps admission serving when
+    the device plane is degraded)."""
+    from .suite import _device_or_host, _note_dispatch_path
+
+    out = _try_native(payloads, sigs65)
+    if out is not None:
+        _note_dispatch_path("admission", "native")
+        return out
+    _note_dispatch_path("admission", "device")
+
+    def _host(p, s):
+        host_out = _admit_batch_native(p, np.asarray(s, dtype=np.uint8))
+        if host_out is None:
+            raise RuntimeError("native admission unavailable for host fallback")
+        return host_out
+
+    return _device_or_host(
+        lambda p, s: _admit_batch_device(p, s, allow_shard=True),
+        _host,
+        payloads,
+        sigs65,
+    )
+
+
+def _admission_plane_exec(reqs):
+    """DevicePlane executor: merge every queued admission request (txpool
+    RPC batches, consensus proposal re-verification, sync imports) into one
+    policy decision + one device program, then slice results per request."""
+    payloads: list[bytes] = []
+    rows = []
+    for r in reqs:
+        payloads.extend(r.payload[0])
+        rows.append(r.payload[1])
+    sigs65 = np.concatenate(rows, axis=0)
+    senders, ok, pubs, digests = _admit_merged(payloads, sigs65)
+    senders, ok = np.asarray(senders), np.asarray(ok)
+    pubs, digests = np.asarray(pubs), np.asarray(digests)
+    out, lo = [], 0
+    for r in reqs:
+        hi = lo + r.n
+        out.append((senders[lo:hi], ok[lo:hi], pubs[lo:hi], digests[lo:hi]))
+        lo = hi
+    return out
+
+
 def admit_batch(
     payloads, sigs65
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -114,36 +263,19 @@ def admit_batch(
     tx hashes [B, 32] uint8). One device program, ONE result transfer —
     or the native host loop when that wins (small batch / CPU-only backend;
     crypto.suite.use_native_batch holds the policy).
+
+    Routed through the shared DevicePlane: concurrent callers' batches
+    coalesce into one program, shapes ride the bucket ladder, and oversized
+    merged batches fan out over the device mesh. ``FISCO_DEVICE_PLANE=0``
+    restores the per-caller direct dispatch exactly.
     FISCO_FORCE_DEVICE_ADMISSION=1 pins the device program (tests use it to
     cover the device path on CPU hosts)."""
-    from ..observability.device import device_span
+    from ..device.plane import get_plane, plane_route
 
     bsz = len(payloads)
-    if not os.environ.get("FISCO_FORCE_DEVICE_ADMISSION"):
-        from .suite import use_native_batch
-
-        if use_native_batch(bsz):
-            # native host loop — shape_key pinned so it never reads as
-            # a compile; the op label keeps the dispatch split visible
-            with device_span("admission_native", bsz, shape_key="native"):
-                out = _admit_batch_native(
-                    payloads, np.asarray(sigs65, dtype=np.uint8)
-                )
-            if out is not None:
-                return out
-    # pad_keccak buckets the batch dim itself (empty-message pad rows);
-    # r/s/v follow the blocks tensor's bucket by construction
-    blocks, nblocks = pad_keccak(list(payloads))
-    bb = blocks.shape[0]
-    with device_span("admission", bsz, shape_key=(bb, blocks.shape[1])):
-        sigs65 = np.asarray(sigs65, dtype=np.uint8)
-        r = pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
-        s = pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
-        v = pad_rows(sigs65[:, 64].astype(np.int32), bb)
-        packed = np.asarray(admission_step_packed(blocks, nblocks, r, s, v))[:bsz]
-        return (
-            packed[:, :20],
-            packed[:, 20] != 0,
-            packed[:, 21:85],
-            packed[:, 85:117],
-        )
+    if plane_route() and bsz:
+        sigs_arr = np.asarray(sigs65, dtype=np.uint8)
+        return get_plane().submit(
+            "admission", (list(payloads), sigs_arr), bsz, _admission_plane_exec
+        ).result()
+    return _admit_direct(payloads, sigs65)

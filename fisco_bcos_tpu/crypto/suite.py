@@ -17,7 +17,9 @@ any divergence would fork a chain.
 
 from __future__ import annotations
 
+import os
 import secrets
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -39,24 +41,96 @@ from .ref.sm3 import sm3 as ref_sm3
 # ---------------------------------------------------------------------------
 
 
+def _hash_plane_exec(name: str, batch_async_direct):
+    """Plane executor for one hash op: merge every queued request's messages
+    into ONE bucket-padded device program, dispatch it WITHOUT syncing, and
+    hand each request a slice resolver — queued hash programs from several
+    callers overlap on device before anyone pays the first round trip."""
+
+    def run(reqs):
+        msgs: list[bytes] = []
+        spans = []
+        for r in reqs:
+            spans.append((len(msgs), len(msgs) + r.n))
+            msgs.extend(r.payload)
+        from ..observability.device import device_span
+        from ..ops.hash_common import bucket_batch
+
+        # span covers the dispatch only (the sync happens in the caller's
+        # resolver); the compile counter keys on the batch bucket as usual
+        with device_span(name, len(msgs), shape_key=bucket_batch(max(len(msgs), 1))):
+            resolve = batch_async_direct(msgs)
+        memo: list = []
+        lock = threading.Lock()
+
+        def realize():
+            with lock:
+                if not memo:
+                    memo.append(resolve())
+                return memo[0]
+
+        return [lambda lo=lo, hi=hi: realize()[lo:hi] for lo, hi in spans]
+
+    return run
+
+
 class HashImpl:
-    """Hash interface (reference: bcos-crypto Hash.h:37-60 + AnyHasher)."""
+    """Hash interface (reference: bcos-crypto Hash.h:37-60 + AnyHasher).
+
+    Batch calls route through the shared :class:`~..device.plane.DevicePlane`
+    (coalesced, bucket-padded, priority-laned); ``FISCO_DEVICE_PLANE=0``
+    restores the direct per-caller dispatch. Subclasses implement the
+    ``_batch_direct`` / ``_batch_async_direct`` pair; the plane executor and
+    the passthrough path both go through those, so the two modes cannot
+    diverge.
+    """
 
     name: str = ""
 
     def hash(self, data: bytes) -> bytes:
         raise NotImplementedError
 
+    def _batch_direct(self, msgs) -> np.ndarray:
+        """Direct (non-plane) batch dispatch: one device program."""
+        raise NotImplementedError
+
+    def _batch_async_direct(self, msgs):
+        """Direct deferred-sync dispatch: () -> [B, 32]. Default dispatches
+        eagerly; device-backed impls override with their ops *_batch_async
+        so the plane executor can defer the sync."""
+        out = self._batch_direct(msgs)
+        return lambda: out
+
     def hash_batch(self, msgs) -> np.ndarray:
         """list[bytes] -> [B, 32] uint8 digests, one device program."""
-        raise NotImplementedError
+        msgs = list(msgs)
+        from ..device.plane import plane_route
+
+        if plane_route() and msgs:
+            return self.hash_batch_async(msgs)()
+        return self._batch_direct(msgs)
 
     def hash_batch_async(self, msgs):
         """Dispatch the device batch, defer the sync: () -> [B, 32] uint8.
-        Default runs eagerly; device-backed impls override to let callers
-        queue several hash programs before any round trip."""
-        out = self.hash_batch(msgs)
-        return lambda: out
+
+        Routed through the device plane so concurrent callers' hash
+        programs coalesce AND overlap before the first sync (pre-plane,
+        this default ran eagerly — each caller synced before the next
+        could even dispatch)."""
+        msgs = list(msgs)
+        from ..device.plane import get_plane, plane_route
+
+        if plane_route() and msgs:
+            fut = get_plane().submit(
+                f"hash.{self.name or type(self).__name__}",
+                msgs,
+                len(msgs),
+                _hash_plane_exec(
+                    self.name or type(self).__name__, self._batch_async_direct
+                ),
+            )
+            return lambda: fut.result()()
+        return self._batch_async_direct(msgs)
 
 
 class Keccak256(HashImpl):
@@ -70,10 +144,10 @@ class Keccak256(HashImpl):
 
         return native_bind.keccak256(data) or ref_keccak256(data)
 
-    def hash_batch(self, msgs) -> np.ndarray:
+    def _batch_direct(self, msgs) -> np.ndarray:
         return keccak_ops.keccak256_batch(msgs)
 
-    def hash_batch_async(self, msgs):
+    def _batch_async_direct(self, msgs):
         return keccak_ops.keccak256_batch_async(msgs)
 
 
@@ -85,10 +159,10 @@ class SM3(HashImpl):
 
         return native_bind.sm3(data) or ref_sm3(data)
 
-    def hash_batch(self, msgs) -> np.ndarray:
+    def _batch_direct(self, msgs) -> np.ndarray:
         return sm3_ops.sm3_batch(msgs)
 
-    def hash_batch_async(self, msgs):
+    def _batch_async_direct(self, msgs):
         return sm3_ops.sm3_batch_async(msgs)
 
 
@@ -100,10 +174,10 @@ class Sha256(HashImpl):
 
         return native_bind.sha256(data) or ref_sha256(data)
 
-    def hash_batch(self, msgs) -> np.ndarray:
+    def _batch_direct(self, msgs) -> np.ndarray:
         return sha256_ops.sha256_batch(msgs)
 
-    def hash_batch_async(self, msgs):
+    def _batch_async_direct(self, msgs):
         return sha256_ops.sha256_batch_async(msgs)
 
 
@@ -148,6 +222,38 @@ def _make_keypair(curve: ref_ecdsa.Curve, secret: int | None) -> KeyPair:
 # legs (tests/test_native_ec.py pins it).
 _SMALL_BATCH = 256
 
+
+def device_min_batch() -> int:
+    """Host-vs-device cutover: batches below this ride the native host loop.
+
+    ``FISCO_DEVICE_MIN_BATCH`` overrides the hardcoded default — the right
+    cutover depends on the device round-trip, and a 100ms-RTT tunneled TPU
+    breaks even hundreds of items later than a local accelerator. Read per
+    call (an env read, ~100ns against a batch dispatch) so operators and
+    tests can retune without a restart."""
+    raw = os.environ.get("FISCO_DEVICE_MIN_BATCH")
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    return _SMALL_BATCH
+
+
+def _note_dispatch_path(op: str, path: str) -> None:
+    """Labeled counter of which leg a batch actually took (native host loop
+    vs device program) — the observable form of the `use_native_batch`
+    policy, so a mistuned FISCO_DEVICE_MIN_BATCH shows up in /metrics
+    instead of as a silent latency cliff."""
+    from ..utils.metrics import REGISTRY
+
+    REGISTRY.counter_add(
+        f'fisco_device_dispatch_path_total{{op="{op}",path="{path}"}}',
+        1.0,
+        help="batch dispatches split by chosen leg (native host vs device)",
+    )
+
+
 _BACKEND_IS_CPU: bool | None = None
 
 
@@ -170,8 +276,8 @@ def device_backend_is_cpu() -> bool:
 
 def use_native_batch(n: int) -> bool:
     """Whether an n-item signature batch should ride the native host loop
-    instead of a device program."""
-    return 0 < n and (n < _SMALL_BATCH or device_backend_is_cpu())
+    instead of a device program (threshold: :func:`device_min_batch`)."""
+    return 0 < n and (n < device_min_batch() or device_backend_is_cpu())
 
 
 # -- device-path circuit breaker (resilience/) -------------------------------
@@ -221,6 +327,73 @@ def _device_or_host(device_fn, host_fn, *args):
         return out
     breaker.record_success()
     return out
+
+
+# -- device-plane executors ---------------------------------------------------
+#
+# One executor per (op, merge-convention): each merges every queued request
+# into one batch, runs the impl's merged-batch body (the SAME body the
+# passthrough path uses — the two modes cannot diverge), and slices the
+# result back per request. Executors run on the plane worker with routing
+# disabled, so nested seam calls (ed25519 recover → verify) take the direct
+# path instead of deadlocking the worker.
+
+
+def _verify_plane_exec(impl):
+    """(hashes [n,32], pubs [n,64], sigs [n,L]) ndarray triples -> ok[n]."""
+
+    def run(reqs):
+        hs = np.concatenate([r.payload[0] for r in reqs], axis=0)
+        ps = np.concatenate([r.payload[1] for r in reqs], axis=0)
+        sg = np.concatenate([r.payload[2] for r in reqs], axis=0)
+        ok = np.asarray(impl._verify_merged(hs, ps, sg))
+        out, lo = [], 0
+        for r in reqs:
+            out.append(ok[lo : lo + r.n])
+            lo += r.n
+        return out
+
+    return run
+
+
+def _verify_plane_exec_lists(impl):
+    """Same as :func:`_verify_plane_exec` for list-of-bytes payloads
+    (ed25519's variable-form signatures)."""
+
+    def run(reqs):
+        hs: list[bytes] = []
+        ps: list[bytes] = []
+        sg: list[bytes] = []
+        for r in reqs:
+            h, p, s = r.payload
+            hs += h
+            ps += p
+            sg += s
+        ok = np.asarray(impl._verify_merged(hs, ps, sg))
+        out, lo = [], 0
+        for r in reqs:
+            out.append(ok[lo : lo + r.n])
+            lo += r.n
+        return out
+
+    return run
+
+
+def _recover_plane_exec(impl):
+    """(hashes [n,32], sigs [n,L]) -> (pubs [n,64], ok[n]) per request."""
+
+    def run(reqs):
+        hs = np.concatenate([r.payload[0] for r in reqs], axis=0)
+        sg = np.concatenate([r.payload[1] for r in reqs], axis=0)
+        pubs, ok = impl._recover_merged(hs, sg)
+        pubs, ok = np.asarray(pubs), np.asarray(ok)
+        out, lo = [], 0
+        for r in reqs:
+            out.append((pubs[lo : lo + r.n], ok[lo : lo + r.n]))
+            lo += r.n
+        return out
+
+    return run
 
 
 class SignatureCrypto:
@@ -312,14 +485,29 @@ class Ed25519Crypto(SignatureCrypto):
         SHA-512 challenges on host (ops/ed25519.py module docstring).
         Small batches and CPU-only backends ride the native host loop like
         the other curves (use_native_batch) — a QC list of 4 signatures
-        must never pay a tunnel round trip or emulated-XLA limb math."""
+        must never pay a tunnel round trip or emulated-XLA limb math.
+        Routed through the device plane (merged with concurrent callers;
+        the host-vs-device cutover applies to the MERGED size)."""
         hashes = [bytes(h) for h in msg_hashes]
         pub_list = [bytes(p) for p in pubs]
         sig_list = [bytes(s) for s in sigs]
+        from ..device.plane import get_plane, plane_route
+
+        if plane_route() and sig_list:
+            return get_plane().submit(
+                "verify.ed25519",
+                (hashes, pub_list, sig_list),
+                len(sig_list),
+                _verify_plane_exec_lists(self),
+            ).result()
+        return self._verify_merged(hashes, pub_list, sig_list)
+
+    def _verify_merged(self, hashes, pub_list, sig_list) -> np.ndarray:
         if use_native_batch(len(sig_list)):
             from .. import native_bind
 
             if native_bind.load() is not None:
+                _note_dispatch_path("ed25519_verify", "native")
                 return np.array(
                     [
                         native_bind.ed25519_verify(p[:32], h, s[:64])
@@ -329,6 +517,7 @@ class Ed25519Crypto(SignatureCrypto):
                 )
         from ..ops import ed25519 as ed_ops
 
+        _note_dispatch_path("ed25519_verify", "device")
         return ed_ops.verify_batch(hashes, pub_list, sig_list)
 
     def batch_recover(self, msg_hashes, sigs):
@@ -417,6 +606,18 @@ class Secp256k1Crypto(SignatureCrypto):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
         pubs = np.asarray(pubs, dtype=np.uint8)
+        from ..device.plane import get_plane, plane_route
+
+        if plane_route() and len(sigs):
+            return get_plane().submit(
+                "verify.secp256k1",
+                (hashes, pubs, sigs),
+                len(sigs),
+                _verify_plane_exec(self),
+            ).result()
+        return self._verify_merged(hashes, pubs, sigs)
+
+    def _verify_merged(self, hashes, pubs, sigs) -> np.ndarray:
         n = len(sigs)
         if use_native_batch(n):
             from .. import native_bind
@@ -429,7 +630,9 @@ class Secp256k1Crypto(SignatureCrypto):
                 n,
             )
             if out is not None:
+                _note_dispatch_path("secp256k1_verify", "native")
                 return np.asarray(out, dtype=bool)
+        _note_dispatch_path("secp256k1_verify", "device")
         return _device_or_host(
             secp_ops.verify_batch, self._host_verify_loop,
             hashes, sigs[:, :32], sigs[:, 32:64], pubs,
@@ -466,6 +669,18 @@ class Secp256k1Crypto(SignatureCrypto):
     def batch_recover(self, msg_hashes, sigs):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
+        from ..device.plane import get_plane, plane_route
+
+        if plane_route() and len(sigs):
+            return get_plane().submit(
+                "recover.secp256k1",
+                (hashes, sigs),
+                len(sigs),
+                _recover_plane_exec(self),
+            ).result()
+        return self._recover_merged(hashes, sigs)
+
+    def _recover_merged(self, hashes, sigs):
         n = len(sigs)
         if use_native_batch(n):
             from .. import native_bind
@@ -478,11 +693,13 @@ class Secp256k1Crypto(SignatureCrypto):
                 n,
             )
             if out is not None:
+                _note_dispatch_path("secp256k1_recover", "native")
                 pubs_raw, oks = out
                 pubs = np.frombuffer(pubs_raw, np.uint8).reshape(n, 64).copy()
                 ok = np.asarray(oks, dtype=bool)
                 pubs[~ok] = 0
                 return pubs, ok
+        _note_dispatch_path("secp256k1_recover", "device")
         return _device_or_host(
             secp_ops.recover_batch, self._host_recover_loop, hashes, sigs
         )
@@ -569,12 +786,26 @@ class SM2Crypto(SignatureCrypto):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
         pubs = np.asarray(pubs, dtype=np.uint8)
+        from ..device.plane import get_plane, plane_route
+
+        if plane_route() and len(sigs):
+            return get_plane().submit(
+                "verify.sm2",
+                (hashes, pubs, sigs),
+                len(sigs),
+                _verify_plane_exec(self),
+            ).result()
+        return self._verify_merged(hashes, pubs, sigs)
+
+    def _verify_merged(self, hashes, pubs, sigs) -> np.ndarray:
         if use_native_batch(len(sigs)):
             out = self._native_batch_verify(
                 hashes, pubs, sigs[:, :32], sigs[:, 32:64]
             )
             if out is not None:
+                _note_dispatch_path("sm2_verify", "native")
                 return out
+        _note_dispatch_path("sm2_verify", "device")
         return _device_or_host(
             sm2_ops.verify_batch, self._host_verify_loop,
             hashes, sigs[:, :32], sigs[:, 32:64], pubs,
@@ -597,12 +828,25 @@ class SM2Crypto(SignatureCrypto):
     def batch_recover(self, msg_hashes, sigs):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
+        from ..device.plane import get_plane, plane_route
+
+        if plane_route() and len(sigs):
+            return get_plane().submit(
+                "recover.sm2",
+                (hashes, sigs),
+                len(sigs),
+                _recover_plane_exec(self),
+            ).result()
+        return self._recover_merged(hashes, sigs)
+
+    def _recover_merged(self, hashes, sigs):
         if use_native_batch(len(sigs)):
             pubs = sigs[:, 64:128]
             ok = self._native_batch_verify(
                 hashes, pubs, sigs[:, :32], sigs[:, 32:64]
             )
             if ok is not None:
+                _note_dispatch_path("sm2_recover", "native")
                 out = np.where(ok[:, None], pubs, np.zeros_like(pubs))
                 return out, ok
 
@@ -613,6 +857,7 @@ class SM2Crypto(SignatureCrypto):
             )
             return np.where(ok_[:, None], pubs_, np.zeros_like(pubs_)), ok_
 
+        _note_dispatch_path("sm2_recover", "device")
         return _device_or_host(sm2_ops.recover_batch, _host_recover, hashes, sigs)
 
 

@@ -1,0 +1,15 @@
+"""Device verification plane — shared, shape-bucketed batch scheduling for
+all device crypto (see :mod:`.plane` and docs/device_plane.md)."""
+
+from .plane import (  # noqa: F401
+    DEFAULT_LANE,
+    LANES,
+    DevicePlane,
+    PlaneRequest,
+    current_lane,
+    device_lane,
+    get_plane,
+    in_plane_executor,
+    plane_enabled,
+    plane_route,
+)

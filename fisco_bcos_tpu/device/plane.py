@@ -1,0 +1,432 @@
+"""DevicePlane — the shared, shape-bucketed batch scheduler for all device
+crypto dispatch.
+
+Before this layer, every caller — txpool admission (txpool/txpool.py
+submit_batch), proposal verification (consensus/engine.py _verify_and_fill,
+consensus/block_validator.py check_block) and tx sync (sync/tx_sync.py
+_on_push) — ran its own synchronous device batch, so arbitrary per-caller
+batch shapes caused recompile churn (visible in the compile-vs-cached
+counters) and the device plane never saturated: the FPGA-ECDSA engine and
+EdDSA/BLS committee-consensus studies (PAPERS.md, arxiv 2112.02229 /
+2302.00418) both get their wins from ONE saturated verification engine fed
+by a request queue, not from per-caller batches.
+
+The plane is that engine's scheduler:
+
+- **Per-op request queue, future-based results.** Callers submit
+  (op, payload, item-count, executor) and get a ``concurrent.futures.Future``
+  back; the crypto seams (crypto/suite.py batch methods,
+  crypto/admission.admit_batch) block on it, so caller APIs are unchanged.
+- **Micro-batch coalescer.** A single worker drains each op's queue after a
+  bounded window (``FISCO_DEVICE_WINDOW_MS``, default 2 ms) or when the
+  queued item count crosses the high-water mark
+  (``FISCO_DEVICE_HIGH_WATER``, default 4096) — concurrent
+  admission/consensus/sync requests merge into one device program.
+- **Shape bucketing.** Merged batches dispatch through the existing
+  bucket-padded host wrappers (ops/hash_common._bucket ladder), so the jit
+  cache converges to ladder-many compiled programs instead of one per batch
+  size; ``fisco_device_compile_total`` stays ≤ the ladder size
+  (tool/check_device_plane.py asserts it).
+- **Priority lanes.** consensus > admission > sync among dispatch-ready
+  op groups, with starvation-free draining: any group whose oldest request
+  has waited past ``FISCO_DEVICE_STARVATION_MS`` (default 50 ms) preempts
+  lane order, oldest first — a gossip flood cannot park a QC check, and a
+  stream of QC checks cannot park gossip forever.
+- **Passthrough mode.** ``FISCO_DEVICE_PLANE=0`` disables routing entirely:
+  every seam takes its exact pre-plane dispatch path (per-caller batches,
+  no coalescing, no fan-out) — the escape hatch the smoke tool exercises.
+
+Executors run ON the worker thread with a thread-local marker set;
+``plane_route()`` returns False there, so an executor calling back into a
+plane-routed seam (e.g. ed25519 batch_recover → batch_verify) takes the
+direct path instead of deadlocking the single worker against itself.
+Results are bit-identical to the direct path by construction: executors
+call the same merged-batch implementations the direct path uses, and
+invalid rows lower validity-lane bits — they never raise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable
+
+# dispatch priority per lane, lower = sooner (consensus is on the critical
+# path of block time; admission feeds the next proposal; sync is gossip)
+LANES = {"consensus": 0, "admission": 1, "sync": 2}
+DEFAULT_LANE = "admission"
+
+_tls = threading.local()
+
+
+def plane_enabled() -> bool:
+    """The master switch, read per call so tool/check_device_plane.py can
+    flip passthrough mode mid-process."""
+    return os.environ.get("FISCO_DEVICE_PLANE", "1") != "0"
+
+
+def in_plane_executor() -> bool:
+    return bool(getattr(_tls, "in_exec", False))
+
+
+def plane_route() -> bool:
+    """True when a batch call should enqueue into the shared plane: the
+    plane is enabled AND this is not already a plane executor (an executor
+    re-entering the queue would deadlock the single worker, so nested seam
+    calls take the direct path)."""
+    return plane_enabled() and not in_plane_executor()
+
+
+def current_lane() -> str:
+    return getattr(_tls, "lane", DEFAULT_LANE)
+
+
+@contextmanager
+def device_lane(name: str):
+    """Tag device-crypto calls in this thread with a priority lane.
+
+    Callers keep their APIs (the issue's seam contract): the consensus
+    engine / block validator / tx sync wrap their verification calls in
+    ``with device_lane("consensus"/"sync")`` and every batch submitted
+    underneath inherits the lane; untagged callers default to "admission".
+    """
+    prev = getattr(_tls, "lane", DEFAULT_LANE)
+    _tls.lane = name
+    try:
+        yield
+    finally:
+        _tls.lane = prev
+
+
+@dataclass
+class PlaneRequest:
+    """One queued batch: op key, op-specific payload, item count, lane."""
+
+    op: str
+    payload: object
+    n: int
+    lane: str
+    t_enq: float
+    future: Future
+
+
+# wait-time buckets: the window is ~2 ms, starvation trips at ~50 ms, and
+# anything past a few hundred ms means the plane is the bottleneck
+WAIT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 1000.0)
+OCCUPANCY_BUCKETS = (0.25, 0.5, 0.75, 0.9, 1.0)
+
+
+class DevicePlane:
+    """The coalescing scheduler. One process-wide instance (``get_plane``)
+    serves every crypto seam; standalone instances exist only in tests.
+
+    Executors are bound lazily at submit time (first one registered per op
+    wins) — the plane imports nothing from the crypto layer, so there is no
+    import cycle and no registration ordering to get wrong.
+    An executor receives the request list and returns one result per
+    request, in order; it runs with the in-executor marker set.
+    """
+
+    def __init__(
+        self,
+        window_ms: float | None = None,
+        high_water: int | None = None,
+        starvation_ms: float | None = None,
+        autostart: bool = True,
+    ):
+        def _env(name: str, default: str) -> float:
+            try:
+                return float(os.environ.get(name, default) or default)
+            except ValueError:
+                return float(default)
+
+        if window_ms is not None:
+            self.window_ms = float(window_ms)
+        elif os.environ.get("FISCO_DEVICE_WINDOW_MS"):
+            self.window_ms = _env("FISCO_DEVICE_WINDOW_MS", "2")
+        else:
+            self.window_ms = self._default_window_ms()
+        self.high_water = (
+            int(_env("FISCO_DEVICE_HIGH_WATER", "4096"))
+            if high_water is None
+            else int(high_water)
+        )
+        self.starvation_ms = (
+            _env("FISCO_DEVICE_STARVATION_MS", "50")
+            if starvation_ms is None
+            else float(starvation_ms)
+        )
+        self._autostart = autostart
+        self._cv = threading.Condition()
+        self._pending: dict[str, list[PlaneRequest]] = {}
+        self._exec_fns: dict[str, Callable] = {}
+        self._thread: threading.Thread | None = None
+        self._busy = False
+        # stats (mutated under _cv; snapshot via stats())
+        self.requests = 0
+        self.dispatches = 0
+        self.merged_requests = 0  # requests that shared a dispatch with others
+        self.items = 0
+        self._wait_ms: deque[float] = deque(maxlen=4096)
+
+    @staticmethod
+    def _default_window_ms() -> float:
+        """2 ms on accelerator backends (noise against a tunneled device's
+        ~100 ms round trip, and every merged straggler is a round trip
+        saved); 0 on CPU-XLA backends, where dispatches are sub-ms native
+        host loops and an idle-queue wait would tax every sequential batch
+        call for nothing — bursts still coalesce while the worker is busy."""
+        try:
+            from ..crypto.suite import device_backend_is_cpu
+
+            return 0.0 if device_backend_is_cpu() else 2.0
+        except Exception:
+            return 2.0
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, op: str, payload, n: int, exec_fn: Callable) -> Future:
+        """Queue one batch for op; returns a Future of the executor's
+        per-request result. The caller's current lane is captured here."""
+        req = PlaneRequest(
+            op, payload, int(n), current_lane(), time.perf_counter(), Future()
+        )
+        with self._cv:
+            self._exec_fns.setdefault(op, exec_fn)
+            self._pending.setdefault(op, []).append(req)
+            self.requests += 1
+            self.items += req.n
+            if self._autostart:
+                self._ensure_thread()
+            self._cv.notify_all()
+        from ..utils.metrics import REGISTRY
+
+        REGISTRY.counter_add(
+            f'fisco_device_plane_requests_total{{op="{op}",lane="{req.lane}"}}',
+            1.0,
+            help="batches submitted to the device plane by op and lane",
+        )
+        return req.future
+
+    # -- scheduler -----------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="device-plane", daemon=True
+            )
+            self._thread.start()
+
+    def _group_ready(self, reqs: list[PlaneRequest], now: float) -> bool:
+        age_ms = (now - reqs[0].t_enq) * 1e3
+        return age_ms >= self.window_ms or sum(r.n for r in reqs) >= self.high_water
+
+    def _pick_ready(self, now: float):
+        """Pop the dispatch-ready op group with the best claim, or None.
+
+        Ready = window elapsed since the group's oldest request, or item
+        count at/over high water. Among ready groups: starved groups (oldest
+        request past starvation_ms) first, oldest first — the aging bound
+        that makes draining starvation-free; then by best lane priority
+        present in the group; ties to the oldest group."""
+        best_op = None
+        best_key = None
+        for op, reqs in self._pending.items():
+            if not reqs or not self._group_ready(reqs, now):
+                continue
+            age_ms = (now - reqs[0].t_enq) * 1e3
+            if age_ms >= self.starvation_ms:
+                key = (0, -age_ms, reqs[0].t_enq)
+            else:
+                lane_rank = min(LANES.get(r.lane, 1) for r in reqs)
+                key = (1, lane_rank, reqs[0].t_enq)
+            if best_key is None or key < best_key:
+                best_key, best_op = key, op
+        if best_op is None:
+            return None
+        return best_op, self._pending.pop(best_op)
+
+    def _next_timeout_s(self, now: float) -> float | None:
+        """Seconds until the earliest group becomes window-ready; None when
+        the queue is empty (sleep until notified)."""
+        deadlines = [
+            reqs[0].t_enq + self.window_ms / 1e3
+            for reqs in self._pending.values()
+            if reqs
+        ]
+        if not deadlines:
+            return None
+        return max(min(deadlines) - now, 0.0)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                group = None
+                while group is None:
+                    group = self._pick_ready(time.perf_counter())
+                    if group is None:
+                        self._cv.wait(self._next_timeout_s(time.perf_counter()))
+                self._busy = True
+            try:
+                self._dispatch(*group)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _dispatch(self, op: str, reqs: list[PlaneRequest]) -> None:
+        # Once a group is popped from _pending, its futures exist only here:
+        # EVERYTHING (telemetry included) runs under the catch-all so that no
+        # failure mode can drop them unresolved — a lost future wedges a
+        # caller blocked in .result() forever.
+        try:
+            self._record_dispatch(op, reqs)
+            _tls.in_exec = True
+            try:
+                results = self._exec_fns[op](reqs)
+            finally:
+                _tls.in_exec = False
+            if len(results) != len(reqs):
+                raise RuntimeError(
+                    f"plane executor for {op} returned {len(results)} results"
+                    f" for {len(reqs)} requests"
+                )
+            for r, res in zip(reqs, results):
+                r.future.set_result(res)
+        except BaseException as e:  # noqa: BLE001 — futures must never wedge
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def _record_dispatch(self, op: str, reqs: list[PlaneRequest]) -> None:
+        from ..utils.metrics import REGISTRY
+
+        now = time.perf_counter()
+        total = sum(r.n for r in reqs)
+        with self._cv:
+            self.dispatches += 1
+            if len(reqs) > 1:
+                self.merged_requests += len(reqs)
+            for r in reqs:
+                self._wait_ms.append((now - r.t_enq) * 1e3)
+        if not REGISTRY.enabled:
+            return
+        for r in reqs:
+            REGISTRY.observe(
+                "fisco_device_plane_wait_ms",
+                (now - r.t_enq) * 1e3,
+                buckets=WAIT_BUCKETS_MS,
+                help="queue wait from submit to dispatch, per lane",
+                lane=r.lane,
+            )
+        REGISTRY.counter_add(
+            f'fisco_device_plane_dispatch_total{{op="{op}"}}',
+            1.0,
+            help="merged device dispatches by op (requests/dispatches = "
+            "coalesce ratio)",
+        )
+        if len(reqs) > 1:
+            REGISTRY.counter_add(
+                f'fisco_device_plane_coalesced_total{{op="{op}"}}',
+                float(len(reqs)),
+                help="requests that shared a merged dispatch with others",
+            )
+        from ..observability import BATCH_BUCKETS
+        from ..ops.hash_common import bucket_batch
+
+        REGISTRY.observe(
+            "fisco_device_plane_batch_items",
+            total,
+            buckets=BATCH_BUCKETS,
+            help="merged batch sizes dispatched by the plane",
+            op=op,
+        )
+        bucket = bucket_batch(max(total, 1))
+        REGISTRY.observe(
+            "fisco_device_plane_bucket_occupancy",
+            total / bucket if bucket else 0.0,
+            buckets=OCCUPANCY_BUCKETS,
+            help="real rows / bucket-padded rows per dispatch (batch dim"
+            " only; pad waste = 1 - occupancy)",
+            op=op,
+        )
+
+    # -- introspection -------------------------------------------------------
+
+    def _depth(self) -> int:
+        with self._cv:
+            return sum(sum(r.n for r in reqs) for reqs in self._pending.values())
+
+    def coalesce_ratio(self) -> float:
+        """Requests per device dispatch (≥ 1.0; 1.0 = no coalescing won)."""
+        with self._cv:
+            return self.requests / self.dispatches if self.dispatches else 1.0
+
+    def wait_p99_ms(self) -> float:
+        with self._cv:
+            waits = sorted(self._wait_ms)
+        if not waits:
+            return 0.0
+        return waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "requests": self.requests,
+                "dispatches": self.dispatches,
+                "merged_requests": self.merged_requests,
+                "items": self.items,
+                "queue_depth": sum(
+                    sum(r.n for r in reqs) for reqs in self._pending.values()
+                ),
+            }
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until the queue is empty and no dispatch is in flight
+        (bench/smoke hook); False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while any(self._pending.values()) or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.05))
+        return True
+
+    def _register_gauges(self) -> None:
+        """Register the queue-depth gauge. Called for the process singleton
+        only (get_plane) — the registry holds a strong ref to the closure
+        and last-registration-wins, so a throwaway instance registering
+        would hijack the metric and pin itself in memory."""
+        try:
+            from ..utils.metrics import REGISTRY
+
+            REGISTRY.gauge_fn(
+                "fisco_device_plane_queue_depth",
+                lambda: float(self._depth()),
+                help="items currently queued in the device plane",
+            )
+        except Exception:  # metrics layer disabled/unavailable — plane works
+            pass
+
+
+_PLANE: DevicePlane | None = None
+_PLANE_LOCK = threading.Lock()
+
+
+def get_plane() -> DevicePlane:
+    """The process-wide plane every crypto seam shares (coalescing across
+    callers is the whole point — per-caller planes would recreate the
+    per-caller batch problem)."""
+    global _PLANE
+    if _PLANE is None:
+        with _PLANE_LOCK:
+            if _PLANE is None:
+                _PLANE = DevicePlane()
+                _PLANE._register_gauges()
+    return _PLANE

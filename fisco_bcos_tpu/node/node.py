@@ -185,6 +185,15 @@ class Node:
         from ..gateway.amop import AMOPService
 
         self.amop = AMOPService(self.front)
+        # shared device-verification plane: spin the worker (and its queue
+        # gauges) up BEFORE consensus traffic so the first proposal never
+        # races the thread start; FISCO_DEVICE_PLANE=0 = passthrough mode,
+        # every crypto seam keeps its per-caller direct dispatch
+        from ..device.plane import get_plane, plane_enabled
+
+        if plane_enabled():
+            get_plane()
+            HEALTH.ok("device-plane", "coalescing scheduler up")
         if durable:
             # restart path: re-admit durably-stored pool txs (signatures
             # re-verified on device; Initializer.cpp:188-195 analog)

@@ -48,6 +48,15 @@ def _count_shape(op: str, key) -> None:
     )
 
 
+def compile_counts() -> dict[str, int]:
+    """Distinct compiled (bucketed) shapes seen per op — the in-process
+    view of ``fisco_device_compile_total``. tool/check_device_plane.py and
+    bench.py read it to assert/report that a ragged flood stays within the
+    bucket ladder instead of recompiling per batch size."""
+    with _seen_lock:
+        return {op: len(shapes) for op, shapes in _seen_shapes.items()}
+
+
 class device_span:
     """Time one host-level device-batch call and emit the full signal set.
 

@@ -90,6 +90,39 @@ def sharded_admission(mesh: Mesh, axis_name: str = DATA_AXIS):
     return jax.jit(f)
 
 
+def sharded_admission_packed(mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Fan-out form of the packed one-transfer admission program
+    (crypto.admission.admission_step_packed) — the DevicePlane's
+    multi-device leg for merged batches above its per-device threshold.
+
+    Each device runs the fused admission body over its batch shard and
+    packs locally; the [B, 117] uint8 result (addr ‖ ok ‖ pubkey ‖ tx_hash)
+    rides ONE all_gather, so the host still pays a single transfer.
+    Bit-identical to the single-chip program lane-for-lane (the body is
+    admission_core verbatim; only the batch partitioning differs).
+
+    Returns a jitted fn (blocks, nblocks, r, s, v) -> [B, 117] uint8
+    replicated; B divisible by the mesh size (the bucket ladder guarantees
+    it for power-of-two meshes)."""
+    from ..crypto.admission import pack_admission_device
+
+    def local(blocks, nblocks, r, s, v):
+        packed = pack_admission_device(
+            *admission_core(blocks, nblocks, r, s, v)
+        )
+        return jax.lax.all_gather(packed, axis_name, tiled=True)
+
+    spec = P(axis_name)
+    f = jax.shard_map(
+        local,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=P(),
+    )
+    return jax.jit(f)
+
+
 def sharded_sm2_verify(mesh: Mesh, axis_name: str = DATA_AXIS):
     """Batch-sharded SM2 verify (the national-crypto lane of the
     verification plane).

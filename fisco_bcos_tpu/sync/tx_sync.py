@@ -128,8 +128,9 @@ class TransactionSync:
             except Exception:
                 continue
         if txs:
-            # device batch verify + admission (importDownloadedTxs:521)
-            self.txpool.submit_batch(txs)
+            # device batch verify + admission (importDownloadedTxs:521);
+            # gossip rides the plane's lowest-priority lane
+            self.txpool.submit_batch(txs, lane="sync")
 
     def _on_request(self, src: bytes, hashes: list[bytes]) -> None:
         found = [t.encode() for t in self.txpool.fetch_txs(hashes) if t is not None]

@@ -102,10 +102,14 @@ class TxPool:
         self._insert(tx, h)
         return TxSubmitResult(h, ErrorCode.SUCCESS, tx.sender)
 
-    def submit_batch(self, txs: list[Transaction]) -> list[TxSubmitResult]:
+    def submit_batch(
+        self, txs: list[Transaction], lane: str = "admission"
+    ) -> list[TxSubmitResult]:
         """Batch admission: ONE fused device program (keccak → recover →
         address) for the whole batch — the TPU replacement for the
-        reference's per-tx verify loop.
+        reference's per-tx verify loop. `lane` tags the device-plane
+        priority of the signature batch (tx-sync imports pass "sync" so
+        gossip floods queue behind consensus/RPC verification).
 
         Gate order matches the reference (dup/static → pool-full → sig):
         only the statically-admissible, within-room subset reaches the
@@ -136,9 +140,12 @@ class TxPool:
             batch_nonces.add(tx.nonce)
             to_verify.append(i)
         if to_verify:
+            from ..device.plane import device_lane
+
             # ONE fused device program (keccak → recover → address); fills
             # hash + sender caches for every verified lane
-            ok = batch_admit([txs[i] for i in to_verify], self.suite)
+            with device_lane(lane):
+                ok = batch_admit([txs[i] for i in to_verify], self.suite)
             persisted: list[tuple[bytes, "Entry"]] = []
             for j, i in enumerate(to_verify):
                 h = txs[i].hash(self.suite)  # cached by the fused pass
@@ -340,7 +347,12 @@ class TxPool:
         got = [t for t in fetched if t is not None]
         if len(got) != len(missing):
             return False, missing
-        ok = batch_admit(got, self.suite)
+        from ..device.plane import device_lane
+
+        # proposal-straggler verification sits on the consensus critical
+        # path — it must preempt admission/sync batches in the plane queue
+        with device_lane("consensus"):
+            ok = batch_admit(got, self.suite)
         if not ok.all():
             return False, missing
         # the fetched txs must BE the missing ones — a peer returning valid

@@ -27,6 +27,12 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cac
 # One shared batch bucket for every device-crypto test — each distinct batch
 # shape is a multi-minute XLA compile on the single-core CPU host.
 os.environ.setdefault("FISCO_TEST_BUCKET", "32")
+# Device-plane coalescing window off for tests: the 2 ms production window
+# adds idle latency to every sequential batch call (thousands across the
+# suite on this 1-core host) and buys nothing for correctness — bursts
+# still coalesce while the worker is busy, which is what the dedicated
+# plane tests pin with explicit windows.
+os.environ.setdefault("FISCO_DEVICE_WINDOW_MS", "0")
 
 import jax  # noqa: E402
 

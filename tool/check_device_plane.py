@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Device-plane smoke check (ISSUE 3 acceptance):
+
+1. With the plane enabled, flood one node with CONCURRENT ragged admission
+   batches, proposal verification (full-tx re-verification) and tx-sync
+   imports, then assert:
+   - the device compile counter stays ≤ the bucket-ladder size per op
+     (ragged shapes must converge onto the ladder, not compile per size);
+   - queue wait p99 is bounded (default 750 ms, --wait-p99-ms);
+   - every submitted tx was admitted exactly once (slices never crossed).
+2. With the plane force-disabled (``FISCO_DEVICE_PLANE=0`` passthrough), a
+   4-node PBFT chain still commits blocks — the escape hatch works.
+
+Runnable locally and from CI::
+
+    python tool/check_device_plane.py [--txs N] [--wait-p99-ms MS]
+
+Exit 0 on success, 1 with a named failure otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+# share the test suite's batch bucket + compile cache so any device program
+# compiles small and only once across runs (same rationale as
+# tool/check_telemetry.py)
+os.environ.setdefault("FISCO_TEST_BUCKET", "32")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_backend_optimization_level" not in _flags:
+    _flags += (
+        " --xla_backend_optimization_level=0"
+        " --xla_llvm_disable_expensive_passes=true"
+    )
+    os.environ["XLA_FLAGS"] = _flags.strip()
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+)
+sys.path.insert(0, _REPO)
+
+try:  # this environment's sitecustomize may pre-import jax on the TPU
+    # tunnel; pin CPU post-import the way tests/conftest.py does
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    raise SystemExit(1)
+
+
+def _make_node():
+    from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+    from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+    from fisco_bcos_tpu.node import Node, NodeConfig
+
+    suite = ecdsa_suite()
+    kp = suite.signature_impl.generate_keypair(secret=0xDE71CE)
+    cfg = NodeConfig(
+        genesis=GenesisConfig(
+            consensus_nodes=[ConsensusNode(kp.pub, weight=1)],
+            tx_count_limit=2000,
+        )
+    )
+    return Node(cfg, keypair=kp)
+
+
+def _flood_txs(suite, tag: str, n: int):
+    from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+
+    fac = TransactionFactory(suite)
+    sender = suite.signature_impl.generate_keypair(secret=0xF10C0)
+    return [
+        fac.create_signed(
+            sender,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500,
+            nonce=f"plane-{tag}-{i}",
+            to=b"\x11" * 20,
+            input=b"\x00" * (i % 96),
+        )
+        for i in range(n)
+    ]
+
+
+def check_plane_flood(n_txs: int, wait_p99_ms: float) -> None:
+    """Concurrent ragged admission + proposal verification + sync imports
+    through one shared plane."""
+    from fisco_bcos_tpu.device.plane import device_lane, get_plane, plane_enabled
+    from fisco_bcos_tpu.observability.device import compile_counts
+    from fisco_bcos_tpu.ops.hash_common import bucket_ladder
+    from fisco_bcos_tpu.txpool.validator import batch_admit
+
+    if not plane_enabled():
+        fail("plane disabled at phase 1 — unset FISCO_DEVICE_PLANE")
+    node = _make_node()
+    suite = node.suite
+
+    # ragged batch schedule: adversarial sizes that would each compile a
+    # distinct program without bucketing
+    sizes = [1, 2, 3, 5, 7, 11, 13, 17, 23, 29, 31, 37, 41, 53, 64, 100]
+    sizes = [s for s in sizes if s <= max(n_txs, 1)]
+    errors: list[str] = []
+    admitted = [0]
+    lock = threading.Lock()
+
+    def rpc_flood(tag: int):
+        # RPC-side admission (default lane)
+        for k, sz in enumerate(sizes):
+            txs = _flood_txs(suite, f"rpc{tag}-{k}", sz)
+            results = node.txpool.submit_batch(txs)
+            bad = [r for r in results if r.status != 0]
+            with lock:
+                admitted[0] += len(results) - len(bad)
+            if bad:
+                errors.append(f"rpc{tag}: {len(bad)}/{len(txs)} rejected")
+
+    def proposal_verify():
+        # consensus-lane re-verification of carried signatures
+        for k, sz in enumerate(sizes):
+            txs = _flood_txs(suite, f"prop-{k}", sz)
+            with device_lane("consensus"):
+                ok = batch_admit(txs, suite)
+            if not ok.all():
+                errors.append(f"proposal batch {k}: verify failed")
+
+    def sync_import():
+        for k, sz in enumerate(sizes):
+            txs = _flood_txs(suite, f"sync-{k}", sz)
+            results = node.txpool.submit_batch(txs, lane="sync")
+            bad = [r for r in results if r.status != 0]
+            with lock:
+                admitted[0] += len(results) - len(bad)
+            if bad:
+                errors.append(f"sync batch {k}: {len(bad)} rejected")
+
+    threads = [
+        threading.Thread(target=rpc_flood, args=(0,)),
+        threading.Thread(target=rpc_flood, args=(1,)),
+        threading.Thread(target=proposal_verify),
+        threading.Thread(target=sync_import),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    plane = get_plane()
+    if not plane.drain(30.0):
+        fail("plane did not drain within 30s")
+    if errors:
+        fail("; ".join(errors[:5]))
+
+    expected = 3 * sum(sizes)  # 2 rpc floods + 1 sync flood (unique nonces)
+    if admitted[0] != expected:
+        fail(f"admitted {admitted[0]} txs, expected {expected}")
+
+    # compile counter vs the bucket ladder: +1 slack for the pinned
+    # "native" shape key ops emit on the host leg
+    max_batch = plane.high_water  # merged batches never exceed high water by more than one request
+    ladder_n = len(bucket_ladder(max(max_batch, max(sizes))))
+    comp = compile_counts()
+    print(f"compile counts per op: {comp} (ladder size {ladder_n})")
+    for op, n in comp.items():
+        if n > ladder_n + 1:
+            fail(
+                f"op {op} compiled {n} distinct shapes > ladder {ladder_n} "
+                "(+1 native) — shape bucketing is not converging"
+            )
+
+    p99 = plane.wait_p99_ms()
+    print(f"plane stats: {plane.stats()}")
+    print(
+        f"coalesce ratio {plane.coalesce_ratio():.2f}, wait p99 {p99:.2f} ms"
+    )
+    if p99 > wait_p99_ms:
+        fail(f"queue wait p99 {p99:.1f} ms > bound {wait_p99_ms} ms")
+    print("OK: plane flood (compile bound, wait p99, slice integrity)")
+
+
+def check_passthrough_chain() -> None:
+    """FISCO_DEVICE_PLANE=0: the 4-node chain must still seal + commit."""
+    os.environ["FISCO_DEVICE_PLANE"] = "0"
+    try:
+        from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+        from fisco_bcos_tpu.device.plane import get_plane, plane_route
+        from fisco_bcos_tpu.front import InprocGateway
+        from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+        from fisco_bcos_tpu.node import Node, NodeConfig
+
+        if plane_route():
+            fail("FISCO_DEVICE_PLANE=0 did not disable routing")
+        before = get_plane().stats()["requests"]
+        suite = ecdsa_suite()
+        keypairs = [
+            suite.signature_impl.generate_keypair(secret=0x0FF + i)
+            for i in range(4)
+        ]
+        cons = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+        gw = InprocGateway(auto=True)
+        nodes = []
+        for kp in keypairs:
+            cfg = NodeConfig(
+                genesis=GenesisConfig(
+                    consensus_nodes=list(cons), tx_count_limit=500
+                )
+            )
+            node = Node(cfg, keypair=kp)
+            gw.connect(node.front)
+            nodes.append(node)
+        entry = nodes[0]
+        txs = _flood_txs(suite, "pass", 40)
+        results = entry.txpool.submit_batch(txs)
+        if any(r.status != 0 for r in results):
+            fail("passthrough admission rejected txs")
+        entry.tx_sync.maintain()
+        stalls = 0
+        while entry.txpool.pending_count() > 0 and stalls < 5:
+            idx = nodes[0].pbft_config.leader_index(
+                nodes[0].block_number() + 1, 0
+            )
+            target = nodes[0].pbft_config.nodes[idx].node_id
+            leader = next(nd for nd in nodes if nd.node_id == target)
+            if not leader.sealer.seal_and_submit():
+                stalls += 1
+        heights = {nd.block_number() for nd in nodes}
+        if heights != {nodes[0].block_number()} or nodes[0].block_number() < 1:
+            fail(f"passthrough chain did not commit: heights {sorted(heights)}")
+        if entry.txpool.pending_count():
+            fail(
+                f"passthrough left {entry.txpool.pending_count()} txs pending"
+            )
+        if get_plane().stats()["requests"] != before:
+            fail("passthrough mode still enqueued into the plane")
+        print(
+            f"OK: passthrough chain committed to height "
+            f"{nodes[0].block_number()} with the plane disabled"
+        )
+    finally:
+        os.environ.pop("FISCO_DEVICE_PLANE", None)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--txs", type=int, default=100, help="max batch size")
+    ap.add_argument(
+        "--wait-p99-ms",
+        type=float,
+        default=750.0,
+        help="queue-wait p99 bound (generous: CI hosts are 1-core)",
+    )
+    args = ap.parse_args()
+    check_plane_flood(args.txs, args.wait_p99_ms)
+    check_passthrough_chain()
+    print("PASS: device plane smoke")
+
+
+if __name__ == "__main__":
+    main()
