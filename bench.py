@@ -534,10 +534,28 @@ def _dump_telemetry(tag: str) -> None:
     mpath = os.path.join(base, f"bench_telemetry.{tag}.metrics.txt")
     tpath = os.path.join(base, f"bench_telemetry.{tag}.trace.json")
     with open(mpath, "w") as f:
-        f.write(REGISTRY.render())
+        # artifact file, not a scrape: include the OpenMetrics exemplars
+        f.write(REGISTRY.render(openmetrics=True))
     with open(tpath, "w") as f:
         f.write(TRACER.export_json())
     print(f"# telemetry metrics={mpath} trace={tpath}", flush=True)
+    # per-tx critical path: stitch the last committed tx's lifecycle into
+    # an ordered stage breakdown with the dominant stage named — the
+    # attributable-latency artifact every perf claim should ship
+    from fisco_bcos_tpu.observability import critical_path
+
+    tx = critical_path.latest_committed_tx()
+    if tx is not None:
+        cpath = os.path.join(base, f"bench_telemetry.{tag}.critical_path.json")
+        doc = critical_path.trace_tx(tx)
+        with open(cpath, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        print(
+            f"# critical path tx={tx[:16]} dominant={doc.get('dominant')} "
+            f"({doc.get('dominant_ms')}ms of {doc.get('total_ms')}ms) "
+            f"-> {cpath}",
+            flush=True,
+        )
 
 
 def _child_budget_s() -> float | None:

@@ -104,6 +104,7 @@ def build_node(opts: ChainOptions):
     )
     gw.connect(node.front)
     from .observability import TRACER
+    from .observability.critical_path import trace_tx
     from .resilience import HEALTH
     from .rpc.group_manager import GroupManager, MultiGroupRpc
     from .utils.metrics import bind_node_metrics
@@ -120,6 +121,7 @@ def build_node(opts: ChainOptions):
         metrics=bind_node_metrics(node),
         tracer=TRACER,
         health=HEALTH,
+        trace_tx=trace_tx,
     )
     ws = None
     if opts.ws_listen_port:

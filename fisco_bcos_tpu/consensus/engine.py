@@ -75,6 +75,11 @@ class ProposalCache:
     t_accept: float = 0.0
     t_prepared: float = 0.0
     t_committed: float = 0.0
+    # this process's trace for the in-flight block: every pbft.* phase
+    # record and the execute/commit span trees hang off it, so one
+    # trace_id covers the block's whole pipeline (critical_path stitches
+    # per-process block traces by number)
+    trace_ctx: object = None
 
 
 class PBFTEngine:
@@ -149,6 +154,17 @@ class PBFTEngine:
 
     def _cache(self, number: int) -> ProposalCache:
         return self._caches.setdefault(number, ProposalCache())
+
+    def _block_ctx(self, number: int, cache: ProposalCache):
+        """Lazily open this process's block trace (root context) — created
+        at first touch of the proposal, reused by every phase."""
+        if cache.trace_ctx is None and TRACER.enabled:
+            cache.trace_ctx = TRACER.new_root_context(name="pbft.block")
+            if cache.trace_ctx is not None:
+                from ..observability import critical_path
+
+                critical_path.note_block_trace(number, cache.trace_ctx.trace_id)
+        return cache.trace_ctx
 
     def has_in_flight(self, number: int) -> bool:
         """A proposal at `number` has been accepted and is being voted on."""
@@ -304,9 +320,13 @@ class PBFTEngine:
             if not self._pre_prepare_gate(msg):
                 return
             leader = self.config.node_at(msg.generated_from)
+            bctx = self._block_ctx(msg.number, self._cache(msg.number))
         # decode + verify + tx fill run OUTSIDE the lock: the metadata fetch
         # can block on tx-sync for seconds, and votes/other handlers must
-        # keep flowing meanwhile (the reference verifies on txpool threads)
+        # keep flowing meanwhile (the reference verifies on txpool threads).
+        # The block trace is attached here so the verification span tree —
+        # txpool.verify_block, straggler fetches, device-plane waits — lands
+        # in this block's trace instead of as disconnected roots.
         try:
             block = Block.decode(msg.proposal_data)
         except Exception:
@@ -316,9 +336,11 @@ class PBFTEngine:
             return
         if block.header.number != msg.number:
             return
-        if not self._verify_and_fill(
-            block, leader.node_id if leader else None, from_self
-        ):
+        with TRACER.attach(bctx):
+            verified = self._verify_and_fill(
+                block, leader.node_id if leader else None, from_self
+            )
+        if not verified:
             _log.warning("proposal %d failed verification", msg.number)
             return
         with self._lock:
@@ -357,6 +379,7 @@ class PBFTEngine:
                 "pbft.pre_prepare",
                 t_gate0,
                 cache.t_accept - t_gate0,
+                parent_ctx=cache.trace_ctx,
                 block=msg.number,
                 view=msg.view,
             )
@@ -384,7 +407,8 @@ class PBFTEngine:
             # header roots/receipts in place, and the certificate path
             # serializes cache state concurrently.
             try:
-                self.scheduler.execute_block(Block.decode(pre_data))
+                with TRACER.attach(bctx):
+                    self.scheduler.execute_block(Block.decode(pre_data))
             except SchedulerError as e:
                 _log.debug("pre-execute %d skipped: %s", msg.number, e)
 
@@ -474,6 +498,7 @@ class PBFTEngine:
                 "pbft.prepare",
                 cache.t_accept,
                 cache.t_prepared - cache.t_accept,
+                parent_ctx=cache.trace_ctx,
                 block=number,
             )
         if self.cstore is not None and cache.block_data:
@@ -515,6 +540,7 @@ class PBFTEngine:
                 "pbft.commit",
                 cache.t_prepared,
                 cache.t_committed - cache.t_prepared,
+                parent_ctx=cache.trace_ctx,
                 block=number,
             )
         self._execute_and_checkpoint(number, cache)
@@ -524,9 +550,9 @@ class PBFTEngine:
         asyncApply) and distribute a checkpoint over the *executed* header."""
         assert cache.block is not None
         try:
-            with TRACER.span(
+            with TRACER.attach(cache.trace_ctx), TRACER.span(
                 "pbft.execute_and_checkpoint", block=number
-            ):  # nests scheduler.execute_block
+            ):  # nests scheduler.execute_block, inside the block trace
                 header = self.scheduler.execute_block(cache.block)
         except SchedulerError as e:
             _log.error("execute block %d failed: %s", number, e)
@@ -584,9 +610,9 @@ class PBFTEngine:
             ]
             header.clear_hash_cache()
             try:
-                with TRACER.span(
+                with TRACER.attach(cache.trace_ctx), TRACER.span(
                     "pbft.checkpoint_commit", block=msg.number
-                ):  # nests scheduler.commit_block
+                ):  # nests scheduler.commit_block, inside the block trace
                     self.scheduler.commit_block(header)
             except SchedulerError as e:
                 _log.error("commit block %d failed: %s", msg.number, e)
@@ -594,15 +620,19 @@ class PBFTEngine:
                 return
             now = time.perf_counter()
             if cache.t_committed:
+                from ..observability.tracer import trace_hex
+
                 REGISTRY.observe(
                     "fisco_pbft_checkpoint_latency_ms",
                     (now - cache.t_committed) * 1e3,
                     help="executed to checkpoint quorum + ledger commit",
+                    exemplar=trace_hex(cache.trace_ctx),
                 )
                 TRACER.record(
                     "pbft.checkpoint",
                     cache.t_committed,
                     now - cache.t_committed,
+                    parent_ctx=cache.trace_ctx,
                     block=msg.number,
                 )
             self.committed_number = msg.number

@@ -78,7 +78,20 @@ class Sealer:
         REGISTRY.counter_add(
             "fisco_sealer_proposals_total", help="block proposals generated"
         )
-        TRACER.record("seal", t0, dur, block=number, txs=len(txs))
+        if TRACER.enabled:
+            from ..observability import critical_path
+
+            # close each absorbed tx's pool-wait gap in ITS trace, then
+            # open the BLOCK's trace with the seal span linking back to
+            # every admission span it picked up (the same fan-in shape the
+            # device-plane merged batch uses)
+            tx_ctxs = critical_path.note_sealed(hashes, number)
+            ctx = TRACER.record(
+                "seal", t0, dur, block=number, txs=len(txs), links=tx_ctxs
+            )
+            critical_path.note_block_trace(
+                number, ctx.trace_id if ctx is not None else None
+            )
         return block
 
     def seal_and_submit(self) -> bool:

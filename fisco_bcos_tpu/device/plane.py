@@ -105,7 +105,10 @@ def device_lane(name: str):
 
 @dataclass
 class PlaneRequest:
-    """One queued batch: op key, op-specific payload, item count, lane."""
+    """One queued batch: op key, op-specific payload, item count, lane.
+    ``ctx`` is the submitting caller's trace context — the merged dispatch
+    span links back to it, and the caller's trace gets a retroactive
+    ``device.plane.wait`` span carrying the batch's span id."""
 
     op: str
     payload: object
@@ -113,6 +116,7 @@ class PlaneRequest:
     lane: str
     t_enq: float
     future: Future
+    ctx: object = None
 
 
 # wait-time buckets: the window is ~2 ms, starvation trips at ~50 ms, and
@@ -192,9 +196,13 @@ class DevicePlane:
 
     def submit(self, op: str, payload, n: int, exec_fn: Callable) -> Future:
         """Queue one batch for op; returns a Future of the executor's
-        per-request result. The caller's current lane is captured here."""
+        per-request result. The caller's current lane — and trace context —
+        are captured here."""
+        from ..observability.tracer import TRACER
+
         req = PlaneRequest(
-            op, payload, int(n), current_lane(), time.perf_counter(), Future()
+            op, payload, int(n), current_lane(), time.perf_counter(), Future(),
+            ctx=TRACER.current_context() if TRACER.enabled else None,
         )
         with self._cv:
             self._exec_fns.setdefault(op, exec_fn)
@@ -285,12 +293,34 @@ class DevicePlane:
         # failure mode can drop them unresolved — a lost future wedges a
         # caller blocked in .result() forever.
         try:
-            self._record_dispatch(op, reqs)
-            _tls.in_exec = True
-            try:
-                results = self._exec_fns[op](reqs)
-            finally:
-                _tls.in_exec = False
+            from ..observability.tracer import TRACER
+
+            # the merged-batch span: parented to the first absorbed caller,
+            # LINKED to every caller it coalesced — the Perfetto view of N
+            # transactions converging into one device program. Entering it
+            # on this worker thread also hands the trace context to the
+            # executor, so the device.<op> spans inside nest under it.
+            # SAMPLED callers only: an unsampled first caller would noop
+            # the whole batch span (suppressing every sampled caller's wait
+            # record), and links to unsampled ctxs would dangle.
+            ctxs = [
+                r.ctx for r in reqs if r.ctx is not None and r.ctx.sampled
+            ]
+            span = TRACER.span(
+                "device.plane.dispatch",
+                parent=ctxs[0] if ctxs else None,
+                links=ctxs,
+                op=op,
+                requests=len(reqs),
+                items=sum(r.n for r in reqs),
+            )
+            with span:
+                self._record_dispatch(op, reqs, getattr(span, "ctx", None))
+                _tls.in_exec = True
+                try:
+                    results = self._exec_fns[op](reqs)
+                finally:
+                    _tls.in_exec = False
             if len(results) != len(reqs):
                 raise RuntimeError(
                     f"plane executor for {op} returned {len(results)} results"
@@ -303,7 +333,10 @@ class DevicePlane:
                 if not r.future.done():
                     r.future.set_exception(e)
 
-    def _record_dispatch(self, op: str, reqs: list[PlaneRequest]) -> None:
+    def _record_dispatch(
+        self, op: str, reqs: list[PlaneRequest], batch_ctx=None
+    ) -> None:
+        from ..observability.tracer import TRACER
         from ..utils.metrics import REGISTRY
 
         now = time.perf_counter()
@@ -314,6 +347,21 @@ class DevicePlane:
                 self.merged_requests += len(reqs)
             for r in reqs:
                 self._wait_ms.append((now - r.t_enq) * 1e3)
+        if batch_ctx is not None:
+            # close the loop from the caller side: each absorbed caller's
+            # trace gets its queue wait as a span naming the merged batch's
+            # span id (the fan-in edge, readable from either end)
+            for r in reqs:
+                if r.ctx is not None and r.ctx.sampled:
+                    TRACER.record(
+                        "device.plane.wait",
+                        t0=r.t_enq,
+                        dur=now - r.t_enq,
+                        parent_ctx=r.ctx,
+                        op=op,
+                        lane=r.lane,
+                        batch_span=f"{batch_ctx.span_id:016x}",
+                    )
         if not REGISTRY.enabled:
             return
         for r in reqs:

@@ -130,6 +130,51 @@ class Node:
             self.executor_manager.start()
             self.executor_manager.wait_for_executors(config.executor_min)
             self.executor = CompositeRemoteExecutor(self.executor_manager)
+            # lifecycle tracing across the Max split: /trace/tx pulls the
+            # executor processes' ring spans through the fleet. The source
+            # holds the manager WEAKLY and removes itself once the manager
+            # is gone — repeated Node constructions in one process must not
+            # pin dead fleets or grow the source list without bound.
+            import weakref
+
+            from ..observability import critical_path
+
+            mgr_ref = weakref.ref(self.executor_manager)
+
+            def _fleet_spans(trace_ids, block):
+                mgr = mgr_ref()
+                if mgr is None:
+                    try:
+                        critical_path.SPAN_SOURCES.remove(_fleet_spans)
+                    except ValueError:
+                        pass
+                    return []
+                members = mgr.members()
+                if not members:
+                    return []
+                from concurrent.futures import ThreadPoolExecutor
+
+                from ..service.remote_manager import _guarded
+
+                # _guarded marks an unreachable member dead (so the NEXT
+                # /trace/tx request skips it instead of re-paying its
+                # timeout); the parallel dial bounds this request to the
+                # slowest member, not the sum over a half-dead fleet
+                def one(m):
+                    try:
+                        return _guarded(
+                            mgr, m, lambda: m.executor.trace_spans(trace_ids, block)
+                        )
+                    except Exception:
+                        return []  # a dead executor must not kill the answer
+
+                out = []
+                with ThreadPoolExecutor(max_workers=min(8, len(members))) as pool:
+                    for spans in pool.map(one, members):
+                        out.extend(spans)
+                return out
+
+            critical_path.SPAN_SOURCES.append(_fleet_spans)
         else:
             self.executor = TransactionExecutor(
                 self.storage,

@@ -9,7 +9,12 @@ Three pieces (ISSUE 1 tentpole):
   composes it; modules observe through the process ``REGISTRY``.
 - :mod:`.tracer` — thread-safe span tracing (``TRACER.span(...)`` context
   managers, nesting, bounded ring) exported as Chrome trace-event JSON at
-  ``GET /trace``.
+  ``GET /trace``. Since ISSUE 4: real trace semantics — 128-bit trace ids,
+  explicit span/parent ids, contextvars + traceparent propagation across
+  the service split, span links, head sampling.
+- :mod:`.critical_path` — the per-transaction lifecycle stitcher behind
+  ``GET /trace/tx/<hash>`` (tx→trace and block→trace indexes, cross-process
+  span collection, ordered stage breakdown with the dominant stage named).
 - :mod:`.device` — the per-op device-crypto signal bundle (batch sizes,
   latency, items/sec, compile-vs-cached counters). Imported directly as
   ``from ..observability.device import device_span`` by the ops wrappers
@@ -27,7 +32,13 @@ from .histogram import (  # noqa: F401
     LATENCY_BUCKETS_MS,
     Histogram,
 )
-from .tracer import TRACER, SpanRecord, Tracer  # noqa: F401
+from .tracer import (  # noqa: F401
+    TRACER,
+    SpanRecord,
+    TraceContext,
+    Tracer,
+    current_context,
+)
 
 
 def set_enabled(flag: bool) -> None:

@@ -13,12 +13,22 @@ mirror the compiled-program shapes).
 Exposition follows Prometheus text format 0.0.4: per label set, cumulative
 ``<name>_bucket{le="..."}`` samples (upper-bound inclusive), a ``+Inf``
 bucket equal to ``_count``, plus ``<name>_sum`` and ``<name>_count``.
+
+Exemplars (ISSUE 4): ``observe(value, exemplar=trace_id_hex)`` remembers
+the LAST exemplar per bucket and renders it in OpenMetrics exemplar syntax
+(``... # {trace_id="<hex>"} <value> <unix_ts>``) so a p99 bucket points at
+a concrete trace to pull from ``/trace/tx/<hash>``. Exemplars are only
+legal in the ``application/openmetrics-text`` format — the classic 0.0.4
+text parser rejects a mid-line ``#`` — so rendering them is opt-in
+(``render_into(lines, with_exemplars=True)``): the HTTP endpoint emits
+them only when the scraper negotiates OpenMetrics via the Accept header.
 """
 
 from __future__ import annotations
 
 import math
 import threading
+import time
 from bisect import bisect_left
 
 # the reference's mtail bucket contract for block execution/commit latency
@@ -59,12 +69,15 @@ class _Child:
     """One label set's state: per-bin counts (bin i = first bucket >= value,
     last bin = overflow/+Inf-only), running sum and count."""
 
-    __slots__ = ("bins", "sum", "count")
+    __slots__ = ("bins", "sum", "count", "exemplars")
 
     def __init__(self, nbuckets: int):
         self.bins = [0] * (nbuckets + 1)
         self.sum = 0.0
         self.count = 0
+        # bin index -> (exemplar label value, observed value, unix ts);
+        # last-write-wins, rendered in OpenMetrics exemplar syntax
+        self.exemplars: dict[int, tuple[str, float, float]] = {}
 
 
 class Histogram:
@@ -86,7 +99,9 @@ class Histogram:
         self._lock = threading.Lock()
         self._children: dict[tuple[tuple[str, str], ...], _Child] = {}
 
-    def observe(self, value: float, labels: dict | None = None) -> None:
+    def observe(
+        self, value: float, labels: dict | None = None, exemplar: str | None = None
+    ) -> None:
         value = float(value)
         key = (
             tuple(sorted((str(k), str(v)) for k, v in labels.items()))
@@ -101,6 +116,8 @@ class Histogram:
             child.bins[idx] += 1
             child.sum += value
             child.count += 1
+            if exemplar:
+                child.exemplars[idx] = (str(exemplar), value, time.time())
 
     def snapshot(self) -> dict:
         """{label_pairs: (cumulative bucket counts ..., sum, count)} — the
@@ -115,17 +132,24 @@ class Histogram:
                 out[key] = (tuple(cum), child.sum, child.count)
         return out
 
-    def render_into(self, lines: list[str]) -> None:
+    def render_into(self, lines: list[str], with_exemplars: bool = False) -> None:
         if self.help:
             lines.append(f"# HELP {self.name} {escape_help(self.help)}")
         lines.append(f"# TYPE {self.name} histogram")
         for key in sorted(self.snapshot_keys()):
-            cum, total, count = self._render_child(key)
-            for bound, c in zip(self.buckets, cum):
+            cum, total, count, exemplars = self._render_child(key)
+            if not with_exemplars:
+                exemplars = {}
+            for i, (bound, c) in enumerate(zip(self.buckets, cum)):
                 lbl = render_labels(key + (("le", format_float(bound)),))
-                lines.append(f"{self.name}_bucket{lbl} {c}")
+                lines.append(
+                    f"{self.name}_bucket{lbl} {c}{_exemplar_suffix(exemplars.get(i))}"
+                )
             lbl = render_labels(key + (("le", "+Inf"),))
-            lines.append(f"{self.name}_bucket{lbl} {count}")
+            lines.append(
+                f"{self.name}_bucket{lbl} {count}"
+                f"{_exemplar_suffix(exemplars.get(len(self.buckets)))}"
+            )
             lines.append(f"{self.name}_sum{render_labels(key)} {total:g}")
             lines.append(f"{self.name}_count{render_labels(key)} {count}")
 
@@ -138,10 +162,19 @@ class Histogram:
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                return [], 0.0, 0
+                return [], 0.0, 0, {}
             bins, total_sum, count = list(child.bins), child.sum, child.count
+            exemplars = dict(child.exemplars)
         cum, total = [], 0
         for b in bins[:-1]:
             total += b
             cum.append(total)
-        return cum, total_sum, count
+        return cum, total_sum, count, exemplars
+
+
+def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:
+    """OpenMetrics exemplar rendering: `` # {trace_id="<v>"} value ts``."""
+    if ex is None:
+        return ""
+    label, value, ts = ex
+    return f' # {{trace_id="{escape_label_value(label)}"}} {value:g} {ts:.3f}'

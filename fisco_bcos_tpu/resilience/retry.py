@@ -108,14 +108,31 @@ class RetryPolicy:
     ):
         """Call ``fn(*args, **kwargs)``, retrying classified errors with
         backoff until attempts or the deadline run out. The LAST error is
-        re-raised (not a wrapper: failover seams key on error types)."""
+        re-raised (not a wrapper: failover seams key on error types).
+
+        Re-attempts (attempt >= 1) run inside a ``retry.attempt`` child
+        span carrying the attempt number, so a fault-injected trace shows
+        the retries instead of an unexplained gap; the first attempt stays
+        span-free (the callee's own spans cover the happy path)."""
+        from ..observability.tracer import TRACER
+
         classify = retry_on if retry_on is not None else self.retry_on
         last: BaseException | None = None
+        name = getattr(fn, "__name__", "call")
         for attempt in range(self.max_attempts):
             if deadline is not None:
-                deadline.check(getattr(fn, "__name__", "call"))
+                deadline.check(name)
             try:
-                return fn(*args, **kwargs)
+                if attempt == 0:
+                    return fn(*args, **kwargs)
+                with TRACER.span(
+                    "retry.attempt", attempt=attempt, fn=name
+                ) as sp:
+                    try:
+                        return fn(*args, **kwargs)
+                    except classify as e:  # type: ignore[misc]
+                        sp.set(error=type(e).__name__)
+                        raise
             except classify as e:  # type: ignore[misc]
                 last = e
                 if attempt + 1 >= self.max_attempts:
@@ -148,7 +165,8 @@ IDEMPOTENT_METHODS: set[str] = {
     "get_hash", "call", "get_code", "get_abi", "known_callee",
     "next_block_header", "get_storage", "ctx_floor",
     # registry / telemetry / health
-    "register", "heartbeat", "metrics", "trace", "health",
+    "register", "heartbeat", "metrics", "trace", "trace_tx", "trace_spans",
+    "health",
 }
 
 NON_IDEMPOTENT_METHODS: set[str] = {

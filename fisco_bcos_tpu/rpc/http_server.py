@@ -17,6 +17,26 @@ from .jsonrpc import JsonRpcImpl
 _log = get_logger("rpc-http")
 
 
+def _accepts_openmetrics(accept: str | None) -> bool:
+    """True when the Accept header opts INTO application/openmetrics-text:
+    an offer with q=0 is an explicit refusal, not an opt-in."""
+    for part in (accept or "").split(","):
+        media, _, params = part.partition(";")
+        if "openmetrics" not in media:
+            continue
+        q = 1.0
+        for p in params.split(";"):
+            k, _, v = p.strip().partition("=")
+            if k == "q":
+                try:
+                    q = float(v)
+                except ValueError:
+                    q = 0.0
+        if q > 0:
+            return True
+    return False
+
+
 class RpcHttpServer:
     """`ssl_context` (gateway.tls.make_server_context) upgrades to HTTPS —
     the reference's boostssl TLS RPC channel."""
@@ -30,15 +50,20 @@ class RpcHttpServer:
         metrics=None,
         tracer=None,
         health=None,
+        trace_tx=None,
     ):
         self.impl = impl
         # `metrics` needs .render() -> str; `tracer` needs .export_json() ->
         # str; `health` needs .to_json() -> str — satisfied by
         # MetricsRegistry/Tracer/HealthRegistry in-process and by the
-        # RemoteTelemetry proxy in the split (Pro/Max) deployment
+        # RemoteTelemetry proxy in the split (Pro/Max) deployment.
+        # `trace_tx` (tx-hash hex -> critical-path dict) serves
+        # GET /trace/tx/<hash>; when omitted, a tracer exposing its own
+        # .trace_tx (RemoteTelemetry) is used.
         self.metrics = metrics
         self.tracer = tracer
         self.health = health
+        self.trace_tx = trace_tx or getattr(tracer, "trace_tx", None)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -70,12 +95,44 @@ class RpcHttpServer:
             def do_GET(self) -> None:  # noqa: N802 — telemetry scrape
                 code = 200
                 if self.path == "/metrics" and outer.metrics is not None:
-                    data = outer.metrics.render().encode()
-                    ctype = "text/plain; version=0.0.4"
+                    # exemplars only under negotiated OpenMetrics — the
+                    # classic 0.0.4 text parser rejects the suffix
+                    om = _accepts_openmetrics(self.headers.get("Accept"))
+                    try:
+                        data = outer.metrics.render(openmetrics=om).encode()
+                    except TypeError:  # renderer without the kwarg
+                        data = outer.metrics.render().encode()
+                        om = False
+                    if om and not data.strip():
+                        # a failed split-mode render returns "" — an empty
+                        # body labeled OpenMetrics lacks the mandatory
+                        # '# EOF' and fails strict scrapers; serve it as
+                        # (empty) classic text instead
+                        om = False
+                    ctype = (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                        if om
+                        else "text/plain; version=0.0.4"
+                    )
                 elif self.path == "/trace" and outer.tracer is not None:
                     # Chrome trace-event JSON — load in Perfetto as-is
                     data = outer.tracer.export_json().encode()
                     ctype = "application/json"
+                elif (
+                    self.path.startswith("/trace/tx/")
+                    and outer.trace_tx is not None
+                ):
+                    # stitched per-transaction critical path (ISSUE 4):
+                    # every lifecycle span sharing the tx's trace set,
+                    # ordered, with the dominant stage named
+                    doc = outer.trace_tx(
+                        self.path.split("?", 1)[0].rsplit("/", 1)[1]
+                    )
+                    data = json.dumps(doc, default=str).encode()
+                    ctype = "application/json"
+                    if not doc.get("found"):
+                        code = 404
                 elif self.path == "/health" and outer.health is not None:
                     # degraded-mode registry (resilience.HEALTH or the
                     # split-mode RemoteTelemetry proxy). 503 ONLY on

@@ -23,6 +23,11 @@ from ..utils.log import get_logger
 _log = get_logger("rpc")
 
 
+# JSON-RPC methods that open a lifecycle trace root; read polling stays
+# span-free so it cannot evict block-lifecycle spans from the bounded ring
+TRACED_RPC_METHODS = frozenset({"sendTransaction"})
+
+
 class JsonRpcError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(message)
@@ -147,7 +152,19 @@ class JsonRpcImpl:
             if fn is None:
                 raise JsonRpcError(-32601, f"method not found: {method}")
             params = request.get("params", [])
-            result = fn(*params)
+            if method in TRACED_RPC_METHODS:
+                from ..observability import TRACER
+
+                # the lifecycle root (Air mode) or the node-side
+                # continuation of the RPC process's root (split mode, via
+                # the facade traceparent). Only lifecycle-bearing methods:
+                # a span per read poll (getBlockNumber at hundreds/s)
+                # would churn the bounded ring and evict the block spans
+                # /trace/tx stitches.
+                with TRACER.span("rpc.request", method=method):
+                    result = fn(*params)
+            else:
+                result = fn(*params)
             return {"jsonrpc": "2.0", "id": rid, "result": result}
         except JsonRpcError as e:
             return {

@@ -138,7 +138,7 @@ class Scheduler:
                     and not verify
                 ):
                     # same proposal re-executed (preExecute cache)
-                    sp.attrs["cache"] = "hit"
+                    sp.set(cache="hit")
                     REGISTRY.counter_add(
                         "fisco_scheduler_preexec_hits_total",
                         help="commit-quorum executions served by the "
@@ -149,13 +149,16 @@ class Scheduler:
                 header = self._execute_block_locked(
                     block, verify, number, proposal_ident
                 )
+                from ..observability.tracer import trace_hex
+
                 REGISTRY.observe(
                     "fisco_block_execute_latency_ms",
                     (time.perf_counter() - t0) * 1e3,
                     help="block execution wall latency (mtail block-exec "
                     "buckets)",
+                    exemplar=trace_hex(sp.ctx),
                 )
-                sp.attrs["txs"] = len(block.transactions)
+                sp.set(txs=len(block.transactions))
                 return header
 
     def _execute_block_locked(
@@ -299,7 +302,7 @@ class Scheduler:
     # -- commitBlock:390 -----------------------------------------------------
 
     def commit_block(self, header: BlockHeader) -> None:
-        with TRACER.span("scheduler.commit_block", block=header.number):
+        with TRACER.span("scheduler.commit_block", block=header.number) as sp:
             t0 = time.perf_counter()
             with self._lock:
                 committed = self._commit_block_locked(header)
@@ -313,10 +316,13 @@ class Scheduler:
                     number, block = committed
                     for cb in list(self.on_committed):
                         self._notify.post(lambda cb=cb: cb(number, block))
+            from ..observability.tracer import trace_hex
+
             REGISTRY.observe(
                 "fisco_block_commit_latency_ms",
                 (time.perf_counter() - t0) * 1e3,
                 help="block commit wall latency (mtail block-commit buckets)",
+                exemplar=trace_hex(sp.ctx),
             )
 
     def _commit_block_locked(self, header: BlockHeader) -> None:
@@ -348,9 +354,13 @@ class Scheduler:
         ledger_writes = StateStorage()
         self.ledger.prewrite_block(cached.block, ledger_writes)
         params = TwoPCParams(number=number)
-        self.executor.prepare(params, extra_writes=ledger_writes)
+        # the 2PC legs as spans: on a remote executor/storage split these
+        # parent the service-side svc.*.prepare/commit spans over the wire
+        with TRACER.span("scheduler.2pc_prepare", block=number):
+            self.executor.prepare(params, extra_writes=ledger_writes)
         timer.stage("prepare")
-        self.executor.commit(params)
+        with TRACER.span("scheduler.2pc_commit", block=number):
+            self.executor.commit(params)
         timer.stage("commit")
         with self._lock:
             self._executed.pop(number, None)

@@ -71,6 +71,9 @@ class ExecutorService:
         s.register("ctx_floor", self._ctx_floor)
         s.register("align", self._align)
         s.register("get_storage", self._get_storage)
+        # lifecycle tracing: hand this process's ring spans to the node
+        # core's /trace/tx stitcher (critical_path.SPAN_SOURCES)
+        s.register("trace_spans", self._trace_spans)
         self.host, self.port = s.host, s.port
 
     def start(self) -> None:
@@ -281,6 +284,17 @@ class ExecutorService:
             w.bytes_(entry.encode())
         return w.out()
 
+    def _trace_spans(self, payload: bytes) -> bytes:
+        import json
+
+        from ..observability import critical_path
+
+        req = json.loads(payload or b"{}")
+        ids = {int(t, 16) for t in req.get("traceIds", ())}
+        return json.dumps(
+            critical_path.local_spans_for(ids, req.get("block")), default=str
+        ).encode()
+
 
 class RemoteExecutor:
     """The scheduler-facing executor seam, over the wire
@@ -305,6 +319,14 @@ class RemoteExecutor:
 
     def get_hash(self) -> bytes:
         return self.client.call("get_hash")
+
+    def trace_spans(self, trace_ids: set, block=None) -> list[dict]:
+        """Fetch the executor process's ring spans for a stitched set —
+        a critical_path.SPAN_SOURCES provider (node/node.py wires it)."""
+        import json
+
+        req = {"traceIds": [f"{t:032x}" for t in trace_ids], "block": block}
+        return json.loads(self.client.call("trace_spans", json.dumps(req).encode()))
 
     def call(self, tx: Transaction) -> TransactionReceipt:
         w = FlatWriter()

@@ -51,13 +51,28 @@ class FrontEndpoint:
         self._worker.stop()
 
     def _on_receive(self, payload: bytes) -> bytes:
+        from ..observability.tracer import TRACER
+
         r = FlatReader(payload)
         module_id = r.u32()
         src = r.bytes_()
         data = r.bytes_()
         r.done()
-        self._worker.post(lambda: self.front.on_receive(module_id, src, data))
+        # the RPC server attached the gateway frame's trace context around
+        # this handler; re-attach it on the dispatch worker so the module
+        # handler's spans stay in the sender's trace (the worker hand-off
+        # would otherwise drop it — contextvars don't cross threads)
+        ctx = TRACER.current_context()
+        self._worker.post(
+            lambda: self._dispatch(ctx, module_id, src, data)
+        )
         return b""
+
+    def _dispatch(self, ctx, module_id: int, src: bytes, data: bytes) -> None:
+        from ..observability.tracer import TRACER
+
+        with TRACER.attach(ctx):
+            self.front.on_receive(module_id, src, data)
 
 
 class _ForwardingFront:

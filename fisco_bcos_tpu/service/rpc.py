@@ -5,8 +5,14 @@ microservices; this transport carries the same request/response shape with
 the framework's flat codec:
 
     frame   = u32 len ‖ body
-    request = u64 id ‖ str method ‖ bytes payload
+    request = u64 id ‖ str method ‖ str traceparent ‖ bytes payload
     reply   = u64 id ‖ u8 ok ‖ bytes payload-or-error
+
+The ``traceparent`` field is the W3C-style trace context
+(``00-<trace_id>-<span_id>-<flags>``, empty when the caller has none):
+the client injects its ambient context, the server re-attaches it around
+the handler and wraps dispatch in a ``svc.<service>.<method>`` span — so
+one trace follows a call across the Pro/Max service split.
 
 Servers dispatch method -> handler(payload bytes) -> payload bytes; the
 client is synchronous (one in-flight pipeline per connection, matching how
@@ -32,6 +38,7 @@ import threading
 from typing import Callable
 
 from ..codec.flat import FlatReader, FlatWriter
+from ..observability.tracer import TRACER, TraceContext
 from ..resilience import faults
 from ..resilience.retry import Deadline, RetryPolicy, is_idempotent
 from ..utils.log import get_logger
@@ -203,6 +210,7 @@ class ServiceServer:
                 r = FlatReader(body)
                 req_id = r.u64()
                 method = r.str_()
+                traceparent = r.str_()
                 payload = r.bytes_()
                 r.done()
             except Exception as e:
@@ -219,8 +227,21 @@ class ServiceServer:
             try:
                 if fn is None:
                     raise ValueError(f"unknown method {method}")
+                ctx = (
+                    TraceContext.from_traceparent(traceparent)
+                    if traceparent and TRACER.enabled
+                    else None
+                )
                 with self._dispatch_lock:
-                    out = fn(payload)
+                    if ctx is not None:
+                        # the remote caller's trace continues here: the
+                        # handler (and every span it opens) joins it
+                        with TRACER.attach(ctx), TRACER.span(
+                            f"svc.{self.name}.{method}"
+                        ):
+                            out = fn(payload)
+                    else:
+                        out = fn(payload)
                 w.u8(1)
                 w.bytes_(out)
             except Exception as e:  # error crosses the wire, not the stack
@@ -334,6 +355,9 @@ class ServiceClient:
             w = FlatWriter()
             w.u64(req_id)
             w.str_(method)
+            # trace context crosses the split here; "" when the tracer is
+            # off or nothing is in flight (one contextvar read either way)
+            w.str_(TRACER.current_traceparent())
             w.bytes_(payload)
             bad: BadFrame | None = None
             try:

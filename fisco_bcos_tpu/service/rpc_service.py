@@ -43,6 +43,7 @@ class RpcFacade:
         self.server.register("handle", self._handle)
         self.server.register("metrics", self._metrics)
         self.server.register("trace", self._trace)
+        self.server.register("trace_tx", self._trace_tx)
         self.server.register("health", self._health)
         self.host, self.port = self.server.host, self.server.port
 
@@ -56,13 +57,33 @@ class RpcFacade:
         req = json.loads(payload)
         return json.dumps(self.impl.handle(req)).encode()
 
-    def _metrics(self, _payload: bytes) -> bytes:
-        return (self.metrics.render() if self.metrics is not None else "").encode()
+    def _metrics(self, payload: bytes) -> bytes:
+        if self.metrics is None:
+            return b""
+        if payload == b"openmetrics":
+            # no silent downgrade: the RPC process labels the response
+            # application/openmetrics-text, so a renderer without the
+            # kwarg must surface as an error reply, not classic text
+            # masquerading as OpenMetrics (no '# EOF', no exemplars)
+            return self.metrics.render(openmetrics=True).encode()
+        return self.metrics.render().encode()
 
     def _trace(self, _payload: bytes) -> bytes:
         if self.tracer is None:
             return b'{"traceEvents": []}'
         return self.tracer.export_json().encode()
+
+    def _trace_tx(self, payload: bytes) -> bytes:
+        """Raw (un-analyzed) critical-path collection for one tx hash hex:
+        the node core owns the tx/block indexes; the RPC process merges its
+        OWN ring's spans (the submit root lives there) before analyzing."""
+        if self.tracer is None:
+            return b'{"found": false, "spans": []}'
+        from ..observability import critical_path
+
+        return json.dumps(
+            critical_path.collect(payload.decode()), default=str
+        ).encode()
 
     def _health(self, _payload: bytes) -> bytes:
         if self.health is None:
@@ -80,7 +101,23 @@ class RemoteJsonRpc:
 
     def handle(self, request: dict) -> dict:
         try:
-            resp = self.client.call("handle", json.dumps(request).encode())
+            method = request.get("method", "")
+            from ..rpc.jsonrpc import TRACED_RPC_METHODS
+
+            if method in TRACED_RPC_METHODS:
+                from ..observability import TRACER
+
+                # the split deployment's lifecycle root: opened in the RPC
+                # process, continued by the node core via the traceparent
+                # the service client injects into the facade call. Read
+                # polls stay span-free (same ring-churn guard as
+                # JsonRpcImpl.handle).
+                with TRACER.span("rpc.forward", method=method):
+                    resp = self.client.call(
+                        "handle", json.dumps(request).encode()
+                    )
+            else:
+                resp = self.client.call("handle", json.dumps(request).encode())
             return json.loads(resp)
         except Exception as e:
             _log.exception("facade call failed")
@@ -106,9 +143,11 @@ class RemoteTelemetry:
     def __init__(self, host: str, port: int, timeout: float = 10.0):
         self.client = ServiceClient(host, port, timeout)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         try:
-            return self.client.call("metrics").decode()
+            return self.client.call(
+                "metrics", b"openmetrics" if openmetrics else b""
+            ).decode()
         except Exception:
             return ""
 
@@ -117,6 +156,28 @@ class RemoteTelemetry:
             return self.client.call("trace").decode()
         except Exception:
             return '{"traceEvents": []}'
+
+    def trace_tx(self, tx_hash_hex: str) -> dict:
+        """Stitch one tx's critical path ACROSS the split: the node core's
+        collection (its ring + indexes) merged with THIS process's spans —
+        the submit root and any rpc-process work belong to the same trace
+        but live in this ring, not the node's."""
+        from ..observability import critical_path
+
+        try:
+            doc = json.loads(
+                self.client.call("trace_tx", tx_hash_hex.encode())
+            )
+        except Exception:
+            return {"found": False, "txHash": tx_hash_hex, "spans": []}
+        if doc.get("found"):
+            trace_ids = {int(t, 16) for t in doc.get("traceIds", ())}
+            local = critical_path.local_spans_for(trace_ids, doc.get("block"))
+            known = {(s["trace_id"], s["span_id"]) for s in doc["spans"]}
+            doc["spans"].extend(
+                s for s in local if (s["trace_id"], s["span_id"]) not in known
+            )
+        return critical_path.analyze(doc)
 
     def to_json(self) -> str:
         """Health JSON for GET /health. An unreachable node core IS a

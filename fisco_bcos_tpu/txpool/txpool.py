@@ -17,6 +17,7 @@ from dataclasses import dataclass
 from ..crypto.suite import CryptoSuite
 from ..ledger import Ledger
 from ..observability import BATCH_BUCKETS, TRACER
+from ..observability import critical_path
 from ..protocol.transaction import Transaction, hash_transactions_batch
 from ..utils.error import ErrorCode
 from ..utils.log import get_logger
@@ -88,19 +89,26 @@ class TxPool:
     # -- admission -----------------------------------------------------------
 
     def submit(self, tx: Transaction) -> TxSubmitResult:
-        """Single-tx admission (RPC path; TxPool.cpp:68 submitTransaction)."""
-        with self._lock:
-            if len(self._txs) >= self.pool_limit:
-                return TxSubmitResult(b"", ErrorCode.TX_POOL_FULL)
-        h = tx.hash(self.suite)
-        with self._lock:
-            if h in self._txs:
-                return TxSubmitResult(h, ErrorCode.ALREADY_IN_TX_POOL)
-        code = self.validator.verify(tx)
-        if code != ErrorCode.SUCCESS:
-            return TxSubmitResult(h, code)
-        self._insert(tx, h)
-        return TxSubmitResult(h, ErrorCode.SUCCESS, tx.sender)
+        """Single-tx admission (RPC path; TxPool.cpp:68 submitTransaction).
+
+        The admission span is the transaction's lifecycle anchor: its trace
+        context is registered with the critical-path index so the sealer
+        can close the pool-wait gap and ``/trace/tx/<hash>`` can stitch."""
+        with TRACER.span("txpool.submit") as sp:
+            with self._lock:
+                if len(self._txs) >= self.pool_limit:
+                    return TxSubmitResult(b"", ErrorCode.TX_POOL_FULL)
+            h = tx.hash(self.suite)
+            with self._lock:
+                if h in self._txs:
+                    return TxSubmitResult(h, ErrorCode.ALREADY_IN_TX_POOL)
+            code = self.validator.verify(tx)
+            if code != ErrorCode.SUCCESS:
+                sp.set(status=code.name)
+                return TxSubmitResult(h, code)
+            self._insert(tx, h)
+            critical_path.note_tx(h, sp.ctx)
+            return TxSubmitResult(h, ErrorCode.SUCCESS, tx.sender)
 
     def submit_batch(
         self, txs: list[Transaction], lane: str = "admission"
@@ -119,6 +127,14 @@ class TxPool:
         equal nonce), so no pre-verification hash pass is needed — the
         fused program's digests fill the hash caches of verified lanes,
         and only rejected lanes pay a host hash for their result row."""
+        with TRACER.span(
+            "txpool.submit_batch", batch=len(txs), lane=lane
+        ) as sp:
+            return self._submit_batch_spanned(txs, lane, sp)
+
+    def _submit_batch_spanned(
+        self, txs: list[Transaction], lane: str, sp
+    ) -> list[TxSubmitResult]:
         t0 = time.perf_counter()
         results: list[TxSubmitResult | None] = [None] * len(txs)
         to_verify: list[int] = []
@@ -155,6 +171,10 @@ class TxPool:
                     results[i] = TxSubmitResult(h, ErrorCode.SUCCESS, txs[i].sender)
                 else:
                     results[i] = TxSubmitResult(h, ErrorCode.INVALID_SIGNATURE)
+            # batch-admitted txs share the batch span as their lifecycle
+            # anchor: ONE index registration for the whole batch (single
+            # lock pass) — the hot loop stays batch-level
+            critical_path.note_txs([h for h, _t in persisted], sp.ctx)
             if self.pstore is not None and persisted:
                 from ..storage.entry import Entry
 
@@ -164,10 +184,10 @@ class TxPool:
                     self.PERSIST_TABLE,
                     [(h, Entry({"value": t.encode()})) for h, t in persisted],
                 )
-        self._record_admission(txs, results, t0)
+        self._record_admission(txs, results, t0, sp)
         return results  # type: ignore[return-value]
 
-    def _record_admission(self, txs, results, t0: float) -> None:
+    def _record_admission(self, txs, results, t0: float, sp) -> None:
         """Batch-level admission telemetry (one observation per batch, never
         per tx — the hot loop above stays untouched)."""
         if not REGISTRY.enabled and not TRACER.enabled:
@@ -181,10 +201,13 @@ class TxPool:
             elif r is not None:
                 reason = _REJECT_REASON.get(r.status, "static")
                 rejects[reason] = rejects.get(reason, 0) + 1
+        from ..observability.tracer import trace_hex
+
         REGISTRY.observe(
             "fisco_txpool_admission_latency_ms",
             dur * 1e3,
             help="submit_batch wall latency (static gates + device verify)",
+            exemplar=trace_hex(sp.ctx),
         )
         REGISTRY.observe(
             "fisco_txpool_batch_size",
@@ -203,9 +226,7 @@ class TxPool:
                 float(n),
                 help="transactions rejected at admission by reason",
             )
-        TRACER.record(
-            "txpool.submit_batch", t0, dur, batch=len(txs), admitted=admitted
-        )
+        sp.set(admitted=admitted)
 
     def _insert(self, tx: Transaction, h: bytes, persist: bool = True) -> None:
         with self._lock:
@@ -326,7 +347,7 @@ class TxPool:
                 help="proposal hash-presence verifications",
             )
             if missing:
-                sp.attrs["missing"] = len(missing)
+                sp.set(missing=len(missing))
                 REGISTRY.counter_add(
                     "fisco_txpool_proposal_missing_total",
                     float(len(missing)),
@@ -388,4 +409,5 @@ class TxPool:
                 [(h, Entry(status=EntryStatus.DELETED)) for h in tx_hashes],
             )
         self.ledger_nonces.commit_block(number, nonces)
+        critical_path.note_committed(tx_hashes, number)
         _log.info("block %d committed: dropped %d txs", number, len(tx_hashes))

@@ -82,18 +82,37 @@ class MetricsRegistry:
         value: float,
         buckets=LATENCY_BUCKETS_MS,
         help: str = "",
+        exemplar: str | None = None,
         **labels,
     ) -> None:
-        """One-call histogram observation (labels as kwargs)."""
+        """One-call histogram observation (labels as kwargs). ``exemplar``
+        (a trace-id hex) ties this sample's bucket to a concrete trace in
+        the OpenMetrics exemplar rendering."""
         if not self.enabled:
             return
-        self.histogram(name, buckets, help).observe(value, labels or None)
+        self.histogram(name, buckets, help).observe(
+            value, labels or None, exemplar=exemplar
+        )
 
     # -- exposition ----------------------------------------------------------
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition format 0.0.4 — each family's
-        ``# HELP``/``# TYPE`` emitted exactly once, help text escaped."""
+        ``# HELP``/``# TYPE`` emitted exactly once, help text escaped.
+
+        ``openmetrics=True`` renders the OpenMetrics variant: histogram
+        exemplars included and a ``# EOF`` terminator — only served when
+        the scraper negotiated ``application/openmetrics-text`` (the 0.0.4
+        parser rejects exemplar suffixes)."""
+        if self is globals().get("REGISTRY"):
+            # pull the tracer's span-drop tallies in at scrape time so a
+            # /metrics-only consumer still sees ring-evict/sampling drops
+            try:
+                from ..observability.tracer import TRACER
+
+                TRACER.flush_drop_metrics()
+            except Exception:
+                pass
         lines: list[str] = []
         with self._lock:
             counters = dict(self._counters)
@@ -106,9 +125,19 @@ class MetricsRegistry:
             for name in samples:
                 by_base.setdefault(name.split("{")[0], []).append(name)
             for base in sorted(by_base):
+                # OpenMetrics names the counter FAMILY without the _total
+                # suffix (samples keep it); a strict parser rejects a TYPE
+                # line whose name ends in _total
+                family = base
+                if (
+                    openmetrics
+                    and mtype == "counter"
+                    and family.endswith("_total")
+                ):
+                    family = family[: -len("_total")]
                 if base in helps:
-                    lines.append(f"# HELP {base} {escape_help(helps[base])}")
-                lines.append(f"# TYPE {base} {mtype}")
+                    lines.append(f"# HELP {family} {escape_help(helps[base])}")
+                lines.append(f"# TYPE {family} {mtype}")
                 for name in sorted(by_base[base]):
                     lines.append(f"{name} {samples[name]:g}")
 
@@ -123,7 +152,9 @@ class MetricsRegistry:
             gauge_vals[name] = val
         emit_family(gauge_vals, "gauge")
         for h in sorted(histograms, key=lambda h: h.name):
-            h.render_into(lines)
+            h.render_into(lines, with_exemplars=openmetrics)
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

@@ -44,6 +44,9 @@ def parse_prom(text):
             assert fam[key] is None, f"duplicate # {kind} for {name}"
             fam[key] = value
         else:
+            # OpenMetrics exemplars ride as a ``# {...}`` suffix on bucket
+            # samples; strip before parsing the sample itself
+            line = line.split(" # ", 1)[0]
             sample, _, value = line.rpartition(" ")
             base = sample.split("{")[0]
             for suffix in ("_bucket", "_sum", "_count"):

@@ -166,15 +166,24 @@ def check_trace(trace: dict) -> None:
     missing = required - names
     if missing:
         fail(f"trace missing spans: {sorted(missing)}")
-    # nesting: the ledger commit runs inside the checkpoint handler's span
+    # nesting by REAL span ids (ISSUE 4 satellite: the parent NAME is just a
+    # display label — the id is unambiguous even for concurrent same-name
+    # stages): the ledger commit runs inside the checkpoint handler's span
+    ckpt_ids = {
+        e["args"]["span_id"]
+        for e in events
+        if e["name"] == "pbft.checkpoint_commit"
+    }
     nested = [
         e
         for e in events
         if e["name"] == "scheduler.commit_block"
-        and e.get("args", {}).get("parent") == "pbft.checkpoint_commit"
+        and e.get("args", {}).get("parent_id") in ckpt_ids
     ]
     if not nested:
         fail("scheduler.commit_block not nested under pbft.checkpoint_commit")
+    if nested[0]["args"].get("parent") != "pbft.checkpoint_commit":
+        fail("display-label parent missing from nested span args")
     print(f"trace ok: {len(events)} spans, full block pipeline present")
 
 
@@ -198,6 +207,119 @@ def check_http() -> None:
     print("http ok: GET /metrics and GET /trace served")
 
 
+def check_split_trace_tx() -> None:
+    """ISSUE 4 acceptance smoke: a Pro-split deployment (node core +
+    storage service here, the RPC front door as its OWN OS process) serves
+    `GET /trace/tx/<hash>` with a stitched lifecycle covering >= 5 stages
+    across >= 2 processes."""
+    import subprocess
+
+    from fisco_bcos_tpu.codec.abi import ABICodec
+    from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+    from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+    from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+    from fisco_bcos_tpu.node import Node, NodeConfig
+    from fisco_bcos_tpu.observability import TRACER
+    from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+    from fisco_bcos_tpu.rpc.jsonrpc import JsonRpcImpl
+    from fisco_bcos_tpu.service import StorageService
+    from fisco_bcos_tpu.service.rpc_service import RpcFacade
+    from fisco_bcos_tpu.storage import MemoryStorage
+    from fisco_bcos_tpu.utils.bytesutil import to_hex
+
+    suite = ecdsa_suite()
+    codec = ABICodec(suite.hash)
+    storage_svc = StorageService(MemoryStorage())
+    storage_svc.start()
+    kp = suite.signature_impl.generate_keypair(secret=0x7E1EAA)
+    node = Node(
+        NodeConfig(
+            genesis=GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub)]),
+            storage_endpoints=f"{storage_svc.host}:{storage_svc.port}",
+        ),
+        keypair=kp,
+    )
+    facade = RpcFacade(JsonRpcImpl(node), tracer=TRACER)
+    facade.start()
+    env = dict(os.environ, PYTHONPATH=_REPO, FISCO_FORCE_CPU="1")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "fisco_bcos_tpu.service", "rpc",
+            "--facade", f"{facade.host}:{facade.port}",
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+        cwd=_REPO,
+        env=env,
+    )
+    try:
+        ready = proc.stdout.readline().strip()
+        if not ready.startswith("READY"):
+            fail(f"rpc process did not come up: {ready!r}")
+        port = int(ready.split("service=")[1].split()[0])
+
+        fac = TransactionFactory(suite)
+        sender = suite.signature_impl.generate_keypair(secret=0x7E1EBB)
+        tx = fac.create_signed(
+            sender,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500,
+            nonce="split-trace-0",
+            to=DAG_TRANSFER_ADDRESS,
+            input=codec.encode_call("userAdd(string,uint256)", "sp", 1),
+        )
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": 1,
+                "method": "sendTransaction",
+                "params": ["group0", "node0", to_hex(tx.encode())],
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            result = json.loads(resp.read())
+            if "result" not in result:
+                fail(f"sendTransaction over the split failed: {result}")
+            tx_hash = result["result"]["transactionHash"]
+        if not node.sealer.seal_and_submit() or node.block_number() != 1:
+            fail("split chain did not commit the block")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace/tx/{tx_hash}", timeout=60
+        ) as resp:
+            doc = json.loads(resp.read())
+        if not doc.get("found"):
+            fail("/trace/tx did not find the submitted tx")
+        stages = {s["name"] for s in doc.get("stages", ())}
+        lifecycle = {
+            "rpc.forward", "rpc.request", "txpool.submit",
+            "txpool.pool_wait", "seal", "pbft.pre_prepare", "pbft.prepare",
+            "pbft.commit", "pbft.checkpoint", "scheduler.execute_block",
+            "scheduler.2pc_prepare", "scheduler.2pc_commit",
+            "scheduler.commit_block",
+        }
+        covered = stages & lifecycle
+        if len(covered) < 5:
+            fail(f"stitched trace covers only {sorted(covered)}")
+        procs = doc.get("processes", 0)
+        if procs < 2:
+            fail(f"stitched trace spans {procs} process(es), expected >= 2")
+        print(
+            f"split trace ok: {len(covered)} lifecycle stages across "
+            f"{procs} processes, dominant={doc.get('dominant')}"
+        )
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        facade.stop()
+        storage_svc.stop()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--txs", type=int, default=96)
@@ -205,6 +327,7 @@ def main() -> int:
     args = ap.parse_args()
     run_chain(args.txs, args.block_cap)
     check_http()
+    check_split_trace_tx()
     print("PASS: telemetry layer live end to end")
     return 0
 
