@@ -740,6 +740,24 @@ if __name__ == "__main__":
     import sys as _sys
 
     if "--telemetry" in _sys.argv:
+        # telemetry artifacts feed dashboards; refuse to produce them from
+        # a tree whose enforced invariants regressed (or whose accepted-debt
+        # baseline went stale) — `python -m fisco_bcos_tpu.analysis` first
+        from fisco_bcos_tpu.analysis import check_repo as _check_repo
+
+        _new, _stale = _check_repo()
+        if _new or _stale:
+            for _f in _new:
+                print(f"# analysis: {_f.render()}", flush=True)
+            for _k in _stale:
+                print(f"# analysis: stale baseline entry: {_k}", flush=True)
+            print(
+                "# --telemetry refused: static-analysis baseline has "
+                f"unreviewed regressions ({len(_new)} new finding(s), "
+                f"{len(_stale)} stale entr(ies))",
+                flush=True,
+            )
+            raise SystemExit(2)
         # dump the metrics snapshot + per-block trace alongside the JSON
         # lines (propagates to --only children through the environment)
         _sys.argv.remove("--telemetry")

@@ -1,0 +1,70 @@
+"""Project-native static analysis + runtime lock-order recording.
+
+The Python/JAX reproduction's answer to the reference node's C++ tooling
+(TSan, clang-tidy, sanitizer CI): an AST-walking framework whose rules
+encode THIS project's invariants —
+
+- :mod:`.checkers.device_dispatch` — device crypto/hash dispatch only
+  through the DevicePlane seams;
+- :mod:`.checkers.shape_bucket` — jit-fed batch shapes routed through the
+  bucket ladder (recompile-churn guard);
+- :mod:`.checkers.jit_purity` — no side effects inside jit-traced bodies;
+- :mod:`.checkers.lock_order` — static lock-acquisition graph: cycles and
+  blocking IO held under a lock;
+- :mod:`.checkers.exceptions` — no silent broad-except swallows;
+- :mod:`.checkers.contracts` — RPC idempotency classification, span
+  closure, histogram bucket contract, the server-side span seam.
+
+Findings diff against the checked-in baseline
+(``tool/analysis_baseline.json``): accepted debt passes, any NEW key
+fails. Run locally with ``python -m fisco_bcos_tpu.analysis``; enforced in
+tier-1 by ``tests/test_static_analysis.py``.
+
+:mod:`.lockorder` is the runtime complement — instrumented
+``threading.Lock``/``RLock`` recording real per-thread acquisition chains
+across the test suite, failing the session on ordering cycles or RPC IO
+under a foreign lock.
+
+Everything importable from here is jax-free: the CLI and the tier-1 test
+run on a cold interpreter in well under the 30 s budget.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    DEFAULT_BASELINE,
+    Checker,
+    Finding,
+    Source,
+    diff_findings,
+    load_baseline,
+    load_sources,
+    save_baseline,
+)
+
+
+def run_all(
+    root: str | None = None,
+    checkers=None,
+    sources: list[Source] | None = None,
+) -> list[Finding]:
+    """Run every (or the given) checkers over the package; stable order."""
+    from .checkers import ALL_CHECKERS
+
+    srcs = sources if sources is not None else load_sources(root)
+    out: list[Finding] = []
+    for cls in checkers or ALL_CHECKERS:
+        out.extend(cls().run(srcs))
+    out.sort(key=lambda f: (f.file, f.line, f.key))
+    return out
+
+
+def check_repo(
+    root: str | None = None, baseline_path: str | None = None
+) -> tuple[list[Finding], list[str]]:
+    """(new findings vs baseline, stale baseline keys) — the enforcement
+    entry point shared by the CLI, the tier-1 test and bench.py's
+    --telemetry gate."""
+    findings = run_all(root)
+    baseline = load_baseline(baseline_path)
+    return diff_findings(findings, baseline)

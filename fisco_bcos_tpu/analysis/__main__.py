@@ -1,0 +1,92 @@
+"""CLI: ``python -m fisco_bcos_tpu.analysis [--format=json|text] ...``.
+
+Exit codes: 0 = clean (no non-baselined findings, no stale baseline
+entries), 1 = new findings or stale baseline entries — the same contract
+the tier-1 test and the ``bench.py --telemetry`` gate enforce — 2 = usage
+error. ``--update-baseline`` rewrites the baseline to the current finding
+set (review the diff before committing it — the baseline is accepted
+debt, not a mute button).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (
+    DEFAULT_BASELINE,
+    diff_findings,
+    load_baseline,
+    run_all,
+    save_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m fisco_bcos_tpu.analysis",
+        description="project-native invariant analyzers (see "
+        "docs/static_analysis.md)",
+    )
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--root", default=None, help="package dir to analyze")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE)
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring accepted debt",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline file to the current finding set",
+    )
+    args = p.parse_args(argv)
+
+    findings = run_all(args.root)
+    if args.update_baseline:
+        old_notes = load_baseline(args.baseline)
+        save_baseline(findings, args.baseline, notes=old_notes)
+        print(
+            f"baseline updated: {len(findings)} accepted findings -> "
+            f"{args.baseline}"
+        )
+        return 0
+    if args.no_baseline:
+        new, stale = findings, []
+    else:
+        new, stale = diff_findings(findings, load_baseline(args.baseline))
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [
+                        {
+                            "key": f.key,
+                            "file": f.file,
+                            "line": f.line,
+                            "checker": f.checker,
+                            "message": f.message,
+                        }
+                        for f in new
+                    ],
+                    "stale_baseline": stale,
+                    "total_findings": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        for key in stale:
+            print(f"stale baseline entry (debt paid? remove it): {key}")
+        print(
+            f"{len(new)} new finding(s), {len(findings) - len(new)} "
+            f"baselined, {len(stale)} stale baseline entr(ies)"
+        )
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

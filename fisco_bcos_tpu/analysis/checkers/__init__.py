@@ -1,0 +1,23 @@
+"""Checker registry — the project-native rule set, one module per rule."""
+
+from __future__ import annotations
+
+from .contracts import ContractChecker
+from .device_dispatch import DeviceDispatchChecker
+from .exceptions import ExceptionHygieneChecker
+from .jit_purity import JitPurityChecker
+from .lock_order import LockOrderChecker
+from .shape_bucket import ShapeBucketChecker
+
+ALL_CHECKERS = (
+    DeviceDispatchChecker,
+    ShapeBucketChecker,
+    JitPurityChecker,
+    LockOrderChecker,
+    ExceptionHygieneChecker,
+    ContractChecker,
+)
+
+
+def checker_names() -> list[str]:
+    return [c.name for c in ALL_CHECKERS]

@@ -1,0 +1,265 @@
+"""Contract completeness: idempotency classification, span closure,
+histogram bucket discipline, the server-side span seam.
+
+Four sub-rules over contracts earlier PRs established:
+
+1. **rpc-unclassified** — every method name registered on a
+   :class:`~fisco_bcos_tpu.service.rpc.ServiceServer` must appear in
+   ``resilience.retry.IDEMPOTENT_METHODS`` or ``NON_IDEMPOTENT_METHODS``
+   (parsed statically from retry.py, plus literal ``mark_idempotent("x")``
+   calls anywhere). An unclassified method silently opts out of auto-retry
+   — or worse, a future default flip double-executes it.
+2. **span-not-closed** — ``TRACER.span(...)`` / ``device_span(...)`` must
+   be entered as a ``with`` item (directly, or via a name assigned and then
+   used as a ``with`` item in the same function). A span that is never
+   ``__exit__``-ed never records and silently truncates its whole trace
+   subtree.
+3. **adhoc-latency-buckets** — ``*.observe("..._ms", ...)`` and
+   ``Histogram("..._ms", ...)`` must not pass a literal bucket list:
+   latency histograms ride the mtail 0/50/100/150 ms contract
+   (``LATENCY_BUCKETS_MS``) or another NAMED ``*_BUCKETS*`` constant, so
+   dashboards built against the reference exposition keep parsing. A
+   literal that shadows the contract drifts silently.
+4. **server-span-seam** — the central ``svc.<service>.<method>`` span in
+   ``service/rpc.py``'s dispatch loop must stay present (it is what makes
+   rule 1's classification observable across the split); its removal is a
+   finding against rpc.py itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding, Source, qualnames
+
+RETRY_MODULE = "fisco_bcos_tpu/resilience/retry.py"
+RPC_MODULE = "fisco_bcos_tpu/service/rpc.py"
+SPAN_FACTORIES = {"span", "device_span"}
+# modules that define/forward the span machinery itself
+SPAN_DEFINING = (
+    "fisco_bcos_tpu/observability/",
+    "fisco_bcos_tpu/analysis/",
+)
+
+
+def _classified_methods(sources: list[Source]) -> set[str]:
+    """The union of both classification sets in retry.py, plus every
+    literal ``mark_idempotent("name"[, flag])`` call in the package."""
+    out: set[str] = set()
+    for src in sources:
+        if src.relpath == RETRY_MODULE:
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for tgt in targets:
+                        if isinstance(tgt, ast.Name) and tgt.id in (
+                            "IDEMPOTENT_METHODS",
+                            "NON_IDEMPOTENT_METHODS",
+                        ):
+                            for el in getattr(node.value, "elts", []):
+                                if isinstance(el, ast.Constant) and isinstance(
+                                    el.value, str
+                                ):
+                                    out.add(el.value)
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Name, ast.Attribute))
+                and (
+                    getattr(node.func, "id", None) == "mark_idempotent"
+                    or getattr(node.func, "attr", None) == "mark_idempotent"
+                )
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+            ):
+                out.add(node.args[0].value)
+    return out
+
+
+class ContractChecker(Checker):
+    name = "contract"
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        out: list[Finding] = []
+        classified = _classified_methods(sources)
+        for src in sources:
+            qn = qualnames(src.tree)
+            self._check_registrations(src, qn, classified, out)
+            if not src.relpath.startswith(SPAN_DEFINING):
+                self._check_span_closure(src, qn, out)
+            self._check_histogram_buckets(src, qn, out)
+        self._check_server_span_seam(sources, out)
+        return out
+
+    # -- rule 1: idempotency classification -----------------------------------
+
+    def _check_registrations(self, src, qn, classified, out) -> None:
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "register"
+                and len(node.args) == 2
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            method = node.args[0].value
+            if method in classified:
+                continue
+            if src.waived(node.lineno, self.name):
+                continue
+            out.append(
+                self.finding(
+                    src,
+                    node,
+                    qn.get(node, ""),
+                    f"rpc-unclassified-{method}",
+                    f"service-RPC method `{method}` has no idempotency "
+                    "classification (resilience.retry IDEMPOTENT_METHODS / "
+                    "NON_IDEMPOTENT_METHODS or mark_idempotent) — retry "
+                    "behavior is undefined for it",
+                )
+            )
+
+    # -- rule 2: span closure -------------------------------------------------
+
+    def _check_span_closure(self, src, qn, out) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            with_exprs: list[ast.expr] = []
+            with_names: set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        with_exprs.append(item.context_expr)
+                        if isinstance(item.context_expr, ast.Name):
+                            with_names.add(item.context_expr.id)
+            assigned_to_with: set[int] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and self._is_span_call(
+                    sub.value
+                ):
+                    if any(
+                        isinstance(t, ast.Name) and t.id in with_names
+                        for t in sub.targets
+                    ):
+                        assigned_to_with.add(id(sub.value))
+            for sub in ast.walk(node):
+                if not self._is_span_call(sub):
+                    continue
+                if any(sub is e for e in with_exprs):
+                    continue
+                if id(sub) in assigned_to_with:
+                    continue
+                if src.waived(sub.lineno, self.name):
+                    continue
+                fname = (
+                    sub.func.attr
+                    if isinstance(sub.func, ast.Attribute)
+                    else sub.func.id
+                )
+                out.append(
+                    self.finding(
+                        src,
+                        sub,
+                        qn.get(node, node.name),
+                        f"span-not-closed-{fname}",
+                        f"`{fname}(...)` is not entered as a `with` item — "
+                        "an unclosed span never records and truncates its "
+                        "trace subtree",
+                    )
+                )
+
+    @staticmethod
+    def _is_span_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in SPAN_FACTORIES:
+            # only the tracer's span factory, not arbitrary .span() methods
+            root = f.value
+            return isinstance(root, ast.Name) and root.id in (
+                "TRACER",
+                "tracer",
+            )
+        return isinstance(f, ast.Name) and f.id in SPAN_FACTORIES
+
+    # -- rule 3: histogram bucket discipline ----------------------------------
+
+    def _check_histogram_buckets(self, src, qn, out) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_observe = isinstance(f, ast.Attribute) and f.attr in (
+                "observe",
+                "histogram",
+            )
+            is_ctor = isinstance(f, ast.Name) and f.id == "Histogram"
+            if not (is_observe or is_ctor):
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            metric = node.args[0].value
+            if not metric.endswith("_ms"):
+                continue
+            buckets = next(
+                (kw.value for kw in node.keywords if kw.arg == "buckets"),
+                None,
+            )
+            if buckets is None and is_ctor and len(node.args) > 1:
+                buckets = node.args[1]
+            if buckets is None:
+                continue  # default = the mtail contract
+            if isinstance(buckets, (ast.Name, ast.Attribute)):
+                name = (
+                    buckets.id
+                    if isinstance(buckets, ast.Name)
+                    else buckets.attr
+                )
+                if "BUCKETS" in name:
+                    continue  # a named, reviewable contract
+            if src.waived(node.lineno, self.name):
+                continue
+            out.append(
+                self.finding(
+                    src,
+                    node,
+                    qn.get(node, ""),
+                    f"adhoc-latency-buckets-{metric}",
+                    f"latency histogram `{metric}` passes ad-hoc literal "
+                    "buckets — use LATENCY_BUCKETS_MS or a named *_BUCKETS "
+                    "constant so the exposition contract stays reviewable",
+                )
+            )
+
+    # -- rule 4: the server-side span seam ------------------------------------
+
+    def _check_server_span_seam(self, sources, out) -> None:
+        rpc = next((s for s in sources if s.relpath == RPC_MODULE), None)
+        if rpc is None:
+            return  # analyzing a fixture tree, not the package
+        if '"svc.' in rpc.text or "f\"svc." in rpc.text or "svc.{" in rpc.text:
+            return
+        out.append(
+            Finding(
+                self.name,
+                rpc.relpath,
+                1,
+                "ServiceServer._serve",
+                "server-span-seam-missing",
+                "the central `svc.<service>.<method>` server-side span is "
+                "gone from service/rpc.py dispatch — cross-process traces "
+                "lose their server leg",
+            )
+        )

@@ -1,0 +1,99 @@
+"""Device-dispatch discipline: device crypto/hash entry points may only be
+called from the DevicePlane seams.
+
+PR 3 centralized ALL device crypto dispatch behind the plane's seams —
+``crypto/suite.py`` batch methods, ``crypto/admission.admit_batch``, the
+``ops/`` host wrappers themselves, ``device/plane.py`` and the sharded
+wrappers in ``parallel/sharding.py``. A module elsewhere importing an ops
+kernel and dispatching its own batch silently forks the dispatch
+discipline: no coalescing, no priority lane, no breaker fallback, and its
+ad-hoc batch shapes re-open the recompile churn the bucket ladder closed.
+
+Rule: importing a device-kernel module (``ops.keccak``, ``ops.secp256k1``,
+``ops.sm2``, ``ops.sm3``, ``ops.sha256``, ``ops.ed25519``, ``ops.merkle``,
+``ops.address``, ``ops.pallas_ec``) — or any *device entry* name from one —
+outside the seam allowlist is a finding. Host-side helpers are exempt:
+``ops.hash_common``/``ops.bigint``/``ops.limb`` everywhere, and the named
+host-tree classes from ``ops.merkle`` (``MerkleTree``/``MerkleProofItem``,
+which ledger/lightnode legitimately use for proofs).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding, Source, qualnames
+
+# device-kernel modules: importing these implies device dispatch
+DEVICE_MODULES = {
+    "keccak", "sha256", "sm3", "sm2", "secp256k1", "ed25519",
+    "merkle", "address", "pallas_ec",
+}
+# names importable from device modules that are host-side only
+HOST_SAFE_NAMES = {
+    "MerkleTree", "MerkleProofItem", "bucket_leaves", "bind_root",
+}
+# modules allowed to dispatch device programs (the seams)
+SEAM_PREFIXES = (
+    "fisco_bcos_tpu/ops/",
+    "fisco_bcos_tpu/crypto/",
+    "fisco_bcos_tpu/device/",
+    "fisco_bcos_tpu/parallel/",
+    "fisco_bcos_tpu/analysis/",  # the checkers read, never dispatch
+)
+
+
+def _imported_device_module(node: ast.AST) -> tuple[str, list[str]] | None:
+    """(device module name, imported names ('' = whole module)) or None."""
+    if isinstance(node, ast.ImportFrom) and node.module:
+        parts = node.module.split(".")
+        # from ..ops import keccak / from ..ops.merkle import merkle_root
+        if parts[-1] in DEVICE_MODULES and (len(parts) == 1 or "ops" in parts):
+            return parts[-1], [a.name for a in node.names]
+        if parts[-1] == "ops" or parts[-1:] == ["ops"]:
+            mods = [a.name for a in node.names if a.name in DEVICE_MODULES]
+            if mods:
+                return mods[0] if len(mods) == 1 else ",".join(mods), [""]
+    elif isinstance(node, ast.Import):
+        for a in node.names:
+            parts = a.name.split(".")
+            if parts[-1] in DEVICE_MODULES and "ops" in parts:
+                return parts[-1], [""]
+    return None
+
+
+class DeviceDispatchChecker(Checker):
+    name = "device-dispatch"
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        out: list[Finding] = []
+        for src in sources:
+            if src.relpath.startswith(SEAM_PREFIXES):
+                continue
+            qn = qualnames(src.tree)
+            for node in ast.walk(src.tree):
+                hit = _imported_device_module(node)
+                if hit is None:
+                    continue
+                mod, names = hit
+                offenders = [
+                    n for n in names if n == "" or n not in HOST_SAFE_NAMES
+                ]
+                if not offenders:
+                    continue
+                if src.waived(node.lineno, self.name):
+                    continue
+                what = ", ".join(n or f"module {mod}" for n in offenders)
+                out.append(
+                    self.finding(
+                        src,
+                        node,
+                        qn.get(node, ""),
+                        f"import-{mod}",
+                        f"device kernel `{what}` (ops.{mod}) imported outside "
+                        "the DevicePlane seams (crypto/suite, crypto/admission, "
+                        "ops/, device/, parallel/) — dispatch must route "
+                        "through the plane",
+                    )
+                )
+        return out

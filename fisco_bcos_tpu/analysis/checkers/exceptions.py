@@ -1,0 +1,112 @@
+"""Exception hygiene: broad handlers must observe, not erase.
+
+The reproduction had 10 ``except Exception: pass`` swallows out of ~80
+handlers — each one a place where a real failure (a dead metrics registry,
+a crashed heartbeat, a failed health callback) vanishes without a log
+line, a counter, or a typed narrowing. The rule:
+
+a handler for ``Exception``/``BaseException``/bare ``except`` whose body
+neither raises, returns a value, logs (``*.info/warning/error/debug/
+exception``), counts a metric (``counter_add``/``note_swallowed``), calls
+any handler function, nor assigns state, is a silent swallow — a finding
+unless the ``except`` line carries ``# analysis: allow(except-hygiene,
+reason)``.
+
+Handlers that do SOMETHING (even ``return None``, or setting a fallback
+value) pass: the rule targets erasure, not tolerance. The fix applied
+across the package routes these through
+:func:`fisco_bcos_tpu.utils.log.note_swallowed`, which debug-logs and
+bumps ``fisco_swallowed_errors_total{site=...}`` so operators can see
+error mass even at INFO level.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+
+from ..core import Checker, Finding, Source, qualnames
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _guarded_digest(try_node: ast.Try) -> str:
+    """Short content hash of the guarded ``try`` body (no line numbers).
+
+    Keys findings to WHAT the handler guards rather than to the handler's
+    position: an index-based ``#i`` disambiguator would let a newly added
+    swallow earlier in the same symbol inherit an existing baselined key
+    (passing the gate) while shifting blame onto the accepted one.
+    """
+    body = "\n".join(ast.dump(stmt) for stmt in try_node.body)
+    return hashlib.sha1(body.encode()).hexdigest()[:8]
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in BROAD for e in t.elts)
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the body observably does nothing with the error."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue  # docstring/ellipsis placeholder
+        if isinstance(stmt, ast.Continue):
+            continue  # loop-shaped pass
+        return False
+    return True
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "except-hygiene"
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        out: list[Finding] = []
+        for src in sources:
+            qn = qualnames(src.tree)
+            digests: dict[ast.ExceptHandler, str] = {}
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Try):
+                    d = _guarded_digest(node)
+                    for handler in node.handlers:
+                        digests[handler] = d
+            # identical guarded bodies in one symbol (rare) fall back to an
+            # occurrence index — order-dependence is then confined to code
+            # that is literally indistinguishable anyway
+            per_key: dict[tuple[str, str], int] = {}
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node) or not _is_silent(node):
+                    continue
+                symbol = qn.get(node, "")
+                if src.waived(node.lineno, self.name):
+                    continue
+                digest = digests.get(node, "orphan")
+                i = per_key.get((symbol, digest), 0)
+                per_key[(symbol, digest)] = i + 1
+                detail = f"silent-swallow@{digest}" + (f"#{i}" if i else "")
+                out.append(
+                    self.finding(
+                        src,
+                        node,
+                        symbol,
+                        detail,
+                        "broad except silently swallows the error — log it, "
+                        "count fisco_swallowed_errors_total (utils.log."
+                        "note_swallowed), narrow the type, or waive with "
+                        "`# analysis: allow(except-hygiene, reason)`",
+                    )
+                )
+        return out

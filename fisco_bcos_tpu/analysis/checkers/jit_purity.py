@@ -1,0 +1,98 @@
+"""Jit purity: side effects inside jit-traced function bodies.
+
+A jitted body runs ONCE per compiled shape at trace time, then never
+again — a ``time.time()`` inside it freezes the clock at trace time, a
+``TRACER``/``REGISTRY`` call records exactly one fake event per compile, a
+lock acquire parks the tracer thread (and is skipped on every cached run),
+Python RNG bakes one draw into the program, and mutating captured state
+from inside the trace is a silent correctness bug (it happens at trace
+time, not run time). The reference catches the C++ analogs with TSan +
+code review; here the ~22 jitted functions are walked mechanically.
+
+Flagged inside any body from the :mod:`..jitmap` inventory:
+
+- calls rooted at ``time``/``random``/``os``/``secrets``/``threading``/
+  ``socket`` (trace-time constants or real side effects),
+- ``print``/``open``/``input`` builtins,
+- telemetry (``TRACER``/``REGISTRY``/logger receivers, ``.observe``/
+  ``.counter_add``/``.gauge_set``/logging-method names),
+- lock traffic (``.acquire()``/``.release()``),
+- ``global``/``nonlocal`` declarations,
+- attribute stores (``obj.attr = ...`` — captured-state mutation).
+
+``jnp``/``lax``/``np`` numeric calls are the purpose of the body and pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import jitmap
+from ..core import Checker, Finding, Source
+
+IMPURE_ROOTS = {"time", "random", "os", "secrets", "threading", "socket"}
+IMPURE_BUILTINS = {"print", "open", "input"}
+TELEMETRY_RECEIVERS = {"TRACER", "REGISTRY", "_log", "log", "logger", "logging"}
+TELEMETRY_METHODS = {
+    "observe", "counter_add", "gauge_set", "gauge_fn", "span", "record",
+    "info", "warning", "error", "debug", "exception", "metric",
+}
+LOCK_METHODS = {"acquire", "release"}
+
+
+def _root_name(node: ast.AST) -> str | None:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class JitPurityChecker(Checker):
+    name = "jit-purity"
+
+    def _offense(self, sub: ast.AST) -> str | None:
+        if isinstance(sub, (ast.Global, ast.Nonlocal)):
+            return "global-state"
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            targets = (
+                sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+            )
+            if any(isinstance(t, ast.Attribute) for t in targets):
+                return "captured-mutation"
+            return None
+        if not isinstance(sub, ast.Call):
+            return None
+        fn = sub.func
+        if isinstance(fn, ast.Name) and fn.id in IMPURE_BUILTINS:
+            return f"builtin-{fn.id}"
+        if isinstance(fn, ast.Attribute):
+            root = _root_name(fn)
+            if root in IMPURE_ROOTS:
+                return f"impure-{root}.{fn.attr}"
+            if root in TELEMETRY_RECEIVERS or fn.attr in TELEMETRY_METHODS:
+                return f"telemetry-{fn.attr}"
+            if fn.attr in LOCK_METHODS:
+                return f"lock-{fn.attr}"
+        return None
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        out: list[Finding] = []
+        for jit in jitmap.collect(sources):
+            src = jit.source
+            for sub in ast.walk(jit.node):
+                offense = self._offense(sub)
+                if offense is None:
+                    continue
+                if src.waived(sub.lineno, self.name):
+                    continue
+                out.append(
+                    self.finding(
+                        src,
+                        sub,
+                        jit.qualname,
+                        offense,
+                        f"side effect `{offense}` inside jit-traced "
+                        f"`{jit.qualname}` — runs at trace time only, "
+                        "skipped on every cached execution",
+                    )
+                )
+        return out

@@ -1,0 +1,297 @@
+"""Static lock-acquisition graph: ordering cycles and blocking calls held
+under a lock.
+
+The ~20 lock-holding modules (txpool, engine, plane, gateway, storage,
+observability) each follow a local discipline, but nothing checked the
+GLOBAL order — a PR that takes ``txpool._lock`` under ``engine._lock``
+while another path takes them reversed deadlocks only under load. TSan
+would catch the C++ analog; here the acquisition graph is built from the
+AST:
+
+- **Locks** are ``self.X = threading.Lock()/RLock()/Condition()``
+  attributes (node ``module:Class.X``) and module-level ``X = Lock()``
+  globals (``module:X``).
+- **Edges**: a ``with``-lock body that lexically acquires another lock, or
+  calls a same-module function/method whose (transitively computed)
+  acquire-set is non-empty, orders the first lock before the second.
+- **Cycles** in that graph are findings — every thread must see one global
+  order.
+- **Blocking calls under a lock**: socket IO, ``time.sleep``, future
+  ``.result()``, frame send/recv, ``client.call`` and thread ``.join``
+  inside a ``with``-lock body serialize every peer of that lock behind a
+  remote's latency (the ACE-runtime offload papers' classic anti-pattern).
+  By-design sites (the RPC client's pipeline lock) carry baseline entries.
+
+The runtime complement (:mod:`..lockorder`) records ACTUAL per-thread
+acquisition chains across the test suite — what static analysis cannot see
+(locks reached through callbacks, cross-module calls).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..core import Checker, Finding, Source, qualnames, tarjan_sccs
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+BLOCKING_ATTRS = {
+    "sleep", "result", "recv", "sendall", "accept", "connect", "join",
+    "drain",
+}
+BLOCKING_NAMES = {"_recv_frame", "_send_frame", "create_connection"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    return name in LOCK_FACTORIES
+
+
+@dataclass
+class _ModuleLocks:
+    src: Source
+    # attr name -> node id, per class; '' key = module globals
+    by_class: dict[str, dict[str, str]] = field(default_factory=dict)
+
+
+def _lock_expr_id(expr: ast.AST, mod: _ModuleLocks, cls: str) -> str | None:
+    """Resolve a with-item expression to a known lock node id."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id == "self":
+            hit = mod.by_class.get(cls, {}).get(expr.attr)
+            if hit:
+                return hit
+            # self.<attr> where the attr is a lock of ANOTHER class in the
+            # same module (mixins): unique-name match
+            hits = {
+                v
+                for c, attrs in mod.by_class.items()
+                for a, v in attrs.items()
+                if a == expr.attr and c
+            }
+            if len(hits) == 1:
+                return hits.pop()
+    if isinstance(expr, ast.Name):
+        return mod.by_class.get("", {}).get(expr.id)
+    return None
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        modules = [self._collect_locks(src) for src in sources]
+        out: list[Finding] = []
+        edges: dict[tuple[str, str], tuple[Source, ast.AST, str]] = {}
+        for mod in modules:
+            self._walk_module(mod, edges, out)
+        out.extend(self._cycles(edges))
+        return out
+
+    # -- lock discovery -------------------------------------------------------
+
+    def _collect_locks(self, src: Source) -> _ModuleLocks:
+        mod = _ModuleLocks(src)
+        qn = qualnames(src.tree)
+        modname = src.relpath
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Assign) or not _is_lock_ctor(node.value):
+                continue
+            scope = qn.get(node, "")
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    cls = scope.split(".")[0] if scope else ""
+                    mod.by_class.setdefault(cls, {})[tgt.attr] = (
+                        f"{modname}:{cls}.{tgt.attr}"
+                    )
+                elif isinstance(tgt, ast.Name) and not scope:
+                    mod.by_class.setdefault("", {})[tgt.id] = (
+                        f"{modname}:{tgt.id}"
+                    )
+        return mod
+
+    # -- acquisition graph ----------------------------------------------------
+
+    def _walk_module(self, mod: _ModuleLocks, edges, out) -> None:
+        src = mod.src
+        qn = qualnames(src.tree)
+        funcs: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.FunctionDef):
+                funcs[qn.get(node, node.name)] = node
+
+        # transitive acquire-sets over the same-module call graph
+        acq_memo: dict[str, set[str]] = {}
+
+        def direct_acquires(fn_qn: str, node: ast.FunctionDef) -> set[str]:
+            cls = fn_qn.split(".")[0] if "." in fn_qn else ""
+            got: set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        lock = _lock_expr_id(item.context_expr, mod, cls)
+                        if lock:
+                            got.add(lock)
+            return got
+
+        def callees(fn_qn: str, node: ast.FunctionDef) -> set[str]:
+            cls = fn_qn.split(".")[0] if "." in fn_qn else ""
+            found: set[str] = set()
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and f"{cls}.{f.attr}" in funcs
+                ):
+                    found.add(f"{cls}.{f.attr}")
+                elif isinstance(f, ast.Name) and f.id in funcs:
+                    found.add(f.id)
+            return found
+
+        def effective_acquires(fn_qn: str, stack: tuple = ()) -> set[str]:
+            if fn_qn in acq_memo:
+                return acq_memo[fn_qn]
+            if fn_qn in stack or fn_qn not in funcs:
+                return set()
+            node = funcs[fn_qn]
+            got = set(direct_acquires(fn_qn, node))
+            for callee in callees(fn_qn, node):
+                got |= effective_acquires(callee, stack + (fn_qn,))
+            acq_memo[fn_qn] = got
+            return got
+
+        for fn_qn, node in funcs.items():
+            cls = fn_qn.split(".")[0] if "." in fn_qn else ""
+            self._walk_body(
+                src, mod, cls, fn_qn, node, funcs, effective_acquires,
+                edges, out, held=(),
+            )
+
+    def _walk_body(
+        self, src, mod, cls, fn_qn, node, funcs, eff_acq, edges, out, held
+    ) -> None:
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, ast.FunctionDef) and sub is not node:
+                continue  # nested defs run later, outside this lock scope
+            new_held = held
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    lock = _lock_expr_id(item.context_expr, mod, cls)
+                    if lock:
+                        for h in new_held:
+                            if h != lock:
+                                edges.setdefault(
+                                    (h, lock), (src, item.context_expr, fn_qn)
+                                )
+                        new_held = new_held + (lock,)
+            elif held and isinstance(sub, (ast.Expr, ast.Assign, ast.Return)):
+                self._check_blocking(src, cls, fn_qn, sub, held, out)
+            # call-propagated edges inside held regions
+            if new_held:
+                for call in self._calls_in_stmt(sub):
+                    callee = self._resolve_callee(call, cls, funcs)
+                    if callee:
+                        for lock in eff_acq(callee):
+                            for h in new_held:
+                                if h != lock:
+                                    edges.setdefault(
+                                        (h, lock), (src, call, fn_qn)
+                                    )
+            self._walk_body(
+                src, mod, cls, fn_qn, sub, funcs, eff_acq, edges, out, new_held
+            )
+
+    @staticmethod
+    def _calls_in_stmt(stmt: ast.AST):
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+    @staticmethod
+    def _resolve_callee(call: ast.Call, cls: str, funcs) -> str | None:
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and f"{cls}.{f.attr}" in funcs
+        ):
+            return f"{cls}.{f.attr}"
+        if isinstance(f, ast.Name) and f.id in funcs:
+            return f.id
+        return None
+
+    # -- blocking calls under a lock ------------------------------------------
+
+    def _check_blocking(self, src, cls, fn_qn, stmt, held, out) -> None:
+        for call in self._calls_in_stmt(stmt):
+            f = call.func
+            name = None
+            if isinstance(f, ast.Attribute):
+                if f.attr in BLOCKING_ATTRS:
+                    # Condition.wait on the held lock itself is the cv
+                    # protocol, not a blocking call under a foreign lock
+                    name = f.attr
+                elif f.attr == "call" and "client" in ast.dump(f.value).lower():
+                    name = "client.call"
+            elif isinstance(f, ast.Name) and f.id in BLOCKING_NAMES:
+                name = f.id
+            if name is None:
+                continue
+            if src.waived(call.lineno, self.name):
+                continue
+            lock = held[-1]
+            out.append(
+                self.finding(
+                    src,
+                    call,
+                    fn_qn,
+                    f"blocking-{name}-under-{lock.rsplit(':', 1)[-1]}",
+                    f"blocking call `{name}` while holding `{lock}` — "
+                    "every peer of that lock serializes behind this IO",
+                )
+            )
+
+    # -- cycles ---------------------------------------------------------------
+
+    def _cycles(self, edges) -> list[Finding]:
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        out: list[Finding] = []
+        for members in tarjan_sccs(graph):
+            if len(members) < 2:
+                continue
+            scc = set(members)
+            src, node, fn_qn = next(
+                edges[(a, b)]
+                for (a, b) in edges
+                if a in scc and b in scc
+            )
+            out.append(
+                self.finding(
+                    src,
+                    node,
+                    "",
+                    "cycle-" + "-".join(
+                        m.rsplit(":", 1)[-1] for m in members
+                    ),
+                    "lock-order cycle: " + " <-> ".join(members)
+                    + " — threads taking these in different orders deadlock",
+                )
+            )
+        return out

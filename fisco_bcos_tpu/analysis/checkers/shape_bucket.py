@@ -1,0 +1,123 @@
+"""Bucket-ladder shape discipline for jit-feeding host wrappers.
+
+Every distinct batch shape fed to a jitted program is one more XLA
+compilation; ``ops/hash_common._bucket`` bounds the set of shapes (the
+"bucket ladder") and ``tool/check_device_plane.py`` asserts the live
+compile counter stays ≤ ladder size. That bound only holds if every host
+wrapper that BUILDS arrays and CALLS a jitted function pads through the
+ladder first.
+
+Rule: a function that (a) calls a name from the package-wide jit inventory
+(:mod:`..jitmap`) and (b) constructs arrays whose shape derives from input
+length (``np.zeros``/``np.array``/``jnp.asarray``/... or ``len()``) must
+(c) also call one of the bucketing/padding helpers (``bucket_batch``,
+``_bucket``, ``bucket_leaves``, ``bucket_ladder``, ``pad_rows``,
+``pad_keccak``, ``pad_md64``) somewhere in its body. Functions that merely
+pass through already-padded tensors (no array construction) are exempt —
+the shape decision was made upstream where the rule already applied.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import jitmap
+from ..core import Checker, Finding, Source, qualnames
+
+BUCKET_HELPERS = {
+    "bucket_batch", "_bucket", "bucket_leaves", "bucket_ladder",
+    "pad_rows", "pad_keccak", "pad_md64",
+}
+ARRAY_BUILDERS = {
+    "zeros", "empty", "ones", "full", "array", "asarray", "frombuffer",
+    "stack", "concatenate",
+}
+
+
+def _called_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _module_bucket_names(tree: ast.Module) -> set[str]:
+    """BUCKET_HELPERS plus every local alias bound by a ``from ... import
+    helper as alias`` (the ops modules import ``bucket_batch as _bucket``,
+    ``pad_rows as _pad_rows``)."""
+    names = set(BUCKET_HELPERS)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in BUCKET_HELPERS and a.asname:
+                    names.add(a.asname)
+    return names
+
+
+class ShapeBucketChecker(Checker):
+    name = "shape-bucket"
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        jits = jitmap.collect(sources)
+        jit_names = jitmap.callable_names(jits)
+        jit_defs = {id(j.node) for j in jits}
+        out: list[Finding] = []
+        for src in sources:
+            qn = qualnames(src.tree)
+            bucket_names = _module_bucket_names(src.tree)
+            # func name -> directly calls a bucket helper (for one-level
+            # propagation: verify_batch buckets via its device_inputs call)
+            direct_buckets: set[str] = set()
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.FunctionDef) and any(
+                    isinstance(sub, ast.Call)
+                    and _called_name(sub) in bucket_names
+                    for sub in ast.walk(node)
+                ):
+                    direct_buckets.add(node.name)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.FunctionDef):
+                    continue
+                if id(node) in jit_defs:
+                    continue  # the traced body itself is shape-static
+                calls_jit_at: ast.Call | None = None
+                builds_arrays = False
+                buckets = False
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.FunctionDef) and sub is not node:
+                        if id(sub) in jit_defs:
+                            # local jitted def (sharding makers): its caller
+                            # is dynamic, skip the enclosing maker
+                            calls_jit_at = None
+                            builds_arrays = False
+                            break
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = _called_name(sub)
+                    if name in jit_names and calls_jit_at is None:
+                        calls_jit_at = sub
+                    elif name in bucket_names or name in direct_buckets:
+                        buckets = True
+                    elif name in ARRAY_BUILDERS:
+                        builds_arrays = True
+                if calls_jit_at is None or buckets or not builds_arrays:
+                    continue
+                if src.waived(calls_jit_at.lineno, self.name) or src.waived(
+                    node.lineno, self.name
+                ):
+                    continue
+                out.append(
+                    self.finding(
+                        src,
+                        calls_jit_at,
+                        qn.get(node, node.name),
+                        f"unbucketed-{_called_name(calls_jit_at)}",
+                        f"`{node.name}` builds arrays and feeds jitted "
+                        f"`{_called_name(calls_jit_at)}` without bucketing "
+                        "the batch shape (bucket_batch/pad_* from "
+                        "ops.hash_common) — every distinct size recompiles",
+                    )
+                )
+        return out

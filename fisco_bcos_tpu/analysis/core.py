@@ -1,0 +1,275 @@
+"""Static-analysis framework: sources, findings, waivers, baseline diff.
+
+The reference node keeps its concurrency/crypto hot paths honest with C++
+tooling (TSan, clang-tidy, sanitizer CI); this package is the Python/JAX
+reproduction's equivalent — an AST-walking checker framework whose rules
+encode THIS project's invariants (DevicePlane-only dispatch, bucket-ladder
+shape discipline, jit purity, lock ordering, exception hygiene, the
+service-RPC idempotency/span/histogram contracts) rather than generic lint.
+
+Design:
+
+- A :class:`Source` is one parsed module (path + text + AST). The loader
+  walks ``fisco_bcos_tpu/`` only — tests/tools are consumers, not subjects.
+- A :class:`Finding` is keyed WITHOUT line numbers
+  (``checker:relpath:symbol:detail``) so accepted debt in the baseline file
+  survives unrelated edits shifting lines; display output still carries
+  ``file:line`` for jumping to the site.
+- **Waivers**: a ``# analysis: allow(<checker>[, reason])`` comment on the
+  flagged line (or the line above it) suppresses the finding at the site —
+  the in-code form of accepted debt, for cases where the exception is
+  load-bearing and a baseline entry would be too far from the code.
+- **Baseline** (:func:`load_baseline` / :func:`diff_findings`): the
+  checked-in ``tool/analysis_baseline.json`` lists accepted finding keys
+  with notes. Existing debt does not fail the build; any NEW key does, and
+  stale baseline entries are reported so the file shrinks as debt is paid.
+
+Everything here is pure AST + text — no jax import, so the CLI and the
+tier-1 test stay fast on a cold process.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PACKAGE_DIR)
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tool", "analysis_baseline.json")
+
+_WAIVER_RE = re.compile(r"#\s*analysis:\s*allow\(\s*([\w.-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``key`` identifies it across line drift;
+    ``file``/``line`` locate it for humans."""
+
+    checker: str
+    file: str  # repo-relative path
+    line: int
+    symbol: str  # enclosing function/class qualname ('' = module level)
+    detail: str  # short stable slug distinguishing findings in one symbol
+    message: str  # human explanation
+
+    @property
+    def key(self) -> str:
+        return f"{self.checker}:{self.file}:{self.symbol}:{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.checker}] {self.message}"
+
+
+@dataclass
+class Source:
+    path: str
+    relpath: str
+    text: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.text.splitlines()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def waived(self, lineno: int, checker: str) -> bool:
+        """True when the flagged line — or the contiguous comment block
+        directly above it — carries an ``# analysis: allow(<checker>)``
+        waiver for this checker."""
+        m = _WAIVER_RE.search(self.line_text(lineno))
+        if m and m.group(1) in (checker, "all"):
+            return True
+        ln = lineno - 1
+        while ln >= 1 and self.line_text(ln).lstrip().startswith("#"):
+            m = _WAIVER_RE.search(self.line_text(ln))
+            if m and m.group(1) in (checker, "all"):
+                return True
+            ln -= 1
+        return False
+
+
+class Checker:
+    """Base: subclasses set ``name`` and implement ``run(sources)``."""
+
+    name = "base"
+
+    def run(self, sources: list[Source]) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(
+        self, src: Source, node: ast.AST, symbol: str, detail: str, message: str
+    ) -> Finding:
+        return Finding(
+            self.name,
+            src.relpath,
+            getattr(node, "lineno", 0),
+            symbol,
+            detail,
+            message,
+        )
+
+
+def load_sources(root: str | None = None) -> list[Source]:
+    """Parse every ``*.py`` under ``root`` (default: the installed
+    ``fisco_bcos_tpu`` package). Paths are reported relative to the repo
+    root when under it, else to ``root``'s parent."""
+    root = os.path.abspath(root or PACKAGE_DIR)
+    base = REPO_ROOT if root.startswith(REPO_ROOT) else os.path.dirname(root)
+    out: list[Source] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            try:
+                tree = ast.parse(text, filename=path)
+            except SyntaxError as e:  # surface, don't crash the run
+                raise RuntimeError(f"cannot parse {path}: {e}") from e
+            out.append(
+                Source(path, os.path.relpath(path, base).replace(os.sep, "/"),
+                       text, tree)
+            )
+    return out
+
+
+# -- qualname helper ----------------------------------------------------------
+
+
+def qualnames(tree: ast.Module) -> dict[ast.AST, str]:
+    """node -> enclosing qualname ('' at module level) for every node."""
+    out: dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, qn: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                child_qn = f"{qn}.{child.name}" if qn else child.name
+            else:
+                child_qn = qn
+            out[child] = child_qn
+            walk(child, child_qn)
+
+    out[tree] = ""
+    walk(tree, "")
+    return out
+
+
+# -- strongly-connected components --------------------------------------------
+
+
+def tarjan_sccs(graph: dict[str, set[str]]) -> list[list[str]]:
+    """Strongly-connected components of ``graph`` (iterative Tarjan).
+
+    Every vertex appears in exactly one SCC; members come back sorted and
+    traversal order is deterministic. Callers filter ``len(scc) >= 2`` for
+    cycles. Shared by the static lock-order checker and the runtime
+    :mod:`..lockorder` recorder so the algorithm cannot diverge.
+    """
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def connect(v: str) -> None:
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            connect(v)
+    return sccs
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: str | None = None) -> dict[str, str]:
+    """{finding key: note}; empty when the file does not exist."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: dict[str, str] = {}
+    for entry in data.get("findings", []):
+        if isinstance(entry, str):
+            out[entry] = ""
+        else:
+            out[entry["key"]] = entry.get("note", "")
+    return out
+
+
+def save_baseline(
+    findings: list[Finding], path: str | None = None, notes: dict | None = None
+) -> None:
+    path = path or DEFAULT_BASELINE
+    notes = notes or {}
+    data = {
+        "_comment": "Accepted static-analysis debt. New findings FAIL; "
+        "remove entries as debt is paid. See docs/static_analysis.md.",
+        "findings": [
+            {"key": f.key, "note": notes.get(f.key, f.message)}
+            for f in sorted(findings, key=lambda f: f.key)
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, ensure_ascii=False)
+        f.write("\n")
+
+
+def diff_findings(
+    findings: list[Finding], baseline: dict[str, str]
+) -> tuple[list[Finding], list[str]]:
+    """(new findings not in baseline, stale baseline keys not found now)."""
+    found_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baseline]
+    stale = sorted(k for k in baseline if k not in found_keys)
+    return new, stale

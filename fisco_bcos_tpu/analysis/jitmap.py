@@ -1,0 +1,155 @@
+"""Package-wide inventory of jit-traced functions (pure AST).
+
+Three definition idioms exist in this codebase and all are collected:
+
+- ``@jax.jit`` (or ``@jit``) decorated ``def`` — the ops kernels.
+- ``name = jax.jit(func)`` module/class-level assignment — the admission
+  steps (``admission_step = jax.jit(admission_core)``): BOTH the wrapper
+  name and the wrapped function count as jitted.
+- ``return jax.jit(f)`` over a local ``def f`` — the sharding makers and
+  ``merkle._device_root_fn``: the local def's body is jit-traced.
+- ``f = jax.shard_map(local, ...); return jax.jit(f)`` — one assignment of
+  a wrapper call (shard_map/pmap/vmap/partial/checkpoint) between the def
+  and the jit: the wrapped local def's body is what traces.
+
+The inventory powers two checkers: jit-purity walks the traced bodies for
+side effects, and shape-bucket treats any *call* to an inventoried name as
+a device-program entry whose feeding shapes must be bucketed.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Source, qualnames
+
+
+@dataclass(frozen=True)
+class JitFunc:
+    source: Source
+    node: ast.FunctionDef
+    qualname: str  # of the traced def itself
+    names: tuple[str, ...]  # callable names referring to it (def + wrappers)
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` call expression."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit":
+        return True
+    return isinstance(fn, ast.Name) and fn.id == "jit"
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return True
+    if isinstance(dec, ast.Name) and dec.id == "jit":
+        return True
+    # @partial(jax.jit, ...) / @jax.jit(static_argnums=...)
+    if isinstance(dec, ast.Call):
+        if _is_jit_call(dec):
+            return True
+        if any(_is_jit_call(a) or _is_jit_decorator(a) for a in dec.args):
+            return True
+    return False
+
+
+_WRAPPERS = {"shard_map", "pmap", "vmap", "partial", "checkpoint"}
+
+
+def _scope_pass(
+    scope: ast.AST, found: dict[int, tuple[ast.FunctionDef, set[str]]]
+) -> None:
+    """Resolve the jit idioms with names bound in THIS scope's subtree.
+
+    Scoped resolution matters for the sharding makers: eight functions each
+    bind ``f = jax.shard_map(local, ...)`` over their own ``local`` def —
+    a module-wide name map would collapse them onto one."""
+    # name -> def node (first wins, matching Python's lookup of a shadowed
+    # name being a bug we don't chase)
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(scope):
+        if isinstance(node, ast.FunctionDef) and node is not scope:
+            defs.setdefault(node.name, node)
+    # name -> wrapped def name, for `f = jax.shard_map(local, ...)`
+    via_wrapper: dict[str, str] = {}
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            wname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            arg = node.value.args[0] if node.value.args else None
+            if (
+                wname in _WRAPPERS
+                and isinstance(arg, ast.Name)
+                and arg.id in defs
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        via_wrapper[tgt.id] = arg.id
+
+    def resolve(arg: ast.AST) -> str | None:
+        """jit-call argument -> traced def name (direct or one wrapper)."""
+        if not isinstance(arg, ast.Name):
+            return None
+        if arg.id in defs:
+            return arg.id
+        return via_wrapper.get(arg.id)
+
+    def note(name: str, aliases: tuple[str, ...] = ()) -> None:
+        node = defs[name]
+        _node, names = found.setdefault(id(node), (node, set()))
+        names.add(name)
+        names.update(aliases)
+
+    for node in ast.walk(scope):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node is not scope
+            and any(_is_jit_decorator(d) for d in node.decorator_list)
+        ):
+            note(node.name)
+        elif isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            tgt_def = resolve(node.value.args[0] if node.value.args else None)
+            if tgt_def is not None:
+                note(
+                    tgt_def,
+                    tuple(
+                        t.id
+                        for t in node.targets
+                        if isinstance(t, ast.Name)
+                    ),
+                )
+        elif isinstance(node, ast.Return) and _is_jit_call(node.value):
+            tgt_def = resolve(node.value.args[0] if node.value.args else None)
+            if tgt_def is not None:
+                note(tgt_def)
+
+
+def collect(sources: list[Source]) -> list[JitFunc]:
+    out: list[JitFunc] = []
+    for src in sources:
+        qn = qualnames(src.tree)
+        found: dict[int, tuple[ast.FunctionDef, set[str]]] = {}
+        _scope_pass(src.tree, found)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.FunctionDef):
+                _scope_pass(node, found)
+        for node, names in found.values():
+            out.append(
+                JitFunc(src, node, qn.get(node, node.name), tuple(sorted(names)))
+            )
+    out.sort(key=lambda j: (j.source.relpath, j.node.lineno))
+    return out
+
+
+def callable_names(jits: list[JitFunc]) -> set[str]:
+    """Every bare name a call site might use for a jitted function."""
+    names: set[str] = set()
+    for j in jits:
+        names.update(j.names)
+    return names

@@ -1,0 +1,309 @@
+"""Runtime lock-order recorder — the dynamic complement to the static
+``lock-order`` checker.
+
+The static checker sees lexical nesting inside one module; it cannot see a
+lock reached through a callback, a cross-module call chain, or a worker
+thread. This module instruments ``threading.Lock``/``threading.RLock`` *at
+the factory* so that every lock **created from package code** records, per
+thread, the chain of lock sites held at each acquisition:
+
+- each instrumented lock is identified by its **creation site**
+  (``fisco_bcos_tpu/txpool/txpool.py:78``) — all instances born at one
+  site are the same node in the order graph, exactly like the static
+  checker's ``module:Class.attr`` ids;
+- acquiring site B while holding site A adds the directed edge ``A -> B``;
+- at session end :meth:`LockOrderRecorder.cycles` finds strongly-connected
+  components in the edge graph — a cycle means two threads can take the
+  same locks in different orders and deadlock under load;
+- :func:`install_io_guards` additionally wraps the service-RPC frame IO so
+  that blocking remote IO performed while holding any *foreign* lock (any
+  instrumented lock not created in ``service/rpc.py`` itself — the client's
+  pipeline lock is the baselined by-design exception) is recorded as a
+  violation.
+
+Locks created by stdlib / third-party code pass through untouched (the
+factory checks the caller's file), so the instrumentation cost is confined
+to package locks: one list append/pop per acquire/release plus one dict
+update per *nested* acquire. ``threading.Condition`` objects the package
+creates without an explicit lock allocate their RLock from inside
+``threading.py`` and therefore stay uninstrumented; Conditions built over a
+package lock (tx_sync's response cv) route through the wrapper's
+``_release_save``/``_acquire_restore`` and keep the held-chain exact across
+``wait()``.
+
+Enabled for the whole test suite from ``tests/conftest.py``; production
+processes never import this module.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass
+
+from .core import tarjan_sccs
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_PKG_MARKER = f"fisco_bcos_tpu{os.sep}"
+_SELF_FILE = os.path.abspath(__file__)
+
+
+def _site_of_caller(depth: int = 2) -> str | None:
+    """Creation site (repo-style relpath:line) when the caller is package
+    code outside the analysis subpackage, else None."""
+    frame = sys._getframe(depth)
+    fn = frame.f_code.co_filename
+    i = fn.rfind(_PKG_MARKER)
+    if i < 0 or f"{os.sep}analysis{os.sep}" in fn[i:]:
+        return None
+    return fn[i:].replace(os.sep, "/") + f":{frame.f_lineno}"
+
+
+@dataclass(frozen=True)
+class Waiver:
+    """An ``allowed_blocking`` entry: the waived hold's reason, plus
+    ``forbid`` substrings that re-narrow it — IO whose ``what`` contains
+    any of them is a violation even under the waived lock. This lets a
+    waiver say "this lock may be held across the execute-path RPC surface
+    (broad, evolving) but never across 2PC verbs" without enumerating
+    every allowed method."""
+
+    reason: str
+    forbid: tuple[str, ...] = ()
+
+
+class LockOrderRecorder:
+    """Per-thread acquisition chains, the global edge set, cycle detection
+    and blocking-IO-under-lock violations."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()  # guards edges/violations; always a leaf
+        self._tls = threading.local()
+        # (held site, acquired site) -> (example thread name, count)
+        self.edges: dict[tuple[str, str], tuple[str, int]] = {}
+        # (what, held sites, thread name)
+        self.blocking_violations: list[tuple[str, tuple[str, ...], str]] = []
+        # site prefixes allowed to be held across blocking IO — the runtime
+        # analog of the static baseline; values are reason strings or
+        # :class:`Waiver` (scoped by ``forbid``), documented in
+        # docs/static_analysis.md
+        self.allowed_blocking: dict[str, str | Waiver] = {}
+
+    # -- per-thread chain -----------------------------------------------------
+
+    def _held(self) -> list[str]:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def on_acquire(self, site: str) -> None:
+        held = self._held()
+        if held and site not in held:
+            # a reentrant re-acquire cannot block, so it orders nothing
+            tname = threading.current_thread().name
+            with self._mu:
+                for h in held:
+                    ex, n = self.edges.get((h, site), (tname, 0))
+                    self.edges[(h, site)] = (ex, n + 1)
+        held.append(site)
+
+    def on_release(self, site: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    def on_release_all(self, site: str) -> None:
+        """Condition.wait released every recursion level at once."""
+        self._tls.held = [s for s in self._held() if s != site]
+
+    def held_sites(self) -> tuple[str, ...]:
+        return tuple(self._held())
+
+    # -- blocking IO under a lock ---------------------------------------------
+
+    def _waived(self, site: str, what: str) -> bool:
+        for prefix, w in self.allowed_blocking.items():
+            if site.startswith(prefix):
+                # plain-string entries waive unconditionally (forbid=())
+                if not any(f in what for f in getattr(w, "forbid", ())):
+                    return True
+        return False
+
+    def note_blocking(self, what: str, exclude_file: str = "") -> None:
+        """Record blocking IO performed while holding any instrumented lock
+        whose creation site is NOT in ``exclude_file`` (the IO layer's own
+        pipeline lock is by-design and baselined)."""
+        held = [
+            s
+            for s in self._held()
+            if not (exclude_file and s.startswith(exclude_file))
+            and not self._waived(s, what)
+        ]
+        if held:
+            with self._mu:
+                self.blocking_violations.append(
+                    (what, tuple(held), threading.current_thread().name)
+                )
+
+    # -- analysis -------------------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        """Strongly-connected components of size >= 2 in the order graph —
+        each one a set of locks two threads can take in opposite orders."""
+        with self._mu:
+            graph: dict[str, set[str]] = {}
+            for a, b in self.edges:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        return [scc for scc in tarjan_sccs(graph) if len(scc) >= 2]
+
+    def report(self) -> dict:
+        with self._mu:
+            edges = {
+                f"{a} -> {b}": {"thread": t, "count": n}
+                for (a, b), (t, n) in sorted(self.edges.items())
+            }
+            violations = [
+                {"what": w, "held": list(h), "thread": t}
+                for (w, h, t) in self.blocking_violations
+            ]
+        return {
+            "edges": edges,
+            "cycles": self.cycles(),
+            "blocking_violations": violations,
+        }
+
+
+RECORDER = LockOrderRecorder()
+
+
+# -- instrumented lock types --------------------------------------------------
+
+
+class InstrumentedLock:
+    """A ``threading.Lock`` that reports acquire/release to the recorder."""
+
+    _factory = staticmethod(_REAL_LOCK)
+
+    def __init__(self, site: str, recorder: LockOrderRecorder = RECORDER):
+        self._inner = self._factory()
+        self._site = site
+        self._rec = recorder
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._rec.on_acquire(self._site)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._rec.on_release(self._site)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} site={self._site} {self._inner!r}>"
+
+
+class InstrumentedRLock(InstrumentedLock):
+    """Reentrant variant; forwards the private Condition protocol so a
+    Condition built over a package RLock keeps exact held-chains across
+    ``wait()`` (tx_sync's response cv)."""
+
+    _factory = staticmethod(_REAL_RLOCK)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        self._rec.on_release_all(self._site)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        self._rec.on_acquire(self._site)
+
+
+# -- installation -------------------------------------------------------------
+
+_installed = False
+
+
+def _lock_factory():
+    site = _site_of_caller()
+    if site is None:
+        return _REAL_LOCK()
+    return InstrumentedLock(site)
+
+
+def _rlock_factory():
+    site = _site_of_caller()
+    if site is None:
+        return _REAL_RLOCK()
+    return InstrumentedRLock(site)
+
+
+def install() -> None:
+    """Patch the ``threading.Lock``/``RLock`` factories so locks created by
+    package code from now on are instrumented. Idempotent."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = False
+
+
+_io_guarded = False
+
+
+def install_io_guards() -> None:
+    """Wrap service-RPC frame IO: any send/recv performed while holding an
+    instrumented lock created outside ``service/rpc.py`` is a recorded
+    blocking-IO-under-lock violation (the client's own pipeline lock is the
+    baselined by-design hold)."""
+    global _io_guarded
+    if _io_guarded:
+        return
+    _io_guarded = True
+    from ..service import rpc as _rpc
+
+    real_send, real_recv = _rpc._send_frame, _rpc._recv_frame
+
+    def send_frame(sock, body, scope=""):
+        RECORDER.note_blocking(
+            f"rpc.send_frame:{scope}", exclude_file="fisco_bcos_tpu/service/rpc.py"
+        )
+        return real_send(sock, body, scope)
+
+    def recv_frame(sock, scope=""):
+        RECORDER.note_blocking(
+            f"rpc.recv_frame:{scope}", exclude_file="fisco_bcos_tpu/service/rpc.py"
+        )
+        return real_recv(sock, scope)
+
+    _rpc._send_frame = send_frame
+    _rpc._recv_frame = recv_frame

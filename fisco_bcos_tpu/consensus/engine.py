@@ -40,7 +40,7 @@ from ..scheduler.scheduler import Scheduler, SchedulerError
 from ..txpool import TxPool
 from ..txpool.validator import batch_admit
 from ..utils.error import ErrorCode
-from ..utils.log import get_logger
+from ..utils.log import get_logger, note_swallowed
 from ..utils.worker import Worker
 from .config import PBFTConfig
 from .messages import (
@@ -804,7 +804,9 @@ class PBFTEngine:
         for raw in payload.prepare_proof:
             try:
                 pm = PBFTMessage.decode(raw)
-            except Exception:
+            except Exception as e:
+                # a malformed proof entry is byzantine-relevant: count it
+                note_swallowed("pbft.prepare_proof_decode", e)
                 continue
             if (
                 pm.packet_type != PacketType.PREPARE
@@ -833,7 +835,8 @@ class PBFTEngine:
         for m in vcs:
             try:
                 p = ViewChangePayload.decode(m.payload)
-            except Exception:
+            except Exception as e:
+                note_swallowed("pbft.viewchange_decode", e)
                 continue
             proven = self._verified_prepared(p)
             if proven is not None and (best is None or proven[0] > best[0]):
@@ -865,7 +868,8 @@ class PBFTEngine:
         for m in votes.values():
             try:
                 p = ViewChangePayload.decode(m.payload)
-            except Exception:
+            except Exception as e:
+                note_swallowed("pbft.viewchange_decode", e)
                 continue
             proven = self._verified_prepared(p)
             if proven is not None and (best is None or proven[0] > best[0]):

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..ops import keccak as keccak_ops
+from ..ops import merkle as merkle_ops
 from ..ops import secp256k1 as secp_ops
 from ..ops import sha256 as sha256_ops
 from ..ops import sm2 as sm2_ops
@@ -889,6 +890,16 @@ class CryptoSuite:
     def calculate_address_batch(self, pubs: np.ndarray) -> np.ndarray:
         digests = self.hash_impl.hash_batch([bytes(p) for p in np.asarray(pubs)])
         return digests[:, 12:]
+
+    def merkle_root_async(self, leaves: np.ndarray):
+        """Dispatch-now, sync-later (() -> bytes) wide device merkle over
+        ``[N, 32]`` uint8 leaves, hasher chosen by this suite.
+
+        This is the DevicePlane seam protocol/ledger callers use instead of
+        importing ``ops.merkle`` directly — the device-dispatch analyzer
+        rejects kernel imports outside the crypto/device/ops/parallel seams.
+        """
+        return merkle_ops.merkle_root_async(leaves, hasher=self.hash_impl.name)
 
 
 def ecdsa_suite() -> CryptoSuite:

@@ -459,8 +459,10 @@ class DevicePlane:
                 lambda: float(self._depth()),
                 help="items currently queued in the device plane",
             )
-        except Exception:  # metrics layer disabled/unavailable — plane works
-            pass
+        except Exception as e:  # metrics layer disabled/unavailable — plane works
+            from ..utils.log import note_swallowed
+
+            note_swallowed("device.plane.gauge_register", e)
 
 
 _PLANE: DevicePlane | None = None

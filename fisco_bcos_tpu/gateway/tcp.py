@@ -141,11 +141,15 @@ class _Peer:
                 chunks, kill = plan.on_send(self.scope, frame)
                 with self.wlock:
                     for c in chunks:
+                        # analysis: allow(lock-order, per-socket write mutex —
+                        # frame atomicity on ONE peer; SO_SNDTIMEO bounds the stall)
                         self.sock.sendall(c)
                 if kill:
                     raise faults.InjectedFault(f"injected kill at {self.scope}")
                 return True
             with self.wlock:
+                # analysis: allow(lock-order, per-socket write mutex —
+                # frame atomicity on ONE peer; SO_SNDTIMEO bounds the stall)
                 self.sock.sendall(frame)
             return True
         except OSError:

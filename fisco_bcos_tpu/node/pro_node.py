@@ -33,8 +33,10 @@ if os.environ.get("FISCO_FORCE_CPU"):  # pragma: no cover - env-dependent
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    except Exception as e:
+        from ..utils.log import note_swallowed
+
+        note_swallowed("pro_node.jax_cpu_pin", e)
 
 import argparse
 import signal

@@ -30,6 +30,7 @@ import time
 from collections import OrderedDict
 from typing import Callable
 
+from ..utils.log import note_swallowed
 from .tracer import TRACER, SpanRecord, TraceContext
 
 # bounded tx lifecycle index: tx hash hex -> {ctx, t_admit, wall_admit,
@@ -219,8 +220,10 @@ def collect(tx_hash_hex: str) -> dict:
     for source in list(SPAN_SOURCES):
         try:
             spans.extend(source(set(trace_ids), block))
-        except Exception:
-            continue  # a dead remote ring must not kill the local answer
+        except Exception as e:
+            # a dead remote ring must not kill the local answer
+            note_swallowed("critical_path.span_source", e)
+            continue
     return {
         "found": True,
         "txHash": key,

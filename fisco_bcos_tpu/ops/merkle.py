@@ -285,6 +285,8 @@ def _words_to_bytes(words):
     return by.reshape(words.shape[0], 32).astype(jnp.uint8)
 
 
+# analysis: allow(shape-bucket) — runs INSIDE jit traces whose leaf count was
+# already padded to bucket_leaves by _device_root_fn's callers
 def _device_level(cur, width: int):
     """One tree level on device: [L, 32] uint8 -> [ceil(L/width), 32]."""
     L = cur.shape[0]

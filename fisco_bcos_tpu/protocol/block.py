@@ -15,7 +15,6 @@ import numpy as np
 
 from ..codec.flat import FlatReader, FlatWriter
 from ..crypto.suite import CryptoSuite
-from ..ops.merkle import merkle_root_async
 from .block_header import BlockHeader
 from .receipt import TransactionReceipt
 from .transaction import Transaction, hash_transactions_batch
@@ -66,12 +65,12 @@ class Block:
         return list(self.tx_metadata)
 
     def calculate_txs_root_async(self, suite: CryptoSuite):
-        """Dispatch-now, sync-later (() -> bytes): see merkle_root_async."""
+        """Dispatch-now, sync-later (() -> bytes): see suite.merkle_root_async."""
         hashes = self.tx_hashes(suite)
         if not hashes:
             return lambda: _EMPTY_ROOT
         leaves = np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
-        return merkle_root_async(leaves, hasher=suite.hash_impl.name)
+        return suite.merkle_root_async(leaves)
 
     def calculate_txs_root(self, suite: CryptoSuite) -> bytes:
         return self.calculate_txs_root_async(suite)()
@@ -81,7 +80,7 @@ class Block:
             return lambda: _EMPTY_ROOT
         hashes = [rc.hash(suite) for rc in self.receipts]
         leaves = np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
-        return merkle_root_async(leaves, hasher=suite.hash_impl.name)
+        return suite.merkle_root_async(leaves)
 
     def calculate_receipts_root(self, suite: CryptoSuite) -> bytes:
         return self.calculate_receipts_root_async(suite)()

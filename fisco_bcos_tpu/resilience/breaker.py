@@ -82,8 +82,10 @@ class HealthRegistry:
                     1.0,
                     help="degraded-mode entries per component",
                 )
-        except Exception:
-            pass
+        except Exception as e:
+            from ..utils.log import note_swallowed
+
+            note_swallowed("health.metrics_export", e)
         if status != "ok":
             from ..utils.log import get_logger
 

@@ -167,12 +167,20 @@ IDEMPOTENT_METHODS: set[str] = {
     # registry / telemetry / health
     "register", "heartbeat", "metrics", "trace", "trace_tx", "trace_spans",
     "health",
+    # key center (pure transforms of the payload under the master key)
+    "encDataKey", "decDataKey",
+    # gateway read/connect surface (re-connecting to a live peer is a no-op)
+    "peers", "connect_peer",
 }
 
 NON_IDEMPOTENT_METHODS: set[str] = {
     "execute_transactions", "dag_execute_transactions",
     "dmc_execute", "dmc_cancel", "dmc_commit_ctx", "dmc_set_ownership",
     "align", "handle", "send", "broadcast", "register_front",
+    # frame delivery to the node: replaying re-dispatches module handlers
+    "on_receive",
+    # quota grant: a retry after a lost reply double-spends the permits
+    "acquire",
 }
 
 

@@ -116,6 +116,8 @@ class WsSession:
             head += bytes([127]) + struct.pack(">Q", n)
         try:
             with self.wlock:
+                # analysis: allow(lock-order, per-session write mutex — ws
+                # frame atomicity on ONE socket, no other lock is ever nested)
                 self.sock.sendall(head + payload)
             return True
         except OSError:

@@ -80,6 +80,14 @@ class Scheduler:
         # block-commit listeners: cb(number, committed Block-with-receipts)
         self.on_committed: list = []
         self._lock = threading.RLock()
+        # heights whose 2PC is in flight lock-free (see commit_block);
+        # the cv serializes committers without holding the lock across IO.
+        # The owning thread is tracked so switch_term — which the storage
+        # layer invokes synchronously on the thread whose IO just failed —
+        # can recognize its own in-flight commit and not wait on itself
+        self._committing: set[int] = set()
+        self._committing_thread: threading.Thread | None = None
+        self._commit_done = threading.Condition(self._lock)
         # listeners drain on a dedicated thread: commit_block is called by the
         # PBFT engine under ITS lock, and a listener doing network I/O (ws
         # block notify to a stalled client) must never stall consensus.
@@ -107,6 +115,21 @@ class Scheduler:
         headers derived from writes the backend may have lost.
         """
         with self._lock:
+            # an in-flight 2PC references _executed state and the backend
+            # this switch is abandoning — wait it out (bounded by the RPC
+            # timeout of the failing leg), exactly as the pre-r10 lock hold
+            # serialized term switches behind the commit in progress.
+            # UNLESS this thread IS the committer: the storage backend
+            # invokes its switch handler synchronously on the thread whose
+            # commit IO just failed, and waiting for our own marker (whose
+            # cleanup only runs after this handler returns) would
+            # self-deadlock — the pre-r10 RLock hold let this same-thread
+            # call reenter and proceed, so keep that semantics
+            while (
+                self._committing
+                and self._committing_thread is not threading.current_thread()
+            ):
+                self._commit_done.wait()
             self.term += 1
             dropped = sorted(self._executed)
             self._executed.clear()
@@ -165,6 +188,16 @@ class Scheduler:
         self, block: Block, verify: bool, number: int, proposal_ident
     ) -> BlockHeader:
         timer = StageTimer(_log, f"ExecuteBlock.{number}")
+
+        # An in-flight lock-free 2PC (commit_block) mutates the committing
+        # block's post-state overlay (ledger prewrite merge, suicides) and
+        # flips the durable height mid-apply — executing against either is a
+        # torn read, so executions drain the commit first, exactly as the
+        # old whole-commit lock hold serialized them. The pipeline win is
+        # unaffected: _committing is empty during the commit-QUORUM wait,
+        # which is when proposal N+1 speculatively executes.
+        while self._committing:
+            self._commit_done.wait()
 
         # Height gate with block pipelining (preExecuteBlock,
         # SchedulerInterface.h:76 / StateMachine.cpp:47 asyncPreApply): the
@@ -302,20 +335,64 @@ class Scheduler:
     # -- commitBlock:390 -----------------------------------------------------
 
     def commit_block(self, header: BlockHeader) -> None:
-        with TRACER.span("scheduler.commit_block", block=header.number) as sp:
+        number = header.number
+        with TRACER.span("scheduler.commit_block", block=number) as sp:
             t0 = time.perf_counter()
             with self._lock:
-                committed = self._commit_block_locked(header)
+                # committers serialize HERE, before the gate, exactly as the
+                # old whole-commit lock did (so a pipelined N+1 committer
+                # blocks until N is fully booked, keeping gate semantics and
+                # notify order intact) — cv.wait releases the lock, so
+                # execute_block callers are not starved while we queue
+                while self._committing:
+                    self._commit_done.wait()
+                cached = self._gate_commit_locked(header)
+            # The prewrite reads and the 2PC legs run OUTSIDE the scheduler
+            # lock: on the Pro/Max splits they round-trip to remote
+            # executor/storage services, and holding self._lock across that
+            # IO would serialize execute_block callers behind remote
+            # latency (the runtime lock-order recorder flags it). The
+            # in-flight marker keeps commits strictly serialized anyway.
+            timer = StageTimer(_log, f"CommitBlock.{number}")
+            try:
+                ledger_writes = StateStorage()
+                self.ledger.prewrite_block(cached.block, ledger_writes)
+                params = TwoPCParams(number=number)
+                # the 2PC legs as spans: on a remote executor/storage split
+                # these parent the service-side svc.*.prepare/commit spans
+                with TRACER.span("scheduler.2pc_prepare", block=number):
+                    self.executor.prepare(params, extra_writes=ledger_writes)
+                timer.stage("prepare")
+                with TRACER.span("scheduler.2pc_commit", block=number):
+                    self.executor.commit(params)
+                timer.stage("commit")
+            except BaseException:
+                # failed commit: clear the marker so recovery can re-drive
+                with self._lock:
+                    self._committing.discard(number)
+                    self._committing_thread = None
+                    self._commit_done.notify_all()
+                raise
+            with self._lock:
+                self._committing.discard(number)
+                self._committing_thread = None
+                self._commit_done.notify_all()
+                self._executed.pop(number, None)
+                for n in [n for n in self._executed if n <= number]:
+                    self._executed.pop(n)
+                if self.txpool is not None:
+                    self.txpool.on_block_committed(
+                        number,
+                        [t.hash(self.suite) for t in cached.block.transactions],
+                    )
                 # listeners run on the notify worker, never on the caller's
-                # thread: the caller is the PBFT engine holding its own RLock,
-                # so a blocking sendall to a stalled ws client here would
-                # freeze consensus. Posting stays INSIDE the lock (post never
-                # blocks) so two concurrent committers cannot enqueue out of
-                # order.
-                if committed is not None:
-                    number, block = committed
-                    for cb in list(self.on_committed):
-                        self._notify.post(lambda cb=cb: cb(number, block))
+                # thread: the caller is the PBFT engine holding its own
+                # RLock, so a blocking sendall to a stalled ws client here
+                # would freeze consensus. Posting stays inside the lock
+                # (post never blocks) so enqueue order matches commit order.
+                block = cached.block
+                for cb in list(self.on_committed):
+                    self._notify.post(lambda cb=cb: cb(number, block))
             from ..observability.tracer import trace_hex
 
             REGISTRY.observe(
@@ -325,7 +402,9 @@ class Scheduler:
                 exemplar=trace_hex(sp.ctx),
             )
 
-    def _commit_block_locked(self, header: BlockHeader) -> None:
+    def _gate_commit_locked(self, header: BlockHeader) -> "ExecutedBlock":
+        """Height-order gate + in-flight marker (runs under self._lock);
+        returns the cached execution whose 2PC the caller drives lock-free."""
         number = header.number
         # commits must land in height order: with the block pipeline, a
         # SPECULATIVE block N+1 is executed (and preparable) while N is
@@ -338,6 +417,9 @@ class Scheduler:
                 ErrorCode.SCHEDULER_INVALID_BLOCK,
                 f"commit out of order: got {number}, expect {expected}",
             )
+        # _committing is empty here: every committer drains it on the cv
+        # before calling this gate, so a duplicate commit of an in-flight
+        # height waits, then fails the height check above once N is booked
         cached = self._executed.get(number)
         if cached is None:
             raise SchedulerError(
@@ -348,30 +430,11 @@ class Scheduler:
                 ErrorCode.SCHEDULER_INVALID_BLOCK,
                 f"commit header mismatch for block {number}",
             )
-        timer = StageTimer(_log, f"CommitBlock.{number}")
         # carry QC signatures into the stored header
         cached.block.header = header
-        ledger_writes = StateStorage()
-        self.ledger.prewrite_block(cached.block, ledger_writes)
-        params = TwoPCParams(number=number)
-        # the 2PC legs as spans: on a remote executor/storage split these
-        # parent the service-side svc.*.prepare/commit spans over the wire
-        with TRACER.span("scheduler.2pc_prepare", block=number):
-            self.executor.prepare(params, extra_writes=ledger_writes)
-        timer.stage("prepare")
-        with TRACER.span("scheduler.2pc_commit", block=number):
-            self.executor.commit(params)
-        timer.stage("commit")
-        with self._lock:
-            self._executed.pop(number, None)
-            stale = [n for n in self._executed if n <= number]
-            for n in stale:
-                self._executed.pop(n)
-        if self.txpool is not None:
-            self.txpool.on_block_committed(
-                number, [t.hash(self.suite) for t in cached.block.transactions]
-            )
-        return number, cached.block
+        self._committing.add(number)
+        self._committing_thread = threading.current_thread()
+        return cached
 
     # -- call:621 ------------------------------------------------------------
 

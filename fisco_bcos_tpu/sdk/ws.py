@@ -112,8 +112,10 @@ class WsClient:
             if "id" not in msg and self.on_notify is not None:
                 try:
                     self.on_notify(msg)
-                except Exception:
-                    pass
+                except Exception as e:
+                    from ..utils.log import note_swallowed
+
+                    note_swallowed("sdk.ws.on_notify", e)
         self._open = False
         with self._cv:
             self._cv.notify_all()

@@ -22,8 +22,10 @@ try:  # pragma: no cover - environment-dependent
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+except Exception as e:
+    from ..utils.log import note_swallowed
+
+    note_swallowed("service_main.jax_cpu_pin", e)
 
 import argparse
 import signal

@@ -27,6 +27,7 @@ from ..protocol.transaction import Transaction
 from ..scheduler.dmc import ExecutorShard, decode_messages, encode_messages
 from ..storage.entry import Entry
 from ..storage.interfaces import StorageInterface, TwoPCParams
+from ..utils.log import note_swallowed
 from .rpc import ServiceClient, ServiceServer
 
 
@@ -104,8 +105,9 @@ class ExecutorService:
         def _loop() -> None:
             try:
                 _register()
-            except Exception:
-                pass
+            except Exception as e:
+                # registry may come up after us; heartbeat loop re-registers
+                note_swallowed("executor_service.register", e)
             while not self._hb_stop.wait(interval):
                 try:
                     w = FlatWriter()
@@ -115,8 +117,10 @@ class ExecutorService:
                     r = FlatReader(resp)
                     if r.u32() != 0:  # registry lost us: re-register
                         _register()
-                except Exception:
-                    continue  # registry down/restarting; keep trying
+                except Exception as e:
+                    # registry down/restarting; keep trying
+                    note_swallowed("executor_service.heartbeat", e)
+                    continue
 
         self._hb_thread = threading.Thread(
             target=_loop, name=f"hb-{self._name}", daemon=True

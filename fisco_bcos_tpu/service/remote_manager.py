@@ -30,7 +30,7 @@ from ..protocol.receipt import TransactionReceipt
 from ..protocol.transaction import Transaction
 from ..resilience import HEALTH, Deadline, RetryPolicy
 from ..storage.interfaces import TwoPCParams
-from ..utils.log import get_logger
+from ..utils.log import get_logger, note_swallowed
 from .executor_service import RemoteExecutor, RemoteShard
 from .rpc import (
     ServiceClient,
@@ -82,8 +82,8 @@ class _Member:
         self.executor.close()
         try:
             self.shard.client.close()
-        except Exception:
-            pass
+        except Exception as e:
+            note_swallowed("remote_manager.shard_close", e)
 
 
 class RemoteExecutorManager:

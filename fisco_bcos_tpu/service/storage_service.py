@@ -15,6 +15,7 @@ from typing import Iterator
 from ..codec.flat import FlatReader, FlatWriter
 from ..resilience import RetryPolicy
 from ..storage.entry import Entry
+from ..utils.log import note_swallowed
 from ..storage.interfaces import (
     TransactionalStorage,
     TraversableStorage,
@@ -161,8 +162,9 @@ class RemoteStorage(TransactionalStorage):
             if handler is not None:
                 try:
                     handler()
-                except Exception:
-                    pass  # reporting must never break the storage path
+                except Exception as e:
+                    # reporting must never break the storage path
+                    note_swallowed("storage_service.heal_handler", e)
 
     def _call(self, method: str, payload: bytes = b"") -> bytes:
         try:
@@ -174,8 +176,9 @@ class RemoteStorage(TransactionalStorage):
                 if handler is not None:
                     try:
                         handler()
-                    except Exception:
-                        pass  # the switch must never mask the storage error
+                    except Exception as e:
+                        # the switch must never mask the storage error
+                        note_swallowed("storage_service.switch_handler", e)
             raise
         except Exception:
             # a reply frame arrived — the transport healed, so the outage

@@ -15,7 +15,7 @@ from ..codec.flat import FlatReader, FlatWriter
 from ..front.front import FrontService, ModuleID
 from ..protocol.transaction import Transaction
 from ..txpool import TxPool
-from ..utils.log import get_logger
+from ..utils.log import get_logger, note_swallowed
 
 _log = get_logger("tx-sync")
 
@@ -125,7 +125,9 @@ class TransactionSync:
         for b in raw:
             try:
                 txs.append(Transaction.decode(b))
-            except Exception:
+            except Exception as e:
+                # a peer pushing undecodable txs is worth counting
+                note_swallowed("tx_sync.push_decode", e)
                 continue
         if txs:
             # device batch verify + admission (importDownloadedTxs:521);
@@ -143,7 +145,8 @@ class TransactionSync:
             for b in raw:
                 try:
                     tx = Transaction.decode(b)
-                except Exception:
+                except Exception as e:
+                    note_swallowed("tx_sync.response_decode", e)
                     continue
                 self._responses[tx.hash(self.suite)] = tx
             self._response_cv.notify_all()

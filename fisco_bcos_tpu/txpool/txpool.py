@@ -20,7 +20,7 @@ from ..observability import BATCH_BUCKETS, TRACER
 from ..observability import critical_path
 from ..protocol.transaction import Transaction, hash_transactions_batch
 from ..utils.error import ErrorCode
-from ..utils.log import get_logger
+from ..utils.log import get_logger, note_swallowed
 from ..utils.metrics import REGISTRY
 from .validator import (
     LedgerNonceChecker,
@@ -250,7 +250,9 @@ class TxPool:
                 continue
             try:
                 txs.append(Transaction.decode(e.get()))
-            except Exception:
+            except Exception as exc:
+                # a corrupt persisted row must not block re-import of the rest
+                note_swallowed("txpool.persist_decode", exc)
                 continue
         if not txs:
             return 0

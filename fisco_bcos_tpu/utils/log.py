@@ -39,6 +39,31 @@ def metric(logger: logging.Logger, desc: str, **kvs: Any) -> None:
     logger.info(kv_line("METRIC", desc, **kvs))
 
 
+def note_swallowed(site: str, exc: BaseException | None = None) -> None:
+    """Observe an intentionally-swallowed error instead of erasing it.
+
+    The except-hygiene analyzer (``fisco_bcos_tpu.analysis``) forbids broad
+    handlers whose body does nothing; every tolerated failure routes through
+    here so operators can see error *mass* per site even at INFO level:
+    a debug log line plus ``fisco_swallowed_errors_total{site=...}``.
+    """
+    try:
+        from .metrics import REGISTRY
+
+        REGISTRY.counter_add(
+            f'fisco_swallowed_errors_total{{site="{site}"}}',
+            1.0,
+            help="errors intentionally swallowed (tolerated), by site",
+        )
+    # analysis: allow(except-hygiene, the swallow observer itself must never raise)
+    except Exception:
+        pass
+    if exc is not None:
+        logging.getLogger("fisco.swallowed").debug(
+            "swallowed at %s: %r", site, exc
+        )
+
+
 class StageTimer:
     """Stage-timing helper mirroring the reference's BlockTrace logs
     (e.g. DMCExecute.0..6 in bcos-scheduler BlockExecutive.cpp:849-1010)."""

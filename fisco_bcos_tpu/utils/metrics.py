@@ -111,8 +111,10 @@ class MetricsRegistry:
                 from ..observability.tracer import TRACER
 
                 TRACER.flush_drop_metrics()
-            except Exception:
-                pass
+            except Exception as e:
+                from .log import note_swallowed
+
+                note_swallowed("metrics.flush_drops", e)
         lines: list[str] = []
         with self._lock:
             counters = dict(self._counters)
@@ -147,7 +149,11 @@ class MetricsRegistry:
             if callable(val):
                 try:
                     val = float(val())
-                except Exception:
+                except Exception as e:
+                    # a broken pull-gauge drops its sample, not the scrape
+                    from .log import note_swallowed
+
+                    note_swallowed("metrics.gauge_eval", e)
                     continue
             gauge_vals[name] = val
         emit_family(gauge_vals, "gauge")

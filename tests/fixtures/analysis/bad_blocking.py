@@ -1,0 +1,11 @@
+"""Fixture: blocking call performed while holding a lock."""
+
+import threading
+import time
+
+L = threading.Lock()
+
+
+def slow():
+    with L:
+        time.sleep(1)

@@ -1,0 +1,11 @@
+"""Fixture: unclassified RPC method, orphan span, ad-hoc latency buckets."""
+
+
+class Servant:
+    def setup(self, server, TRACER, REGISTRY):
+        server.register("totally_unclassified", self.handle)
+        TRACER.span("orphan")
+        REGISTRY.observe("fixture_latency_ms", 1.0, buckets=[1, 2, 3])
+
+    def handle(self, payload):
+        return payload

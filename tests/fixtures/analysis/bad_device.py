@@ -1,0 +1,3 @@
+"""Fixture: device-kernel import outside the DevicePlane seams."""
+
+from fisco_bcos_tpu.ops import secp256k1  # noqa: F401  (device-dispatch)

@@ -1,0 +1,8 @@
+"""Fixture: broad except handler that silently erases the error."""
+
+
+def risky():
+    try:
+        return 1 // 0
+    except Exception:
+        pass

@@ -1,0 +1,11 @@
+"""Fixture: side effect inside a jit-traced body (trace-time clock read)."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def stamped(x):
+    t = time.time()
+    return x * t

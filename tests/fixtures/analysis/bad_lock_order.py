@@ -1,0 +1,18 @@
+"""Fixture: two module locks acquired in opposite orders (cycle)."""
+
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+
+def ab():
+    with A:
+        with B:
+            return 1
+
+
+def ba():
+    with B:
+        with A:
+            return 2

@@ -1,0 +1,14 @@
+"""Fixture: input-sized arrays fed to a jitted function with no bucketing."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+def feed(items):
+    arr = np.zeros((len(items), 32))
+    return kernel(arr)

@@ -1,0 +1,19 @@
+"""Fixture: code every checker accepts — the no-false-positive control."""
+
+import threading
+
+from fisco_bcos_tpu.ops.merkle import MerkleTree  # host-safe name
+
+L = threading.Lock()
+
+
+def guarded(x):
+    with L:
+        return x + 1
+
+
+def tolerant():
+    try:
+        return MerkleTree
+    except ValueError as e:
+        return e

@@ -115,6 +115,63 @@ def test_storage_loss_triggers_term_switch_and_recovers():
         scheduler.stop()
 
 
+def test_switch_term_on_committing_thread_does_not_deadlock():
+    """Storage loss mid-2PC: RemoteStorage._call fires the switch handler
+    synchronously on the thread whose IO just failed — here, the committing
+    thread itself, with the in-flight commit marker set. switch_term must
+    recognize its own commit (the marker's cleanup only runs after the
+    handler returns) and proceed instead of waiting on itself, exactly as
+    the old whole-commit RLock hold let the same-thread call reenter."""
+    import threading
+
+    from fisco_bcos_tpu.service.rpc import ServiceConnectionError
+
+    storage = MemoryStorage()
+    kp = SUITE.signature_impl.generate_keypair(secret=0x5708)
+    ledger = Ledger(storage, SUITE)
+    ledger.build_genesis(
+        GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub, weight=1)])
+    )
+    executor = TransactionExecutor(storage, SUITE)
+    scheduler = Scheduler(executor, ledger, storage, SUITE)
+    fac = TransactionFactory(SUITE)
+    b1 = _make_block(ledger, kp, fac, 1, 1)
+    h1 = scheduler.execute_block(b1)
+
+    marker_at_switch = []
+
+    def failing_prepare(params, **kw):
+        # the storage layer's connection-loss path, inlined: handler on the
+        # committing thread, then the error propagates
+        marker_at_switch.append(set(scheduler._committing))
+        scheduler.switch_term()
+        raise ServiceConnectionError("storage lost mid-2PC")
+
+    executor.prepare = failing_prepare
+
+    result: dict = {}
+
+    def commit():
+        try:
+            scheduler.commit_block(h1)
+            result["exc"] = None
+        except Exception as e:  # captured for the main thread to assert on
+            result["exc"] = e
+
+    t = threading.Thread(target=commit, daemon=True)
+    t.start()
+    t.join(10)
+    try:
+        assert not t.is_alive(), "commit_block deadlocked in switch_term"
+        assert marker_at_switch == [{1}]  # handler ran with the marker set
+        assert isinstance(result["exc"], ServiceConnectionError)
+        assert scheduler.term == 1
+        assert scheduler._executed == {}
+        assert scheduler._committing == set()
+    finally:
+        scheduler.stop()
+
+
 def test_reads_fail_over_cleanly_mid_outage():
     """During the outage window every storage call raises (never hangs), and
     the first post-restart call heals without constructing a new client."""
