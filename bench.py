@@ -40,9 +40,10 @@ def _init_jax() -> None:
     """jax import + cache config — called by the --only children (and the
     bench functions' own imports), NOT by the orchestrating parent, which
     never touches a device."""
-    if os.environ.get("FISCO_BENCH_CPU_FALLBACK") and os.environ.get(
-        "FISCO_BENCH_CHILD_NAME"
-    ) in ("admission", "sm2"):
+    _child = os.environ.get("FISCO_BENCH_CHILD_NAME") or ""
+    if os.environ.get("FISCO_BENCH_CPU_FALLBACK") and (
+        _child in ("admission", "sm2") or _child.startswith("scenario")
+    ):
         # tunnel down: the EC children's numbers are already
         # degraded-and-labeled, so trade runtime for compile time the way
         # tests/conftest.py does — at full LLVM opt a single EC program
@@ -520,6 +521,80 @@ def bench_flood() -> None:
         )
 
 
+def bench_scenario(name: str) -> None:
+    """--scenario child: run a named scenario-lab workload on a live chain
+    and emit a per-group TPS/latency breakdown (fisco_bcos_tpu/scenario/).
+
+    Two artifact surfaces: JSON metric lines (one per group, plus the
+    isolation ratio when applicable) and the full runner document written
+    next to the bench output as ``bench_scenario.<name>.json`` — the
+    per-group breakdown, quota/demotion snapshot, health registry, fault
+    counts and the determinism digest for the seed."""
+    from fisco_bcos_tpu.scenario import ScenarioRunner, run_isolation_bench
+
+    seed = int(os.environ.get("FISCO_SCENARIO_SEED", "0") or 0)
+    scale = float(os.environ.get("FISCO_SCENARIO_SCALE", "1") or 1)
+    budget = _child_budget_s()
+    deadline = max(budget - 20, 30) if budget is not None else None
+    if name == "isolation":
+        doc = run_isolation_bench(seed=seed, scale=scale, deadline_s=deadline)
+        ratio = doc["victim_ratio"]
+        err = doc.get("error") or doc["combined"].get("error")
+        # acceptance: victim keeps >= 0.7x of its solo TPS while the abuser
+        # floods — vs_baseline is measured/required so >= 1.0 passes
+        _emit(
+            "scenario_isolation_victim_tps_ratio", ratio, "x-solo",
+            ratio / 0.7, error=err,
+        )
+        # only the ABUSER group's shed counts as proof: the victim's own
+        # quota drops (or solo-leg residue) passing the gate would claim
+        # isolation that never happened
+        abuser = doc["abuser_group"]
+        shed = sum(
+            v
+            for k, v in doc["abuse_shed_counters"].items()
+            if f'group="{abuser}"' in k
+        )
+        _emit(
+            "scenario_isolation_abuse_shed_txs", shed, "tx",
+            1.0 if shed > 0 else 0.0,
+            error=None if shed > 0 else "no abuser traffic shed at admission",
+        )
+        group_docs = {
+            **doc["combined"]["groups"],
+            "solo:" + doc["victim_group"]: doc["solo"]["groups"][
+                doc["victim_group"]
+            ],
+        }
+    else:
+        doc = ScenarioRunner(
+            name, seed=seed, scale=scale, deadline_s=deadline
+        ).run()
+        group_docs = doc["groups"]
+    for g, gd in sorted(group_docs.items()):
+        label = g.replace(":", "_")
+        _emit(
+            f"scenario_{name}_{label}_tps", gd["tps"], "tx/s", 0.0,
+            error=doc.get("error"),
+        )
+        print(
+            f"# scenario {name} group={g} submitted={gd['submitted']} "
+            f"admitted={gd['admitted']} committed={gd['committed']} "
+            f"rejected={gd['rejected']} p50={gd['latency_ms_p50']}ms "
+            f"p95={gd['latency_ms_p95']}ms",
+            flush=True,
+        )
+    base = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(base, f"bench_scenario.{name}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    print(
+        f"# scenario artifact -> {path} (seed={seed}, digest="
+        f"{doc.get('determinism_digest', doc.get('combined', {}).get('determinism_digest', ''))[:16]})",
+        flush=True,
+    )
+
+
 def _dump_telemetry(tag: str) -> None:
     """--telemetry mode: write the metrics snapshot + trace next to the
     bench JSON lines (per-child files — each --only child is its own
@@ -724,6 +799,16 @@ def _main_only(name: str) -> None:
         "merkle": bench_merkle,
         "flood": bench_flood,
     }
+    if name.startswith("scenario:"):
+        scen = name.split(":", 1)[1]
+        _init_jax()
+        try:
+            bench_scenario(scen)
+            _dump_telemetry(f"scenario_{scen}")
+        except Exception as e:
+            print(f"# bench scenario {scen} failed: {e}", flush=True)
+            raise SystemExit(1)
+        return
     if name not in fns:
         print(f"# unknown bench '{name}'", flush=True)
         raise SystemExit(2)
@@ -734,6 +819,58 @@ def _main_only(name: str) -> None:
     except Exception as e:
         print(f"# bench bench_{name} failed: {e}", flush=True)
         raise SystemExit(1)
+
+
+def _main_scenario(name: str) -> None:
+    """--scenario parent: run one named scenario through the same killable
+    --only child machinery as the metric benches (a wedged chain or a
+    flapped TPU tunnel costs this run, not the caller's whole budget)."""
+    import subprocess
+    import sys
+
+    from fisco_bcos_tpu.scenario import SCENARIOS
+
+    if name not in SCENARIOS and name != "isolation":
+        known = ", ".join(sorted(SCENARIOS))
+        print(f"# unknown scenario '{name}' (known: {known})", flush=True)
+        raise SystemExit(2)
+    try:
+        total_s = float(os.environ.get("FISCO_BENCH_TOTAL_BUDGET", "1200"))
+    except ValueError:
+        total_s = 1200.0
+    if not _probe_backend(timeout_s=int(min(240, total_s / 6))):
+        print(f"# {_CPU_FALLBACK_NOTE}", flush=True)
+        os.environ["FISCO_BENCH_CPU_FALLBACK"] = "1"
+    child = f"scenario:{name}"
+    env = dict(
+        os.environ,
+        FISCO_BENCH_CHILD_BUDGET=str(int(total_s - 20)),
+        FISCO_BENCH_CHILD_NAME=child,
+    )
+    rc = 0
+    out = err = ""
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--only", child],
+            timeout=total_s + 15,
+            capture_output=True,
+            env=env,
+        )
+        out = res.stdout.decode(errors="replace")
+        err = res.stderr.decode(errors="replace")
+        rc = 1 if res.returncode else 0
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode(errors="replace")
+        err = (e.stderr or b"").decode(errors="replace")
+        print(f"# scenario {name} timed out after {total_s}s", flush=True)
+        rc = 1
+    for line in out.splitlines():
+        if line.startswith("{") or line.startswith("#"):
+            print(line, flush=True)
+    if rc:
+        for line in err.splitlines()[-4:]:
+            print(f"# scenario stderr: {line[:300]}", flush=True)
+    raise SystemExit(rc)
 
 
 if __name__ == "__main__":
@@ -762,11 +899,25 @@ if __name__ == "__main__":
         # lines (propagates to --only children through the environment)
         _sys.argv.remove("--telemetry")
         os.environ["FISCO_BENCH_TELEMETRY"] = "1"
-    if len(_sys.argv) >= 2 and _sys.argv[1] == "--only":
+    if "--seed" in _sys.argv:
+        i = _sys.argv.index("--seed")
+        if i + 1 >= len(_sys.argv):
+            print("usage: bench.py --scenario <name> [--seed N]")
+            raise SystemExit(2)
+        os.environ["FISCO_SCENARIO_SEED"] = _sys.argv[i + 1]
+        del _sys.argv[i : i + 2]
+    if "--scenario" in _sys.argv:
+        i = _sys.argv.index("--scenario")
+        if i + 1 >= len(_sys.argv):
+            print("usage: bench.py [--telemetry] --scenario <name> [--seed N]")
+            raise SystemExit(2)
+        _main_scenario(_sys.argv[i + 1])
+    elif len(_sys.argv) >= 2 and _sys.argv[1] == "--only":
         if len(_sys.argv) < 3:
             print(
                 "usage: bench.py [--telemetry] "
-                "[--only admission|sm2|merkle|flood]"
+                "[--only admission|sm2|merkle|flood|scenario:<name>] "
+                "[--scenario <name> [--seed N]]"
             )
             raise SystemExit(2)
         _main_only(_sys.argv[2])

@@ -32,6 +32,19 @@ The plane is that engine's scheduler:
   has waited past ``FISCO_DEVICE_STARVATION_MS`` (default 50 ms) preempts
   lane order, oldest first — a gossip flood cannot park a QC check, and a
   stream of QC checks cannot park gossip forever.
+- **Group-fair selection (multi-tenant isolation).** Every request carries
+  the chain group that produced it (``device_group``, tagged by each
+  group's txpool). When a dispatch-ready op queue holds traffic from MORE
+  than one tenant group, the dispatch is assembled by deficit-weighted
+  round-robin across groups *within* each priority lane: each group earns
+  ``FISCO_DEVICE_GROUP_QUANTUM`` items (x its
+  ``FISCO_DEVICE_GROUP_WEIGHTS`` weight) per round and spends its deficit
+  on its oldest requests, and the merged batch is capped at the high-water
+  mark — so one group flooding admission batches cannot fill every device
+  program while another group's batch sits queued behind the backlog.
+  Deferred requests keep their enqueue time (aging still applies) and
+  count into ``fisco_device_plane_deferred_total{op,group}``. Single-group
+  queues take the exact pre-fairness path: everything merges, no cap.
 - **Passthrough mode.** ``FISCO_DEVICE_PLANE=0`` disables routing entirely:
   every seam takes its exact pre-plane dispatch path (per-caller batches,
   no coalescing, no fan-out) — the escape hatch the smoke tool exercises.
@@ -86,6 +99,26 @@ def current_lane() -> str:
     return getattr(_tls, "lane", DEFAULT_LANE)
 
 
+def current_group() -> str:
+    """The tenant (chain group) this thread's device batches belong to;
+    "" = ungrouped (single-group deployments, internal callers)."""
+    return getattr(_tls, "group", "")
+
+
+@contextmanager
+def device_group(name: str):
+    """Tag device-crypto calls in this thread with their tenant group, the
+    unit the plane's deficit-round-robin arbitrates between. Same contract
+    as :func:`device_lane`: the txpool wraps its batch calls, everything
+    submitted underneath inherits the tag."""
+    prev = getattr(_tls, "group", "")
+    _tls.group = name
+    try:
+        yield
+    finally:
+        _tls.group = prev
+
+
 @contextmanager
 def device_lane(name: str):
     """Tag device-crypto calls in this thread with a priority lane.
@@ -117,6 +150,7 @@ class PlaneRequest:
     t_enq: float
     future: Future
     ctx: object = None
+    group: str = ""  # tenant group (deficit-round-robin arbitration unit)
 
 
 # wait-time buckets: the window is ~2 ms, starvation trips at ~50 ms, and
@@ -165,6 +199,21 @@ class DevicePlane:
             if starvation_ms is None
             else float(starvation_ms)
         )
+        # group-fair selection: items each tenant group earns per DRR round,
+        # scaled by its weight (FISCO_DEVICE_GROUP_WEIGHTS="g0=2,g1=1");
+        # deficits persist across dispatches while a group has backlog and
+        # reset when it drains (classic DRR)
+        self.group_quantum = max(1, int(_env("FISCO_DEVICE_GROUP_QUANTUM", "256")))
+        self.group_weights: dict[str, float] = {}
+        for part in os.environ.get("FISCO_DEVICE_GROUP_WEIGHTS", "").split(","):
+            name, _, w = part.strip().partition("=")
+            if name and w:
+                try:
+                    self.group_weights[name] = max(float(w), 1e-6)
+                except ValueError:
+                    pass
+        self._deficit: dict[str, float] = {}
+        self._drr_rotor = 0  # rotates the serving order across dispatches
         self._autostart = autostart
         self._cv = threading.Condition()
         self._pending: dict[str, list[PlaneRequest]] = {}
@@ -203,6 +252,7 @@ class DevicePlane:
         req = PlaneRequest(
             op, payload, int(n), current_lane(), time.perf_counter(), Future(),
             ctx=TRACER.current_context() if TRACER.enabled else None,
+            group=current_group(),
         )
         with self._cv:
             self._exec_fns.setdefault(op, exec_fn)
@@ -241,7 +291,12 @@ class DevicePlane:
         count at/over high water. Among ready groups: starved groups (oldest
         request past starvation_ms) first, oldest first — the aging bound
         that makes draining starvation-free; then by best lane priority
-        present in the group; ties to the oldest group."""
+        present in the group; ties to the oldest group.
+
+        Returns ``(op, taken, deferred)``: multi-tenant queues are trimmed
+        by :meth:`_select_fair`; requests it defers go back to the FRONT of
+        the op's queue (enqueue times intact, so aging and window readiness
+        survive) and are reported for the deferred counter."""
         best_op = None
         best_key = None
         for op, reqs in self._pending.items():
@@ -257,7 +312,95 @@ class DevicePlane:
                 best_key, best_op = key, op
         if best_op is None:
             return None
-        return best_op, self._pending.pop(best_op)
+        taken, deferred = self._select_fair(self._pending.pop(best_op))
+        if deferred:
+            self._pending[best_op] = deferred
+        return best_op, taken, deferred
+
+    def _weight(self, group: str) -> float:
+        return self.group_weights.get(group, 1.0)
+
+    def _select_fair(self, reqs: list[PlaneRequest]):
+        """Deficit-weighted round-robin across tenant groups within each
+        priority lane: assemble one merged dispatch of at most
+        ``high_water`` items (a single oversized request still dispatches
+        whole — requests are indivisible), leaving the surplus queued.
+
+        Single-tenant queues (the common case, and every pre-multi-group
+        deployment) take the exact legacy path: all requests merge, no cap.
+        Returns ``(taken, deferred)`` with FIFO order preserved inside each
+        (lane, group); ``taken`` is never empty."""
+        all_groups = {r.group for r in reqs}
+        if len(all_groups) <= 1:
+            return reqs, []
+        cap = self.high_water
+        # per-round quantum scaled so one round across n groups roughly
+        # fills the cap — an unscaled quantum >= cap would let whichever
+        # group serves first spend the whole dispatch before the others'
+        # turns, which is exactly the monopoly DRR exists to prevent
+        base_q = max(1, min(self.group_quantum, cap // len(all_groups)))
+        by_lane: dict[int, dict[str, deque]] = {}
+        for r in reqs:
+            lane_q = by_lane.setdefault(LANES.get(r.lane, 1), {})
+            lane_q.setdefault(r.group, deque()).append(r)
+        taken: list[PlaneRequest] = []
+        taken_ids: set[int] = set()
+        total = 0
+        rotor = self._drr_rotor
+        self._drr_rotor += 1
+        for rank in sorted(by_lane):
+            queues = by_lane[rank]
+            # rotate the serving order across dispatches so no group is
+            # structurally first every time
+            order = list(queues)
+            start = rotor % len(order)
+            order = order[start:] + order[:start]
+            while total < cap and any(queues.values()):
+                # one DRR round: every backlogged group earns one quantum,
+                # then spends its deficit on its oldest requests — a huge
+                # request accumulates rounds until funded, so nothing
+                # starves, it just waits its proportional turn
+                for g in order:
+                    q = queues[g]
+                    if not q:
+                        continue
+                    self._deficit[g] = (
+                        self._deficit.get(g, 0.0) + base_q * self._weight(g)
+                    )
+                    while q and total < cap and self._deficit[g] >= q[0].n:
+                        r = q.popleft()
+                        self._deficit[g] -= r.n
+                        taken.append(r)
+                        taken_ids.add(id(r))
+                        total += r.n
+                    if total >= cap:
+                        break
+            if total >= cap:
+                break
+        deferred = [r for r in reqs if id(r) not in taken_ids]
+        # classic DRR: a group that drained its backlog forfeits its credit
+        # (deficits only persist across dispatches while traffic is queued)
+        still_backlogged = {r.group for r in deferred}
+        for g in {r.group for r in reqs} - still_backlogged:
+            self._deficit.pop(g, None)
+        return taken, deferred
+
+    def _note_deferred(self, op: str, deferred: list[PlaneRequest]) -> None:
+        """Export fairness decisions (called OUTSIDE the scheduler lock)."""
+        from ..utils.metrics import REGISTRY
+
+        if not deferred or not REGISTRY.enabled:
+            return
+        per_group: dict[str, int] = {}
+        for r in deferred:
+            per_group[r.group] = per_group.get(r.group, 0) + 1
+        for g, n in per_group.items():
+            REGISTRY.counter_add(
+                f'fisco_device_plane_deferred_total{{group="{g}",op="{op}"}}',
+                float(n),
+                help="requests deferred to a later dispatch by group-fair "
+                "deficit-round-robin (the multi-tenant backpressure signal)",
+            )
 
     def _next_timeout_s(self, now: float) -> float | None:
         """Seconds until the earliest group becomes window-ready; None when
@@ -274,14 +417,16 @@ class DevicePlane:
     def _run(self) -> None:
         while True:
             with self._cv:
-                group = None
-                while group is None:
-                    group = self._pick_ready(time.perf_counter())
-                    if group is None:
+                picked = None
+                while picked is None:
+                    picked = self._pick_ready(time.perf_counter())
+                    if picked is None:
                         self._cv.wait(self._next_timeout_s(time.perf_counter()))
+                op, reqs, deferred = picked
                 self._busy = True
             try:
-                self._dispatch(*group)
+                self._note_deferred(op, deferred)
+                self._dispatch(op, reqs)
             finally:
                 with self._cv:
                     self._busy = False

@@ -81,10 +81,19 @@ class FrontService:
 
 
 class GatewayInterface:
-    def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+    """``group`` (keyword-only, default "") attributes the frame to a chain
+    group for multi-tenant bandwidth accounting — transports that police
+    budgets label their drop counters with it; others ignore it."""
+
+    def send(
+        self, module_id: int, src: bytes, dst: bytes, payload: bytes,
+        group: str = "",
+    ) -> None:
         raise NotImplementedError
 
-    def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+    def broadcast(
+        self, module_id: int, src: bytes, payload: bytes, group: str = ""
+    ) -> None:
         raise NotImplementedError
 
 
@@ -123,10 +132,15 @@ class InprocGateway(GatewayInterface):
             with self._lock:
                 self._queue.append((module_id, src, dst, payload))
 
-    def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+    def send(
+        self, module_id: int, src: bytes, dst: bytes, payload: bytes,
+        group: str = "",
+    ) -> None:
         self._enqueue(module_id, src, dst, payload)
 
-    def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+    def broadcast(
+        self, module_id: int, src: bytes, payload: bytes, group: str = ""
+    ) -> None:
         with self._lock:
             targets = [nid for nid in self._fronts if nid != src]
         for dst in targets:

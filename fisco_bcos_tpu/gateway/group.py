@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 
 from ..front.front import FrontService, GatewayInterface
-from ..utils.log import get_logger
+from ..utils.log import get_logger, note_swallowed
 
 _log = get_logger("group-gw")
 
@@ -39,15 +39,28 @@ class _GroupFacade(GatewayInterface):
         self.mux = mux
         self.group_id = group_id
 
-    def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+    def send(
+        self, module_id: int, src: bytes, dst: bytes, payload: bytes,
+        group: str = "",
+    ) -> None:
         gw = self.mux.transport
         if gw is not None:
-            gw.send(module_id, src, dst, _wrap(self.group_id, payload))
+            # the facade knows the tenant: label the frame so the transport's
+            # bandwidth policer can attribute any drop to this group
+            gw.send(
+                module_id, src, dst, _wrap(self.group_id, payload),
+                group=self.group_id,
+            )
 
-    def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+    def broadcast(
+        self, module_id: int, src: bytes, payload: bytes, group: str = ""
+    ) -> None:
         gw = self.mux.transport
         if gw is not None:
-            gw.broadcast(module_id, src, _wrap(self.group_id, payload))
+            gw.broadcast(
+                module_id, src, _wrap(self.group_id, payload),
+                group=self.group_id,
+            )
 
 
 class GroupGateway:
@@ -68,7 +81,8 @@ class GroupGateway:
     def on_receive(self, module_id: int, src: bytes, payload: bytes) -> None:
         try:
             group_id, inner = _unwrap(payload)
-        except (IndexError, UnicodeDecodeError):
+        except (IndexError, UnicodeDecodeError) as e:
+            note_swallowed("gateway.group.unwrap", e)
             _log.warning("undecodable group frame from %s", src.hex()[:8])
             return
         with self._lock:

@@ -72,30 +72,36 @@ class RateLimiterManager:
         self._registry = registry
         self._lock = threading.Lock()
 
-    def _count_drop(self, scope: str, nbytes: int) -> None:
+    def _count_drop(self, scope: str, nbytes: int, group: str = "") -> None:
+        """``group`` labels the drop with the chain group whose traffic was
+        shed (multi-tenant attribution — ISSUE 6); empty = ungrouped frame,
+        keeping the original series untouched for single-group deployments."""
         with self._lock:
             self.dropped += 1
+        labels = f'scope="{scope}"'
+        if group:
+            labels = f'group="{group}",{labels}'
         reg = self._registry if self._registry is not None else _metrics.REGISTRY
         reg.counter_add(
-            f'fisco_gateway_ratelimit_dropped_total{{scope="{scope}"}}',
+            f"fisco_gateway_ratelimit_dropped_total{{{labels}}}",
             help="frames dropped by outbound bandwidth policing",
         )
         reg.counter_add(
-            f'fisco_gateway_ratelimit_dropped_bytes_total{{scope="{scope}"}}',
+            f"fisco_gateway_ratelimit_dropped_bytes_total{{{labels}}}",
             float(nbytes),
             help="payload bytes dropped by outbound bandwidth policing",
         )
 
-    def check(self, module_id: int, nbytes: int) -> bool:
+    def check(self, module_id: int, nbytes: int, group: str = "") -> bool:
         # charge the TOTAL budget first: if it rejects, the module budget is
         # untouched (charging module-then-total double-charged dropped frames
         # against the module, throttling it below its configured rate)
         if self.total is not None and not self.total.try_acquire(nbytes):
-            self._count_drop("total", nbytes)
+            self._count_drop("total", nbytes, group)
             return False
         lim = self.by_module.get(int(module_id))
         if lim is not None and not lim.try_acquire(nbytes):
-            self._count_drop("module", nbytes)
+            self._count_drop("module", nbytes, group)
             return False
         return True
 

@@ -48,7 +48,7 @@ import zlib
 
 from ..front.front import FrontService, GatewayInterface
 from ..resilience import faults
-from ..utils.log import get_logger
+from ..utils.log import get_logger, note_swallowed
 from .router import MAX_DISTANCE, RouterTable
 from .tls import NODE_ID_URI_SCHEME
 
@@ -305,9 +305,12 @@ class TcpGateway(GatewayInterface):
             _KIND_DATA, module_id, flags, self.node_id, dst, payload, ttl=ttl
         )
 
-    def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+    def send(
+        self, module_id: int, src: bytes, dst: bytes, payload: bytes,
+        group: str = "",
+    ) -> None:
         if self._limiter is not None and not self._limiter.check(
-            module_id, len(payload)
+            module_id, len(payload), group
         ):
             _log.warning("rate limit dropped send to %s", dst.hex()[:8])
             return
@@ -329,9 +332,11 @@ class TcpGateway(GatewayInterface):
         if not peer.send(frame):
             self._drop(peer)
 
-    def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+    def broadcast(
+        self, module_id: int, src: bytes, payload: bytes, group: str = ""
+    ) -> None:
         if self._limiter is not None and not self._limiter.check(
-            module_id, len(payload)
+            module_id, len(payload), group
         ):
             _log.warning("rate limit dropped broadcast")
             return
@@ -530,6 +535,17 @@ class TcpGateway(GatewayInterface):
                 if self.router.update_from(peer.node_id, entries):
                     self._advertise_routes()
                 continue
+            if kind != _KIND_DATA:
+                # an unrecognized kind is wire garbage (a corrupt-fault
+                # bit-flip, a flaky NIC): count + drop — it must never fall
+                # through to local delivery as if it were data
+                note_swallowed(
+                    "gateway.tcp.bad_kind", ValueError(f"frame kind {kind}")
+                )
+                _log.warning(
+                    "unknown frame kind %d from %s — dropped", kind, peer.scope
+                )
+                continue
             if kind == _KIND_DATA and flags & _FLAG_BROADCAST:
                 (seq,) = struct.unpack("<I", dst[:4])
                 if src == self.node_id or not self._bcast_is_new(
@@ -564,7 +580,8 @@ class TcpGateway(GatewayInterface):
                     if d.unconsumed_tail:
                         _log.warning("oversized frame from %s dropped", src.hex()[:8])
                         continue
-                except zlib.error:
+                except zlib.error as e:
+                    note_swallowed("gateway.tcp.corrupt_frame", e)
                     _log.warning("corrupt compressed frame from %s", src.hex()[:8])
                     continue
             if self._front is not None:

@@ -73,7 +73,7 @@ class LightNodeService:
         w.u64(req_id)
         w.u8(1)
         try:
-            self._fill_response(module, r, w)
+            self._fill_response(module, r, w, src)
             w_ok = True
         except Exception as e:  # malformed request / missing data
             _log.info("lightnode request failed: %s", e)
@@ -81,7 +81,9 @@ class LightNodeService:
         if w_ok:
             self.node.front.send_message(module, src, w.out())
 
-    def _fill_response(self, module: int, r: FlatReader, w: FlatWriter) -> None:
+    def _fill_response(
+        self, module: int, r: FlatReader, w: FlatWriter, src: bytes = b""
+    ) -> None:
         node = self.node
         if module == ModuleID.LIGHTNODE_GET_STATUS:
             r.done()
@@ -119,7 +121,9 @@ class LightNodeService:
             raw = r.bytes_()
             r.done()
             tx = Transaction.decode(raw)
-            res = node.txpool.submit(tx)
+            # the requesting lightnode is the strike source: one spamming
+            # client must not demote the shared default for everyone
+            res = node.txpool.submit(tx, source=f"lightnode:{src.hex()[:16]}")
             w.u64(int(res.status))
             w.fixed(res.tx_hash.ljust(32, b"\x00")[:32], 32)
         elif module == ModuleID.LIGHTNODE_CALL:

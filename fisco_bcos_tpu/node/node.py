@@ -54,6 +54,12 @@ class NodeConfig:
     # (waitForExecutorConnection).
     executor_registry: str = ""
     executor_min: int = 1
+    # multi-tenant admission quota for THIS group (txs/sec into the pool;
+    # 0 = unlimited / env default FISCO_GROUP_ADMISSION_RATE). On a
+    # multi-group host every group's pool shares one device plane — the
+    # quota is what keeps an abusive group's flood from taxing the rest.
+    admission_rate: float = 0.0
+    admission_burst: float = 0.0  # 0 = 2x rate
     genesis: GenesisConfig = field(default_factory=GenesisConfig)
 
 
@@ -110,6 +116,12 @@ class Node:
             block_limit=config.block_limit,
             persistent_store=self.storage if durable else None,
         )
+        if config.admission_rate > 0:
+            self.txpool.quotas.configure(
+                config.group_id,
+                config.admission_rate,
+                config.admission_burst or None,
+            )
         # degraded-mode registry: seed the components this node owns so
         # GET /health lists them from boot (unknown != ok for an operator)
         from ..resilience import HEALTH

@@ -7,7 +7,8 @@ suite can provoke executor loss, shard loss and network flaps on demand and
 get the same failure sequence on every run.
 
 A :class:`FaultPlan` is a list of rules. Each rule names an *action*
-(``drop``/``delay``/``duplicate``/``truncate``/``refuse``/``kill``), a
+(``drop``/``delay``/``duplicate``/``truncate``/``corrupt``/``refuse``/
+``kill``), a
 *site* (``connect``/``send``/``recv``/``*``) and a *target* substring
 matched against the transport's scope string (service clients use
 ``"host:port"``, servers ``"svc:<name>"``, the gateway ``"gw:<port>"``), so
@@ -30,7 +31,15 @@ environment spec parsed once at transport import (:func:`ensure_env_plan`):
 Spec grammar: ``;``-separated clauses; ``seed=N`` may appear once; every
 other clause is ``action@site:target[,key=val...]`` with keys ``p`` (float
 probability), ``count`` (max firings), ``after`` (pass N matching events
-first), ``ms`` (delay milliseconds), ``keep`` (truncate: bytes kept).
+first), ``ms`` (delay milliseconds), ``keep`` (truncate: bytes kept),
+``bits`` (corrupt: bit flips per frame).
+
+``corrupt`` flips ``bits`` seeded-random bits in the frame *body* (never
+the length header, so the frame still parses as a frame and the garbage
+reaches the CODEC) — the wire-level garbage a flaky NIC or a malicious
+peer produces. Decode paths must reject it with typed errors
+(:class:`~fisco_bcos_tpu.service.rpc.BadFrame`, dropped-peer logs) and
+count it (``note_swallowed`` sites), never crash or silently absorb it.
 
 Injected failures surface as :class:`InjectedFault`, an ``OSError``
 subclass — every transport already treats ``OSError`` as connection loss,
@@ -54,7 +63,7 @@ class InjectedFault(OSError):
     existing connection-loss handling absorbs it unchanged)."""
 
 
-_ACTIONS = ("drop", "delay", "duplicate", "truncate", "refuse", "kill")
+_ACTIONS = ("drop", "delay", "duplicate", "truncate", "corrupt", "refuse", "kill")
 _SITES = ("connect", "send", "recv", "*")
 
 
@@ -63,7 +72,7 @@ class FaultRule:
 
     __slots__ = (
         "action", "site", "target", "p", "count", "after",
-        "delay_ms", "keep", "fired", "seen",
+        "delay_ms", "keep", "bits", "fired", "seen",
     )
 
     def __init__(
@@ -76,6 +85,7 @@ class FaultRule:
         after: int = 0,
         delay_ms: float = 10.0,
         keep: int = 2,
+        bits: int = 3,
     ):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}")
@@ -89,6 +99,7 @@ class FaultRule:
         self.after = int(after)  # pass this many matching events first
         self.delay_ms = float(delay_ms)
         self.keep = int(keep)  # truncate: wire bytes that still go out
+        self.bits = int(bits)  # corrupt: bit flips per frame body
         self.fired = 0
         self.seen = 0
 
@@ -145,6 +156,10 @@ class FaultPlan:
     def truncate(self, site: str = "*", target: str = "*", **kw):
         return self.rule("truncate", site, target, **kw)
 
+    def corrupt(self, site: str = "*", target: str = "*", **kw):
+        """Seeded bit-flips in the frame body (codec-level garbage)."""
+        return self.rule("corrupt", site, target, **kw)
+
     def refuse_connect(self, target: str = "*", **kw):
         return self.rule("refuse", "connect", target, **kw)
 
@@ -174,7 +189,7 @@ class FaultPlan:
                     k = k.strip()
                     if k == "p":
                         kw["p"] = float(v)
-                    elif k in ("count", "after", "keep"):
+                    elif k in ("count", "after", "keep", "bits"):
                         kw[k] = int(v)
                     elif k == "ms":
                         kw["delay_ms"] = float(v)
@@ -204,6 +219,20 @@ class FaultPlan:
                 return r
         return None
 
+    def _corrupt_bytes(self, data: bytes, bits: int, skip: int = 0) -> bytes:
+        """Flip ``bits`` seeded-random bits in ``data[skip:]`` — ``skip``
+        protects the length header so the frame still parses as a frame and
+        the garbage reaches the codec, which is the layer under test."""
+        span = len(data) - skip
+        if span <= 0 or bits <= 0:
+            return data
+        buf = bytearray(data)
+        with self._lock:
+            for _ in range(bits):
+                i = skip + self._rng.randrange(span)
+                buf[i] ^= 1 << self._rng.randrange(8)
+        return bytes(buf)
+
     def on_connect(self, scope: str) -> None:
         r = self._fire("connect", scope)
         if r is not None and r.action in ("refuse", "kill", "drop"):
@@ -226,6 +255,11 @@ class FaultPlan:
             # a torn write: part of the frame goes out, then the link dies —
             # what a crashed peer mid-sendall looks like from the other end
             return [wire[: r.keep]], True
+        if r.action == "corrupt":
+            # garbage-on-the-wire: the frame arrives intact-looking but its
+            # body is bit-flipped — the connection stays up, the DECODER
+            # must reject it (skip=4 spares the u32 length header)
+            return [self._corrupt_bytes(wire, r.bits, skip=4)], False
         # kill / refuse at the send site: connection dies before the write
         return [], True
 
@@ -240,6 +274,8 @@ class FaultPlan:
             return body
         if r.action == "truncate":
             return body[: r.keep]
+        if r.action == "corrupt":
+            return self._corrupt_bytes(body, r.bits)
         if r.action == "duplicate":
             return body  # duplication is a send-side concept; pass through
         raise InjectedFault(f"injected {r.action} on recv at {scope}")

@@ -72,10 +72,15 @@ class RpcHttpServer:
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length)
                     req = json.loads(body)
-                    if isinstance(req, list):
-                        resp = [outer.impl.handle(r) for r in req]
-                    else:
-                        resp = outer.impl.handle(req)
+                    # strike attribution: this client's IP is the source
+                    # the txpool files invalid-signature strikes against
+                    from .jsonrpc import client_source
+
+                    with client_source(f"rpc:{self.client_address[0]}"):
+                        if isinstance(req, list):
+                            resp = [outer.impl.handle(r) for r in req]
+                        else:
+                            resp = outer.impl.handle(req)
                     data = json.dumps(resp).encode()
                     self.send_response(200)
                 except Exception as e:

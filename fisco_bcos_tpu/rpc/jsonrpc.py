@@ -8,7 +8,9 @@ hashes/bytes with 0x prefixes).
 
 from __future__ import annotations
 
+import contextvars
 import time
+from contextlib import contextmanager
 from typing import Any
 
 from ..node.node import Node
@@ -26,6 +28,26 @@ _log = get_logger("rpc")
 # JSON-RPC methods that open a lifecycle trace root; read polling stays
 # span-free so it cannot evict block-lifecycle spans from the bounded ring
 TRACED_RPC_METHODS = frozenset({"sendTransaction"})
+
+# Which client is submitting, for the txpool's strike accounting
+# (txpool/quota.py). The transports bind their peer address around handle()
+# so one client spamming invalid signatures demotes ITSELF, not the shared
+# default "local" — a shared strike source would let three garbage txs from
+# anyone block every client's submissions (renewable RPC-wide DoS).
+CLIENT_SOURCE: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rpc_client_source", default="local"
+)
+
+
+@contextmanager
+def client_source(source: str):
+    """Bind the submitting client's identity (e.g. ``rpc:<ip>``) for the
+    duration of a request dispatch."""
+    token = CLIENT_SOURCE.set(source)
+    try:
+        yield
+    finally:
+        CLIENT_SOURCE.reset(token)
 
 
 class JsonRpcError(Exception):
@@ -183,7 +205,7 @@ class JsonRpcImpl:
 
     def send_transaction(self, group: str, node_name: str, data: str, require_proof: bool = False) -> dict:
         tx = Transaction.decode(from_hex(data))
-        result = self.node.txpool.submit(tx)
+        result = self.node.txpool.submit(tx, source=CLIENT_SOURCE.get())
         if result.status != ErrorCode.SUCCESS:
             raise JsonRpcError(int(result.status), result.status.name)
         # gossip promptly so peers can verify proposals carrying this tx

@@ -331,7 +331,12 @@ class WsService:
                 )
             return
         if self.impl is not None:
-            session.send_json(self.impl.handle(req))
+            from .jsonrpc import client_source
+
+            # strike attribution: the ws peer's IP is the source the
+            # txpool files invalid-signature strikes against
+            with client_source(f"rpc:{session.addr[0]}"):
+                session.send_json(self.impl.handle(req))
         else:
             session.send_json(
                 {"jsonrpc": "2.0", "id": rid,

@@ -1,0 +1,203 @@
+"""Scenario core: the event model, the composition engine, the registry.
+
+A *workload primitive* (:mod:`.workloads`) is a generator function that
+yields :class:`SubmitTxs` events from a :class:`WorkloadContext` and a
+``random.Random``. A :class:`Scenario` names a set of primitives over a
+set of chain groups, optionally composed with a fault-plan spec
+(:mod:`fisco_bcos_tpu.resilience.faults` grammar, seeded from the scenario
+seed) and a suggested per-group admission quota; :meth:`Scenario.events`
+interleaves the primitives' streams with a seeded round-robin picker so
+the merged sequence — not just each stream — is deterministic.
+
+Derived RNGs use plain integer arithmetic (``seed * K + index``), never
+``hash()`` (string hashing is salted per process and would break the
+bit-determinism the acceptance criteria pin).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Iterator
+
+from ..crypto.suite import CryptoSuite, KeyPair, ecdsa_suite
+from ..protocol.transaction import Transaction, TransactionFactory
+
+# one arbitrary odd multiplier keeps per-stream RNGs independent of the
+# master interleaver without builtin hash()
+_SEED_STRIDE = 1_000_003
+
+
+@dataclass
+class SubmitTxs:
+    """One admission batch: which group's pool, claimed by which source
+    (strike accounting), on which device-plane lane."""
+
+    group: str
+    txs: list[Transaction]
+    source: str = "local"
+    lane: str = "admission"
+
+    def encode(self) -> bytes:
+        """Canonical bytes for determinism digests: the signed data + the
+        signature — everything the chain can observe. (``import_time`` is
+        node-local arrival metadata, zeroed by the context anyway.)"""
+        head = f"{self.group}|{self.source}|{self.lane}|".encode()
+        return head + b"".join(t.encode_data() + t.signature for t in self.txs)
+
+
+class WorkloadContext:
+    """Everything a primitive needs to mint transactions deterministically:
+    the crypto suite, an ABI codec, deterministic keypairs by secret, and
+    the chain/group identifiers the validator will check."""
+
+    def __init__(
+        self,
+        suite: CryptoSuite | None = None,
+        chain_id: str = "chain0",
+        block_limit: int = 500,
+    ):
+        from ..codec.abi import ABICodec
+
+        self.suite = suite if suite is not None else ecdsa_suite()
+        self.codec = ABICodec(self.suite.hash)
+        self.factory = TransactionFactory(self.suite)
+        self.chain_id = chain_id
+        self.block_limit = block_limit
+        self._keys: dict[int, KeyPair] = {}
+
+    def keypair(self, secret: int) -> KeyPair:
+        kp = self._keys.get(secret)
+        if kp is None:
+            kp = self._keys[secret] = self.suite.signature_impl.generate_keypair(
+                secret=secret
+            )
+        return kp
+
+    def signed_tx(
+        self, secret: int, group: str, nonce: str, to: bytes, input: bytes
+    ) -> Transaction:
+        """RFC6979 signing — byte-identical for identical inputs. The
+        factory's wall-clock ``import_time`` is zeroed (it is node-local
+        arrival metadata, not part of the hash preimage) so even the full
+        wire encoding replays bit-identically."""
+        tx = self.factory.create_signed(
+            self.keypair(secret),
+            chain_id=self.chain_id,
+            group_id=group,
+            block_limit=self.block_limit,
+            nonce=nonce,
+            to=to,
+            input=input,
+        )
+        tx.import_time = 0
+        return tx
+
+    def garbage_sig_tx(
+        self, rng: random.Random, group: str, nonce: str, to: bytes, input: bytes
+    ) -> Transaction:
+        """A statically-admissible tx with a seeded-garbage signature of the
+        right length: it passes every cheap gate and fails only at the
+        device verify — the worst-case admission spam, because the node
+        pays crypto for it unless quotas/strikes shed the source first."""
+        tx = self.factory.create(
+            chain_id=self.chain_id,
+            group_id=group,
+            block_limit=self.block_limit,
+            nonce=nonce,
+            to=to,
+            input=input,
+        )
+        tx.signature = bytes(
+            rng.getrandbits(8) for _ in range(self.suite.signature_impl.sig_len)
+        )
+        tx.import_time = 0
+        return tx
+
+
+# a primitive: (ctx, rng) -> iterator of SubmitTxs
+Workload = Callable[[WorkloadContext, random.Random], Iterator[SubmitTxs]]
+
+
+@dataclass
+class Scenario:
+    """A named, composable traffic shape.
+
+    ``build(ctx, scale)`` returns the list of workload generators (already
+    bound to per-stream RNG seeds is the caller's job — see
+    :meth:`events`); ``fault_spec`` is a :func:`FaultPlan.from_spec`
+    grammar string whose seed is overridden by the scenario seed, so fault
+    firing replays with the traffic; ``quota_rate`` is the per-group
+    admission rate (txs/s) the runner configures when the scenario is
+    about isolation (0 = leave quotas alone).
+    """
+
+    name: str
+    description: str
+    groups: tuple[str, ...]
+    build: Callable[[WorkloadContext, random.Random, float], list[Iterator[SubmitTxs]]]
+    fault_spec: str | None = None
+    quota_rate: float = 0.0
+    # groups whose traffic is hostile (artifact labeling + victim math)
+    abusive_groups: tuple[str, ...] = field(default=())
+
+    def events(self, seed: int, scale: float = 1.0) -> Iterator[SubmitTxs]:
+        """The deterministic merged event stream. ``scale`` multiplies
+        workload sizes (primitives read it, the interleave is unaffected
+        beyond stream lengths)."""
+        ctx = WorkloadContext()
+        master = random.Random(seed)
+        streams = self.build(ctx, random.Random(seed * _SEED_STRIDE + 1), scale)
+        live = list(streams)
+        while live:
+            idx = master.randrange(len(live))
+            try:
+                yield next(live[idx])
+            except StopIteration:
+                live.pop(idx)
+
+    def fault_plan(self, seed: int):
+        """The composed fault plan (None when the scenario runs clean)."""
+        if not self.fault_spec:
+            return None
+        from ..resilience.faults import FaultPlan
+
+        plan = FaultPlan.from_spec(self.fault_spec)
+        plan.seed = seed
+        plan._rng = random.Random(seed)
+        return plan
+
+    def digest(self, seed: int, scale: float = 1.0) -> str:
+        """sha256 over the canonical encoding of every event — the
+        bit-determinism witness (same seed ⇒ same digest, across runs and
+        processes)."""
+        h = hashlib.sha256()
+        for ev in self.events(seed, scale):
+            h.update(ev.encode())
+        return h.hexdigest()
+
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def register(s: Scenario) -> Scenario:
+    SCENARIOS[s.name] = s
+    return s
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        known = ", ".join(sorted(SCENARIOS))
+        raise KeyError(f"unknown scenario {name!r} (known: {known})") from None
+
+
+def list_scenarios() -> list[tuple[str, str]]:
+    return [(s.name, s.description) for _n, s in sorted(SCENARIOS.items())]
+
+
+# the canned compositions register on import (workloads imports base, so
+# the registration lives there to avoid a cycle)
+from . import workloads as _workloads  # noqa: E402,F401  (registration side effect)

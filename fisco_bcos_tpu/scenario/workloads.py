@@ -1,0 +1,302 @@
+"""Workload primitives + the canned scenario catalog.
+
+Each primitive is a generator of :class:`~.base.SubmitTxs` batches, pure
+in ``(ctx, rng, ...)`` — all randomness comes from the passed
+``random.Random``, all signing is RFC6979-deterministic, so the emitted
+transaction bytes replay exactly for a given seed (the scenario lab's
+core contract).
+
+The catalog at the bottom names the compositions the issue calls for:
+invalid-signature storms, mempool churn with duplicate/replacement spam,
+hot-contract contention floods (the DMC/key-lock worst case), cross-group
+traffic, sync storms from lagging peers, and the two-tenant ``isolation``
+scenario the acceptance bench runs (abuser + victim on one node).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator
+
+from ..executor.precompiled import DAG_TRANSFER_ADDRESS
+from .base import Scenario, SubmitTxs, WorkloadContext, register
+
+# distinct deterministic key spaces per role so primitives never collide
+_SECRET_FLOOD = 0x51E9A
+_SECRET_HOT = 0x68C7
+_SECRET_CHURN = 0xC4A12
+_SECRET_SYNC = 0x57AC
+
+
+def _add_call(ctx: WorkloadContext, user: str, amount: int = 100) -> bytes:
+    return ctx.codec.encode_call("userAdd(string,uint256)", user, amount)
+
+
+def _transfer_call(ctx: WorkloadContext, a: str, b: str, amount: int) -> bytes:
+    return ctx.codec.encode_call("userTransfer(string,string,uint256)", a, b, amount)
+
+
+def valid_flood(
+    ctx: WorkloadContext,
+    rng: random.Random,
+    group: str,
+    n: int,
+    batch: int = 64,
+    tag: str = "flood",
+    source: str = "local",
+    lane: str = "admission",
+) -> Iterator[SubmitTxs]:
+    """The standard well-behaved load: unique userAdd parallel-transfer txs
+    (what every bench round ran until now — here it is the *victim* traffic
+    the adversarial primitives run against)."""
+    txs = []
+    for i in range(n):
+        txs.append(
+            ctx.signed_tx(
+                _SECRET_FLOOD,
+                group,
+                nonce=f"{tag}-{group}-{i}",
+                to=DAG_TRANSFER_ADDRESS,
+                input=_add_call(ctx, f"u-{tag}-{group}-{i}", 1 + rng.randrange(100)),
+            )
+        )
+        if len(txs) >= batch:
+            yield SubmitTxs(group, txs, source=source, lane=lane)
+            txs = []
+    if txs:
+        yield SubmitTxs(group, txs, source=source, lane=lane)
+
+
+def invalid_sig_storm(
+    ctx: WorkloadContext,
+    rng: random.Random,
+    group: str,
+    n: int,
+    batch: int = 64,
+    tag: str = "storm",
+    source: str = "spammer",
+) -> Iterator[SubmitTxs]:
+    """Statically-admissible txs with seeded-garbage signatures: the
+    worst-case admission spam (every tx reaches the device verify unless
+    quotas/strike demotion shed the source first)."""
+    txs = []
+    for i in range(n):
+        txs.append(
+            ctx.garbage_sig_tx(
+                rng,
+                group,
+                nonce=f"{tag}-bad-{group}-{i}",
+                to=DAG_TRANSFER_ADDRESS,
+                input=_add_call(ctx, f"x-{tag}-{i}"),
+            )
+        )
+        if len(txs) >= batch:
+            yield SubmitTxs(group, txs, source=source)
+            txs = []
+    if txs:
+        yield SubmitTxs(group, txs, source=source)
+
+
+def mempool_churn(
+    ctx: WorkloadContext,
+    rng: random.Random,
+    group: str,
+    n: int,
+    batch: int = 32,
+    tag: str = "churn",
+    source: str = "churner",
+) -> Iterator[SubmitTxs]:
+    """Duplicate/replacement spam: every unique tx is re-submitted
+    ``1..3`` extra times (exact duplicates → ``ALREADY_IN_TX_POOL``) and
+    interleaved with *nonce-replacement* attempts — a different payload
+    under an already-pooled nonce, which the pool must also refuse (the
+    reference's nonce checkers; accepting it would let spam evict paid
+    traffic). The pool's dup gates absorb all of it without device work."""
+    txs: list = []
+    for i in range(n):
+        nonce = f"{tag}-{group}-{i}"
+        tx = ctx.signed_tx(
+            _SECRET_CHURN, group, nonce=nonce,
+            to=DAG_TRANSFER_ADDRESS, input=_add_call(ctx, f"c-{tag}-{i}"),
+        )
+        txs.append(tx)
+        for _dup in range(1 + rng.randrange(3)):
+            txs.append(tx)  # exact duplicate object: same bytes on the wire
+        # replacement spam: same nonce, different input
+        txs.append(
+            ctx.signed_tx(
+                _SECRET_CHURN, group, nonce=nonce,
+                to=DAG_TRANSFER_ADDRESS,
+                input=_add_call(ctx, f"c-{tag}-{i}-replaced", 7),
+            )
+        )
+        if len(txs) >= batch:
+            yield SubmitTxs(group, txs, source=source)
+            txs = []
+    if txs:
+        yield SubmitTxs(group, txs, source=source)
+
+
+def hot_contract_flood(
+    ctx: WorkloadContext,
+    rng: random.Random,
+    group: str,
+    n: int,
+    batch: int = 64,
+    hot_users: int = 4,
+    tag: str = "hot",
+    source: str = "local",
+) -> Iterator[SubmitTxs]:
+    """The DMC/key-lock worst case: after seeding a tiny user set, every
+    transfer touches the same few storage keys, so parallel execution
+    degenerates to serialized key-lock rounds — the contention profile the
+    DAG/DMC executor has to survive, generated on demand."""
+    users = [f"hot-{tag}-{u}" for u in range(hot_users)]
+    setup = [
+        ctx.signed_tx(
+            _SECRET_HOT, group, nonce=f"{tag}-seed-{group}-{u}",
+            to=DAG_TRANSFER_ADDRESS, input=_add_call(ctx, users[u], 1_000_000),
+        )
+        for u in range(hot_users)
+    ]
+    yield SubmitTxs(group, setup, source=source)
+    txs = []
+    for i in range(n):
+        a = rng.randrange(hot_users)
+        b = (a + 1 + rng.randrange(hot_users - 1)) % hot_users if hot_users > 1 else a
+        txs.append(
+            ctx.signed_tx(
+                _SECRET_HOT, group, nonce=f"{tag}-{group}-{i}",
+                to=DAG_TRANSFER_ADDRESS,
+                input=_transfer_call(ctx, users[a], users[b], 1),
+            )
+        )
+        if len(txs) >= batch:
+            yield SubmitTxs(group, txs, source=source)
+            txs = []
+    if txs:
+        yield SubmitTxs(group, txs, source=source)
+
+
+def sync_storm(
+    ctx: WorkloadContext,
+    rng: random.Random,
+    group: str,
+    n: int,
+    batch: int = 48,
+    peers: int = 3,
+    tag: str = "sync",
+) -> Iterator[SubmitTxs]:
+    """Lagging peers flushing their backlogs at once: valid txs arriving on
+    the plane's lowest-priority *sync* lane from several peer sources —
+    composed with a delay fault plan this reproduces the gossip burst that
+    follows a partition healing."""
+    txs = []
+    peer = 0
+    for i in range(n):
+        txs.append(
+            ctx.signed_tx(
+                _SECRET_SYNC, group, nonce=f"{tag}-{group}-{i}",
+                to=DAG_TRANSFER_ADDRESS, input=_add_call(ctx, f"s-{tag}-{i}"),
+            )
+        )
+        if len(txs) >= batch:
+            yield SubmitTxs(
+                group, txs, source=f"peer:{tag}-{peer}", lane="sync"
+            )
+            peer = (peer + 1) % peers
+            txs = []
+    if txs:
+        yield SubmitTxs(group, txs, source=f"peer:{tag}-{peer}", lane="sync")
+
+
+# ---------------------------------------------------------------------------
+# the catalog
+# ---------------------------------------------------------------------------
+
+# base sizes at scale=1.0 — tier-1 tests run scale<=0.2, the bench scales up
+_N = 192
+
+
+def _sub_rng(rng: random.Random, k: int) -> random.Random:
+    """Per-stream RNG forked arithmetically (never via hash())."""
+    return random.Random(rng.randrange(1 << 62) * 4 + k % 4)
+
+
+register(Scenario(
+    name="flood",
+    description="single-group well-behaved flood (the solo baseline)",
+    groups=("group0",),
+    build=lambda ctx, rng, s: [
+        valid_flood(ctx, _sub_rng(rng, 0), "group0", int(_N * s) or 1),
+    ],
+))
+
+register(Scenario(
+    name="invalid-sig-storm",
+    description="garbage-signature spam racing a small honest flood",
+    groups=("group0",),
+    build=lambda ctx, rng, s: [
+        invalid_sig_storm(ctx, _sub_rng(rng, 0), "group0", int(2 * _N * s) or 1),
+        valid_flood(ctx, _sub_rng(rng, 1), "group0", int(_N * s // 2) or 1),
+    ],
+))
+
+register(Scenario(
+    name="mempool-churn",
+    description="duplicate + nonce-replacement spam over an honest flood",
+    groups=("group0",),
+    build=lambda ctx, rng, s: [
+        mempool_churn(ctx, _sub_rng(rng, 0), "group0", int(_N * s) or 1),
+        valid_flood(ctx, _sub_rng(rng, 1), "group0", int(_N * s // 2) or 1),
+    ],
+))
+
+register(Scenario(
+    name="hot-contract",
+    description="key-lock contention flood (DMC worst case) on one contract",
+    groups=("group0",),
+    build=lambda ctx, rng, s: [
+        hot_contract_flood(ctx, _sub_rng(rng, 0), "group0", int(_N * s) or 1),
+    ],
+))
+
+register(Scenario(
+    name="cross-group",
+    description="independent valid floods on two groups of one host set",
+    groups=("group0", "group1"),
+    build=lambda ctx, rng, s: [
+        valid_flood(ctx, _sub_rng(rng, 0), "group0", int(_N * s) or 1),
+        valid_flood(ctx, _sub_rng(rng, 1), "group1", int(_N * s) or 1),
+    ],
+))
+
+register(Scenario(
+    name="sync-storm",
+    description="lagging peers flushing sync backlogs under delayed gossip",
+    groups=("group0",),
+    # every 3rd gateway send is delayed 5ms — the healing-partition shape;
+    # seed= is overridden by the scenario seed at plan build time
+    fault_spec="delay@send:gw,p=0.34,ms=5",
+    build=lambda ctx, rng, s: [
+        sync_storm(ctx, _sub_rng(rng, 0), "group0", int(2 * _N * s) or 1),
+        valid_flood(ctx, _sub_rng(rng, 1), "group0", int(_N * s // 2) or 1),
+    ],
+))
+
+register(Scenario(
+    name="isolation",
+    description="group A floods invalid-signature spam while group B runs "
+    "the standard flood on the same node — the multi-tenant acceptance case",
+    groups=("groupA", "groupB"),
+    abusive_groups=("groupA",),
+    # the quota is what sheds A's spam at the door; B stays un-throttled
+    # because its honest rate sits far below the same per-group budget
+    quota_rate=4000.0,
+    build=lambda ctx, rng, s: [
+        invalid_sig_storm(
+            ctx, _sub_rng(rng, 0), "groupA", int(4 * _N * s) or 1, batch=96,
+        ),
+        valid_flood(ctx, _sub_rng(rng, 1), "groupB", int(_N * s) or 1),
+    ],
+))

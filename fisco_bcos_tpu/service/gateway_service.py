@@ -199,7 +199,10 @@ class RemoteGateway(GatewayInterface):
     # frames — which PBFT re-delivery tolerates — not dead node threads.
     # The self-healing ServiceClient redials on the next call.
 
-    def send(self, module_id: int, src: bytes, dst: bytes, payload: bytes) -> None:
+    def send(
+        self, module_id: int, src: bytes, dst: bytes, payload: bytes,
+        group: str = "",
+    ) -> None:
         w = FlatWriter()
         w.u32(module_id)
         w.bytes_(src)
@@ -210,7 +213,9 @@ class RemoteGateway(GatewayInterface):
         except Exception as e:
             _log.warning("gateway send dropped (%s)", e)
 
-    def broadcast(self, module_id: int, src: bytes, payload: bytes) -> None:
+    def broadcast(
+        self, module_id: int, src: bytes, payload: bytes, group: str = ""
+    ) -> None:
         w = FlatWriter()
         w.u32(module_id)
         w.bytes_(src)

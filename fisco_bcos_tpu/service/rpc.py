@@ -41,7 +41,7 @@ from ..codec.flat import FlatReader, FlatWriter
 from ..observability.tracer import TRACER, TraceContext
 from ..resilience import faults
 from ..resilience.retry import Deadline, RetryPolicy, is_idempotent
-from ..utils.log import get_logger
+from ..utils.log import get_logger, note_swallowed
 
 _log = get_logger("service-rpc")
 
@@ -199,7 +199,9 @@ class ServiceServer:
             try:
                 body = _recv_frame(sock, self._scope)
             except BadFrame as e:
-                # poisoned stream: drop the connection, the client redials
+                # poisoned stream: drop the connection, the client redials;
+                # counted so a corrupt-frame storm is visible at /metrics
+                note_swallowed("service.rpc.bad_frame", e)
                 _log.warning("service %s: %s — dropping connection", self.name, e)
                 break
             except OSError:
@@ -215,7 +217,10 @@ class ServiceServer:
                 r.done()
             except Exception as e:
                 # an undecodable REQUEST frame desyncs the pipeline: typed
-                # log + connection drop (was: thread death with no trace)
+                # log + connection drop (was: thread death with no trace);
+                # counted — injected `corrupt` faults land here and the
+                # scenario lab asserts the rejects are observable
+                note_swallowed("service.rpc.bad_request", e)
                 _log.warning(
                     "service %s: bad request frame (%s) — dropping connection",
                     self.name, e,

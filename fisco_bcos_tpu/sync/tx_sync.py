@@ -108,7 +108,7 @@ class TransactionSync:
             if pkt == TxsPacket.PUSH:
                 raw = r.seq(lambda r2: r2.bytes_())
                 r.done()
-                self._on_push(raw)
+                self._on_push(raw, src)
             elif pkt == TxsPacket.REQUEST:
                 hashes = r.seq(lambda r2: r2.fixed(32))
                 r.done()
@@ -120,7 +120,7 @@ class TransactionSync:
         except Exception as e:
             _log.warning("bad tx-sync message from %s: %s", src.hex()[:8], e)
 
-    def _on_push(self, raw: list[bytes]) -> None:
+    def _on_push(self, raw: list[bytes], src: bytes = b"") -> None:
         txs = []
         for b in raw:
             try:
@@ -131,8 +131,12 @@ class TransactionSync:
                 continue
         if txs:
             # device batch verify + admission (importDownloadedTxs:521);
-            # gossip rides the plane's lowest-priority lane
-            self.txpool.submit_batch(txs, lane="sync")
+            # gossip rides the plane's lowest-priority lane, and the peer id
+            # is the strike source — a peer spamming invalid signatures gets
+            # demoted at this pool's door
+            self.txpool.submit_batch(
+                txs, lane="sync", source=f"peer:{src.hex()[:16]}"
+            )
 
     def _on_request(self, src: bytes, hashes: list[bytes]) -> None:
         found = [t.encode() for t in self.txpool.fetch_txs(hashes) if t is not None]

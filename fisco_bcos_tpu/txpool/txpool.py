@@ -39,6 +39,8 @@ _REJECT_REASON = {
     ErrorCode.TX_POOL_FULL: "full",
     ErrorCode.INVALID_SIGNATURE: "sig",
     ErrorCode.BLOCK_LIMIT_CHECK_FAIL: "expired",
+    ErrorCode.OVER_GROUP_QUOTA: "quota",
+    ErrorCode.SOURCE_DEMOTED: "demoted",
 }
 
 
@@ -61,10 +63,18 @@ class TxPool:
         pool_limit: int = 15000 * 9,
         block_limit: int = 600,
         persistent_store=None,
+        quotas=None,
     ):
         self.suite = suite
         self.ledger = ledger
+        self.group_id = group_id
         self.pool_limit = pool_limit
+        # multi-tenant admission policer (per-group token bucket + strike
+        # demotion); default = the process-wide singleton so every group's
+        # pool shares ONE model of the node's capacity
+        from .quota import get_quotas
+
+        self.quotas = quotas if quotas is not None else get_quotas()
         # durable pool (reference: Initializer.cpp:188-195 re-imports pool
         # txs on boot); None -> memory-only pool
         self.pstore = persistent_store
@@ -88,13 +98,18 @@ class TxPool:
 
     # -- admission -----------------------------------------------------------
 
-    def submit(self, tx: Transaction) -> TxSubmitResult:
+    def submit(self, tx: Transaction, source: str = "local") -> TxSubmitResult:
         """Single-tx admission (RPC path; TxPool.cpp:68 submitTransaction).
 
         The admission span is the transaction's lifecycle anchor: its trace
         context is registered with the critical-path index so the sealer
-        can close the pool-wait gap and ``/trace/tx/<hash>`` can stitch."""
+        can close the pool-wait gap and ``/trace/tx/<hash>`` can stitch.
+        ``source`` names the submitter for strike accounting (RPC session /
+        gossip peer)."""
         with TRACER.span("txpool.submit") as sp:
+            if self.quotas.demoted(self.group_id, source):
+                self.quotas.count_demoted_drop(self.group_id, 1)
+                return TxSubmitResult(b"", ErrorCode.SOURCE_DEMOTED)
             with self._lock:
                 if len(self._txs) >= self.pool_limit:
                     return TxSubmitResult(b"", ErrorCode.TX_POOL_FULL)
@@ -102,8 +117,14 @@ class TxPool:
             with self._lock:
                 if h in self._txs:
                     return TxSubmitResult(h, ErrorCode.ALREADY_IN_TX_POOL)
+            # the quota gate sits BEFORE the signature verify: shed traffic
+            # must cost no crypto
+            if self.quotas.try_admit(self.group_id, 1) < 1:
+                return TxSubmitResult(h, ErrorCode.OVER_GROUP_QUOTA)
             code = self.validator.verify(tx)
             if code != ErrorCode.SUCCESS:
+                if code == ErrorCode.INVALID_SIGNATURE:
+                    self.quotas.note_invalid(self.group_id, source, 1)
                 sp.set(status=code.name)
                 return TxSubmitResult(h, code)
             self._insert(tx, h)
@@ -111,7 +132,11 @@ class TxPool:
             return TxSubmitResult(h, ErrorCode.SUCCESS, tx.sender)
 
     def submit_batch(
-        self, txs: list[Transaction], lane: str = "admission"
+        self,
+        txs: list[Transaction],
+        lane: str = "admission",
+        source: str = "local",
+        policed: bool = True,
     ) -> list[TxSubmitResult]:
         """Batch admission: ONE fused device program (keccak → recover →
         address) for the whole batch — the TPU replacement for the
@@ -119,10 +144,11 @@ class TxPool:
         priority of the signature batch (tx-sync imports pass "sync" so
         gossip floods queue behind consensus/RPC verification).
 
-        Gate order matches the reference (dup/static → pool-full → sig):
-        only the statically-admissible, within-room subset reaches the
-        device, so a full pool or an all-replay batch costs no device
-        program at all. A pooled duplicate is caught by its nonce
+        Gate order matches the reference (dup/static → pool-full → sig),
+        with the multi-tenant gates around it: a demoted ``source`` is
+        refused before any work, and the group's admission quota funds only
+        part of an over-rate batch — so a full pool, an all-replay batch,
+        or a quota-shed flood costs no device program at all. A pooled duplicate is caught by its nonce
         (``_insert`` registers every pooled nonce, and equal hash implies
         equal nonce), so no pre-verification hash pass is needed — the
         fused program's digests fill the hash caches of verified lanes,
@@ -130,12 +156,26 @@ class TxPool:
         with TRACER.span(
             "txpool.submit_batch", batch=len(txs), lane=lane
         ) as sp:
-            return self._submit_batch_spanned(txs, lane, sp)
+            return self._submit_batch_spanned(txs, lane, source, policed, sp)
 
     def _submit_batch_spanned(
-        self, txs: list[Transaction], lane: str, sp
+        self,
+        txs: list[Transaction],
+        lane: str,
+        source: str,
+        policed: bool,
+        sp,
     ) -> list[TxSubmitResult]:
         t0 = time.perf_counter()
+        if policed and txs and self.quotas.demoted(self.group_id, source):
+            # a demoted spammer's whole batch is refused before static
+            # checks, hashing, or any device work — maximum shed, zero cost
+            self.quotas.count_demoted_drop(self.group_id, len(txs))
+            results = [
+                TxSubmitResult(b"", ErrorCode.SOURCE_DEMOTED) for _ in txs
+            ]
+            self._record_admission(txs, results, t0, sp)
+            return results
         results: list[TxSubmitResult | None] = [None] * len(txs)
         to_verify: list[int] = []
         with self._lock:
@@ -155,13 +195,36 @@ class TxPool:
                 continue
             batch_nonces.add(tx.nonce)
             to_verify.append(i)
+        # group quota: the bucket funds a PREFIX of the admissible subset
+        # (partial grant); the overflow is shed before the device verify so
+        # an over-rate group costs no device program for the shed part.
+        # `policed=False` bypasses tenant policing for node-internal
+        # re-admission (boot reload of the persisted pool). The sync lane
+        # is bucket-exempt: gossip imports were already rate-policed at the
+        # RPC edge that admitted them, and re-charging every replica's
+        # bucket would multiply one tx's cost by the replication factor —
+        # strike demotion (above) still covers spamming peers.
+        granted = (
+            self.quotas.try_admit(self.group_id, len(to_verify))
+            if policed and lane != "sync"
+            else len(to_verify)
+        )
+        if granted < len(to_verify):
+            for i in to_verify[granted:]:
+                results[i] = TxSubmitResult(
+                    txs[i].hash(self.suite), ErrorCode.OVER_GROUP_QUOTA
+                )
+            to_verify = to_verify[:granted]
         if to_verify:
-            from ..device.plane import device_lane
+            from ..device.plane import device_group, device_lane
 
             # ONE fused device program (keccak → recover → address); fills
-            # hash + sender caches for every verified lane
-            with device_lane(lane):
+            # hash + sender caches for every verified lane. The group tag
+            # makes the plane's deficit-round-robin see this batch as this
+            # tenant's traffic.
+            with device_group(self.group_id), device_lane(lane):
                 ok = batch_admit([txs[i] for i in to_verify], self.suite)
+            invalid = 0
             persisted: list[tuple[bytes, "Entry"]] = []
             for j, i in enumerate(to_verify):
                 h = txs[i].hash(self.suite)  # cached by the fused pass
@@ -170,7 +233,13 @@ class TxPool:
                     persisted.append((h, txs[i]))
                     results[i] = TxSubmitResult(h, ErrorCode.SUCCESS, txs[i].sender)
                 else:
+                    invalid += 1
                     results[i] = TxSubmitResult(h, ErrorCode.INVALID_SIGNATURE)
+            if invalid:
+                # strike the source: repeated invalid-signature batches get
+                # the submitter demoted (spam or a broken client — either
+                # way the node stops paying to verify it)
+                self.quotas.note_invalid(self.group_id, source, invalid)
             # batch-admitted txs share the batch span as their lifecycle
             # anchor: ONE index registration for the whole batch (single
             # lock pass) — the hot loop stays batch-level
@@ -221,10 +290,14 @@ class TxPool:
             help="transactions admitted to the pool",
         )
         for reason, n in rejects.items():
+            # group-labeled so a multi-tenant node can attribute shed load:
+            # "we are dropping group-X spam" is a different story from
+            # "we are dropping everyone's txs"
             REGISTRY.counter_add(
-                f'fisco_txpool_rejected_total{{reason="{reason}"}}',
+                f'fisco_txpool_rejected_total{{group="{self.group_id}"'
+                f',reason="{reason}"}}',
                 float(n),
-                help="transactions rejected at admission by reason",
+                help="transactions rejected at admission by group and reason",
             )
         sp.set(admitted=admitted)
 
@@ -256,7 +329,9 @@ class TxPool:
                 continue
         if not txs:
             return 0
-        results = self.submit_batch(txs)
+        # node-internal re-admission: tenant quotas must not shed a pool
+        # the node itself persisted (signatures still re-verify on device)
+        results = self.submit_batch(txs, policed=False)
         ok = sum(1 for r in results if r.status == ErrorCode.SUCCESS)
         _log.info("re-imported %d/%d persisted pool txs", ok, len(txs))
         return ok
@@ -370,11 +445,11 @@ class TxPool:
         got = [t for t in fetched if t is not None]
         if len(got) != len(missing):
             return False, missing
-        from ..device.plane import device_lane
+        from ..device.plane import device_group, device_lane
 
         # proposal-straggler verification sits on the consensus critical
         # path — it must preempt admission/sync batches in the plane queue
-        with device_lane("consensus"):
+        with device_group(self.group_id), device_lane("consensus"):
             ok = batch_admit(got, self.suite)
         if not ok.all():
             return False, missing

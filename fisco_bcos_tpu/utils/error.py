@@ -26,6 +26,11 @@ class ErrorCode(IntEnum):
     INVALID_GROUP_ID = 10007
     INVALID_SIGNATURE = 10008
     REQUEST_NOT_BELONG_TO_THE_GROUP = 10009
+    # multi-tenant isolation (this framework's extension of the admission
+    # family): per-group token-bucket quota exceeded / submitting source
+    # demoted after repeated invalid-signature strikes
+    OVER_GROUP_QUOTA = 10010
+    SOURCE_DEMOTED = 10011
     # Scheduler / executor
     SCHEDULER_INVALID_BLOCK = 21000
     SCHEDULER_BLOCK_IN_QUEUE = 21001

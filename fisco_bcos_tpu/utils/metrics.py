@@ -94,6 +94,17 @@ class MetricsRegistry:
             value, labels or None, exemplar=exemplar
         )
 
+    def counters_matching(self, base: str) -> dict[str, float]:
+        """Snapshot of every counter series whose name starts with ``base``
+        (full labeled name -> value) — programmatic artifact access (the
+        scenario runner embeds isolation counters in its JSON)."""
+        with self._lock:
+            return {
+                name: v
+                for name, v in self._counters.items()
+                if name.startswith(base)
+            }
+
     # -- exposition ----------------------------------------------------------
 
     def render(self, openmetrics: bool = False) -> str:

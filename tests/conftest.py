@@ -79,6 +79,17 @@ jax.config.update(
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+@pytest.fixture(autouse=True)
+def _reset_admission_quotas():
+    """The per-group admission policer is a process singleton (txpool/
+    quota.py); strike/demotion state must not leak across tests."""
+    yield
+    from fisco_bcos_tpu.txpool import quota
+
+    if quota._QUOTAS is not None:
+        quota._QUOTAS.reset()
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _lockorder_enforcement():
     """Fail the session if the suite's REAL lock traffic produced an

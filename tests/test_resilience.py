@@ -473,3 +473,103 @@ def test_split_mode_health_survives_dead_facade():
         assert body["components"]["node-core"]["status"] == "degraded"
     finally:
         svc.stop()
+
+
+# -- corrupt action (ISSUE 6 satellite) ---------------------------------------
+
+
+def test_corrupt_spec_parsing_and_builder():
+    plan = FaultPlan.from_spec("seed=9;corrupt@recv:42001,bits=5,count=2")
+    (r,) = plan._rules
+    assert r.action == "corrupt" and r.bits == 5 and r.count == 2
+    plan2 = FaultPlan(seed=9).corrupt("send", "x", bits=5, count=2)
+    (r2,) = plan2._rules
+    assert r2.action == "corrupt" and r2.bits == 5
+
+
+def test_corrupt_bitflips_are_seeded_and_spare_the_header():
+    wire = bytes(range(4, 104))  # 4-byte "header" + 96-byte body
+
+    def flipped(seed):
+        plan = FaultPlan(seed=seed).corrupt("send", "*", bits=6)
+        chunks, kill = plan.on_send("anywhere", wire)
+        assert not kill and len(chunks) == 1
+        return chunks[0]
+
+    a, b, c = flipped(3), flipped(3), flipped(4)
+    assert a == b != c  # deterministic per seed
+    assert a != wire  # something actually flipped
+    assert a[:4] == wire[:4]  # length header intact: frame still parses
+    # exactly <=6 bits differ (xor popcount)
+    diff = sum(bin(x ^ y).count("1") for x, y in zip(a, wire))
+    assert 0 < diff <= 6
+
+
+def test_corrupt_reply_rejected_typed_never_crashes():
+    from fisco_bcos_tpu.service.rpc import ServiceRemoteError
+
+    s = _echo_server()
+    try:
+        c = ServiceClient(s.host, s.port, timeout=5)
+        assert c.call("echo", b"warm") == b"warm"
+        # many trials: wherever the flips land (id, ok flag, length words,
+        # payload) the outcome must be a typed error or a decoded reply —
+        # anything else (struct.error, MemoryError, hang) is the bug class
+        # the corrupt action exists to catch
+        for i in range(12):
+            install_fault_plan(
+                FaultPlan(seed=100 + i).corrupt(
+                    "recv", f"{s.port}/echo", count=1, bits=8
+                )
+            )
+            payload = bytes((i + j) & 0xFF for j in range(48))
+            try:
+                out = c.call("echo", payload)
+                assert isinstance(out, bytes)
+            except ServiceRemoteError:
+                pass  # BadFrame / FrameTooLarge / connection loss: all typed
+            clear_fault_plan()
+            assert c.call("echo", b"again") == b"again"  # always self-heals
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_corrupt_request_counted_at_server():
+    from fisco_bcos_tpu.service.rpc import ServiceRemoteError
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    s = _echo_server()
+    try:
+        before = sum(
+            REGISTRY.counters_matching("fisco_swallowed_errors_total").values()
+        )
+        c = ServiceClient(s.host, s.port, timeout=5)
+        assert c.call("echo", b"warm") == b"warm"
+        # corrupt OUTBOUND requests until the server visibly drops one as
+        # undecodable (some flips land in the payload and decode fine)
+        hit = False
+        for i in range(10):
+            install_fault_plan(
+                FaultPlan(seed=200 + i).corrupt(
+                    "send", f"{s.port}/echo", count=1, bits=10
+                )
+            )
+            try:
+                c.call("echo", bytes(range(64)))
+            except ServiceRemoteError:
+                pass
+            clear_fault_plan()
+            after = sum(
+                REGISTRY.counters_matching(
+                    "fisco_swallowed_errors_total"
+                ).values()
+            )
+            if after > before:
+                hit = True
+                break
+            assert c.call("echo", b"sane") == b"sane"
+        assert hit, "no corrupt request was ever counted as rejected"
+        c.close()
+    finally:
+        s.stop()
