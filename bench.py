@@ -530,13 +530,47 @@ def bench_scenario(name: str) -> None:
     next to the bench output as ``bench_scenario.<name>.json`` — the
     per-group breakdown, quota/demotion snapshot, health registry, fault
     counts and the determinism digest for the seed."""
-    from fisco_bcos_tpu.scenario import ScenarioRunner, run_isolation_bench
+    from fisco_bcos_tpu.scenario import (
+        ScenarioRunner,
+        run_isolation_bench,
+        run_proof_storm_bench,
+    )
 
     seed = int(os.environ.get("FISCO_SCENARIO_SEED", "0") or 0)
     scale = float(os.environ.get("FISCO_SCENARIO_SCALE", "1") or 1)
     budget = _child_budget_s()
     deadline = max(budget - 20, 30) if budget is not None else None
-    if name == "isolation":
+    if name == "proof-storm":
+        doc = run_proof_storm_bench(seed=seed, scale=scale, deadline_s=deadline)
+        err = doc.get("error")
+        speedup = doc["speedup_vs_direct"]
+        # acceptance: >= 50x proofs/sec over the direct per-request
+        # Ledger.tx_proof rebuild at 10^5 queued clients
+        _emit(
+            "scenario_proof_storm_proofs_per_s", doc["proofs_per_s"], "proof/s",
+            speedup / 50.0, error=err,
+        )
+        _emit(
+            "scenario_proof_storm_cache_hit_ratio", doc["cache_hit_ratio"],
+            "ratio", doc["cache_hit_ratio"] / 0.9, error=err,
+        )
+        # the write path must keep >= 0.7x its solo TPS under the storm
+        ratio = doc["flood"]["ratio"]
+        _emit(
+            "scenario_proof_storm_flood_tps_ratio", ratio, "x-solo",
+            ratio / 0.7, error=err,
+        )
+        print(
+            f"# proof-storm: {doc['proofs_served']} proofs to "
+            f"{doc['queued_clients']} queued clients, "
+            f"p95={doc['proof_batch_latency_ms_p95']}ms/batch, "
+            f"steady {doc['proofs_per_s_steady']}/s vs direct "
+            f"{doc['direct_baseline_proofs_per_s']}/s (speedup {speedup}x), "
+            f"verify_failures={doc['verify_failures']}",
+            flush=True,
+        )
+        group_docs = {}
+    elif name == "isolation":
         doc = run_isolation_bench(seed=seed, scale=scale, deadline_s=deadline)
         ratio = doc["victim_ratio"]
         err = doc.get("error") or doc["combined"].get("error")
@@ -720,7 +754,13 @@ def main() -> None:
     # cheap-compile-first: the deadline split hands each child
     # remaining/remaining_count, so early finishers donate surplus to the
     # expensive EC children and the flood
-    names = ("merkle", "admission", "sm2", "flood")
+    names = ["merkle", "admission", "sm2", "flood"]
+    # ROADMAP frontier wired into the round cadence: the isolation
+    # victim-ratio (>=0.7x acceptance) and the proof-storm read path are
+    # tracked per round alongside flood TPS. FISCO_BENCH_SCENARIOS=0 opts
+    # out; the children ride the same deadline split + kill machinery.
+    if os.environ.get("FISCO_BENCH_SCENARIOS", "1") != "0":
+        names += ["scenario:isolation", "scenario:proof-storm"]
     for i, name in enumerate(names):
         remaining = total_s - (time.monotonic() - t_start) - 10  # emit reserve
         if remaining < 20:
@@ -830,7 +870,7 @@ def _main_scenario(name: str) -> None:
 
     from fisco_bcos_tpu.scenario import SCENARIOS
 
-    if name not in SCENARIOS and name != "isolation":
+    if name not in SCENARIOS and name not in ("isolation", "proof-storm"):
         known = ", ".join(sorted(SCENARIOS))
         print(f"# unknown scenario '{name}' (known: {known})", flush=True)
         raise SystemExit(2)

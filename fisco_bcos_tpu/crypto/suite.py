@@ -901,6 +901,59 @@ class CryptoSuite:
         """
         return merkle_ops.merkle_root_async(leaves, hasher=self.hash_impl.name)
 
+    def merkle_tree(self, leaves: np.ndarray) -> "merkle_ops.MerkleTree":
+        """Build a full proof-capable tree (every level retained) over
+        ``[N, 32]`` uint8 leaves — the ProofPlane's frozen-tree builder.
+
+        Routed through the shared DevicePlane as the ``merkle_tree`` op on
+        the caller's lane (the ProofPlane submits under
+        ``device_lane("proof")``, the lane below ``sync``), so cache-miss
+        tree builds from a proof storm queue BEHIND consensus, admission
+        and gossip batches instead of competing with them. Leaf counts are
+        bucket-padded inside :class:`~fisco_bcos_tpu.ops.merkle.MerkleTree`
+        (``bucket_leaves``), so the compiled-program set stays within the
+        ladder. Bit-identical to a direct ``MerkleTree(...)`` build by
+        construction — both paths run the same constructor.
+        """
+        from ..device.plane import get_plane, plane_route
+
+        leaves = np.asarray(leaves, dtype=np.uint8)
+        if plane_route() and len(leaves) > 1:
+            # op name carries the hasher (like `hash.<name>` / `sm2_verify`):
+            # the plane binds ONE executor per op name process-wide, and a
+            # multi-suite host (keccak + SM groups) must not have the first
+            # suite's hasher capture every group's tree builds
+            return get_plane().submit(
+                f"merkle_tree.{self.hash_impl.name}",
+                leaves,
+                len(leaves),
+                _merkle_tree_plane_exec(self.hash_impl.name),
+            ).result()
+        return merkle_ops.MerkleTree(leaves, hasher=self.hash_impl.name)
+
+
+def _merkle_tree_plane_exec(hasher: str):
+    """Plane executor for proof-tree builds: each request is its own tree
+    (different heights — there is nothing sound to merge across roots), but
+    dispatching them through one plane slot serializes read-path hashing
+    behind the priority lanes and shares the dispatch accounting."""
+
+    def run(reqs):
+        from ..observability.device import device_span
+
+        out = []
+        for r in reqs:
+            leaves = r.payload
+            with device_span(
+                "merkle_tree",
+                len(leaves),
+                shape_key=(hasher, merkle_ops.bucket_leaves(max(len(leaves), 1))),
+            ):
+                out.append(merkle_ops.MerkleTree(leaves, hasher=hasher))
+        return out
+
+    return run
+
 
 def ecdsa_suite() -> CryptoSuite:
     """Keccak256 + secp256k1 (the reference's default, non-SM suite)."""

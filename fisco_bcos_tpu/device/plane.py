@@ -27,8 +27,9 @@ The plane is that engine's scheduler:
   cache converges to ladder-many compiled programs instead of one per batch
   size; ``fisco_device_compile_total`` stays ≤ the ladder size
   (tool/check_device_plane.py asserts it).
-- **Priority lanes.** consensus > admission > sync among dispatch-ready
-  op groups, with starvation-free draining: any group whose oldest request
+- **Priority lanes.** consensus > admission > sync > proof among
+  dispatch-ready op groups, with starvation-free draining: any group whose
+  oldest request
   has waited past ``FISCO_DEVICE_STARVATION_MS`` (default 50 ms) preempts
   lane order, oldest first — a gossip flood cannot park a QC check, and a
   stream of QC checks cannot park gossip forever.
@@ -70,8 +71,11 @@ from dataclasses import dataclass
 from typing import Callable
 
 # dispatch priority per lane, lower = sooner (consensus is on the critical
-# path of block time; admission feeds the next proposal; sync is gossip)
-LANES = {"consensus": 0, "admission": 1, "sync": 2}
+# path of block time; admission feeds the next proposal; sync is gossip;
+# proof is the read path — light-client proof storms must never starve the
+# write path, so their tree builds rank below everything, bounded only by
+# the starvation aging like every other lane)
+LANES = {"consensus": 0, "admission": 1, "sync": 2, "proof": 3}
 DEFAULT_LANE = "admission"
 
 _tls = threading.local()

@@ -34,6 +34,9 @@ class ModuleID(IntEnum):
     LIGHTNODE_GET_STATUS = 4003
     LIGHTNODE_SEND_TRANSACTION = 4004
     LIGHTNODE_CALL = 4005
+    # batched proof fetch (ISSUE 7 read path): one round trip carries N
+    # tx/receipt proofs, served from the full node's ProofPlane cache
+    LIGHTNODE_GET_PROOFS = 4006
     SYNC_PUSH_TRANSACTION = 5000
 
 # callback(from_node_id: bytes, payload: bytes) -> None

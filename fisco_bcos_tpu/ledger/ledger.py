@@ -158,6 +158,10 @@ class Ledger:
     def __init__(self, storage: StorageInterface, suite: CryptoSuite):
         self.storage = storage
         self.suite = suite
+        # read-path proof server (proofs/plane.py), attached by Node boot.
+        # None (or FISCO_PROOF_PLANE=0) = the direct per-request rebuild
+        # bodies below — the cache-off fallback the bit-identity tests pin.
+        self.proof_plane = None
 
     # -- genesis ------------------------------------------------------------
 
@@ -350,32 +354,71 @@ class Ledger:
 
     def _proof(self, number: int, target_hash: bytes) -> tuple[list[MerkleProofItem], int, int] | None:
         hashes = self.tx_hashes_by_number(number)
-        if target_hash not in hashes:
+        try:
+            idx = hashes.index(target_hash)  # one scan (was: `in` + .index)
+        except ValueError:
             return None
-        idx = hashes.index(target_hash)
         leaves = np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
         tree = MerkleTree(leaves, hasher=self.suite.hash_impl.name)
         return tree.proof(idx), idx, len(hashes)
 
     def tx_proof(self, tx_hash: bytes):
-        """-> (proof items, leaf index, leaf count) against header.txs_root."""
+        """-> (proof items, leaf index, leaf count) against header.txs_root.
+
+        Served from the ProofPlane's frozen-tree cache when attached (Node
+        boot wires it); the direct rebuild below is the cache-off fallback
+        (FISCO_PROOF_PLANE=0 / bare Ledger constructions)."""
+        if self.proof_plane is not None:
+            return self.proof_plane.tx_proof(tx_hash)
         rc = self.receipt_by_hash(tx_hash)
         if rc is None:
             return None
         return self._proof(rc.block_number, tx_hash)
 
     def receipt_proof(self, tx_hash: bytes):
-        """Proof that the *receipt* is in its block's receiptsRoot."""
+        """Proof that the *receipt* is in its block's receiptsRoot. Same
+        ProofPlane delegation contract as :meth:`tx_proof` — the fallback
+        re-reads every receipt in the block per request, which is exactly
+        the O(N)-per-proof shape the plane exists to kill."""
+        if self.proof_plane is not None:
+            return self.proof_plane.receipt_proof(tx_hash)
         rc = self.receipt_by_hash(tx_hash)
         if rc is None:
             return None
-        number = rc.block_number
+        return self._receipt_proof_direct(tx_hash, rc.block_number)
+
+    def _receipt_proof_direct(self, tx_hash: bytes, number: int):
         hashes = self.tx_hashes_by_number(number)
+        try:
+            idx = hashes.index(tx_hash)  # locate BEFORE paying N receipt reads
+        except ValueError:
+            return None
         rcs = [self.receipt_by_hash(h) for h in hashes]
         rc_hashes = [x.hash(self.suite) for x in rcs if x is not None]
         if len(rc_hashes) != len(hashes):
             return None
-        idx = hashes.index(tx_hash)
         leaves = np.frombuffer(b"".join(rc_hashes), dtype=np.uint8).reshape(-1, 32)
         tree = MerkleTree(leaves, hasher=self.suite.hash_impl.name)
         return tree.proof(idx), idx, len(rc_hashes)
+
+    def proof_batch_direct(
+        self, hashes: list[bytes], kind: str = "tx"
+    ) -> list[tuple | None]:
+        """The cache-off batch shape (aligned ``(number, items, idx, n)`` or
+        None per hash): per-hash direct rebuilds, shared by every surface's
+        FISCO_PROOF_PLANE=0 fallback (rpc, lightnode, bench) so the
+        fallback semantics can't drift between copies."""
+        out: list[tuple | None] = []
+        for h in hashes:
+            rc = self.receipt_by_hash(h)
+            if rc is None:
+                out.append(None)
+                continue
+            number = rc.block_number
+            p = (
+                self._proof(number, h)
+                if kind == "tx"
+                else self._receipt_proof_direct(h, number)
+            )
+            out.append(None if p is None else (number, *p))
+        return out

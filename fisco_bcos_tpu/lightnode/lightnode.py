@@ -44,6 +44,7 @@ _REQ_MODULES = (
     ModuleID.LIGHTNODE_GET_STATUS,
     ModuleID.LIGHTNODE_SEND_TRANSACTION,
     ModuleID.LIGHTNODE_CALL,
+    ModuleID.LIGHTNODE_GET_PROOFS,
 )
 
 
@@ -131,6 +132,44 @@ class LightNodeService:
             r.done()
             rc = node.scheduler.call(Transaction.decode(raw))
             w.bytes_(rc.encode())
+        elif module == ModuleID.LIGHTNODE_GET_PROOFS:
+            # multi-hash proof frame (ISSUE 7): u8 kind (0=tx 1=receipt) +
+            # N tx hashes in; per hash out: u8 found, u64 block number,
+            # [encoded receipt when kind=receipt — the leaf the client must
+            # re-hash], proof. One round trip, N proofs, one tree build per
+            # height on the ProofPlane.
+            kind = "receipt" if r.u8() else "tx"
+            hashes = r.seq(lambda r2: r2.fixed(32))
+            r.done()
+            from ..proofs import MAX_PROOF_BATCH
+
+            if len(hashes) > MAX_PROOF_BATCH:
+                # same cap as getProofBatch: the gateway takes 128MB frames,
+                # so without this one client buys millions of locator reads
+                raise ValueError(
+                    f"proof batch over {MAX_PROOF_BATCH} hashes"
+                )
+            results = _proof_batch(node, hashes, kind)
+            entries = []
+            for h, res in zip(hashes, results):
+                pw = FlatWriter()
+                if res is None:
+                    pw.u8(0)
+                else:
+                    number, items, idx, count = res
+                    pw.u8(1)
+                    pw.u64(number)
+                    if kind == "receipt":
+                        rc = node.ledger.receipt_by_hash(h)
+                        if rc is None:  # raced a rollback: report not-found
+                            nf = FlatWriter()
+                            nf.u8(0)
+                            entries.append(nf.out())
+                            continue
+                        pw.bytes_(rc.encode())
+                    _write_proof(pw, (items, idx, count))
+                entries.append(pw.out())
+            w.seq(entries, lambda w2, b: w2.bytes_(b))
         else:
             raise ValueError(f"unknown lightnode module {module}")
 
@@ -163,6 +202,15 @@ def _read_proof(r: FlatReader):
         )
     )
     return items, idx, count
+
+
+def _proof_batch(node, hashes: list[bytes], kind: str):
+    """Serve N proofs through the node's ProofPlane (one tree per height);
+    per-hash direct rebuilds only when the plane is disabled."""
+    plane = getattr(node, "proof_plane", None)
+    if plane is not None:
+        return plane.proof_batch(hashes, kind)
+    return node.ledger.proof_batch_direct(hashes, kind)
 
 
 # ---------------------------------------------------------------------------
@@ -304,6 +352,71 @@ class LightNode:
         ):
             raise ValueError("receipt proof fails against the verified root")
         return rc
+
+    def get_proof_batch(
+        self, tx_hashes: list[bytes], kind: str = "tx"
+    ) -> dict[bytes, tuple]:
+        """N membership proofs in ONE round trip (LIGHTNODE_GET_PROOFS),
+        each verified against the locally-synced header before acceptance.
+
+        ``kind="tx"``: proves each tx hash is a leaf of its block's
+        ``txsRoot`` (the leaf IS the requested hash). ``kind="receipt"``:
+        the response carries each encoded receipt; its re-hashed digest is
+        proven against ``receiptsRoot``. Returns
+        ``tx_hash -> (block_number, receipt-or-None)`` for every hash the
+        full node answered; raises ``ValueError`` on ANY proof that fails
+        verification or references an unsynced header — a partially-lying
+        full node taints the whole batch."""
+        if kind not in ("tx", "receipt"):
+            raise ValueError(f"unknown proof kind {kind!r}")
+        from ..proofs import MAX_PROOF_BATCH
+
+        if len(tx_hashes) > MAX_PROOF_BATCH:
+            # fail fast: the server rejects oversize batches without a
+            # response frame, which would surface here as a blind timeout
+            raise ValueError(f"proof batch over {MAX_PROOF_BATCH} hashes")
+        r = self._request(
+            ModuleID.LIGHTNODE_GET_PROOFS,
+            lambda w: (
+                w.u8(1 if kind == "receipt" else 0),
+                w.seq(list(tx_hashes), lambda w2, h: w2.fixed(h, 32)),
+            ),
+        )
+        entries = r.seq(lambda r2: r2.bytes_())
+        r.done()
+        if len(entries) != len(tx_hashes):
+            raise ValueError("full node answered a different batch size")
+        out: dict[bytes, tuple] = {}
+        for h, raw in zip(tx_hashes, entries):
+            pr = FlatReader(raw)
+            if not pr.u8():
+                pr.done()
+                continue  # not found on the full node
+            number = pr.u64()
+            rc = None
+            if kind == "receipt":
+                rc = TransactionReceipt.decode(pr.bytes_())
+                leaf = rc.hash(self.suite)
+            else:
+                leaf = h
+            proof = _read_proof(pr)
+            pr.done()
+            header = self.headers.get(number)
+            if header is None:
+                raise ValueError(f"proof references unsynced header {number}")
+            if proof is None:
+                raise ValueError("full node sent no proof")
+            items, idx, count = proof
+            root = header.receipts_root if kind == "receipt" else header.txs_root
+            if not MerkleTree.verify_proof(
+                leaf, idx, count, items, root, hasher=self.suite.hash_impl.name
+            ):
+                raise ValueError(
+                    f"{kind} proof for {h.hex()[:16]} fails against the "
+                    "verified root"
+                )
+            out[h] = (number, rc)
+        return out
 
     def send_transaction(self, tx: Transaction) -> tuple[int, bytes]:
         r = self._request(

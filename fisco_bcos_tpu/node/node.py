@@ -203,10 +203,32 @@ class Node:
             self.executor_manager.on_change.append(
                 lambda _term: self.scheduler.switch_term()
             )
+        # read-path proof plane (proofs/plane.py): frozen-tree cache warmed
+        # at commit time, invalidated on rollback re-drive and failover;
+        # ledger.tx_proof/receipt_proof delegate to it from here on.
+        # FISCO_PROOF_PLANE=0 keeps the direct per-request rebuild path.
+        from ..proofs import ProofPlane, proof_plane_enabled
+
+        self.proof_plane = None
+        if proof_plane_enabled():
+            self.proof_plane = ProofPlane(self.ledger, self.suite)
+            self.ledger.proof_plane = self.proof_plane
+            self.scheduler.on_committed.append(self.proof_plane.on_committed)
+            if hasattr(raw_storage, "on_rollback"):
+                raw_storage.on_rollback.append(self.proof_plane.on_rolled_back)
+            HEALTH.ok("proof-plane", "frozen-tree proof cache up")
         # storage failover seam (Initializer.cpp:225-235): backend loss
         # drops the in-flight scheduler term instead of wedging consensus
+        # (and clears the proof cache — the recovered backend may disagree
+        # about any height the cache froze)
         if hasattr(raw_storage, "set_switch_handler"):
-            raw_storage.set_switch_handler(self.scheduler.switch_term)
+
+            def _on_storage_switch() -> None:
+                self.scheduler.switch_term()
+                if self.proof_plane is not None:
+                    self.proof_plane.on_failover()
+
+            raw_storage.set_switch_handler(_on_storage_switch)
         # injected front = multi-group hosting (gateway/group.py GroupGateway
         # hands each group its own front over one shared transport)
         self.front = front if front is not None else FrontService(self.keypair.pub)

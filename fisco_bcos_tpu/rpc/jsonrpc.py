@@ -98,6 +98,16 @@ def _receipt_json(rc: TransactionReceipt, tx_hash: bytes, suite) -> dict:
     }
 
 
+def _proof_json(items, idx: int, n: int) -> dict:
+    """Wide-merkle proof JSON shape (shared by getTransaction's txProof,
+    getTransactionReceipt's receiptProof and getProofBatch)."""
+    return {
+        "index": idx,
+        "leaves": n,
+        "path": [[to_hex(g) for g in it.group] for it in items],
+    }
+
+
 def _header_json(h: BlockHeader, suite) -> dict:
     return {
         "version": h.version,
@@ -142,6 +152,7 @@ class JsonRpcImpl:
             "sendTransaction": self.send_transaction,
             "getTransaction": self.get_transaction,
             "getTransactionReceipt": self.get_transaction_receipt,
+            "getProofBatch": self.get_proof_batch,
             "getBlockByHash": self.get_block_by_hash,
             "getBlockByNumber": self.get_block_by_number,
             "getBlockHashByNumber": self.get_block_hash_by_number,
@@ -235,11 +246,7 @@ class JsonRpcImpl:
             p = self.node.ledger.tx_proof(h)
             if p is not None:
                 items, idx, n = p
-                out["txProof"] = {
-                    "index": idx,
-                    "leaves": n,
-                    "path": [[to_hex(g) for g in it.group] for it in items],
-                }
+                out["txProof"] = _proof_json(items, idx, n)
         return out
 
     def get_transaction_receipt(self, group: str, node_name: str, tx_hash: str, proof: bool = False) -> dict:
@@ -247,7 +254,45 @@ class JsonRpcImpl:
         rc = self.node.ledger.receipt_by_hash(h)
         if rc is None:
             raise JsonRpcError(-32602, "receipt not found")
-        return _receipt_json(rc, h, self.suite)
+        out = _receipt_json(rc, h, self.suite)
+        if proof:
+            p = self.node.ledger.receipt_proof(h)
+            if p is not None:
+                items, idx, n = p
+                out["receiptProof"] = _proof_json(items, idx, n)
+        return out
+
+    def get_proof_batch(
+        self, group: str = "", node_name: str = "",
+        tx_hashes: list | None = None, kind: str = "tx",
+    ) -> dict:
+        """ProofPlane batch surface (ISSUE 7): one request carries N
+        hashes, the node answers from the frozen-tree cache — one tree per
+        height, O(depth) per proof — instead of N full rebuilds."""
+        from ..proofs import MAX_PROOF_BATCH
+
+        if kind not in ("tx", "receipt"):
+            raise JsonRpcError(-32602, f"unknown proof kind {kind!r}")
+        hashes = [from_hex(h) for h in (tx_hashes or [])]
+        if len(hashes) > MAX_PROOF_BATCH:
+            raise JsonRpcError(
+                -32602, f"proof batch over {MAX_PROOF_BATCH} hashes"
+            )
+        plane = getattr(self.node, "proof_plane", None)
+        if plane is not None:
+            results = plane.proof_batch(hashes, kind)
+        else:  # cache-off fallback: per-hash direct rebuild
+            results = self.node.ledger.proof_batch_direct(hashes, kind)
+        proofs = []
+        for res in results:
+            if res is None:
+                proofs.append(None)
+                continue
+            number, items, idx, n = res
+            doc = _proof_json(items, idx, n)
+            doc["blockNumber"] = number
+            proofs.append(doc)
+        return {"kind": kind, "proofs": proofs}
 
     # -- block methods -------------------------------------------------------
 

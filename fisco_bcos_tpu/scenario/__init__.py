@@ -26,6 +26,7 @@ from .base import (
     get_scenario,
     list_scenarios,
 )
+from .proof_storm import run_proof_storm_bench
 from .runner import ScenarioRunner, run_isolation_bench
 
 __all__ = [
@@ -37,4 +38,5 @@ __all__ = [
     "get_scenario",
     "list_scenarios",
     "run_isolation_bench",
+    "run_proof_storm_bench",
 ]

@@ -99,6 +99,12 @@ class Client:
     def get_transaction_receipt(self, tx_hash: str, with_proof: bool = True) -> dict:
         return self._grouped("getTransactionReceipt", tx_hash, with_proof)
 
+    def get_proof_batch(self, tx_hashes: list[str], kind: str = "tx") -> dict:
+        """N merkle proofs in one round trip (served from the node's
+        ProofPlane frozen-tree cache): ``{"kind", "proofs": [doc|None]}``,
+        each doc carrying blockNumber/index/leaves/path."""
+        return self._grouped("getProofBatch", list(tx_hashes), kind)
+
     def get_code(self, address: str) -> str:
         return self._grouped("getCode", address)
 

@@ -72,6 +72,11 @@ class DistributedStorage(TransactionalStorage):
         # these before any witness-based roll-forward, or it could
         # resurrect a dead block number.
         self._rolled_back: dict[int, set[int]] = {}
+        # rollback listeners: cb(number) fired on EVERY rollback attempt of
+        # a declared-dead number — initial drive and re-drives alike — so
+        # read-side caches (the ProofPlane's frozen trees) evict the height
+        # eagerly instead of waiting for their serve-time identity checks
+        self.on_rollback: list = []
         for i, sh in enumerate(self.shards):
             # every shard loss funnels into ONE switch seam; RemoteStorage
             # dedups per-shard episodes, this layer scopes them by index
@@ -294,6 +299,16 @@ class DistributedStorage(TransactionalStorage):
             )
         else:
             self._rolled_back.pop(number, None)
+        # fire AFTER the drive attempt: listeners see the number already
+        # declared dead (witness retired first), and they fire again on
+        # every re-drive — idempotent evictions by contract
+        for cb in list(self.on_rollback):
+            try:
+                cb(number)
+            except Exception as e:  # a listener must not break the 2PC
+                from ..utils.log import note_swallowed
+
+                note_swallowed("storage.distributed.on_rollback", e)
 
     def unresolved_rollbacks(self) -> dict[int, set[int]]:
         """Observability/test surface: numbers declared dead whose rollback

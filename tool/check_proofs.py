@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""ProofPlane smoke check (ISSUE 7 acceptance shape, small scale).
+
+Four phases, runnable locally and from CI next to the other check_* tools:
+
+1. **Static analysis stays clean** — the new proofs/ module obeys the
+   device-dispatch / shape-bucket / lock-order / contract checkers
+   (`python -m fisco_bcos_tpu.analysis` baseline: no new, no stale).
+2. **Bit-identity** — ProofPlane-served tx/receipt proofs byte-equal the
+   direct per-request `Ledger` rebuild across a bucket-ladder boundary,
+   and `MerkleTree.verify_proof` accepts both.
+3. **Storm, live** — a 4-node chain floods while >= 8 client threads
+   hammer batched proofs (the proof-storm bench at reduced scale).
+   Asserts: every queued client served, cache hit ratio > 0.9 at steady
+   state, ZERO failed verifications, and the write path kept committing.
+4. **RPC surface** — `getProofBatch` answers over a live node with
+   verifiable proofs and None for unknown hashes.
+
+Exit 0 on success, 1 with a named failure otherwise::
+
+    python tool/check_proofs.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("FISCO_TEST_BUCKET", "32")
+os.environ.setdefault("FISCO_DEVICE_WINDOW_MS", "0")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_backend_optimization_level" not in _flags:
+    _flags += (
+        " --xla_backend_optimization_level=0"
+        " --xla_llvm_disable_expensive_passes=true"
+    )
+    os.environ["XLA_FLAGS"] = _flags.strip()
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+)
+sys.path.insert(0, _REPO)
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    raise SystemExit(1)
+
+
+def check_analysis_clean() -> None:
+    from fisco_bcos_tpu.analysis import check_repo
+
+    new, stale = check_repo()
+    if new:
+        for f in new:
+            print(f"  {f.render()}")
+        fail(f"{len(new)} new static-analysis finding(s) — proofs/ must obey the checkers")
+    if stale:
+        fail(f"{len(stale)} stale analysis baseline entr(ies): {stale}")
+    print("ok: static-analysis baseline clean")
+
+
+def check_bit_identity() -> None:
+    import hashlib
+
+    from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+    from fisco_bcos_tpu.ledger import Ledger
+    from fisco_bcos_tpu.ledger.ledger import (
+        SYS_HASH_2_RECEIPT,
+        SYS_NUMBER_2_HASH,
+        SYS_NUMBER_2_TXS,
+        _encode_hash_list,
+    )
+    from fisco_bcos_tpu.proofs import ProofPlane
+    from fisco_bcos_tpu.protocol.receipt import TransactionReceipt
+    from fisco_bcos_tpu.storage import MemoryStorage
+    from fisco_bcos_tpu.storage.entry import Entry
+
+    suite = ecdsa_suite()
+    storage = MemoryStorage()
+    ledger = Ledger(storage, suite)
+    for number, k in ((1, 16), (2, 17), (3, 48)):  # the ladder boundary
+        hashes = [hashlib.sha256(b"%d-%d" % (number, i)).digest() for i in range(k)]
+        storage.set_row(
+            SYS_NUMBER_2_TXS, str(number).encode(),
+            Entry().set(_encode_hash_list(hashes)),
+        )
+        for h in hashes:
+            storage.set_row(
+                SYS_HASH_2_RECEIPT, h,
+                Entry().set(TransactionReceipt(block_number=number).encode()),
+            )
+        storage.set_row(
+            SYS_NUMBER_2_HASH, str(number).encode(),
+            Entry().set(hashlib.sha256(b"hdr%d" % number).digest()),
+        )
+        probe = hashes[k // 2]
+        direct_tx = ledger.tx_proof(probe)
+        direct_rc = ledger.receipt_proof(probe)
+        ledger.proof_plane = ProofPlane(ledger, suite)
+        if ledger.tx_proof(probe) != direct_tx:
+            fail(f"tx proof diverges from the direct path at {k} leaves")
+        if ledger.receipt_proof(probe) != direct_rc:
+            fail(f"receipt proof diverges from the direct path at {k} leaves")
+        ledger.proof_plane = None
+    print("ok: plane-served proofs byte-equal the direct path across the ladder")
+
+
+def check_storm_live() -> None:
+    from fisco_bcos_tpu.scenario import run_proof_storm_bench
+
+    doc = run_proof_storm_bench(
+        seed=1, scale=0.1, workers=8, clients=6000, deadline_s=420
+    )
+    if doc.get("error"):
+        fail(f"proof storm errored: {doc['error']}")
+    if doc["proofs_served"] != doc["queued_clients"]:
+        fail(
+            f"only {doc['proofs_served']}/{doc['queued_clients']} queued "
+            "clients served"
+        )
+    if doc["verify_failures"]:
+        fail(f"{doc['verify_failures']} served proofs failed verification")
+    if doc["cache_hit_ratio"] <= 0.9:
+        fail(f"steady-state cache hit ratio {doc['cache_hit_ratio']} <= 0.9")
+    if doc["flood"]["committed"] <= 0:
+        fail("the concurrent flood committed nothing")
+    print(
+        f"ok: storm served {doc['proofs_served']} proofs from 8 client "
+        f"threads at {doc['proofs_per_s']}/s (steady "
+        f"{doc['proofs_per_s_steady']}/s, direct "
+        f"{doc['direct_baseline_proofs_per_s']}/s, hit ratio "
+        f"{doc['cache_hit_ratio']}), flood committed "
+        f"{doc['flood']['committed']} txs concurrently"
+    )
+
+
+def check_rpc_surface() -> None:
+    sys.path.insert(0, os.path.join(_REPO, "tests"))
+    from test_pbft import leader_of, make_chain, submit_txs
+
+    from fisco_bcos_tpu.ops.merkle import MerkleProofItem, MerkleTree
+    from fisco_bcos_tpu.rpc.jsonrpc import JsonRpcImpl
+    from fisco_bcos_tpu.utils.bytesutil import from_hex, to_hex
+
+    nodes, _gw = make_chain(4)
+    leader = leader_of(nodes, 1)
+    submit_txs(leader, 4)
+    if not leader.sealer.seal_and_submit():
+        fail("smoke chain could not commit a block")
+    node = nodes[0]
+    hashes = node.ledger.tx_hashes_by_number(1)
+    rpc = JsonRpcImpl(node)
+    out = rpc.handle(
+        {
+            "jsonrpc": "2.0", "id": 1, "method": "getProofBatch",
+            "params": ["group0", "", [to_hex(h) for h in hashes] + ["0x" + "00" * 32], "tx"],
+        }
+    )
+    res = out.get("result") or fail(f"getProofBatch errored: {out}")
+    if res["proofs"][-1] is not None:
+        fail("unknown hash did not map to None")
+    header = node.ledger.header_by_number(1)
+    suite = node.suite
+    for h, doc in zip(hashes, res["proofs"]):
+        idx = doc["index"]
+        rebuilt = []
+        for grp in doc["path"]:
+            g0 = (idx // 16) * 16
+            rebuilt.append(
+                MerkleProofItem(
+                    group=tuple(from_hex(g) for g in grp), index=idx - g0
+                )
+            )
+            idx //= 16
+        if not MerkleTree.verify_proof(
+            h, doc["index"], doc["leaves"], rebuilt, header.txs_root,
+            hasher=suite.hash_impl.name,
+        ):
+            fail("getProofBatch proof fails verification against the header")
+    print(f"ok: getProofBatch served {len(hashes)} verifiable proofs + None")
+
+
+def main() -> None:
+    check_analysis_clean()
+    check_bit_identity()
+    check_storm_live()
+    check_rpc_surface()
+    print("ALL PROOF CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()
