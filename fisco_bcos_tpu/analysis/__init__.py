@@ -11,6 +11,11 @@ encode THIS project's invariants —
 - :mod:`.checkers.jit_purity` — no side effects inside jit-traced bodies;
 - :mod:`.checkers.lock_order` — static lock-acquisition graph: cycles and
   blocking IO held under a lock;
+- :mod:`.checkers.guarded_state` — per-class lock-claim inference: writes
+  and compound RMWs of a claimed field outside its guard, guarded mutable
+  containers escaping by reference;
+- :mod:`.checkers.atomicity` — lock-free check-then-act sequences and
+  unlocked lazy-init of shared singletons;
 - :mod:`.checkers.exceptions` — no silent broad-except swallows;
 - :mod:`.checkers.contracts` — RPC idempotency classification, span
   closure, histogram bucket contract, the server-side span seam.
@@ -20,10 +25,14 @@ Findings diff against the checked-in baseline
 fails. Run locally with ``python -m fisco_bcos_tpu.analysis``; enforced in
 tier-1 by ``tests/test_static_analysis.py``.
 
-:mod:`.lockorder` is the runtime complement — instrumented
+The runtime complements: :mod:`.lockorder` — instrumented
 ``threading.Lock``/``RLock`` recording real per-thread acquisition chains
 across the test suite, failing the session on ordering cycles or RPC IO
-under a foreign lock.
+under a foreign lock; :mod:`.raceguard` — the sampling Eraser-lockset
+recorder over the hot-class watch-list (``FISCO_RACEGUARD=1``); and
+:mod:`.interleave` — the seeded deterministic interleaving explorer that
+drives :mod:`.harnesses` through forced preemption schedules
+(``tool/check_races.py``).
 
 Everything importable from here is jax-free: the CLI and the tier-1 test
 run on a cold interpreter in well under the 30 s budget.

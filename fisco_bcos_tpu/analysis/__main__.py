@@ -33,6 +33,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--root", default=None, help="package dir to analyze")
     p.add_argument("--baseline", default=DEFAULT_BASELINE)
     p.add_argument(
+        "--checker", action="append", metavar="NAME",
+        help="run only this checker (repeatable / comma-separated); the "
+        "baseline diff is scoped to the selected checkers' keys",
+    )
+    p.add_argument(
+        "--list", action="store_true",
+        help="print the registered checkers with one-line descriptions",
+    )
+    p.add_argument(
         "--no-baseline", action="store_true",
         help="report every finding, ignoring accepted debt",
     )
@@ -42,7 +51,37 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = p.parse_args(argv)
 
-    findings = run_all(args.root)
+    from .checkers import ALL_CHECKERS, checker_by_name
+
+    if args.list:
+        width = max(len(c.name) for c in ALL_CHECKERS)
+        for c in ALL_CHECKERS:
+            desc = getattr(c, "description", "") or "(no description)"
+            print(f"{c.name:<{width}}  {desc}")
+        return 0
+
+    selected = None
+    if args.checker:
+        names = [n for arg in args.checker for n in arg.split(",") if n]
+        if not names:
+            # an empty selection must not run ALL checkers against a
+            # baseline scoped to NONE (every accepted debt would read new)
+            print("--checker given but no checker names resolved")
+            return 2
+        selected = []
+        for n in names:
+            cls = checker_by_name(n)
+            if cls is None:
+                known = ", ".join(c.name for c in ALL_CHECKERS)
+                print(f"unknown checker {n!r} (known: {known})")
+                return 2
+            selected.append(cls)
+        if args.update_baseline:
+            print("--update-baseline requires the full checker set "
+                  "(a filtered run would drop every other checker's debt)")
+            return 2
+
+    findings = run_all(args.root, checkers=selected)
     if args.update_baseline:
         old_notes = load_baseline(args.baseline)
         save_baseline(findings, args.baseline, notes=old_notes)
@@ -54,7 +93,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.no_baseline:
         new, stale = findings, []
     else:
-        new, stale = diff_findings(findings, load_baseline(args.baseline))
+        baseline = load_baseline(args.baseline)
+        if selected is not None:
+            # scope the diff to the selected checkers: every other
+            # checker's accepted debt would otherwise read as stale
+            chosen = {c.name for c in selected}
+            baseline = {
+                k: v for k, v in baseline.items()
+                if k.split(":", 1)[0] in chosen
+            }
+        new, stale = diff_findings(findings, baseline)
 
     if args.format == "json":
         print(

@@ -2,9 +2,11 @@
 
 from __future__ import annotations
 
+from .atomicity import AtomicityChecker
 from .contracts import ContractChecker
 from .device_dispatch import DeviceDispatchChecker
 from .exceptions import ExceptionHygieneChecker
+from .guarded_state import GuardedStateChecker
 from .jit_purity import JitPurityChecker
 from .lock_order import LockOrderChecker
 from .shape_bucket import ShapeBucketChecker
@@ -14,6 +16,8 @@ ALL_CHECKERS = (
     ShapeBucketChecker,
     JitPurityChecker,
     LockOrderChecker,
+    GuardedStateChecker,
+    AtomicityChecker,
     ExceptionHygieneChecker,
     ContractChecker,
 )
@@ -21,3 +25,11 @@ ALL_CHECKERS = (
 
 def checker_names() -> list[str]:
     return [c.name for c in ALL_CHECKERS]
+
+
+def checker_by_name(name: str):
+    """Resolve a checker class by its registered name (None if unknown)."""
+    for c in ALL_CHECKERS:
+        if c.name == name:
+            return c
+    return None

@@ -1,0 +1,304 @@
+"""Atomicity: lock-free check-then-act and unlocked lazy-init.
+
+The guarded-state checker catches writes that forgot their lock; this one
+catches the subtler class where every individual operation is atomic under
+the GIL yet the *sequence* is not:
+
+- **Check-then-act on shared containers/fields** — in a class that owns a
+  lock, an ``if`` whose test reads ``self``-state and whose body acts on
+  the same state, with no lock lexically held::
+
+      if k in self._cache:            if not self._started:
+          return self._cache[k]           self._started = True
+                                          self._spawn()
+
+  Between the test and the act any other thread may mutate the state: the
+  read returns a value the act no longer sees (KeyError on the index), or
+  two threads both pass the ``not self._started`` gate and double-start.
+  Two detail classes: ``check-then-act-<field>`` for the membership/index
+  form, ``racy-lazy-init-<field>`` for the test-then-assign form.
+- **Unlocked lazy-init of module singletons** — a module-level factory
+  that assigns a ``global`` inside ``if X is None:`` with no module lock
+  held. Two threads racing the factory each build an instance and one
+  wins arbitrarily — callers end up holding *different* singletons (two
+  DevicePlanes each coalescing half the traffic). The project's
+  double-checked pattern (outer unlocked check, assignment under the
+  lock — ``get_plane``/``get_quotas``) passes because the assignment
+  itself is guarded.
+
+Scope control (false positives are the death of a gate): the class rules
+only fire in classes that own at least one ``threading`` lock — a class
+with no lock is single-threaded by design or someone else's problem, and
+flagging it would train people to ignore the checker. ``__init__`` and
+``*_locked``-suffixed methods are exempt exactly as in guarded-state.
+Benign sites carry ``# analysis: allow(atomicity, reason)`` waivers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding, Source, qualnames
+from .guarded_state import MUTATORS, _EXEMPT_METHODS, _own_exprs, _self_attr
+from .lock_order import _is_lock_ctor
+
+
+def _test_self_membership(test: ast.AST) -> str | None:
+    """`k in self.d` / `k not in self.d` -> 'd'."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        if isinstance(test.ops[0], (ast.In, ast.NotIn)):
+            return _self_attr(test.comparators[0])
+    return None
+
+
+def _test_self_truthiness(test: ast.AST) -> str | None:
+    """`not self.f` / `self.f is None` / `self.f` -> 'f'."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _self_attr(test.operand)
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], (ast.Is, ast.Eq))
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        return _self_attr(test.left)
+    return _self_attr(test)
+
+
+def _tests_of(test: ast.AST):
+    """Flatten `a or b` / `a and b` into candidate atoms."""
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            yield from _tests_of(v)
+    else:
+        yield test
+
+
+class AtomicityChecker(Checker):
+    name = "atomicity"
+    description = (
+        "flag lock-free check-then-act sequences (`if k in self.d: "
+        "... self.d[k]`, `if not self._x: self._x = ...`) in lock-owning "
+        "classes, and unlocked lazy-init of module-level singletons"
+    )
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        out: list[Finding] = []
+        for src in sources:
+            qn = qualnames(src.tree)
+            module_locks = self._module_locks(src.tree)
+            for node in src.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._check_class(src, node, qn, out)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._check_lazy_init(src, node, qn, module_locks, out)
+        return out
+
+    # -- class rules ----------------------------------------------------------
+
+    def _class_locks(self, cls: ast.ClassDef) -> set[str]:
+        locks: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        locks.add(attr)
+        return locks
+
+    def _check_class(self, src, cls: ast.ClassDef, qn, out) -> None:
+        locks = self._class_locks(cls)
+        if not locks:
+            return
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name in _EXEMPT_METHODS or item.name.endswith("_locked"):
+                continue
+            self._walk(src, item, qn, locks, out, held=False)
+
+    def _walk(self, src, node, qn, locks, out, held: bool) -> None:
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                self._walk(src, sub, qn, locks, out, held=False)
+                continue
+            now_held = held
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in locks:
+                        now_held = True
+            if isinstance(sub, ast.If) and not now_held:
+                self._check_if(src, sub, qn, locks, out)
+            self._walk(src, sub, qn, locks, out, now_held)
+
+    def _check_if(self, src, node: ast.If, qn, locks, out) -> None:
+        fn_qn = qn.get(node, "")
+        for test in _tests_of(node.test):
+            fld = _test_self_membership(test)
+            if fld is not None and self._acts_on(node.body, fld, locks):
+                self._emit(
+                    src, node, fn_qn, f"check-then-act-{fld}",
+                    f"lock-free check-then-act on `self.{fld}`: the test and "
+                    "the dependent access race other threads' mutations — "
+                    "hold the owning lock across both, or use a single "
+                    "atomic op (.get/.setdefault/.pop(k, None)), or waive "
+                    "with `# analysis: allow(atomicity, reason)`", out,
+                )
+                return
+            fld = _test_self_truthiness(test)
+            if fld is not None and self._assigns(node.body, fld, locks):
+                self._emit(
+                    src, node, fn_qn, f"racy-lazy-init-{fld}",
+                    f"test-then-assign of `self.{fld}` without the lock: two "
+                    "threads can both pass the gate and double-initialize — "
+                    "assign under the owning lock (double-checked is fine) "
+                    "or waive with `# analysis: allow(atomicity, reason)`",
+                    out,
+                )
+                return
+
+    def _unguarded_stmts(self, body: list, locks):
+        """Statements in `body` NOT under a `with self.<lock>:` — an act
+        that re-takes the lock is the double-checked pattern, not a race."""
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)) and any(
+                _self_attr(i.context_expr) in locks for i in stmt.items
+            ):
+                continue
+            yield stmt
+            children = []
+            for s in ast.iter_child_nodes(stmt):
+                if isinstance(s, ast.stmt):
+                    children.append(s)
+                elif isinstance(s, ast.excepthandler):
+                    children.extend(s.body)
+            yield from self._unguarded_stmts(children, locks)
+
+    def _acts_on(self, body: list, fld: str, locks) -> bool:
+        for stmt in self._unguarded_stmts(body, locks):
+            for sub in _own_exprs(stmt):
+                if isinstance(sub, ast.Subscript) and _self_attr(sub.value) == fld:
+                    return True
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in MUTATORS
+                    and _self_attr(sub.func.value) == fld
+                ):
+                    return True
+        return False
+
+    def _assigns(self, body: list, fld: str, locks) -> bool:
+        for stmt in self._unguarded_stmts(body, locks):
+            if isinstance(stmt, ast.Assign) and any(
+                _self_attr(t) == fld for t in stmt.targets
+            ):
+                return True
+            if isinstance(stmt, ast.AugAssign) and _self_attr(stmt.target) == fld:
+                return True
+        return False
+
+    def _emit(self, src, node, fn_qn, detail, msg, out) -> None:
+        if src.waived(node.lineno, self.name):
+            return
+        out.append(self.finding(src, node, fn_qn, detail, msg))
+
+    # -- module singleton lazy-init -------------------------------------------
+
+    def _module_locks(self, tree: ast.Module) -> set[str]:
+        locks: set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        locks.add(tgt.id)
+        return locks
+
+    def _check_lazy_init(self, src, fn, qn, module_locks, out) -> None:
+        declared: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+        if not declared:
+            return
+        fn_qn = qn.get(fn, fn.name)
+
+        def walk(node, held: bool) -> None:
+            for sub in ast.iter_child_nodes(node):
+                now_held = held
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        if (
+                            isinstance(item.context_expr, ast.Name)
+                            and item.context_expr.id in module_locks
+                        ):
+                            now_held = True
+                if isinstance(sub, ast.If) and not now_held:
+                    for test in _tests_of(sub.test):
+                        name = self._global_none_test(test, declared)
+                        if name is not None and self._assigns_global(
+                            sub.body, name, module_locks
+                        ):
+                            self._emit(
+                                src, sub, fn_qn,
+                                f"unlocked-lazy-init-{name}",
+                                f"lazy-init of module singleton `{name}` "
+                                "without a lock: two racing callers each "
+                                "build an instance and end up holding "
+                                "different singletons — guard the "
+                                "assignment (double-checked locking) or "
+                                "waive with `# analysis: allow(atomicity, "
+                                "reason)`", out,
+                            )
+                walk(sub, now_held)
+
+        walk(fn, False)
+
+    @staticmethod
+    def _global_none_test(test: ast.AST, declared: set[str]) -> str | None:
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            t = test.operand
+        elif (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Is)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        ):
+            t = test.left
+        else:
+            return None
+        if isinstance(t, ast.Name) and t.id in declared:
+            return t.id
+        return None
+
+    def _assigns_global(self, body: list, name: str, module_locks) -> bool:
+        """True when `name` is assigned in `body` with no module lock held
+        (a nested `with LOCK:` around the assignment passes)."""
+
+        def walk(stmts, held: bool) -> bool:
+            for stmt in stmts:
+                now_held = held
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        if (
+                            isinstance(item.context_expr, ast.Name)
+                            and item.context_expr.id in module_locks
+                        ):
+                            now_held = True
+                if not now_held and isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in stmt.targets
+                ):
+                    return True
+                children = [
+                    s for s in ast.iter_child_nodes(stmt)
+                    if isinstance(s, ast.stmt)
+                ]
+                if children and walk(children, now_held):
+                    return True
+            return False
+
+        return walk(body, False)

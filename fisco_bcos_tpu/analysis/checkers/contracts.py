@@ -82,6 +82,10 @@ def _classified_methods(sources: list[Source]) -> set[str]:
 
 class ContractChecker(Checker):
     name = "contract"
+    description = (
+        "RPC methods classified for idempotency, spans closed as with-"
+        "items, *_ms histograms on named *_BUCKETS constants"
+    )
 
     def run(self, sources: list[Source]) -> list[Finding]:
         out: list[Finding] = []

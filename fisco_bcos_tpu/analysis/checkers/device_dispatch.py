@@ -64,6 +64,10 @@ def _imported_device_module(node: ast.AST) -> tuple[str, list[str]] | None:
 
 class DeviceDispatchChecker(Checker):
     name = "device-dispatch"
+    description = (
+        "device crypto/hash kernels import only inside the DevicePlane "
+        "seams (ops/crypto/device/parallel) — everyone else uses the suite"
+    )
 
     def run(self, sources: list[Source]) -> list[Finding]:
         out: list[Finding] = []

@@ -70,6 +70,10 @@ def _is_silent(handler: ast.ExceptHandler) -> bool:
 
 class ExceptionHygieneChecker(Checker):
     name = "except-hygiene"
+    description = (
+        "broad except handlers must observe the error (log / count / "
+        "narrow / assign fallback) — silent pass/continue erasure fails"
+    )
 
     def run(self, sources: list[Source]) -> list[Finding]:
         out: list[Finding] = []

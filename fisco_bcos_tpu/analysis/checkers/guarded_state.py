@@ -1,0 +1,320 @@
+"""Guard inference: which lock protects which field, and who forgets it.
+
+The other checkers police *how* locks are taken (ordering, blocking IO
+under a hold); nothing checked *what the locks are for*. This checker
+infers, per class, the lock discipline the code itself implies and then
+holds every access to it:
+
+- **Lock discovery** — ``self.X = threading.Lock()/RLock()/Condition()``
+  attributes, exactly like the static lock-order checker. A Condition
+  built over a sibling lock (``threading.Condition(self._lock)``) is an
+  alias: holding the condition IS holding the lock.
+- **Claim inference** — a field *written* while lexically inside
+  ``with self.<lock>:`` is claimed by that lock. Writes cover plain
+  assignment, compound read-modify-write (``+=``), mutating container
+  calls (``.append``/``.pop``/``.update``/…) and subscript stores
+  (``self.d[k] = v`` / ``del self.d[k]``).
+- **Violations** — any write-class access of a claimed field with no
+  claiming lock lexically held. ``__init__`` is exempt (construction
+  happens before publication), as are methods whose name ends in
+  ``_locked`` — the project's convention for "caller holds the lock"
+  (``_insert_locked``, ``_gate_commit_locked``); the convention is the
+  documentation the checker enforces everywhere else.
+- **Guarded-container escape** — ``return self.f`` / ``yield self.f``
+  where ``f`` is a claimed *mutable container* hands the caller a live
+  reference that outlives the guard; iterating it while a writer holds
+  the lock is the race the guard existed to prevent. Returning a copy
+  (``dict(self.f)``, ``list(...)``, ``.copy()``, a comprehension) passes
+  because the copy happens under whatever guard the callee holds.
+
+Plain reads are NOT flagged: single-attribute loads are atomic under the
+GIL and flagging them would bury the real findings (torn compound
+updates, lost increments, mid-iteration mutation) in noise. Accepted
+sites carry ``# analysis: allow(guarded-state, reason)`` waivers — the
+satellite contract is that benign debt lives in-code, not in the
+baseline.
+
+The runtime complement is :mod:`...analysis.raceguard` — the lockset
+recorder that sees dynamic guard relationships (fields guarded by a
+caller's lock three frames up) that this lexical inference cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..core import Checker, Finding, Source, qualnames
+from .lock_order import _is_lock_ctor
+
+# container method calls that mutate the receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "add", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "insert", "move_to_end", "sort", "reverse", "rotate",
+}
+
+# init-time RHS forms that mark a field as a mutable container
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "deque", "OrderedDict", "defaultdict",
+    "Counter", "bytearray",
+}
+
+# access kinds (write class — reads are deliberately out of scope)
+W_ASSIGN = "write"
+W_RMW = "rmw"  # AugAssign, mutator calls, subscript stores
+
+_EXEMPT_METHODS = ("__init__", "__post_init__", "__del__")
+
+
+@dataclass
+class _Access:
+    fld: str
+    kind: str  # W_ASSIGN | W_RMW
+    node: ast.AST
+    fn_qn: str
+    method: str  # the class-level method name (exemption unit)
+    held: frozenset
+
+
+@dataclass
+class _Escape:
+    fld: str
+    node: ast.AST
+    fn_qn: str
+    method: str
+
+
+@dataclass
+class _ClassState:
+    locks: dict = field(default_factory=dict)  # attr -> canonical attr (alias)
+    containers: set = field(default_factory=set)
+    accesses: list = field(default_factory=list)
+    escapes: list = field(default_factory=list)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _own_exprs(stmt: ast.AST):
+    """Every expression node belonging to THIS statement — child statements
+    (a compound statement's body) are skipped; they are visited separately
+    with their own held-set, and scanning them here would record their
+    accesses against the wrong guard."""
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.stmt, ast.excepthandler)):
+            continue
+        yield from ast.walk(child)
+
+
+class GuardedStateChecker(Checker):
+    name = "guarded-state"
+    description = (
+        "infer which lock guards which self._field (a field written under "
+        "`with self._lock:` is claimed by it); flag writes/RMWs outside the "
+        "guard and guarded mutable containers escaping by reference"
+    )
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        out: list[Finding] = []
+        for src in sources:
+            qn = qualnames(src.tree)
+            for node in src.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._check_class(src, node, qn, out)
+        return out
+
+    # -- per-class analysis ----------------------------------------------------
+
+    def _check_class(self, src, cls: ast.ClassDef, qn, out) -> None:
+        st = _ClassState()
+        self._collect_locks(cls, st)
+        if not st.locks:
+            return  # no lock, no discipline to infer
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_fn(src, item, qn, st, item.name)
+        claims = self._claims(st)
+        if not claims:
+            return
+        seen: set[tuple[str, str]] = set()
+        for acc in st.accesses:
+            claiming = claims.get(acc.fld)
+            if not claiming or acc.held & claiming:
+                continue
+            if acc.method in _EXEMPT_METHODS or acc.method.endswith("_locked"):
+                continue
+            if src.waived(acc.node.lineno, self.name):
+                continue
+            detail = f"unguarded-{acc.kind}-{acc.fld}"
+            if (acc.fn_qn, detail) in seen:
+                continue
+            seen.add((acc.fn_qn, detail))
+            locks = "/".join(sorted(f"self.{x}" for x in claiming))
+            out.append(
+                self.finding(
+                    src, acc.node, acc.fn_qn, detail,
+                    f"{acc.kind} of `self.{acc.fld}` outside its guard — the "
+                    f"field is claimed by `{locks}` (written under it "
+                    "elsewhere); take the lock, rename the method *_locked "
+                    "if the caller holds it, or waive with `# analysis: "
+                    "allow(guarded-state, reason)`",
+                )
+            )
+        for esc in st.escapes:
+            claiming = claims.get(esc.fld)
+            if not claiming or esc.fld not in st.containers:
+                continue
+            if src.waived(esc.node.lineno, self.name):
+                continue
+            detail = f"escape-{esc.fld}"
+            if (esc.fn_qn, detail) in seen:
+                continue
+            seen.add((esc.fn_qn, detail))
+            out.append(
+                self.finding(
+                    src, esc.node, esc.fn_qn, detail,
+                    f"`self.{esc.fld}` is a lock-guarded mutable container "
+                    "escaping by reference — the caller iterates it outside "
+                    "the guard while writers mutate it; return a copy "
+                    "(dict(...)/list(...)/.copy()) or waive",
+                )
+            )
+
+    def _collect_locks(self, cls: ast.ClassDef, st: _ClassState) -> None:
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or not _is_lock_ctor(node.value):
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                # Condition(self._lock) aliases the condition to its lock:
+                # holding either is the same guard
+                canonical = attr
+                call = node.value
+                if isinstance(call, ast.Call) and call.args:
+                    arg_attr = _self_attr(call.args[0])
+                    if arg_attr is not None:
+                        canonical = st.locks.get(arg_attr, arg_attr)
+                st.locks[attr] = canonical
+
+    def _claims(self, st: _ClassState) -> dict[str, frozenset]:
+        claims: dict[str, set] = {}
+        for acc in st.accesses:
+            if acc.held:
+                claims.setdefault(acc.fld, set()).update(acc.held)
+        return {f: frozenset(s) for f, s in claims.items()}
+
+    # -- statement walk with lexical held-set ---------------------------------
+
+    def _walk_fn(self, src, fn, qn, st: _ClassState, method: str) -> None:
+        fn_qn = qn.get(fn, fn.name)
+
+        def held_of(with_node: ast.With, held: frozenset) -> frozenset:
+            got = set(held)
+            for item in with_node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in st.locks:
+                    got.add(st.locks[attr])
+            return frozenset(got)
+
+        def walk(node: ast.AST, held: frozenset) -> None:
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    # nested defs run later, outside this lexical guard
+                    walk(sub, frozenset())
+                    continue
+                new_held = held
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    new_held = held_of(sub, held)
+                self._note_stmt(src, sub, fn_qn, method, new_held, st)
+                walk(sub, new_held)
+
+        self._note_init_containers(fn, method, st)
+        walk(fn, frozenset())
+
+    def _note_init_containers(self, fn, method: str, st: _ClassState) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                v = node.value
+                if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                                  ast.DictComp, ast.SetComp)):
+                    st.containers.add(attr)
+                elif isinstance(v, ast.Call):
+                    f = v.func
+                    name = f.attr if isinstance(f, ast.Attribute) else (
+                        f.id if isinstance(f, ast.Name) else None
+                    )
+                    if name in _CONTAINER_CTORS:
+                        st.containers.add(attr)
+
+    def _note_stmt(self, src, stmt, fn_qn, method, held, st: _ClassState) -> None:
+        note = st.accesses.append
+
+        def targets_of(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from targets_of(e)
+            else:
+                yield t
+
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            tgts = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in tgts:
+                for tgt in targets_of(t):
+                    attr = _self_attr(tgt)
+                    if attr is not None and attr not in st.locks:
+                        note(_Access(attr, W_ASSIGN, stmt, fn_qn, method, held))
+                    elif isinstance(tgt, ast.Subscript):
+                        a = _self_attr(tgt.value)
+                        if a is not None:
+                            st.containers.add(a)
+                            note(_Access(a, W_RMW, stmt, fn_qn, method, held))
+        elif isinstance(stmt, ast.AugAssign):
+            attr = _self_attr(stmt.target)
+            if attr is not None:
+                note(_Access(attr, W_RMW, stmt, fn_qn, method, held))
+            elif isinstance(stmt.target, ast.Subscript):
+                a = _self_attr(stmt.target.value)
+                if a is not None:
+                    st.containers.add(a)
+                    note(_Access(a, W_RMW, stmt, fn_qn, method, held))
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Subscript):
+                    a = _self_attr(tgt.value)
+                    if a is not None:
+                        st.containers.add(a)
+                        note(_Access(a, W_RMW, stmt, fn_qn, method, held))
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._note_escape(stmt, stmt.value, fn_qn, method, st)
+        # mutator calls + yield escapes in this statement's own expressions
+        for sub in _own_exprs(stmt):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                a = _self_attr(sub.func.value)
+                if a is not None and sub.func.attr in MUTATORS:
+                    st.containers.add(a)
+                    note(_Access(a, W_RMW, sub, fn_qn, method, held))
+            elif isinstance(sub, ast.Yield) and sub.value is not None:
+                self._note_escape(sub, sub.value, fn_qn, method, st)
+
+    def _note_escape(self, node, value, fn_qn, method, st: _ClassState) -> None:
+        vals = value.elts if isinstance(value, ast.Tuple) else [value]
+        for v in vals:
+            attr = _self_attr(v)
+            if attr is not None and attr not in st.locks:
+                st.escapes.append(_Escape(attr, node, fn_qn, method))

@@ -48,6 +48,10 @@ def _root_name(node: ast.AST) -> str | None:
 
 class JitPurityChecker(Checker):
     name = "jit-purity"
+    description = (
+        "no side effects inside jit-traced bodies (time/RNG/locks/"
+        "telemetry/attr stores run once at trace time, then never again)"
+    )
 
     def _offense(self, sub: ast.AST) -> str | None:
         if isinstance(sub, (ast.Global, ast.Nonlocal)):

@@ -83,6 +83,10 @@ def _lock_expr_id(expr: ast.AST, mod: _ModuleLocks, cls: str) -> str | None:
 
 class LockOrderChecker(Checker):
     name = "lock-order"
+    description = (
+        "static lock-acquisition graph: ordering cycles (Tarjan SCC) and "
+        "blocking calls (sleep/socket/.result/.join/frame IO) under a lock"
+    )
 
     def run(self, sources: list[Source]) -> list[Finding]:
         modules = [self._collect_locks(src) for src in sources]

@@ -58,6 +58,10 @@ def _module_bucket_names(tree: ast.Module) -> set[str]:
 
 class ShapeBucketChecker(Checker):
     name = "shape-bucket"
+    description = (
+        "functions building input-sized arrays for jitted callables must "
+        "pad through the bucket ladder — every raw shape is an XLA compile"
+    )
 
     def run(self, sources: list[Source]) -> list[Finding]:
         jits = jitmap.collect(sources)

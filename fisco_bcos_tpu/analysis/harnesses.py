@@ -1,0 +1,414 @@
+"""Interleaving harnesses — small concurrent scenarios over the REAL hot
+classes, driven by the :mod:`.interleave` explorer.
+
+Each harness builds the subsystem's real objects (stub collaborators, no
+background threads — the determinism contract), runs 2–3 workers through
+a genuinely contended sequence, and asserts the invariant that a lost
+update / torn sequence would break. The explorer preempts at every lock
+edge and watched-field access, so the schedules these harnesses survive
+include exactly the interleavings production would need OS-scheduler bad
+luck to hit.
+
+The four real harnesses (``HARNESSES``) ride ``tool/check_races.py``'s
+seeded sweep; :class:`RacyCounterHarness` is the *injected race* — the
+canary proving the explorer actually finds and shrinks a data race (it
+must FAIL; the suite asserts it does within a bounded seed budget).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+
+# -- injected fixture race ----------------------------------------------------
+
+
+class _RacyCounter:
+    """The textbook lost update: read and write with no lock (the lock
+    exists and is deliberately unused — raceguard sees the empty lockset,
+    the check sees the lost increment)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc_racy(self) -> None:
+        v = self.value  # preemption here is the lost-update window
+        # analysis: allow(guarded-state, the injected race IS the fixture)
+        self.value = v + 1
+
+    def inc_guarded(self) -> None:
+        with self._lock:
+            v = self.value
+            self.value = v + 1
+
+
+class RacyCounterHarness:
+    name = "racy-counter"
+
+    def __init__(self, guarded: bool = False):
+        self.guarded = guarded
+        self.watch = [(_RacyCounter, ("value",))]
+
+    def setup(self):
+        return {"counter": _RacyCounter()}
+
+    def threads(self, ctx):
+        c = ctx["counter"]
+        fn = c.inc_guarded if self.guarded else c.inc_racy
+
+        def worker():
+            fn()
+            fn()
+
+        return [("t1", worker), ("t2", worker)]
+
+    def check(self, ctx):
+        got = ctx["counter"].value
+        assert got == 4, f"lost update: counter={got}, expected 4"
+
+
+# -- DevicePlane coalescer ----------------------------------------------------
+
+
+class DevicePlaneHarness:
+    """Two submitters race the queue while a drainer runs the scheduler's
+    pick/dispatch sequence — the stats counters, pending map and futures
+    must stay coherent under any interleaving."""
+
+    name = "device-plane"
+
+    def __init__(self):
+        from ..device.plane import DevicePlane
+
+        self.watch = [(DevicePlane, (
+            "requests", "dispatches", "merged_requests", "items", "_busy",
+        ))]
+
+    def setup(self):
+        from ..device.plane import DevicePlane
+
+        plane = DevicePlane(
+            window_ms=0, high_water=64, starvation_ms=1e9, autostart=False
+        )
+        return {"plane": plane, "futs": []}
+
+    def threads(self, ctx):
+        import time
+
+        plane = ctx["plane"]
+        futs = ctx["futs"]
+
+        def exec_fn(reqs):
+            return [r.n for r in reqs]
+
+        def submitter(n):
+            def run():
+                futs.append((n, plane.submit("verify", None, n, exec_fn)))
+
+            return run
+
+        def drainer():
+            for _ in range(200):
+                done = [f.done() for _, f in list(futs)]
+                if len(done) == 2 and all(done):
+                    return
+                with plane._cv:
+                    picked = plane._pick_ready_locked(time.perf_counter())
+                if picked is not None:
+                    op, reqs, deferred = picked
+                    plane._note_deferred(op, deferred)
+                    plane._dispatch(op, reqs)
+
+        return [("sub1", submitter(1)), ("sub2", submitter(2)),
+                ("drain", drainer)]
+
+    def check(self, ctx):
+        plane = ctx["plane"]
+        futs = ctx["futs"]
+        assert len(futs) == 2, f"submissions lost: {len(futs)}"
+        for n, f in futs:
+            assert f.done(), f"future for n={n} never resolved"
+            assert f.result(timeout=0) == n, "result misrouted across slices"
+        st = plane.stats()
+        assert st["requests"] == 2 and st["items"] == 3, st
+        assert st["queue_depth"] == 0, st
+        assert 1 <= st["dispatches"] <= 2, st
+
+
+# -- ProofPlane singleflight --------------------------------------------------
+
+
+class _FakeReceipt:
+    def __init__(self, number):
+        self.block_number = number
+
+
+class _FakeProofLedger:
+    def __init__(self, tx_hashes):
+        self.txs = list(tx_hashes)
+        self.alive = True
+
+    def receipt_by_hash(self, h):
+        return _FakeReceipt(1) if self.alive and h in self.txs else None
+
+    def block_hash_by_number(self, number):
+        return b"B" * 32 if self.alive and number == 1 else None
+
+    def tx_hashes_by_number(self, number):
+        return list(self.txs) if self.alive and number == 1 else []
+
+
+class _FakeTree:
+    def __init__(self, leaves):
+        self.levels = [list(leaves), [hashlib.sha256(b"".join(leaves)).digest()]]
+        self.n = len(leaves)
+        self.width = max(len(leaves), 2)
+
+
+class _FakeProofSuite:
+    def merkle_tree(self, arr):
+        return _FakeTree([bytes(row) for row in arr])
+
+
+class ProofPlaneHarness:
+    """Concurrent cache misses for one height must coalesce on the
+    singleflight future while an invalidator races evictions — every
+    caller still gets a proof for the live identity, exactly one build
+    per generation, and the hit/miss ledger stays consistent."""
+
+    name = "proof-singleflight"
+
+    def __init__(self):
+        from ..proofs.plane import ProofPlane
+
+        self.watch = [(ProofPlane, (
+            "requests", "hits", "misses", "builds_lazy", "coalesced_builds",
+        ))]
+
+    def setup(self):
+        from ..proofs.plane import ProofPlane
+
+        h1, h2 = b"\x01" * 32, b"\x02" * 32
+        ledger = _FakeProofLedger([h1, h2])
+        plane = ProofPlane(ledger, _FakeProofSuite(), capacity=4)
+        return {"plane": plane, "hashes": (h1, h2), "out": {}}
+
+    def threads(self, ctx):
+        plane = ctx["plane"]
+        h1, h2 = ctx["hashes"]
+        out = ctx["out"]
+
+        def reader(name, h):
+            def run():
+                out[name] = plane.proof_batch([h])
+
+            return run
+
+        def invalidator():
+            plane.invalidate(1, reason="rollback")
+
+        return [("r1", reader("r1", h1)), ("r2", reader("r2", h2)),
+                ("inval", invalidator)]
+
+    def check(self, ctx):
+        plane = ctx["plane"]
+        out = ctx["out"]
+        assert set(out) == {"r1", "r2"}, f"readers lost: {sorted(out)}"
+        for name, expect_idx in (("r1", 0), ("r2", 1)):
+            res = out[name][0]
+            assert res is not None, f"{name}: proof missing for a live height"
+            number, items, idx, n = res
+            assert number == 1 and idx == expect_idx and n == 2, res
+        st = plane.stats()
+        assert st["hits"] + st["misses"] == st["requests"], st
+        assert st["builds_lazy"] >= 1, st
+        # generations: at most one build per eviction epoch (initial +
+        # post-invalidate), never one per caller
+        assert st["builds_lazy"] <= 2, st
+
+
+# -- AdmissionQuotas strikes --------------------------------------------------
+
+
+class AdmissionQuotasHarness:
+    """Two sources of strikes race the demotion edge while a reader takes
+    snapshots — strikes must not be lost (two strikes at limit 2 ⇒
+    demoted), grants must match the bucket, and the shed ledger adds up."""
+
+    name = "admission-quotas"
+
+    def __init__(self):
+        from ..txpool.quota import AdmissionQuotas
+
+        self.watch = [(AdmissionQuotas, ("_groups",))]
+
+    def setup(self):
+        from ..txpool.quota import AdmissionQuotas
+
+        quotas = AdmissionQuotas(
+            default_rate=1000.0, default_burst=1000.0, strike_limit=2,
+            strike_window_s=600.0, demote_s=600.0,
+        )
+        return {"q": quotas, "granted": []}
+
+    def threads(self, ctx):
+        q = ctx["q"]
+        granted = ctx["granted"]
+
+        def striker():
+            granted.append(q.try_admit("g", 5))
+            q.note_invalid("g", "spammer", 3)
+
+        def reader():
+            q.demoted("g", "spammer")
+            q.snapshot()
+            q.count_demoted_drop("g", 2)
+
+        return [("s1", striker), ("s2", striker), ("read", reader)]
+
+    def check(self, ctx):
+        q = ctx["q"]
+        assert sum(ctx["granted"]) == 10, ctx["granted"]
+        assert q.demoted("g", "spammer"), "strike lost: source not demoted"
+        snap = q.snapshot()["g"]
+        assert snap["demote_drops"] == 2, snap
+        assert snap["demoted_sources"] == ["spammer"], snap
+
+
+# -- Scheduler commit markers -------------------------------------------------
+
+
+class _FakeSchedHeader:
+    def __init__(self, number):
+        self.number = number
+
+    def hash(self, _suite):
+        return b"H%031d" % self.number
+
+
+class _FakeSchedBlock:
+    def __init__(self, header):
+        self.header = header
+        self.transactions = []
+        self.receipts = []
+
+    def tx_hashes(self, _suite):
+        return []
+
+
+class _FakeSchedLedger:
+    def __init__(self):
+        self.height = 0
+
+    def block_number(self):
+        return self.height
+
+    def prewrite_block(self, block, writes):
+        pass
+
+
+class _FakeSchedExecutor:
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def prepare(self, params, extra_writes=None):
+        pass
+
+    def commit(self, params):
+        self._ledger.height = params.number
+
+
+class _InlineNotify:
+    """Stands in for the commit-notify Worker: listeners run synchronously
+    on the committing worker (no unmanaged thread may race a schedule)."""
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def post(self, fn):
+        fn()
+
+
+class SchedulerHarness:
+    """Two committers and a storage-term switcher race the in-flight
+    commit marker and its condition variable — commits must land in
+    height order, the marker must never leak, and switch_term must wait
+    out (never deadlock against) an in-flight 2PC."""
+
+    name = "scheduler-commit"
+
+    def __init__(self):
+        from ..scheduler.scheduler import Scheduler
+
+        self.watch = [(Scheduler, ("term", "_committing_thread"))]
+
+    def setup(self):
+        from ..scheduler.scheduler import ExecutedBlock, Scheduler
+
+        ledger = _FakeSchedLedger()
+        executor = _FakeSchedExecutor(ledger)
+        sched = Scheduler(
+            executor, ledger, backend=None, suite=None,
+            notify_worker=_InlineNotify(),
+        )
+        for n in (1, 2):
+            header = _FakeSchedHeader(n)
+            sched._executed[n] = ExecutedBlock(
+                header, _FakeSchedBlock(header), tx_hashes=()
+            )
+        committed: list[int] = []
+        sched.on_committed.append(lambda n, _b: committed.append(n))
+        return {"sched": sched, "ledger": ledger, "committed": committed}
+
+    def threads(self, ctx):
+        from ..scheduler.scheduler import SchedulerError
+
+        sched = ctx["sched"]
+
+        def committer(number):
+            header = _FakeSchedHeader(number)
+
+            def run():
+                for _ in range(50):
+                    try:
+                        sched.commit_block(header)
+                        return
+                    except SchedulerError:
+                        # out of order (predecessor not booked) or dropped
+                        # by a term switch: retry / give up respectively
+                        if number not in sched._executed:
+                            return
+                return
+
+            return run
+
+        def switcher():
+            sched.switch_term()
+
+        return [("c1", committer(1)), ("c2", committer(2)),
+                ("switch", switcher)]
+
+    def check(self, ctx):
+        sched = ctx["sched"]
+        committed = ctx["committed"]
+        assert sched.term == 1, f"term switch lost: {sched.term}"
+        assert not sched._committing, f"marker leaked: {sched._committing}"
+        assert sched._committing_thread is None, "committer identity leaked"
+        # commits that happened landed in height order, and the ledger head
+        # equals the highest booked height (nothing torn by the switch)
+        assert committed == sorted(committed), committed
+        assert ctx["ledger"].height == (committed[-1] if committed else 0)
+
+
+HARNESSES = {
+    h.name: h
+    for h in (DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
+              SchedulerHarness)
+}
+
+FIXTURE_HARNESSES = {RacyCounterHarness.name: RacyCounterHarness}

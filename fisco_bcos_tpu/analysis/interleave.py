@@ -1,0 +1,598 @@
+"""Seeded deterministic interleaving explorer — schedule fuzzing for the
+package's concurrent hot paths ("tpu-tsan"'s second half).
+
+A race the lockset recorder can describe still needs a *schedule* that
+triggers it; the OS scheduler finds that schedule once a month, in
+production, at 3am. This module takes scheduling away from the OS: one
+worker runs at a time, and at every **preemption point** — cooperative
+lock acquire/release, condition wait/notify, watched-field access — the
+driver parks the running worker and picks who runs next from a seeded
+RNG. The whole interleaving of a harness is then a pure function of the
+seed: *same seed ⇒ bit-identical schedule digest*, every failing seed is
+replayable, and a failing schedule can be **shrunk** to a minimal digest
+by deleting preemptions that don't matter.
+
+Mechanics
+---------
+- ``threading.Lock``/``RLock`` factories are patched for the duration of
+  a run (composing with — and restoring — the lockorder factory patch):
+  every lock created during harness setup/execution becomes cooperative.
+  A managed worker acquires by try-acquire + park; the driver wakes
+  blocked workers when the holder releases. Non-managed threads fall
+  through to the real primitive untouched.
+- ``Condition.wait``/``notify`` are patched the same way: a managed
+  waiter parks until a notify bumps the condition's generation (modelled
+  spurious wakeups stay legal); a *timed* waiter is additionally woken
+  when nothing else can run — modelling timeout expiry deterministically
+  instead of burning wall-clock.
+- Watched-field preemption rides a private :class:`~.raceguard.RaceGuard`
+  whose ``access_hook`` parks the worker — so the classic lost-update
+  interleaving (both threads read, then both write) is *forced*, not
+  hoped for.
+- A schedule **fails** on: harness ``check()`` assertion, an uncaught
+  worker exception, a deadlock (no runnable/wakeable worker), a step-
+  budget blowout, or any lockset race the run's RaceGuard confirmed.
+- **Determinism contract**: harness threads must not race *unmanaged*
+  threads on cooperative state (harnesses stub background workers out),
+  and must not branch on wall-clock deltas at preemption granularity.
+  Labels use creation sites and per-run condition indexes, never ``id()``.
+
+Deadlock note: a schedule that parks every worker (all blocked on locks
+whose holders are blocked) is itself a *finding* — the explorer reports
+it with every worker's last label instead of hanging the suite.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import sys
+import threading
+from dataclasses import dataclass, field
+
+from .lockorder import _REAL_LOCK, _REAL_RLOCK
+from .raceguard import RaceGuard
+
+_REAL_CV_WAIT = threading.Condition.wait
+_REAL_CV_NOTIFY = threading.Condition.notify
+
+_EXPLORE_MUTEX = _REAL_LOCK()  # one exploration at a time per process
+
+_tls = threading.local()
+
+# module-global active explorer (read by the cooperative primitives)
+_ACTIVE: "Explorer | None" = None
+
+
+class _Killed(BaseException):
+    """Raised inside parked workers during teardown — BaseException so
+    harness code's `except Exception` cannot swallow the unwind."""
+
+
+def _ctx():
+    exp = _ACTIVE
+    if exp is None or exp._killing:
+        return None, None
+    w = getattr(_tls, "worker", None)
+    if w is None or w.exp is not exp:
+        return None, None
+    return exp, w
+
+
+def _site(depth: int = 2) -> str:
+    f = sys._getframe(depth)
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+# -- cooperative primitives ---------------------------------------------------
+
+
+class CoopLock:
+    """Lock wrapper: cooperative for managed workers, transparent for
+    everyone else (incl. after the exploration that created it ends)."""
+
+    _factory = staticmethod(_REAL_LOCK)
+
+    def __init__(self, site: str):
+        self._inner = self._factory()
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        exp, w = _ctx()
+        if exp is None:
+            return self._inner.acquire(blocking, timeout)
+        if not blocking:
+            got = self._inner.acquire(False)
+            if got:
+                w.held.append(self._site)
+            return got
+        # a timed blocking acquire parks like any other (the holder must be
+        # schedulable to release); timeout expiry is modelled like timed
+        # cv waits — woken as 'timeout' only when nothing else can run
+        return exp._coop_acquire(w, self, timed=timeout >= 0)
+
+    def release(self) -> None:
+        self._inner.release()
+        exp, w = _ctx()
+        if exp is not None:
+            exp._coop_released(w, self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class CoopRLock(CoopLock):
+    _factory = staticmethod(_REAL_RLOCK)
+
+    def locked(self) -> bool:  # RLock has no .locked() pre-3.12
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    # Condition protocol (a Condition over this lock stays cooperative)
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        exp, w = _ctx()
+        if exp is not None:
+            w.held = [s for s in w.held if s != self._site]
+            exp._coop_released(w, self, pause=False)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        exp, w = _ctx()
+        if exp is None:
+            self._inner._acquire_restore(state)
+            return
+        count = state[0] if isinstance(state, tuple) else 1
+        while not self._inner.acquire(False):
+            w.blocked_on = self
+            exp._pause(w, f"blocked:{self._site}", "lockwait")
+        w.blocked_on = None
+        for _ in range(int(count) - 1):
+            self._inner.acquire(False)
+        w.held.append(self._site)
+
+
+def _coop_wait(cv, timeout=None):
+    exp, w = _ctx()
+    if exp is None:
+        return _REAL_CV_WAIT(cv, timeout)
+    return exp._cv_wait(w, cv, timeout)
+
+
+def _coop_notify(cv, n=1):
+    exp, _w = _ctx()
+    if exp is not None:
+        exp._cv_notified(cv)
+    return _REAL_CV_NOTIFY(cv, n)
+
+
+# -- worker / outcome ---------------------------------------------------------
+
+
+class _Gate:
+    """Binary semaphore on a REAL lock (``threading.Event`` would be built
+    from the patched cooperative Condition and recurse into the driver).
+    ``wait`` consumes one ``set``; strictly paired by the drive protocol."""
+
+    __slots__ = ("_lk",)
+
+    def __init__(self):
+        self._lk = _REAL_LOCK()
+        self._lk.acquire()  # start closed
+
+    def wait(self) -> None:
+        self._lk.acquire()
+
+    def set(self) -> None:
+        try:
+            self._lk.release()
+        except RuntimeError:
+            pass  # already open (teardown double-set)
+
+
+class _Worker:
+    __slots__ = ("name", "exp", "thread", "go", "parked", "state", "label",
+                 "held", "blocked_on", "cv", "timed", "wake_reason", "error")
+
+    def __init__(self, name: str, exp: "Explorer"):
+        self.name = name
+        self.exp = exp
+        self.thread: threading.Thread | None = None
+        self.go = _Gate()
+        self.parked = _Gate()
+        self.state = "ready"
+        self.label = "start"
+        self.held: list[str] = []
+        self.blocked_on = None
+        self.cv = None
+        self.timed = False
+        self.wake_reason = ""
+        self.error: BaseException | None = None
+
+
+@dataclass
+class Outcome:
+    """One explored schedule. ``digest`` is the stable identity of the
+    interleaving (sha256 over the grant sequence); ``decisions`` replays
+    it (`Explorer(replay=decisions)`)."""
+
+    seed: int | None
+    status: str  # ok | check | exception | deadlock | budget
+    error: str = ""
+    digest: str = ""
+    steps: int = 0
+    decisions: list = field(default_factory=list)
+    trace: list = field(default_factory=list)
+    races: list = field(default_factory=list)
+
+    @property
+    def failed(self) -> bool:
+        return self.status != "ok" or bool(self.races)
+
+    def summary(self) -> str:
+        what = self.status + (f" ({self.error})" if self.error else "")
+        if self.races:
+            what += f" races={self.races}"
+        return f"seed={self.seed} {what} steps={self.steps} digest={self.digest}"
+
+
+# -- the explorer -------------------------------------------------------------
+
+
+class Explorer:
+    """Drives one harness through one schedule (seeded or replayed)."""
+
+    def __init__(self, seed: int | None = None, replay: list | None = None,
+                 max_steps: int = 50_000):
+        if seed is None and replay is None:
+            raise ValueError("need a seed or a replay decision list")
+        self.seed = seed
+        self._rng = random.Random(seed if seed is not None else 0)
+        self._replay = list(replay) if replay is not None else None
+        self.max_steps = int(max_steps)
+        self._killing = False
+        self.workers: list[_Worker] = []
+        self.trace: list[tuple[str, str]] = []
+        self.decisions: list[str] = []
+        self._cv_gen: dict[int, int] = {}
+        self._cv_ids: dict[int, int] = {}
+        self._progress = False  # a lock was acquired since the last stall scan
+        self._last = None  # previously granted worker (replay fallback)
+
+    # -- worker-side hooks ----------------------------------------------------
+
+    def _pause(self, w: _Worker, label: str, state: str) -> None:
+        w.label = label
+        w.state = state
+        w.parked.set()
+        w.go.wait()
+        if self._killing:
+            raise _Killed()
+
+    def _coop_acquire(self, w: _Worker, lock: CoopLock,
+                      timed: bool = False) -> bool:
+        while True:
+            self._pause(w, f"acquire:{lock._site}", "ready")
+            if lock._inner.acquire(False):
+                w.held.append(lock._site)
+                self._progress = True
+                return True
+            w.blocked_on = lock
+            w.timed = timed
+            w.wake_reason = ""
+            self._pause(w, f"blocked:{lock._site}", "lockwait")
+            w.blocked_on = None
+            w.timed = False
+            if timed and w.wake_reason == "timeout":
+                return False  # modelled expiry: nothing else could run
+
+    def _coop_released(self, w: _Worker | None, lock: CoopLock,
+                       pause: bool = True) -> None:
+        if w is not None and lock._site in w.held:
+            for i in range(len(w.held) - 1, -1, -1):
+                if w.held[i] == lock._site:
+                    del w.held[i]
+                    break
+        for other in self.workers:
+            if other.state == "lockwait" and other.blocked_on is lock:
+                other.state = "ready"
+        if pause and w is not None:
+            self._pause(w, f"release:{lock._site}", "ready")
+
+    def _cv_label(self, cv) -> str:
+        idx = self._cv_ids.setdefault(id(cv), len(self._cv_ids))
+        return f"cv{idx}"
+
+    def _cv_wait(self, w: _Worker, cv, timeout) -> bool:
+        gen0 = self._cv_gen.get(id(cv), 0)
+        label = self._cv_label(cv)
+        state = cv._release_save()  # releasing the lock may itself pause
+        try:
+            # a notify may have landed during the release pause — parking
+            # then would be a missed wakeup (nothing would re-ready us)
+            if self._cv_gen.get(id(cv), 0) == gen0:
+                w.cv = cv
+                w.timed = timeout is not None
+                w.wake_reason = ""
+                self._pause(w, f"wait:{label}", "cvwait")
+            notified = (
+                self._cv_gen.get(id(cv), 0) != gen0
+                or w.wake_reason == "notify"
+            )
+        finally:
+            w.cv = None
+            w.timed = False
+            cv._acquire_restore(state)
+        return notified
+
+    def _cv_notified(self, cv) -> None:
+        self._cv_gen[id(cv)] = self._cv_gen.get(id(cv), 0) + 1
+        for other in self.workers:
+            if other.state == "cvwait" and other.cv is cv:
+                other.state = "ready"
+                other.wake_reason = "notify"
+
+    def _field_hook(self, cls_name: str, fld: str, is_write: bool) -> None:
+        exp, w = _ctx()
+        if exp is self and w is not None:
+            kind = "w" if is_write else "r"
+            self._pause(w, f"{kind}:{cls_name}.{fld}", "ready")
+
+    # -- patching -------------------------------------------------------------
+
+    def _install(self):
+        saved = (
+            threading.Lock, threading.RLock,
+            threading.Condition.wait, threading.Condition.notify,
+        )
+
+        def lock_factory():
+            return CoopLock(_site())
+
+        def rlock_factory():
+            return CoopRLock(_site())
+
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        threading.Condition.wait = _coop_wait
+        threading.Condition.notify = _coop_notify
+        return saved
+
+    @staticmethod
+    def _restore(saved) -> None:
+        (threading.Lock, threading.RLock,
+         threading.Condition.wait, threading.Condition.notify) = saved
+
+    # -- the drive loop -------------------------------------------------------
+
+    def run(self, harness) -> Outcome:
+        global _ACTIVE
+        with _EXPLORE_MUTEX:
+            from ..observability.tracer import TRACER
+            from ..utils.metrics import REGISTRY
+
+            guard = RaceGuard(
+                lockset_fn=lambda: tuple(getattr(_tls, "worker").held)
+                if getattr(_tls, "worker", None) is not None else (),
+                thread_filter=lambda: getattr(_tls, "worker", None) is not None,
+            )
+            guard.access_hook = self._field_hook
+            saved_telemetry = (REGISTRY.enabled, TRACER.enabled)
+            REGISTRY.enabled = TRACER.enabled = False
+            from .raceguard import RACEGUARD
+
+            saved_paused = RACEGUARD.paused
+            RACEGUARD.paused = True  # suite guard can't see coop locksets
+            saved = self._install()
+            _ACTIVE = self
+            try:
+                for cls, fields in getattr(harness, "watch", ()):
+                    guard.watch(cls, fields)
+                ctx = harness.setup()
+                outcome = self._drive(harness, ctx)
+            finally:
+                self._kill_stragglers()
+                _ACTIVE = None
+                self._restore(saved)
+                guard.unwatch_all()
+                RACEGUARD.paused = saved_paused
+                REGISTRY.enabled, TRACER.enabled = saved_telemetry
+            outcome.races = guard.report()
+            if outcome.status == "ok" and outcome.races:
+                outcome.error = "; ".join(outcome.races)
+            if outcome.status == "ok" and outcome.error == "":
+                try:
+                    harness.check(ctx)
+                except AssertionError as e:
+                    outcome.status = "check"
+                    outcome.error = str(e) or "harness check failed"
+            outcome.digest = self._digest()
+            outcome.decisions = self.decisions
+            outcome.trace = self.trace
+            return outcome
+
+    def _drive(self, harness, ctx) -> Outcome:
+        for name, fn in harness.threads(ctx):
+            w = _Worker(name, self)
+            w.thread = threading.Thread(
+                target=self._worker_main, args=(w, fn),
+                name=f"interleave-{name}", daemon=True,
+            )
+            self.workers.append(w)
+        for w in self.workers:
+            w.thread.start()
+            w.parked.wait()  # workers park at 'start' before running
+        steps = 0
+        stall_retry = False
+        while True:
+            live = [w for w in self.workers if w.state != "done"]
+            if not live:
+                status, err = "ok", ""
+                break
+            ready = [w for w in live if w.state == "ready"]
+            if not ready:
+                timed = [
+                    w for w in live
+                    if w.state in ("cvwait", "lockwait") and w.timed
+                ]
+                if timed:
+                    for w in timed:
+                        w.state = "ready"
+                        w.wake_reason = "timeout"
+                    continue
+                lockers = [w for w in live if w.state == "lockwait"]
+                if lockers and not stall_retry:
+                    # one deterministic re-probe round: with no unmanaged
+                    # threads, lock states cannot change while everyone is
+                    # parked — if nobody acquires, it is a real deadlock
+                    stall_retry = True
+                    self._progress = False
+                    for w in lockers:
+                        w.state = "ready"
+                    continue
+                status = "deadlock"
+                err = "; ".join(
+                    f"{w.name}@{w.label} holds {w.held}" for w in live
+                )
+                break
+            if steps >= self.max_steps:
+                status, err = "budget", f"exceeded {self.max_steps} steps"
+                break
+            w = self._choose(ready)
+            if self._progress:
+                stall_retry = False
+            self.trace.append((w.name, w.label))
+            self.decisions.append(w.name)
+            steps += 1
+            self._last = w
+            w.state = "running"
+            w.go.set()
+            w.parked.wait()
+        errors = [w for w in self.workers if w.error is not None]
+        if errors and status == "ok":
+            status = "exception"
+            err = "; ".join(f"{w.name}: {w.error!r}" for w in errors)
+        return Outcome(self.seed, status, error=err, steps=steps)
+
+    def _choose(self, ready: list[_Worker]) -> _Worker:
+        if self._replay is not None:
+            if len(self.decisions) < len(self._replay):
+                name = self._replay[len(self.decisions)]
+                for w in ready:
+                    if w.name == name:
+                        return w
+            # past (or off) the script: run-to-completion — stay on the
+            # last-granted worker when possible, else first by position
+            if self._last is not None and self._last in ready:
+                return self._last
+            return ready[0]
+        if len(ready) == 1:
+            return ready[0]
+        return ready[self._rng.randrange(len(ready))]
+
+    def _worker_main(self, w: _Worker, fn) -> None:
+        _tls.worker = w
+        try:
+            self._pause(w, "start", "ready")
+            fn()
+        except _Killed:
+            pass
+        except BaseException as e:  # noqa: BLE001 — reported as the outcome
+            w.error = e
+        finally:
+            _tls.worker = None
+            w.state = "done"
+            w.parked.set()
+
+    def _kill_stragglers(self) -> None:
+        self._killing = True
+        for w in self.workers:
+            if w.state != "done":
+                w.go.set()
+        for w in self.workers:
+            if w.thread is not None:
+                w.thread.join(timeout=5.0)
+
+    def _digest(self) -> str:
+        h = hashlib.sha256()
+        for name, label in self.trace:
+            h.update(f"{name}:{label}\n".encode())
+        return h.hexdigest()[:16]
+
+
+# -- exploration / shrinking helpers ------------------------------------------
+
+
+def sweep(harness_factory, seeds, max_steps: int = 50_000):
+    """Run each seed; returns (outcomes, first failing outcome or None)."""
+    outcomes = []
+    for seed in seeds:
+        out = Explorer(seed=seed, max_steps=max_steps).run(harness_factory())
+        outcomes.append(out)
+        if out.failed:
+            return outcomes, out
+    return outcomes, None
+
+
+def replay(harness_factory, decisions, seed=None, max_steps: int = 50_000):
+    out = Explorer(seed=seed, replay=decisions, max_steps=max_steps).run(
+        harness_factory()
+    )
+    out.seed = seed
+    return out
+
+
+def _switches(decisions: list) -> int:
+    return sum(1 for a, b in zip(decisions, decisions[1:]) if a != b)
+
+
+def shrink(harness_factory, outcome: Outcome, budget: int = 200) -> Outcome:
+    """Greedily delete preemptions from a failing schedule: at every point
+    where the grant switched workers, try staying on the previous worker
+    and truncating the rest (run-to-completion fallback). A candidate is
+    kept when it still fails with strictly fewer context switches (ties
+    broken by length); the fixpoint is the minimal schedule and its digest
+    is the race's stable identity across runs."""
+    best = outcome
+    changed = True
+    while changed and budget > 0:
+        changed = False
+        i = 1
+        while i < len(best.decisions) and budget > 0:
+            d = best.decisions
+            if d[i] != d[i - 1]:
+                cand = replay(
+                    harness_factory, d[:i] + [d[i - 1]], seed=best.seed
+                )
+                budget -= 1
+                if cand.failed and (
+                    _switches(cand.decisions), len(cand.decisions)
+                ) < (_switches(best.decisions), len(best.decisions)):
+                    best = cand
+                    changed = True
+                    continue
+            i += 1
+    return best
+
+
+def find_and_shrink(harness_factory, max_seeds: int = 64,
+                    max_steps: int = 50_000):
+    """Seeds 0..max_seeds-1 until one fails, then shrinks it.
+    Returns (failing seed outcome or None, shrunk outcome or None)."""
+    _outcomes, failing = sweep(harness_factory, range(max_seeds), max_steps)
+    if failing is None:
+        return None, None
+    return failing, shrink(harness_factory, failing)

@@ -152,7 +152,7 @@ class PBFTEngine:
         reference's waterlines check)."""
         return self.committed_number < number <= self.committed_number + self.MAX_AHEAD
 
-    def _cache(self, number: int) -> ProposalCache:
+    def _cache_locked(self, number: int) -> ProposalCache:
         return self._caches.setdefault(number, ProposalCache())
 
     def _block_ctx(self, number: int, cache: ProposalCache):
@@ -295,7 +295,7 @@ class PBFTEngine:
         if msg.generated_from != self.config.leader_index(msg.number, msg.view):
             _log.warning("pre-prepare from non-leader %d", msg.generated_from)
             return False
-        cache = self._cache(msg.number)
+        cache = self._cache_locked(msg.number)
         if cache.pre_prepare is not None:
             # accepting a SECOND proposal for the same (number, view) and
             # voting again is equivocation — PBFT safety forbids it
@@ -320,7 +320,7 @@ class PBFTEngine:
             if not self._pre_prepare_gate(msg):
                 return
             leader = self.config.node_at(msg.generated_from)
-            bctx = self._block_ctx(msg.number, self._cache(msg.number))
+            bctx = self._block_ctx(msg.number, self._cache_locked(msg.number))
         # decode + verify + tx fill run OUTSIDE the lock: the metadata fetch
         # can block on tx-sync for seconds, and votes/other handlers must
         # keep flowing meanwhile (the reference verifies on txpool threads).
@@ -363,7 +363,7 @@ class PBFTEngine:
                     )
                     return
                 self.cstore.save_vote(msg.number, msg.view, msg.proposal_hash)
-            cache = self._cache(msg.number)
+            cache = self._cache_locked(msg.number)
             cache.pre_prepare = msg
             cache.block = block
             cache.block_data = block.encode()  # accept-time snapshot
@@ -465,7 +465,7 @@ class PBFTEngine:
         with self._lock:
             if not self._in_waterline(msg.number) or msg.view != self.view:
                 return
-            cache = self._cache(msg.number)
+            cache = self._cache_locked(msg.number)
             cache.prepares[msg.generated_from] = msg  # buffered even pre-proposal
             self._check_prepared_quorum(msg.number, cache)
 
@@ -473,7 +473,7 @@ class PBFTEngine:
         with self._lock:
             if not self._in_waterline(msg.number) or msg.view != self.view:
                 return
-            cache = self._cache(msg.number)
+            cache = self._cache_locked(msg.number)
             cache.commits[msg.generated_from] = msg
             self._check_commit_quorum(msg.number, cache)
 
@@ -585,7 +585,7 @@ class PBFTEngine:
         with self._lock:
             if not self._in_waterline(msg.number):
                 return
-            cache = self._cache(msg.number)
+            cache = self._cache_locked(msg.number)
             cache.checkpoints[msg.generated_from] = msg
             if cache.stable or cache.executed_header is None:
                 return
@@ -749,7 +749,7 @@ class PBFTEngine:
             self._sign(nv)
             self._broadcast(nv)
             self._lock_view_to_prepared(msg.view, list(votes.values()))
-            self._enter_view(msg.view)
+            self._enter_view_locked(msg.view)
             self._repropose_from(votes)
 
     def _handle_new_view(self, msg: PBFTMessage) -> None:
@@ -783,7 +783,7 @@ class PBFTEngine:
                 _log.warning("new-view %d with insufficient proof", msg.view)
                 return
             self._lock_view_to_prepared(msg.view, valid_vcs)
-            self._enter_view(msg.view)
+            self._enter_view_locked(msg.view)
 
     def _verified_prepared(
         self, payload: ViewChangePayload
@@ -847,7 +847,7 @@ class PBFTEngine:
         _view, block, proposal_hash = best
         self._view_locks[view] = (block.header.number, proposal_hash)
 
-    def _enter_view(self, view: int) -> None:
+    def _enter_view_locked(self, view: int) -> None:
         self.view = view
         self.to_view = view
         self.timeout_state = False
@@ -921,7 +921,7 @@ class PBFTEngine:
             }
             if self._weight(agreeing) >= self.config.quorum and msg.view > self.view:
                 self._recover_responses.clear()
-                self._enter_view(msg.view)
+                self._enter_view_locked(msg.view)
 
     def request_recover(self) -> None:
         with self._lock:

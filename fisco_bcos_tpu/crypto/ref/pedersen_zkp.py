@@ -82,6 +82,8 @@ def default_blinding_base():
     """H = hash-to-point of a fixed tag (nothing-up-my-sleeve: nobody knows
     log_G(H), which Pedersen hiding requires)."""
     global _H_CACHE
+    # analysis: allow(atomicity, idempotent memo — the derivation is
+    # deterministic, racing initializers compute the identical point)
     if _H_CACHE is None:
         ctr = 0
         while True:

@@ -265,6 +265,8 @@ def device_backend_is_cpu() -> bool:
     should prefer the host path regardless of _SMALL_BATCH. Cached: backend
     identity cannot change within a process."""
     global _BACKEND_IS_CPU
+    # analysis: allow(atomicity, idempotent memo — racing initializers both
+    # compute the same immutable backend identity, last write wins harmlessly)
     if _BACKEND_IS_CPU is None:
         try:
             import jax
@@ -284,6 +286,7 @@ def use_native_batch(n: int) -> bool:
 # -- device-path circuit breaker (resilience/) -------------------------------
 
 _DEVICE_BREAKER = None
+_DEVICE_BREAKER_LOCK = threading.Lock()
 
 
 def _device_breaker():
@@ -298,10 +301,14 @@ def _device_breaker():
     if _DEVICE_BREAKER is None:
         from ..resilience import CircuitBreaker
 
-        _DEVICE_BREAKER = CircuitBreaker(
-            "device-crypto", failure_threshold=2, reset_timeout=60.0,
-            critical=False,  # the host loop keeps serving: slower, not down
-        )
+        # double-checked: two racing callers must end up sharing ONE breaker
+        # — split breakers would each see half the failures and never trip
+        with _DEVICE_BREAKER_LOCK:
+            if _DEVICE_BREAKER is None:
+                _DEVICE_BREAKER = CircuitBreaker(
+                    "device-crypto", failure_threshold=2, reset_timeout=60.0,
+                    critical=False,  # host loop keeps serving: slower, not down
+                )
     return _DEVICE_BREAKER
 
 

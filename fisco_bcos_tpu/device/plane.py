@@ -219,7 +219,12 @@ class DevicePlane:
         self._deficit: dict[str, float] = {}
         self._drr_rotor = 0  # rotates the serving order across dispatches
         self._autostart = autostart
-        self._cv = threading.Condition()
+        # Condition over an EXPLICIT package-created RLock: a bare
+        # Condition() allocates its lock inside threading.py, which the
+        # lock-order factory filter skips — this way the plane's guard
+        # participates in runtime lock-order recording and the raceguard
+        # lockset, like every other package lock
+        self._cv = threading.Condition(threading.RLock())
         self._pending: dict[str, list[PlaneRequest]] = {}
         self._exec_fns: dict[str, Callable] = {}
         self._thread: threading.Thread | None = None
@@ -264,7 +269,7 @@ class DevicePlane:
             self.requests += 1
             self.items += req.n
             if self._autostart:
-                self._ensure_thread()
+                self._ensure_thread_locked()
             self._cv.notify_all()
         from ..utils.metrics import REGISTRY
 
@@ -277,7 +282,7 @@ class DevicePlane:
 
     # -- scheduler -----------------------------------------------------------
 
-    def _ensure_thread(self) -> None:
+    def _ensure_thread_locked(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._run, name="device-plane", daemon=True
@@ -288,7 +293,7 @@ class DevicePlane:
         age_ms = (now - reqs[0].t_enq) * 1e3
         return age_ms >= self.window_ms or sum(r.n for r in reqs) >= self.high_water
 
-    def _pick_ready(self, now: float):
+    def _pick_ready_locked(self, now: float):
         """Pop the dispatch-ready op group with the best claim, or None.
 
         Ready = window elapsed since the group's oldest request, or item
@@ -423,7 +428,7 @@ class DevicePlane:
             with self._cv:
                 picked = None
                 while picked is None:
-                    picked = self._pick_ready(time.perf_counter())
+                    picked = self._pick_ready_locked(time.perf_counter())
                     if picked is None:
                         self._cv.wait(self._next_timeout_s(time.perf_counter()))
                 op, reqs, deferred = picked

@@ -87,11 +87,16 @@ class LeaderElection:
         """Start campaigning; returns current leadership immediately and
         keeps renewing/retrying on the keepalive thread."""
         self._set_leader(self._try_claim())
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._keepalive, name=f"election-{self.key}", daemon=True
-            )
-            self._thread.start()
+        # spawn under the lock: two concurrent campaign() calls must not
+        # each start a keepalive thread (double renewals would hammer the
+        # store and fight over the lease)
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._keepalive, name=f"election-{self.key}",
+                    daemon=True,
+                )
+                self._thread.start()
         return self._leader
 
     def _keepalive(self) -> None:
@@ -143,9 +148,13 @@ class LeaderElection:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        # claim the thread under the lock, join OUTSIDE it — the keepalive
+        # thread takes self._lock in _try_claim, so joining under the lock
+        # would deadlock against the very thread being joined
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
         self.resign()
         with self._lock:
             self._conn.close()

@@ -30,14 +30,14 @@ class TokenBucketRateLimiter:
         self._last = time.monotonic()
         self._lock = threading.Lock()
 
-    def _refill(self) -> None:
+    def _refill_locked(self) -> None:
         now = time.monotonic()
         self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
         self._last = now
 
     def try_acquire(self, tokens: float = 1.0) -> bool:
         with self._lock:
-            self._refill()
+            self._refill_locked()
             if tokens <= self._tokens:
                 self._tokens -= tokens
                 return True
@@ -45,7 +45,7 @@ class TokenBucketRateLimiter:
 
     def available(self) -> float:
         with self._lock:
-            self._refill()
+            self._refill_locked()
             return self._tokens
 
 

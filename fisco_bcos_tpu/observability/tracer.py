@@ -294,6 +294,7 @@ class Tracer:
     def _drop(self, reason: str) -> None:
         # benign-race int bump: a lost increment under contention is noise,
         # a lock here would tax every sampled-out span
+        # analysis: allow(guarded-state, deliberate lock-free fast path)
         self._dropped[reason] = self._dropped.get(reason, 0) + 1
 
     def drop_counts(self) -> dict:

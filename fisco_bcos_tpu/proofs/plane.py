@@ -174,13 +174,19 @@ class ProofPlane:
             retry: list[int] = []
             with self._lock:
                 memo = [self._hash2num.get(h) for h in hashes]
+            fresh: list[tuple[bytes, int]] = []
             for i, (h, number) in enumerate(zip(hashes, memo)):
                 if number is None:
                     number = self._locate(h)
                     if number is None:
                         continue
-                    self._memo_height(h, number)
+                    fresh.append((h, number))
                 by_height.setdefault(number, []).append(i)
+            if fresh:
+                # one lock round for the whole batch's new locations — a
+                # 1024-hash cold batch previously took the plane lock per
+                # hash, interleaving with writers each time
+                self._memo_many(fresh)
             for number, idxs in by_height.items():
                 ent = self._tree(number, kind)
                 for i in idxs:
@@ -253,8 +259,12 @@ class ProofPlane:
         return None if rc is None else rc.block_number
 
     def _memo_height(self, tx_hash: bytes, number: int) -> None:
+        self._memo_many([(tx_hash, number)])
+
+    def _memo_many(self, pairs: list[tuple[bytes, int]]) -> None:
         with self._lock:
-            self._hash2num[tx_hash] = number
+            for tx_hash, number in pairs:
+                self._hash2num[tx_hash] = number
             while len(self._hash2num) > self._hash2num_cap:
                 self._hash2num.popitem(last=False)
 

@@ -67,6 +67,7 @@ class Scheduler:
         backend: TransactionalStorage,
         suite: CryptoSuite,
         txpool=None,
+        notify_worker=None,
     ):
         self.executor = executor
         self.ledger = ledger
@@ -92,8 +93,13 @@ class Scheduler:
         # PBFT engine under ITS lock, and a listener doing network I/O (ws
         # block notify to a stalled client) must never stall consensus.
         # Started here — commit_block has two concurrent callers (engine,
-        # block sync) and Worker.start is not thread-safe
-        self._notify = Worker("commit-notify")
+        # block sync) and Worker.start is not thread-safe. `notify_worker`
+        # is the injection seam for deterministic tests (the interleave
+        # scheduler harness posts inline: no unmanaged thread may race a
+        # seeded schedule).
+        self._notify = (
+            notify_worker if notify_worker is not None else Worker("commit-notify")
+        )
         self._notify.start()
 
     def stop(self) -> None:

@@ -302,7 +302,7 @@ class ServiceClient:
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
 
-    def _drop_sock(self) -> None:
+    def _drop_sock_locked(self) -> None:
         if self.sock is not None:
             try:
                 self.sock.close()
@@ -373,7 +373,7 @@ class ServiceClient:
             except OSError:
                 body = None
             if body is None:
-                self._drop_sock()
+                self._drop_sock_locked()
             elif deadline is not None:
                 self.sock.settimeout(self._timeout)  # restore for next call
         if bad is not None:
@@ -388,14 +388,14 @@ class ServiceClient:
             r.done()
         except Exception as e:
             with self._lock:
-                self._drop_sock()  # reply stream is garbage: resync by redial
+                self._drop_sock_locked()  # reply stream is garbage: resync by redial
             raise BadFrame(f"{method}: undecodable reply ({e})")
         if got_id != req_id:
             # a stale reply (e.g. a duplicated request's second answer) has
             # desynced the pipeline; drop the socket so the next call starts
             # from a clean stream instead of shifting every reply by one
             with self._lock:
-                self._drop_sock()
+                self._drop_sock_locked()
             raise BadFrame(f"{method}: response id mismatch")
         if not ok:
             raise ServiceRemoteError(f"{method}: {out.decode(errors='replace')}")
@@ -403,4 +403,4 @@ class ServiceClient:
 
     def close(self) -> None:
         with self._lock:
-            self._drop_sock()
+            self._drop_sock_locked()

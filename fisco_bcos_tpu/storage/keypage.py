@@ -77,7 +77,7 @@ class KeyPageStorage(TransactionalStorage):
 
     # -- page plumbing --------------------------------------------------------
 
-    def _meta(self, table: str) -> list[bytes]:
+    def _meta_locked(self, table: str) -> list[bytes]:
         cached = self._meta_cache.get(table)
         if cached is not None:
             return list(cached)
@@ -88,7 +88,7 @@ class KeyPageStorage(TransactionalStorage):
         self._meta_cache[table] = list(starts)
         return starts
 
-    def _save_meta(self, table: str, starts: list[bytes]) -> None:
+    def _save_meta_locked(self, table: str, starts: list[bytes]) -> None:
         self._meta_cache[table] = list(starts)
         self.inner.set_row(META_TABLE, table.encode(), Entry({"value": _encode_meta(starts)}))
 
@@ -96,7 +96,7 @@ class KeyPageStorage(TransactionalStorage):
     def _page_key(table: str, start: bytes) -> bytes:
         return table.encode() + b"\x00" + start
 
-    def _load_page(self, table: str, start: bytes) -> list[tuple[bytes, Entry]]:
+    def _load_page_locked(self, table: str, start: bytes) -> list[tuple[bytes, Entry]]:
         pk = (table, start)
         cached = self._page_cache.get(pk)
         if cached is not None:
@@ -108,7 +108,7 @@ class KeyPageStorage(TransactionalStorage):
         self._page_cache[pk] = list(items)
         return items
 
-    def _save_page(self, table: str, start: bytes, items: list[tuple[bytes, Entry]]) -> None:
+    def _save_page_locked(self, table: str, start: bytes, items: list[tuple[bytes, Entry]]) -> None:
         if len(self._page_cache) >= self._CACHE_MAX_PAGES:
             self._page_cache.clear()
         self._page_cache[(table, start)] = list(items)
@@ -123,7 +123,7 @@ class KeyPageStorage(TransactionalStorage):
         i = bisect.bisect_right(starts, key) - 1
         return max(i, 0)
 
-    def _delete_page_row(self, table: str, start: bytes) -> None:
+    def _delete_page_row_locked(self, table: str, start: bytes) -> None:
         self._page_cache.pop((table, start), None)
         self.inner.set_row(
             PAGE_TABLE,
@@ -175,11 +175,11 @@ class KeyPageStorage(TransactionalStorage):
     def get_row(self, table: str, key: bytes) -> Entry | None:
         key = bytes(key)
         with self._lock:
-            starts = self._meta(table)
+            starts = self._meta_locked(table)
             idx = self._page_for(starts, key)
             if idx is None:
                 return None
-            for k, e in self._load_page(table, starts[idx]):
+            for k, e in self._load_page_locked(table, starts[idx]):
                 if k == key:
                     return None if e.deleted else e.copy()
         return None
@@ -192,7 +192,7 @@ class KeyPageStorage(TransactionalStorage):
         page-grouping the 2PC prepare path uses) — a per-row path would
         re-codec a whole page per row, ~1000x slower for bulk loads."""
         with self._lock:
-            starts = self._meta(table)
+            starts = self._meta_locked(table)
             meta_dirty = False
             # per-page pending writes as a dict (last write wins), merged
             # into the decoded page ONCE at write-out — per-item list
@@ -206,24 +206,24 @@ class KeyPageStorage(TransactionalStorage):
                 start = starts[self._page_for(starts, key)]
                 staged.setdefault(start, {})[key] = entry.copy()
             for start, pending in staged.items():
-                merged = {k: e for k, e in self._load_page(table, start)}
+                merged = {k: e for k, e in self._load_page_locked(table, start)}
                 merged.update(pending)
                 ops, dirty = self._chunk_page(start, sorted(merged.items()), starts)
                 meta_dirty |= dirty
                 for cstart, chunk in ops:
                     if chunk is None:
-                        self._delete_page_row(table, cstart)
+                        self._delete_page_row_locked(table, cstart)
                     else:
-                        self._save_page(table, cstart, chunk)
+                        self._save_page_locked(table, cstart, chunk)
             if meta_dirty:
-                self._save_meta(table, starts)
+                self._save_meta_locked(table, starts)
 
     def get_primary_keys(self, table: str) -> list[bytes]:
         out: list[bytes] = []
         with self._lock:
-            for start in self._meta(table):
+            for start in self._meta_locked(table):
                 out.extend(
-                    k for k, e in self._load_page(table, start) if not e.deleted
+                    k for k, e in self._load_page_locked(table, start) if not e.deleted
                 )
         return out
 
@@ -257,7 +257,7 @@ class KeyPageStorage(TransactionalStorage):
             for table, key, entry in writes.traverse():
                 key = bytes(key)
                 if table not in metas:  # setdefault would re-copy per row
-                    metas[table] = self._meta(table)
+                    metas[table] = self._meta_locked(table)
                 starts = metas[table]
                 idx = self._page_for(starts, key)
                 if idx is None:
@@ -271,7 +271,7 @@ class KeyPageStorage(TransactionalStorage):
             rows: list[tuple[str, bytes, Entry]] = []
             for (table, start), pending in staged.items():
                 starts = metas[table]
-                merged = {k: e for k, e in self._load_page(table, start)}
+                merged = {k: e for k, e in self._load_page_locked(table, start)}
                 merged.update(pending)
                 ops, _dirty = self._chunk_page(start, sorted(merged.items()), starts)
                 for cstart, chunk in ops:

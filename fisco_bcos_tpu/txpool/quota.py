@@ -149,10 +149,10 @@ class AdmissionQuotas:
         state survives reconfiguration — a demoted spammer must not be
         amnestied by an operator retuning the rate."""
         with self._lock:
-            st = self._group(group)
+            st = self._group_locked(group)
             st.bucket = self._make_bucket(rate, burst)
 
-    def _group(self, group: str) -> _GroupState:
+    def _group_locked(self, group: str) -> _GroupState:
         st = self._groups.get(group)
         if st is None:
             st = self._groups[group] = _GroupState(
@@ -167,7 +167,7 @@ class AdmissionQuotas:
         if n <= 0:
             return 0
         with self._lock:
-            st = self._group(group)
+            st = self._group_locked(group)
             bucket = st.bucket
         if bucket is None:
             return n
@@ -205,7 +205,7 @@ class AdmissionQuotas:
     def count_demoted_drop(self, group: str, n: int) -> None:
         """Account txs refused because their source is demoted."""
         with self._lock:
-            st = self._group(group)
+            st = self._group_locked(group)
         self._count_shed(group, st, "demoted", n)
 
     def note_invalid(self, group: str, source: str, n_invalid: int) -> None:
@@ -216,7 +216,7 @@ class AdmissionQuotas:
         now = time.monotonic()
         demote = False
         with self._lock:
-            st = self._group(group)
+            st = self._group_locked(group)
             dq = st.strikes.setdefault(source, deque())
             dq.append(now)
             while dq and now - dq[0] > self.strike_window_s:
@@ -260,7 +260,7 @@ class AdmissionQuotas:
         from ..resilience import HEALTH
 
         with self._lock:
-            st = self._group(group)
+            st = self._group_locked(group)
             first = not st.shedding
             st.shedding = True
         if first:

@@ -304,6 +304,8 @@ class TxPool:
     def _insert(self, tx: Transaction, h: bytes, persist: bool = True) -> None:
         with self._lock:
             self._txs[h] = tx
+        # analysis: allow(guarded-state, TxPoolNonceChecker is internally
+        # locked — the pool lock guards _txs, not the nonce set)
         self.pool_nonces.insert(tx.nonce)
         if persist and self.pstore is not None:
             from ..storage.entry import Entry

@@ -8,18 +8,25 @@ gauges. We emit the same shape: ``[badge] desc|k1=v1|k2=v2``.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Any
 
 _FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
 _configured = False
+_CONFIGURE_LOCK = threading.Lock()
 
 
 def _configure() -> None:
     global _configured
     if not _configured:
-        logging.basicConfig(level=logging.INFO, format=_FORMAT)
-        _configured = True
+        # double-checked: basicConfig is NOT idempotent when two threads
+        # race it before the root logger has handlers (duplicate handlers
+        # double every log line from then on)
+        with _CONFIGURE_LOCK:
+            if not _configured:
+                logging.basicConfig(level=logging.INFO, format=_FORMAT)
+                _configured = True
 
 
 def get_logger(name: str) -> logging.Logger:

@@ -42,8 +42,12 @@ class NodeTimeMaintenance:
                 return
             self._offsets[peer] = offset
             median = int(statistics.median(self._offsets.values()))
-        if abs(median) > MAX_OFFSET_MS and not self._warned:
-            self._warned = True
+            # test-and-set under the lock: two samples crossing the
+            # threshold together must produce ONE warning, not two
+            warn = abs(median) > MAX_OFFSET_MS and not self._warned
+            if warn:
+                self._warned = True
+        if warn:
             _log.warning(
                 "local clock is %d ms off the peer median — fix NTP "
                 "(consensus timestamps will look invalid to peers)",

@@ -67,6 +67,25 @@ if _LOCKORDER:
         ),
     }
 
+# Sampling lockset race recorder (analysis/raceguard.py): watches the hot
+# shared-state classes' field traffic suite-wide and fails the session on
+# lockset violations. Default OFF — the __getattribute__ instrumentation
+# costs real time and tier-1 already runs against its timeout (see the
+# tier1-timing-budget note); enable locally with FISCO_RACEGUARD=1.
+_RACEGUARD = os.environ.get("FISCO_RACEGUARD", "0") == "1"
+if _RACEGUARD:
+    if not _LOCKORDER:
+        # the guard's locksets COME FROM the lockorder recorder: without
+        # the factory patch every access reads as lock-free and the whole
+        # session fails on false races — refuse loudly instead
+        raise RuntimeError(
+            "FISCO_RACEGUARD=1 requires the lockorder recorder "
+            "(unset FISCO_LOCKORDER=0)"
+        )
+    from fisco_bcos_tpu.analysis import raceguard as _raceguard
+
+    _raceguard.install()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -109,6 +128,21 @@ def _lockorder_enforcement():
     assert not viol, (
         "blocking RPC IO performed while holding a lock during the test "
         f"suite: {viol}"
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _raceguard_enforcement():
+    """When FISCO_RACEGUARD=1, fail the session on any lockset violation
+    the suite's real field traffic produced (the dynamic complement of the
+    guarded-state checker — see docs/static_analysis.md)."""
+    yield
+    if not _RACEGUARD:
+        return
+    races = _raceguard.RACEGUARD.report()
+    assert not races, (
+        "raceguard lockset violations recorded during the test suite "
+        "(no single lock protected every access):\n" + "\n".join(races)
     )
 
 

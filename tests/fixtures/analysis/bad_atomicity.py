@@ -1,0 +1,30 @@
+"""Fixture: every atomicity rule — lock-free check-then-act on a shared
+container, test-then-assign lazy init, and an unlocked module singleton."""
+
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+        self._started = False
+
+    def check_then_act(self, k):
+        if k in self._cache:  # check-then-act-_cache
+            return self._cache[k]
+        return None
+
+    def start(self):
+        if not self._started:  # racy-lazy-init-_started
+            self._started = True
+
+
+_SINGLETON = None
+
+
+def get_singleton():
+    global _SINGLETON
+    if _SINGLETON is None:  # unlocked-lazy-init-_SINGLETON
+        _SINGLETON = Cache()
+    return _SINGLETON

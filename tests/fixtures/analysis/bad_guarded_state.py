@@ -1,0 +1,27 @@
+"""Fixture: every guarded-state rule — unguarded write, unguarded RMW,
+and a guarded mutable container escaping by reference."""
+
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0
+        self._items = {}
+
+    def guarded(self, n):
+        with self._lock:
+            self.count = 1  # claims `count`
+            self.total += n  # claims `total`
+            self._items[n] = n  # claims `_items`
+
+    def racy_write(self):
+        self.count = 0  # unguarded-write-count
+
+    def racy_rmw(self, n):
+        self.total += n  # unguarded-rmw-total
+
+    def escape(self):
+        return self._items  # escape-_items (live reference leaves the guard)

@@ -240,12 +240,12 @@ def test_priority_lanes_and_starvation_ordering():
 
     now = time.perf_counter()
     plane.starvation_ms = 60_000  # nothing starved: lane order decides
-    op, reqs, _def = plane._pick_ready(now)
+    op, reqs, _def = plane._pick_ready_locked(now)
     assert op == "op.cons" and reqs[0].lane == "consensus"
     plane._pending[op] = reqs  # put it back
 
     plane.starvation_ms = 0.001  # everything starved: oldest group first
-    op, _reqs, _def = plane._pick_ready(now)
+    op, _reqs, _def = plane._pick_ready_locked(now)
     assert op == "op.sync"
 
 
@@ -362,7 +362,7 @@ def test_single_group_selection_unchanged():
             plane.submit("op", [i], 60, _noop_exec)  # 300 items >> high_water
     import time
 
-    op, taken, deferred = plane._pick_ready(time.perf_counter())
+    op, taken, deferred = plane._pick_ready_locked(time.perf_counter())
     assert op == "op" and len(taken) == 5 and deferred == []
 
 
@@ -381,7 +381,7 @@ def test_drr_bounds_abusive_group_and_serves_victim():
     with device_group("victim"):
         plane.submit("op", ["v"], 50, _noop_exec)
 
-    op, taken, deferred = plane._pick_ready(time.perf_counter())
+    op, taken, deferred = plane._pick_ready_locked(time.perf_counter())
     groups_taken = [r.group for r in taken]
     assert "victim" in groups_taken  # served in the first dispatch
     items = sum(r.n for r in taken)
@@ -407,7 +407,7 @@ def test_drr_drains_abuser_eventually_and_resets_deficit():
         plane.submit("op", ["b0"], 50, _noop_exec)
     seen_payloads = []
     for _ in range(10):
-        picked = plane._pick_ready(time.perf_counter())
+        picked = plane._pick_ready_locked(time.perf_counter())
         if picked is None:
             break
         _op, taken, _deferred = picked
@@ -434,7 +434,7 @@ def test_drr_weights_shift_share():
     with device_group("basic"):
         for i in range(20):
             plane.submit("op", [f"b{i}"], 25, _noop_exec)
-    _op, taken, deferred = plane._pick_ready(time.perf_counter())
+    _op, taken, deferred = plane._pick_ready_locked(time.perf_counter())
     gold = sum(r.n for r in taken if r.group == "gold")
     basic = sum(r.n for r in taken if r.group == "basic")
     assert deferred  # contention actually happened
@@ -454,7 +454,7 @@ def test_drr_respects_lane_priority_between_groups():
             plane.submit("op", [i], 60, _noop_exec)
     with device_group("chain"), device_lane("consensus"):
         plane.submit("op", ["qc"], 10, _noop_exec)
-    _op, taken, _deferred = plane._pick_ready(time.perf_counter())
+    _op, taken, _deferred = plane._pick_ready_locked(time.perf_counter())
     assert taken[0].lane == "consensus" and taken[0].group == "chain"
 
 

@@ -37,9 +37,11 @@ from fisco_bcos_tpu.analysis import (
 )
 from fisco_bcos_tpu.analysis.checkers import (
     ALL_CHECKERS,
+    AtomicityChecker,
     ContractChecker,
     DeviceDispatchChecker,
     ExceptionHygieneChecker,
+    GuardedStateChecker,
     JitPurityChecker,
     LockOrderChecker,
     ShapeBucketChecker,
@@ -158,6 +160,22 @@ def test_fixture_contracts(fixture_findings):
     assert base + "rpc-unclassified-totally_unclassified" in got
     assert base + "span-not-closed-span" in got
     assert base + "adhoc-latency-buckets-fixture_latency_ms" in got
+
+
+def test_fixture_guarded_state(fixture_findings):
+    got = _keys(fixture_findings, "guarded-state")
+    base = "guarded-state:tests/fixtures/analysis/bad_guarded_state.py:"
+    assert base + "Stats.racy_write:unguarded-write-count" in got
+    assert base + "Stats.racy_rmw:unguarded-rmw-total" in got
+    assert base + "Stats.escape:escape-_items" in got
+
+
+def test_fixture_atomicity(fixture_findings):
+    got = _keys(fixture_findings, "atomicity")
+    base = "atomicity:tests/fixtures/analysis/bad_atomicity.py:"
+    assert base + "Cache.check_then_act:check-then-act-_cache" in got
+    assert base + "Cache.start:racy-lazy-init-_started" in got
+    assert base + "get_singleton:unlocked-lazy-init-_SINGLETON" in got
 
 
 def test_clean_fixture_has_no_findings(fixture_findings):
@@ -319,6 +337,118 @@ def test_jit_purity_pure_body_passes():
         "    return y * 2\n"
     )
     assert not JitPurityChecker().run([src])
+
+
+def test_guarded_state_locked_suffix_and_init_exempt():
+    src = _src(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"  # init writes never flag
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def _bump_locked(self):\n"
+        "        self.n += 1\n"  # caller-holds-the-lock convention
+    )
+    assert not GuardedStateChecker().run([src])
+
+
+def test_guarded_state_condition_aliases_its_lock():
+    src = _src(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "        self.n = 0\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def b(self):\n"
+        "        with self._cv:\n"  # holding the cv IS holding the lock
+        "            self.n += 1\n"
+    )
+    assert not GuardedStateChecker().run([src])
+
+
+def test_guarded_state_copy_return_passes_reference_fails():
+    base = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._d = {}\n"
+        "    def put(self, k):\n"
+        "        with self._lock:\n"
+        "            self._d[k] = k\n"
+    )
+    leaky = _src(base + "    def snap(self):\n        return self._d\n")
+    found = GuardedStateChecker().run([leaky])
+    assert any(f.detail == "escape-_d" for f in found), found
+    copied = _src(base + "    def snap(self):\n        return dict(self._d)\n")
+    assert not GuardedStateChecker().run([copied])
+
+
+def test_atomicity_double_checked_locking_passes():
+    src = _src(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._x = None\n"
+        "    def get(self):\n"
+        "        if self._x is None:\n"
+        "            with self._lock:\n"
+        "                if self._x is None:\n"
+        "                    self._x = object()\n"
+        "        return self._x\n"
+    )
+    assert not AtomicityChecker().run([src])
+    racy = _src(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._x = None\n"
+        "    def get(self):\n"
+        "        if self._x is None:\n"
+        "            self._x = object()\n"
+        "        return self._x\n"
+    )
+    assert [f.detail for f in AtomicityChecker().run([racy])] == [
+        "racy-lazy-init-_x"
+    ]
+
+
+def test_atomicity_module_singleton_double_checked_passes():
+    src = _src(
+        "import threading\n"
+        "_X = None\n"
+        "_L = threading.Lock()\n"
+        "def get():\n"
+        "    global _X\n"
+        "    if _X is None:\n"
+        "        with _L:\n"
+        "            if _X is None:\n"
+        "                _X = object()\n"
+        "    return _X\n"
+    )
+    assert not AtomicityChecker().run([src])
+
+
+def test_cli_list_and_checker_filter(capsys):
+    from fisco_bcos_tpu.analysis.__main__ import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for c in ALL_CHECKERS:
+        assert c.name in out
+        assert getattr(c, "description", "")  # every checker documents itself
+    # filtered run: clean, and other checkers' baselined debt is NOT stale
+    assert main(["--checker", "guarded-state,atomicity"]) == 0
+    assert main(["--checker", "nope"]) == 2
 
 
 # -- runtime lock-order recorder ---------------------------------------------
