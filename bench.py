@@ -478,9 +478,29 @@ def bench_flood() -> None:
     measure_deadline = (
         t_child + child_budget - 10 if child_budget is not None else None
     )
+    # ISSUE 9: the 100 Hz sampling profiler rides the MEASURED round under
+    # --telemetry, so the round artifact carries where the interpreter
+    # actually spent the flood window; its duty cycle (sample cost /
+    # wall) is the honest on/off overhead bound on this 1-core host
+    prof = None
+    if os.environ.get("FISCO_BENCH_TELEMETRY"):
+        from fisco_bcos_tpu.observability import critical_path
+        from fisco_bcos_tpu.observability.pipeline import PIPELINE
+        from fisco_bcos_tpu.observability.profiler import SamplingProfiler
+
+        # measured-window boundary: drop the warm/compile round's tx index
+        # and stage totals so the artifact's per-stage vector covers ONLY
+        # the measured flood — otherwise round-over-round check_perf diffs
+        # would be dominated by cold-vs-warm compile variance
+        critical_path.clear_indexes()
+        PIPELINE.reset()
+        prof = SamplingProfiler(hz=100.0)
+        prof.start()
     t0 = time.perf_counter()
     flood_round(measured_txs, deadline=measure_deadline)
     dt = time.perf_counter() - t0
+    if prof is not None:
+        prof.stop()
     committed = nodes[0].ledger.total_transaction_count() - before
     if committed < n:
         err = err or f"only {committed}/{n} txs committed"
@@ -504,6 +524,8 @@ def bench_flood() -> None:
         flush=True,
     )
     _emit(M_FLOOD[0], tps, M_FLOOD[1], tps / 10_000.0, error=err)  # vs README.md:10
+    if prof is not None:
+        _dump_pipeline_artifact("flood", tps, prof, dt)
     if plane_enabled():
         plane = get_plane()
         plane.drain(10.0)
@@ -625,6 +647,66 @@ def bench_scenario(name: str) -> None:
     print(
         f"# scenario artifact -> {path} (seed={seed}, digest="
         f"{doc.get('determinism_digest', doc.get('combined', {}).get('determinism_digest', ''))[:16]})",
+        flush=True,
+    )
+
+
+def _dump_pipeline_artifact(tag: str, tps: float, prof, window_s: float) -> None:
+    """ISSUE 9 round artifact: per-stage utilization + blocked-on edges
+    (the pipeline observatory snapshot), the per-stage self-time vector
+    aggregated across ALL sampled txs in the flood window (what
+    tool/check_perf.py diffs round over round), and the 100 Hz profiler's
+    self-time/flamegraph fold with its measured duty-cycle overhead."""
+    from fisco_bcos_tpu.observability import critical_path
+    from fisco_bcos_tpu.observability.pipeline import PIPELINE, pipeline_doc
+
+    PIPELINE.sample_once()  # final watermark sweep before the snapshot
+    report = prof.report()
+    agg = critical_path.aggregate_stage_self_ms()
+    stage_self_ms = {
+        name: v["self_ms"] for name, v in agg["stages"].items()
+    }
+    doc = {
+        "tag": tag,
+        "flood_tps": round(tps, 2),
+        "window_s": round(window_s, 3),
+        "stage_self_ms": stage_self_ms,
+        "stage_agg": agg,
+        "pipeline": pipeline_doc(),
+        "profile": report,
+    }
+    base = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(base, f"bench_telemetry.{tag}.pipeline.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    overhead_pct = report["overhead"]["duty_cycle"] * 100.0
+    # acceptance: the 100 Hz profiler must cost < 5% flood TPS —
+    # vs_baseline is allowed/measured so >= 1.0 passes
+    _emit(
+        "flood_profiler_overhead_pct",
+        overhead_pct,
+        "%",
+        5.0 / max(overhead_pct, 1e-6),
+        error=None if overhead_pct < 5.0 else "profiler duty cycle >= 5%",
+    )
+    stages = doc["pipeline"]["stages"]  # the SAME snapshot the artifact holds
+    busiest = max(
+        stages.items(), key=lambda kv: kv[1]["busy_ms"], default=(None, None)
+    )[0]
+    edges = sorted(
+        (
+            (s, on, ms)
+            for s, v in stages.items()
+            for on, ms in v["blocked_ms"].items()
+        ),
+        key=lambda e: -e[2],
+    )
+    top_edge = f"{edges[0][0]} blocked_on={edges[0][1]} {edges[0][2]:.0f}ms" \
+        if edges else "none"
+    print(
+        f"# pipeline: busiest={busiest} top_blocked=[{top_edge}] "
+        f"profiler_samples={report['samples']} "
+        f"overhead={overhead_pct:.2f}% -> {path}",
         flush=True,
     )
 

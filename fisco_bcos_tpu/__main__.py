@@ -103,8 +103,9 @@ def build_node(opts: ChainOptions):
         client_ssl_context=cli_ssl,
     )
     gw.connect(node.front)
-    from .observability import TRACER
+    from .observability import TRACER, profiler
     from .observability.critical_path import trace_tx
+    from .observability.pipeline import pipeline_doc
     from .resilience import HEALTH
     from .rpc.group_manager import GroupManager, MultiGroupRpc
     from .utils.metrics import bind_node_metrics
@@ -122,6 +123,8 @@ def build_node(opts: ChainOptions):
         tracer=TRACER,
         health=HEALTH,
         trace_tx=trace_tx,
+        pipeline=pipeline_doc,
+        profile=profiler.profile,
     )
     ws = None
     if opts.ws_listen_port:

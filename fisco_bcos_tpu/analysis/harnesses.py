@@ -405,10 +405,86 @@ class SchedulerHarness:
         assert ctx["ledger"].height == (committed[-1] if committed else 0)
 
 
+# -- Pipeline observatory stage machine ---------------------------------------
+
+
+class PipelineObsHarness:
+    """Two pipeline workers drive busy/blocked transitions on ONE stage
+    while a sampler thread takes snapshots and watermark sweeps — the
+    interval counters must not lose updates, the thread counts must
+    return to zero, and no snapshot may tear (ISSUE 9: the recorder is
+    always-on shared state touched by every pipeline worker plus the
+    background sampler)."""
+
+    name = "pipeline-obs"
+
+    def __init__(self):
+        from ..observability.pipeline import PipelineRecorder, StageStats
+
+        self.watch = [
+            (PipelineRecorder, ("_stages", "_marks")),
+            (StageStats, (
+                "busy_ms", "intervals", "blocked_intervals", "n_busy",
+                "n_blocked", "state",
+            )),
+        ]
+
+    def setup(self):
+        from ..observability.pipeline import PipelineRecorder
+
+        # deterministic injected clock (the explorer forbids wall clocks);
+        # monotone under any interleaving because += happens under the
+        # recorder's (instrumented) lock or a worker-local read
+        ticks = {"t": 0.0}
+        lock = threading.Lock()
+
+        def clock():
+            with lock:
+                ticks["t"] += 1.0
+                return ticks["t"]
+
+        rec = PipelineRecorder(clock=clock, enabled=True, emit_metrics=False)
+        rec.add_probe("depth", lambda: 1)
+        return {"rec": rec, "snaps": []}
+
+    def threads(self, ctx):
+        rec = ctx["rec"]
+        snaps = ctx["snaps"]
+
+        def worker():
+            for _ in range(2):
+                with rec.busy("stage"):
+                    with rec.blocked("downstream"):
+                        pass
+
+        def sampler():
+            rec.sample_once()
+            snaps.append(rec.snapshot())
+
+        return [("w1", worker), ("w2", worker), ("sample", sampler)]
+
+    def check(self, ctx):
+        rec = ctx["rec"]
+        snap = rec.snapshot()["stage"]
+        # the lost-update canaries: 2 workers x 2 intervals each
+        assert snap["intervals"] == 4, snap
+        assert snap["blocked_intervals"] == 4, snap
+        assert snap["active_threads"] == 0, snap
+        assert snap["blocked_threads"] == 0, snap
+        assert snap["state"] == "idle", snap
+        assert snap["busy_ms"] > 0 and snap["blocked_ms"]["downstream"] > 0, snap
+        marks = rec.watermarks()
+        assert marks["depth"]["n"] == 1, marks
+        for s in ctx["snaps"]:
+            st = s.get("stage")
+            if st is not None:
+                assert st["active_threads"] >= 0 and st["intervals"] <= 4, st
+
+
 HARNESSES = {
     h.name: h
     for h in (DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
-              SchedulerHarness)
+              SchedulerHarness, PipelineObsHarness)
 }
 
 FIXTURE_HARNESSES = {RacyCounterHarness.name: RacyCounterHarness}

@@ -257,6 +257,14 @@ DEFAULT_WATCHLIST: tuple[tuple[str, str, tuple[str, ...]], ...] = (
      ("term", "_committing_thread")),
     ("fisco_bcos_tpu.utils.metrics", "MetricsRegistry",
      ("_counters", "_gauges", "_histograms")),
+    # the pipeline observatory's always-on shared state (ISSUE 9): stage
+    # stat maps and per-stage accumulators, hit concurrently by every
+    # pipeline worker plus the watermark sampler thread
+    ("fisco_bcos_tpu.observability.pipeline", "PipelineRecorder",
+     ("_stages", "_probes", "_marks")),
+    ("fisco_bcos_tpu.observability.pipeline", "StageStats",
+     ("busy_ms", "intervals", "blocked_intervals", "n_busy", "n_blocked",
+      "state")),
 )
 
 _installed = False

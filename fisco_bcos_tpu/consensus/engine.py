@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from ..front.front import FrontService, ModuleID
 from ..ledger import Ledger
 from ..observability import TRACER
+from ..observability.pipeline import PIPELINE
 from ..utils.metrics import REGISTRY
 from ..protocol.block import Block
 from ..protocol.block_header import SignatureTuple
@@ -187,7 +188,10 @@ class PBFTEngine:
     def submit_proposal(self, block: Block) -> bool:
         """Leader entry (asyncSubmitProposal:325): wrap the sealed block in a
         signed PrePrepare, broadcast, and process it locally."""
-        with self._lock:
+        # the leader's own pre-prepare (and, single-node, the whole phase
+        # chain down to commit) runs here, not through handle_message —
+        # same consensus-stage accounting either way
+        with PIPELINE.busy("consensus"), self._lock:
             number = block.header.number
             if self.timeout_state:
                 return False
@@ -281,7 +285,11 @@ class PBFTEngine:
                 PacketType.RECOVER_REQUEST: self._handle_recover_request,
                 PacketType.RECOVER_RESPONSE: self._handle_recover_response,
             }[msg.packet_type]
-        handler(msg)
+        # the consensus stage is this worker processing one message; the
+        # execute/commit legs inside flip it to blocked-on attribution so
+        # PBFT bookkeeping time and downstream-stage time stay separable
+        with PIPELINE.busy("consensus"):
+            handler(msg)
 
     # ------------------------------------------------------------ pre-prepare
 
@@ -552,6 +560,8 @@ class PBFTEngine:
         try:
             with TRACER.attach(cache.trace_ctx), TRACER.span(
                 "pbft.execute_and_checkpoint", block=number
+            ), PIPELINE.blocked(
+                "execute"
             ):  # nests scheduler.execute_block, inside the block trace
                 header = self.scheduler.execute_block(cache.block)
         except SchedulerError as e:
@@ -612,6 +622,8 @@ class PBFTEngine:
             try:
                 with TRACER.attach(cache.trace_ctx), TRACER.span(
                     "pbft.checkpoint_commit", block=msg.number
+                ), PIPELINE.blocked(
+                    "commit"
                 ):  # nests scheduler.commit_block, inside the block trace
                     self.scheduler.commit_block(header)
             except SchedulerError as e:

@@ -14,6 +14,7 @@ import time
 
 from ..ledger import Ledger
 from ..observability import TRACER
+from ..observability.pipeline import PIPELINE
 from ..protocol.block import Block
 from ..protocol.block_header import BlockHeader, ParentInfo
 from ..txpool import TxPool
@@ -44,31 +45,45 @@ class Sealer:
         cfg = self.ledger.ledger_config()
         number = cfg.block_number + 1
         if not self.config.is_leader(number, self.engine.view):
+            PIPELINE.mark_idle("sealer")
             return None
         if self.engine.has_in_flight(number):
             # a proposal is already being voted on: sealing (hashing +
             # device merkle) every tick just to be rejected by the engine's
-            # self-equivocation guard is pure waste
+            # self-equivocation guard is pure waste. For the pipeline
+            # observatory this IS the sealer's blocked state — attributed
+            # to the commit 2PC when one is in flight (the height can't
+            # advance until it lands), else to the consensus quorum.
+            PIPELINE.mark_blocked(
+                "sealer",
+                "2pc_commit"
+                if self.engine.scheduler.in_flight_commits()
+                else "consensus_quorum",
+            )
             return None
         t0 = time.perf_counter()
-        txs = self.txpool.seal_txs(cfg.tx_count_limit)
-        if len(txs) < self.min_seal_txs:
-            return None
-        parent_hash = cfg.block_hash
-        suite = self.config.suite
-        header = BlockHeader(
-            version=1,
-            number=number,
-            parent_info=[ParentInfo(cfg.block_number, parent_hash)],
-            timestamp=int(time.time() * 1000),
-            sealer=self.config.my_index if self.config.my_index is not None else 0,
-            sealer_list=[n.node_id for n in self.config.nodes],
-            consensus_weights=[n.weight for n in self.config.nodes],
-        )
-        hashes = [t.hash(suite) for t in txs]
-        block = Block(header=header, tx_metadata=hashes)
-        header.txs_root = block.calculate_txs_root(suite)
-        header.clear_hash_cache()
+        with PIPELINE.busy("sealer"):
+            txs = self.txpool.seal_txs(cfg.tx_count_limit)
+            if len(txs) < self.min_seal_txs:
+                PIPELINE.mark_idle("sealer")
+                return None
+            parent_hash = cfg.block_hash
+            suite = self.config.suite
+            header = BlockHeader(
+                version=1,
+                number=number,
+                parent_info=[ParentInfo(cfg.block_number, parent_hash)],
+                timestamp=int(time.time() * 1000),
+                sealer=self.config.my_index
+                if self.config.my_index is not None
+                else 0,
+                sealer_list=[n.node_id for n in self.config.nodes],
+                consensus_weights=[n.weight for n in self.config.nodes],
+            )
+            hashes = [t.hash(suite) for t in txs]
+            block = Block(header=header, tx_metadata=hashes)
+            header.txs_root = block.calculate_txs_root(suite)
+            header.clear_hash_cache()
         dur = time.perf_counter() - t0
         REGISTRY.observe(
             "fisco_sealer_seal_latency_ms",

@@ -270,12 +270,12 @@ def admit_batch(
     restores the per-caller direct dispatch exactly.
     FISCO_FORCE_DEVICE_ADMISSION=1 pins the device program (tests use it to
     cover the device path on CPU hosts)."""
-    from ..device.plane import get_plane, plane_route
+    from ..device.plane import get_plane, plane_route, plane_wait
 
     bsz = len(payloads)
     if plane_route() and bsz:
         sigs_arr = np.asarray(sigs65, dtype=np.uint8)
-        return get_plane().submit(
+        return plane_wait(get_plane().submit(
             "admission", (list(payloads), sigs_arr), bsz, _admission_plane_exec
-        ).result()
+        ))
     return _admit_direct(payloads, sigs65)

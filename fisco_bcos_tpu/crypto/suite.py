@@ -119,7 +119,7 @@ class HashImpl:
         this default ran eagerly — each caller synced before the next
         could even dispatch)."""
         msgs = list(msgs)
-        from ..device.plane import get_plane, plane_route
+        from ..device.plane import get_plane, plane_route, plane_wait_deferred
 
         if plane_route() and msgs:
             fut = get_plane().submit(
@@ -130,7 +130,7 @@ class HashImpl:
                     self.name or type(self).__name__, self._batch_async_direct
                 ),
             )
-            return lambda: fut.result()()
+            return lambda: plane_wait_deferred(fut)
         return self._batch_async_direct(msgs)
 
 
@@ -499,15 +499,15 @@ class Ed25519Crypto(SignatureCrypto):
         hashes = [bytes(h) for h in msg_hashes]
         pub_list = [bytes(p) for p in pubs]
         sig_list = [bytes(s) for s in sigs]
-        from ..device.plane import get_plane, plane_route
+        from ..device.plane import get_plane, plane_route, plane_wait
 
         if plane_route() and sig_list:
-            return get_plane().submit(
+            return plane_wait(get_plane().submit(
                 "verify.ed25519",
                 (hashes, pub_list, sig_list),
                 len(sig_list),
                 _verify_plane_exec_lists(self),
-            ).result()
+            ))
         return self._verify_merged(hashes, pub_list, sig_list)
 
     def _verify_merged(self, hashes, pub_list, sig_list) -> np.ndarray:
@@ -614,15 +614,15 @@ class Secp256k1Crypto(SignatureCrypto):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
         pubs = np.asarray(pubs, dtype=np.uint8)
-        from ..device.plane import get_plane, plane_route
+        from ..device.plane import get_plane, plane_route, plane_wait
 
         if plane_route() and len(sigs):
-            return get_plane().submit(
+            return plane_wait(get_plane().submit(
                 "verify.secp256k1",
                 (hashes, pubs, sigs),
                 len(sigs),
                 _verify_plane_exec(self),
-            ).result()
+            ))
         return self._verify_merged(hashes, pubs, sigs)
 
     def _verify_merged(self, hashes, pubs, sigs) -> np.ndarray:
@@ -677,15 +677,15 @@ class Secp256k1Crypto(SignatureCrypto):
     def batch_recover(self, msg_hashes, sigs):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
-        from ..device.plane import get_plane, plane_route
+        from ..device.plane import get_plane, plane_route, plane_wait
 
         if plane_route() and len(sigs):
-            return get_plane().submit(
+            return plane_wait(get_plane().submit(
                 "recover.secp256k1",
                 (hashes, sigs),
                 len(sigs),
                 _recover_plane_exec(self),
-            ).result()
+            ))
         return self._recover_merged(hashes, sigs)
 
     def _recover_merged(self, hashes, sigs):
@@ -794,15 +794,15 @@ class SM2Crypto(SignatureCrypto):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
         pubs = np.asarray(pubs, dtype=np.uint8)
-        from ..device.plane import get_plane, plane_route
+        from ..device.plane import get_plane, plane_route, plane_wait
 
         if plane_route() and len(sigs):
-            return get_plane().submit(
+            return plane_wait(get_plane().submit(
                 "verify.sm2",
                 (hashes, pubs, sigs),
                 len(sigs),
                 _verify_plane_exec(self),
-            ).result()
+            ))
         return self._verify_merged(hashes, pubs, sigs)
 
     def _verify_merged(self, hashes, pubs, sigs) -> np.ndarray:
@@ -836,15 +836,15 @@ class SM2Crypto(SignatureCrypto):
     def batch_recover(self, msg_hashes, sigs):
         sigs = np.asarray(sigs, dtype=np.uint8)
         hashes = np.asarray(msg_hashes, dtype=np.uint8)
-        from ..device.plane import get_plane, plane_route
+        from ..device.plane import get_plane, plane_route, plane_wait
 
         if plane_route() and len(sigs):
-            return get_plane().submit(
+            return plane_wait(get_plane().submit(
                 "recover.sm2",
                 (hashes, sigs),
                 len(sigs),
                 _recover_plane_exec(self),
-            ).result()
+            ))
         return self._recover_merged(hashes, sigs)
 
     def _recover_merged(self, hashes, sigs):
@@ -922,7 +922,7 @@ class CryptoSuite:
         ladder. Bit-identical to a direct ``MerkleTree(...)`` build by
         construction — both paths run the same constructor.
         """
-        from ..device.plane import get_plane, plane_route
+        from ..device.plane import get_plane, plane_route, plane_wait
 
         leaves = np.asarray(leaves, dtype=np.uint8)
         if plane_route() and len(leaves) > 1:
@@ -930,12 +930,12 @@ class CryptoSuite:
             # the plane binds ONE executor per op name process-wide, and a
             # multi-suite host (keccak + SM groups) must not have the first
             # suite's hasher capture every group's tree builds
-            return get_plane().submit(
+            return plane_wait(get_plane().submit(
                 f"merkle_tree.{self.hash_impl.name}",
                 leaves,
                 len(leaves),
                 _merkle_tree_plane_exec(self.hash_impl.name),
-            ).result()
+            ))
         return merkle_ops.MerkleTree(leaves, hasher=self.hash_impl.name)
 
 

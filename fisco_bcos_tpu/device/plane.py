@@ -434,8 +434,11 @@ class DevicePlane:
                 op, reqs, deferred = picked
                 self._busy = True
             try:
+                from ..observability.pipeline import PIPELINE
+
                 self._note_deferred(op, deferred)
-                self._dispatch(op, reqs)
+                with PIPELINE.busy("device_plane"):
+                    self._dispatch(op, reqs)
             finally:
                 with self._cv:
                     self._busy = False
@@ -564,6 +567,18 @@ class DevicePlane:
         with self._cv:
             return sum(sum(r.n for r in reqs) for reqs in self._pending.values())
 
+    def lane_depths(self) -> dict[str, int]:
+        """Queued items by priority lane — the pipeline observatory's
+        per-lane backpressure watermark (one probe, one lock round)."""
+        with self._cv:
+            out: dict[str, int] = {}
+            for reqs in self._pending.values():
+                for r in reqs:
+                    out[r.lane] = out.get(r.lane, 0) + r.n
+        for lane in LANES:
+            out.setdefault(lane, 0)
+        return out
+
     def coalesce_ratio(self) -> float:
         """Requests per device dispatch (≥ 1.0; 1.0 = no coalescing won)."""
         with self._cv:
@@ -617,6 +632,30 @@ class DevicePlane:
             from ..utils.log import note_swallowed
 
             note_swallowed("device.plane.gauge_register", e)
+
+
+def plane_wait(fut: Future):
+    """Block on a plane future, attributing the wait to the calling
+    thread's ambient pipeline stage (``<stage> blocked_on=device_plane`` —
+    the edge that says the admission/consensus/execute worker was parked
+    behind the shared crypto engine, not doing its own work). Every crypto
+    seam that queues into the plane resolves its future through here."""
+    from ..observability.pipeline import PIPELINE
+
+    with PIPELINE.blocked("device_plane"):
+        return fut.result()
+
+
+def plane_wait_deferred(fut: Future):
+    """:func:`plane_wait` for two-phase hash futures whose resolved value
+    is a deferred-sync callable: BOTH the queue wait and the device sync
+    are the caller blocked behind the plane, so both run inside the one
+    blocked attribution — otherwise the sync (the expensive half on a
+    tunneled device) would count as the caller's busy time."""
+    from ..observability.pipeline import PIPELINE
+
+    with PIPELINE.blocked("device_plane"):
+        return fut.result()()
 
 
 _PLANE: DevicePlane | None = None

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from ..codec.abi import ABICodec
 from ..crypto.suite import CryptoSuite
 from ..observability import BATCH_BUCKETS, TRACER
+from ..observability.pipeline import PIPELINE
 from ..protocol.block_header import BlockHeader
 from ..protocol.receipt import TransactionReceipt, TransactionStatus
 from ..protocol.transaction import Transaction
@@ -424,7 +425,12 @@ class TransactionExecutor:
         if self._block is None:
             raise RuntimeError("call next_block_header first")
         base = self.reserve_contexts(len(txs))
-        with TRACER.span("executor.execute", mode="serial", txs=len(txs)):
+        # reentrant no-op under scheduler.execute_block's execute stage;
+        # the REAL accounting seam for the Max executor-service processes,
+        # where this is the block work's entry point
+        with TRACER.span(
+            "executor.execute", mode="serial", txs=len(txs)
+        ), PIPELINE.busy("execute"):
             t0 = time.perf_counter()
             out = [
                 self._execute_one(tx, self._block, context_id=base + i)
@@ -523,6 +529,14 @@ class TransactionExecutor:
         return levels
 
     def dag_execute_transactions(
+        self, txs: list[Transaction]
+    ) -> list[TransactionReceipt]:
+        # same stage seam as the serial batch (reentrant under the
+        # scheduler's execute stage; the entry point on a Max executor)
+        with PIPELINE.busy("execute"):
+            return self._dag_execute_transactions(txs)
+
+    def _dag_execute_transactions(
         self, txs: list[Transaction]
     ) -> list[TransactionReceipt]:
         """Conflict-DAG execution: level-by-level; txs WITHIN a level run on

@@ -273,6 +273,30 @@ class Node:
         if plane_enabled():
             get_plane()
             HEALTH.ok("device-plane", "coalescing scheduler up")
+        # pipeline observatory (ISSUE 9): backpressure watermark probes at
+        # every inter-stage boundary, sampled by one background thread into
+        # bounded timelines (GET /pipeline + Chrome-trace counter events).
+        # First registration wins — in a multi-node test process the entry
+        # node's queues are the observed ones. FISCO_PIPELINE_OBS=0 skips
+        # registration entirely (add_probe refuses, sampler never starts).
+        from ..observability.pipeline import PIPELINE
+
+        if PIPELINE.enabled:
+            PIPELINE.add_probe("txpool.pending", self.txpool.pending_count)
+            PIPELINE.add_probe("sealer.backlog", self.txpool.unsealed_count)
+            PIPELINE.add_probe(
+                "scheduler.inflight_2pc", self.scheduler.in_flight_commits
+            )
+            PIPELINE.add_probe(
+                "scheduler.notify_queue", self.scheduler.notify_depth
+            )
+            if plane_enabled():
+                PIPELINE.add_probe("device_plane", get_plane().lane_depths)
+            if self.proof_plane is not None:
+                PIPELINE.add_probe(
+                    "proof_plane.pending", self.proof_plane.pending_builds
+                )
+            PIPELINE.ensure_sampler()
         if durable:
             # restart path: re-admit durably-stored pool txs (signatures
             # re-verified on device; Initializer.cpp:188-195 analog)

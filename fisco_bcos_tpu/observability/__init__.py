@@ -20,6 +20,14 @@ Three pieces (ISSUE 1 tentpole):
   ``from ..observability.device import device_span`` by the ops wrappers
   (kept out of this namespace so importing the package never drags in the
   metrics registry mid-import).
+- :mod:`.pipeline` — the pipeline observatory (ISSUE 9): per-stage
+  busy/idle/blocked occupancy with blocked-on attribution plus the
+  backpressure watermark sampler behind ``GET /pipeline``. Imported
+  directly (``from ..observability.pipeline import PIPELINE``) by the
+  pipeline workers; ``FISCO_PIPELINE_OBS=0`` noops it independently of
+  the metrics/tracer switch.
+- :mod:`.profiler` — the in-process sampling wall-clock profiler behind
+  ``GET /profile?seconds=N`` (collapsed stacks + self time).
 
 ``set_enabled(False)`` (or env ``FISCO_TELEMETRY=0`` before import) turns
 the whole layer into no-ops — the switch the bench overhead A/B uses.

@@ -39,10 +39,18 @@ from .tracer import TRACER, SpanRecord, TraceContext
 # "trace expired", the same answer the ring gives.
 _TX_CAP = 16384
 _BLOCK_CAP = 1024
+# miss-reason memory (ISSUE 9 satellite): a /trace/tx miss distinguishes
+# "unsampled" (head sampling dropped the tx at admission — it was seen) and
+# "evicted" (the bounded index overwrote it) from a plain "unknown" hash,
+# so operators stop chasing sampled-out transactions. Both are bounded
+# rings themselves; falling off THEM degrades the answer to "unknown".
+_MISS_CAP = 16384
 
 _lock = threading.Lock()
 _tx_index: "OrderedDict[str, dict]" = OrderedDict()
 _block_index: "OrderedDict[int, list[int]]" = OrderedDict()
+_unsampled: "OrderedDict[str, bool]" = OrderedDict()
+_evicted: "OrderedDict[str, bool]" = OrderedDict()
 
 # optional extra span providers (other processes' rings): callables
 # (trace_ids:set[int], block:int|None) -> list[span dicts]. Node boot can
@@ -51,10 +59,20 @@ SPAN_SOURCES: list[Callable] = []
 
 
 def reset() -> None:
+    clear_indexes()
+    del SPAN_SOURCES[:]
+
+
+def clear_indexes() -> None:
+    """Drop the tx/block/miss indexes but keep registered SPAN_SOURCES —
+    the measured-window boundary (`bench.py --telemetry` clears here when
+    its profiler starts so the round artifact's per-stage aggregation
+    covers the measured flood only, not the warm/compile round)."""
     with _lock:
         _tx_index.clear()
         _block_index.clear()
-    del SPAN_SOURCES[:]
+        _unsampled.clear()
+        _evicted.clear()
 
 
 def note_tx(tx_hash: bytes, ctx: TraceContext | None) -> None:
@@ -64,8 +82,22 @@ def note_tx(tx_hash: bytes, ctx: TraceContext | None) -> None:
 
 def note_txs(tx_hashes, ctx: TraceContext | None) -> None:
     """Batch registration — one lock pass, one timestamp, for the admission
-    hot loop (a 15k-tx batch must not pay 15k lock cycles here)."""
+    hot loop (a 15k-tx batch must not pay 15k lock cycles here). Txs whose
+    trace was head-sampled out (or whose tracer is off) are remembered in
+    the bounded unsampled ring so a later miss can say WHY."""
     if ctx is None or not ctx.sampled:
+        # only a LIVE tracer's sampling decision is worth remembering:
+        # with the tracer off (FISCO_TELEMETRY=0 — the bench overhead
+        # A/B's zero-telemetry leg) this must stay the pre-change early
+        # return, not per-tx ring bookkeeping, and a later miss honestly
+        # answers "unknown" because nothing was traced at all
+        if not TRACER.enabled:
+            return
+        with _lock:
+            for h in tx_hashes:
+                _unsampled[h.hex()] = True
+            while len(_unsampled) > _MISS_CAP:
+                _unsampled.popitem(last=False)
         return
     t_admit = time.perf_counter()
     wall = time.time()
@@ -79,7 +111,10 @@ def note_txs(tx_hashes, ctx: TraceContext | None) -> None:
                 "committed": None,
             }
         while len(_tx_index) > _TX_CAP:
-            _tx_index.popitem(last=False)
+            key, _entry = _tx_index.popitem(last=False)
+            _evicted[key] = True
+        while len(_evicted) > _MISS_CAP:
+            _evicted.popitem(last=False)
 
 
 # pool-wait spans are per-tx: cap them per block so a 15k-tx production
@@ -209,8 +244,33 @@ def collect(tx_hash_hex: str) -> dict:
     key = tx_hash_hex.lower().removeprefix("0x")
     with _lock:
         entry = _tx_index.get(key)
-    if entry is None:
-        return {"found": False, "txHash": key, "spans": []}
+        if entry is None:
+            # structured miss (ISSUE 9 satellite): unknown ≠ unsampled ≠
+            # evicted — each sends the operator somewhere different
+            if key in _unsampled:
+                reason, detail = (
+                    "unsampled",
+                    "head sampling dropped this tx at admission "
+                    "(FISCO_TRACE_SAMPLE) — raise the rate to trace it",
+                )
+            elif key in _evicted:
+                reason, detail = (
+                    "evicted",
+                    "the bounded lifecycle index overwrote this tx — it was "
+                    "traced, but too long ago",
+                )
+            else:
+                reason, detail = (
+                    "unknown",
+                    "this node never admitted a tx with this hash",
+                )
+            return {
+                "found": False,
+                "txHash": key,
+                "reason": reason,
+                "detail": detail,
+                "spans": [],
+            }
     ctx: TraceContext = entry["ctx"]
     block = entry["block"]
     trace_ids = {ctx.trace_id}
@@ -299,6 +359,64 @@ def analyze(doc: dict) -> dict:
 def trace_tx(tx_hash_hex: str) -> dict:
     """The one-call form (Air mode / in-process): collect + analyze."""
     return analyze(collect(tx_hash_hex))
+
+
+def aggregate_stage_self_ms(committed_only: bool = True) -> dict:
+    """Per-stage self-time totals across ALL sampled txs in the index —
+    the flood-window stage vector ``bench.py --telemetry`` writes into the
+    round artifact and ``tool/check_perf.py`` diffs round-over-round.
+
+    The per-exemplar ``trace_tx`` answers "where did THIS tx's time go";
+    this aggregates: take the union of every indexed (committed) tx's
+    trace ids plus their blocks' trace ids, select the ring's spans once
+    (a span shared by many txs — the block's execute span — counts ONCE,
+    not per tx), compute self times exactly as :func:`analyze` does, and
+    sum by stage name."""
+    import os
+
+    with _lock:
+        entries = [
+            {"ctx": e["ctx"], "block": e["block"], "committed": e["committed"]}
+            for e in _tx_index.values()
+        ]
+    trace_ids: set[int] = set()
+    blocks: set[int] = set()
+    n_txs = 0
+    for e in entries:
+        if committed_only and e["committed"] is None:
+            continue
+        n_txs += 1
+        trace_ids.add(e["ctx"].trace_id)
+        if e["block"] is not None:
+            blocks.add(e["block"])
+    with _lock:
+        for b in blocks:
+            trace_ids.update(_block_index.get(b, ()))
+    block_strs = {str(b) for b in blocks}
+    pid = os.getpid()
+    spans = []
+    for rec in TRACER.spans():
+        block_attr = rec.attrs.get("block")
+        if (
+            rec.trace_id in trace_ids
+            or (block_attr is not None and str(block_attr) in block_strs)
+            or (rec.links and any(t in trace_ids for t, _s in rec.links))
+        ):
+            spans.append(_span_dict(rec, TRACER.epoch, pid))
+    doc = analyze({"found": True, "spans": spans})
+    totals: dict[str, dict] = {}
+    for s in doc.get("stages", ()):
+        t = totals.setdefault(s["name"], {"self_ms": 0.0, "count": 0})
+        t["self_ms"] += s["self_ms"]
+        t["count"] += 1
+    for t in totals.values():
+        t["self_ms"] = round(t["self_ms"], 3)
+    return {
+        "txs": n_txs,
+        "blocks": len(blocks),
+        "spans": len(spans),
+        "stages": totals,
+    }
 
 
 def latest_committed_tx() -> str | None:

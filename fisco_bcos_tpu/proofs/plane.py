@@ -490,6 +490,12 @@ class ProofPlane:
             total = self.hits + self.misses
             return self.hits / total if total else 0.0
 
+    def pending_builds(self) -> int:
+        """Frozen-tree builds currently in flight (singleflight futures) —
+        the read-path watermark the pipeline observatory samples."""
+        with self._lock:
+            return len(self._building)
+
     def stats(self) -> dict:
         with self._lock:
             return {

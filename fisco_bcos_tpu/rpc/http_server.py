@@ -51,6 +51,8 @@ class RpcHttpServer:
         tracer=None,
         health=None,
         trace_tx=None,
+        pipeline=None,
+        profile=None,
     ):
         self.impl = impl
         # `metrics` needs .render() -> str; `tracer` needs .export_json() ->
@@ -58,12 +60,17 @@ class RpcHttpServer:
         # MetricsRegistry/Tracer/HealthRegistry in-process and by the
         # RemoteTelemetry proxy in the split (Pro/Max) deployment.
         # `trace_tx` (tx-hash hex -> critical-path dict) serves
-        # GET /trace/tx/<hash>; when omitted, a tracer exposing its own
-        # .trace_tx (RemoteTelemetry) is used.
+        # GET /trace/tx/<hash>; `pipeline` (() -> dict) serves the stage
+        # occupancy/watermark document at GET /pipeline; `profile`
+        # (seconds -> dict) serves the sampling profiler at
+        # GET /profile?seconds=N. When omitted, a tracer exposing its own
+        # .trace_tx/.pipeline/.profile (RemoteTelemetry) is used.
         self.metrics = metrics
         self.tracer = tracer
         self.health = health
         self.trace_tx = trace_tx or getattr(tracer, "trace_tx", None)
+        self.pipeline = pipeline or getattr(tracer, "pipeline", None)
+        self.profile = profile or getattr(tracer, "profile", None)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -138,6 +145,30 @@ class RpcHttpServer:
                     ctype = "application/json"
                     if not doc.get("found"):
                         code = 404
+                elif (
+                    self.path.split("?", 1)[0] == "/pipeline"
+                    and outer.pipeline is not None
+                ):
+                    # stage occupancy + blocked-on edges + backpressure
+                    # watermark timelines (ISSUE 9 pipeline observatory)
+                    data = json.dumps(outer.pipeline(), default=str).encode()
+                    ctype = "application/json"
+                elif (
+                    self.path.split("?", 1)[0] == "/profile"
+                    and outer.profile is not None
+                ):
+                    # sampling wall-clock profiler: blocks for ?seconds=N
+                    # (server-side clamped) and returns collapsed stacks +
+                    # per-function self time
+                    from urllib.parse import parse_qs, urlsplit
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    seconds = (qs.get("seconds") or ["2"])[0]
+                    doc = outer.profile(seconds)
+                    data = json.dumps(doc, default=str).encode()
+                    ctype = "application/json"
+                    if doc.get("error"):
+                        code = 503
                 elif self.path == "/health" and outer.health is not None:
                     # degraded-mode registry (resilience.HEALTH or the
                     # split-mode RemoteTelemetry proxy). 503 ONLY on

@@ -23,6 +23,7 @@ from ..crypto.suite import CryptoSuite
 from ..executor.executor import TransactionExecutor
 from ..ledger import Ledger
 from ..observability import TRACER
+from ..observability.pipeline import PIPELINE
 from ..protocol.block import Block
 from ..protocol.block_header import BlockHeader
 from ..protocol.transaction import TransactionAttribute
@@ -40,6 +41,14 @@ class SchedulerError(Exception):
     def __init__(self, code: ErrorCode, msg: str):
         super().__init__(msg)
         self.code = code
+
+
+def _run_notify(cb, number: int, block) -> None:
+    """One commit-notify delivery on the notify worker, accounted as the
+    pipeline's notify stage (ws push / proof-plane warm build / sync hooks
+    all ride this thread — its saturation is a real backpressure signal)."""
+    with PIPELINE.busy("notify"):
+        cb(number, block)
 
 
 def _is_executor_loss(e: Exception) -> bool:
@@ -107,6 +116,25 @@ class Scheduler:
         delivered first — Worker.stop posts a sentinel and joins)."""
         self._notify.stop()
 
+    # -- pipeline-observatory probes (observability/pipeline.py) -------------
+
+    def in_flight_commits(self) -> int:
+        """Heights whose 2PC is currently in flight (0 or 1 by the commit
+        serialization) — a backpressure watermark and the sealer's
+        blocked-on discriminator. Deliberately LOCK-FREE: execute_block
+        holds self._lock for the whole block execution, and this is polled
+        by the sealer tick and the 25 ms watermark sampler — parking them
+        there would make the observatory perturb the pipeline it measures.
+        A stale read only shifts one tick's attribution."""
+        return len(self._committing)
+
+    def notify_depth(self) -> int:
+        """Queued-but-undelivered commit notifications."""
+        try:
+            return self._notify._queue.qsize()
+        except (AttributeError, NotImplementedError):
+            return 0
+
     # -- storage failover (SchedulerManager.cpp asyncSwitchTerm) -------------
 
     def switch_term(self) -> None:
@@ -158,7 +186,9 @@ class Scheduler:
         # the lock covers the whole execution: the executor's block context is
         # shared state, and two interleaved same-height executions would
         # corrupt each other's state layer
-        with TRACER.span("scheduler.execute_block", block=number) as sp:
+        with TRACER.span(
+            "scheduler.execute_block", block=number
+        ) as sp, PIPELINE.busy("execute"):
             with self._lock:
                 cached = self._executed.get(number)
                 if (
@@ -202,8 +232,10 @@ class Scheduler:
         # old whole-commit lock hold serialized them. The pipeline win is
         # unaffected: _committing is empty during the commit-QUORUM wait,
         # which is when proposal N+1 speculatively executes.
-        while self._committing:
-            self._commit_done.wait()
+        if self._committing:
+            with PIPELINE.blocked("2pc_commit"):
+                while self._committing:
+                    self._commit_done.wait()
 
         # Height gate with block pipelining (preExecuteBlock,
         # SchedulerInterface.h:76 / StateMachine.cpp:47 asyncPreApply): the
@@ -342,7 +374,9 @@ class Scheduler:
 
     def commit_block(self, header: BlockHeader) -> None:
         number = header.number
-        with TRACER.span("scheduler.commit_block", block=number) as sp:
+        with TRACER.span(
+            "scheduler.commit_block", block=number
+        ) as sp, PIPELINE.busy("commit"):
             t0 = time.perf_counter()
             with self._lock:
                 # committers serialize HERE, before the gate, exactly as the
@@ -350,8 +384,10 @@ class Scheduler:
                 # blocks until N is fully booked, keeping gate semantics and
                 # notify order intact) — cv.wait releases the lock, so
                 # execute_block callers are not starved while we queue
-                while self._committing:
-                    self._commit_done.wait()
+                if self._committing:
+                    with PIPELINE.blocked("prior_commit"):
+                        while self._committing:
+                            self._commit_done.wait()
                 cached = self._gate_commit_locked(header)
             # The prewrite reads and the 2PC legs run OUTSIDE the scheduler
             # lock: on the Pro/Max splits they round-trip to remote
@@ -366,10 +402,14 @@ class Scheduler:
                 params = TwoPCParams(number=number)
                 # the 2PC legs as spans: on a remote executor/storage split
                 # these parent the service-side svc.*.prepare/commit spans
-                with TRACER.span("scheduler.2pc_prepare", block=number):
+                with TRACER.span(
+                    "scheduler.2pc_prepare", block=number
+                ), PIPELINE.blocked("2pc_prepare"):
                     self.executor.prepare(params, extra_writes=ledger_writes)
                 timer.stage("prepare")
-                with TRACER.span("scheduler.2pc_commit", block=number):
+                with TRACER.span(
+                    "scheduler.2pc_commit", block=number
+                ), PIPELINE.blocked("2pc_commit"):
                     self.executor.commit(params)
                 timer.stage("commit")
             except BaseException:
@@ -398,7 +438,9 @@ class Scheduler:
                 # (post never blocks) so enqueue order matches commit order.
                 block = cached.block
                 for cb in list(self.on_committed):
-                    self._notify.post(lambda cb=cb: cb(number, block))
+                    self._notify.post(
+                        lambda cb=cb: _run_notify(cb, number, block)
+                    )
             from ..observability.tracer import trace_hex
 
             REGISTRY.observe(

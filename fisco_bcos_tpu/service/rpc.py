@@ -31,11 +31,15 @@ seams; with no plan installed each hook is one global pointer read.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import socket
 import struct
 import threading
 from typing import Callable
+
+# shared no-op guard for register(concurrent=True) handlers
+_NULL_CTX = contextlib.nullcontext()
 
 from ..codec.flat import FlatReader, FlatWriter
 from ..observability.tracer import TRACER, TraceContext
@@ -140,12 +144,22 @@ class ServiceServer:
         # one lock: service handlers mutate shared state (executor block
         # context, storage), and tars servants are effectively serialized too
         self._dispatch_lock = threading.Lock()
+        # methods opted OUT of that serialization (register(concurrent=True)):
+        # read-only handlers that touch no shared mutable state and may block
+        # for seconds (the sampling profiler) — serializing them would let
+        # one GET /profile stall every JSON-RPC call on the split
+        self._concurrent: set[str] = set()
         # live connections, closed on stop so a stopped service drops its
         # clients like a crashed process would (failover tests depend on it)
         self._conns: set[socket.socket] = set()
 
-    def register(self, method: str, fn: Callable[[bytes], bytes]) -> None:
+    def register(
+        self, method: str, fn: Callable[[bytes], bytes],
+        concurrent: bool = False,
+    ) -> None:
         self._methods[method] = fn
+        if concurrent:
+            self._concurrent.add(method)
 
     def start(self) -> None:
         threading.Thread(
@@ -237,7 +251,11 @@ class ServiceServer:
                     if traceparent and TRACER.enabled
                     else None
                 )
-                with self._dispatch_lock:
+                with (
+                    _NULL_CTX
+                    if method in self._concurrent
+                    else self._dispatch_lock
+                ):
                     if ctx is not None:
                         # the remote caller's trace continues here: the
                         # handler (and every span it opens) joins it

@@ -45,6 +45,11 @@ class RpcFacade:
         self.server.register("trace", self._trace)
         self.server.register("trace_tx", self._trace_tx)
         self.server.register("health", self._health)
+        self.server.register("pipeline", self._pipeline)
+        # concurrent: the profiler blocks for seconds reading only
+        # sys._current_frames() — under the dispatch lock one /profile
+        # would stall every JSON-RPC call on the split
+        self.server.register("profile", self._profile, concurrent=True)
         self.host, self.port = self.server.host, self.server.port
 
     def start(self) -> None:
@@ -89,6 +94,29 @@ class RpcFacade:
         if self.health is None:
             return b'{"status": "ok", "components": {}}'
         return self.health.to_json().encode()
+
+    def _pipeline(self, _payload: bytes) -> bytes:
+        """The node core's stage-occupancy/watermark document — the split
+        deployment's GET /pipeline source (the pipeline lives where the
+        pipeline workers live)."""
+        from ..observability.pipeline import pipeline_doc
+
+        return json.dumps(pipeline_doc(), default=str).encode()
+
+    def _profile(self, payload: bytes) -> bytes:
+        """Sample THIS process (the node core — where the pipeline burns
+        its wall time) for the requested seconds. Clamped server-side
+        below the telemetry proxy's RPC timeout — the client-side clamp
+        in RemoteTelemetry must not be the only guard."""
+        from ..observability import profiler
+
+        try:
+            seconds = float(payload.decode() or "2")
+        except ValueError:
+            seconds = 2.0
+        return json.dumps(
+            profiler.profile(min(seconds, 8.0)), default=str
+        ).encode()
 
 
 class RemoteJsonRpc:
@@ -178,6 +206,34 @@ class RemoteTelemetry:
                 s for s in local if (s["trace_id"], s["span_id"]) not in known
             )
         return critical_path.analyze(doc)
+
+    def pipeline(self) -> dict:
+        """GET /pipeline over the split: the node core owns the stage
+        recorder; an unreachable core degrades to an explicit error doc."""
+        try:
+            return json.loads(self.client.call("pipeline", b""))
+        except Exception as e:
+            return {
+                "enabled": False,
+                "error": f"facade unreachable: {e}",
+                "stages": {},
+                "watermarks": {},
+            }
+
+    def profile(self, seconds=2.0) -> dict:
+        """GET /profile over the split — samples the NODE CORE process.
+        Clamped below this proxy's RPC timeout so a long profile can never
+        read as a dead facade."""
+        try:
+            seconds = min(float(seconds), 8.0)
+        except (TypeError, ValueError):
+            seconds = 2.0
+        try:
+            return json.loads(
+                self.client.call("profile", str(seconds).encode())
+            )
+        except Exception as e:
+            return {"error": f"facade unreachable: {e}"}
 
     def to_json(self) -> str:
         """Health JSON for GET /health. An unreachable node core IS a

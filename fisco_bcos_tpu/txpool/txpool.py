@@ -153,9 +153,11 @@ class TxPool:
         equal nonce), so no pre-verification hash pass is needed — the
         fused program's digests fill the hash caches of verified lanes,
         and only rejected lanes pay a host hash for their result row."""
+        from ..observability.pipeline import PIPELINE
+
         with TRACER.span(
             "txpool.submit_batch", batch=len(txs), lane=lane
-        ) as sp:
+        ) as sp, PIPELINE.busy("admission"):
             return self._submit_batch_spanned(txs, lane, source, policed, sp)
 
     def _submit_batch_spanned(

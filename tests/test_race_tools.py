@@ -31,6 +31,7 @@ from fisco_bcos_tpu.analysis.harnesses import (
     HARNESSES,
     AdmissionQuotasHarness,
     DevicePlaneHarness,
+    PipelineObsHarness,
     ProofPlaneHarness,
     RacyCounterHarness,
     SchedulerHarness,
@@ -186,7 +187,7 @@ def test_deadlock_schedule_is_reported_not_hung():
 @pytest.mark.parametrize(
     "cls",
     [DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
-     SchedulerHarness],
+     SchedulerHarness, PipelineObsHarness],
     ids=lambda c: c.name,
 )
 def test_real_harness_seeded_sweep(cls):
@@ -198,7 +199,7 @@ def test_real_harness_seeded_sweep(cls):
 def test_real_harnesses_registry_complete():
     assert set(HARNESSES) == {
         "device-plane", "proof-singleflight", "admission-quotas",
-        "scheduler-commit",
+        "scheduler-commit", "pipeline-obs",
     }
 
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Diff two pipeline round artifacts — the mechanical half of the
+throughput campaign's "each win proved per stage" acceptance.
+
+``bench.py --telemetry`` writes ``bench_telemetry.flood.pipeline.json``
+per round: flood TPS plus the per-stage self-time vector aggregated across
+every sampled tx in the flood window (``stage_self_ms``). This tool
+compares two such artifacts (OLD then NEW) and exits nonzero when:
+
+- any stage's self time REGRESSED by >= --threshold (default 20%) — with
+  an absolute floor (--min-ms, default 5 ms) so microsecond stages can't
+  trip the gate on noise; or
+- flood TPS dropped by >= --tps-threshold (default 20%).
+
+Improvements are reported, never fatal. Stages present in only one
+artifact are reported as added/removed (informational — a refactor may
+legitimately rename a stage; renames that HIDE a regression still show as
+a TPS drop).
+
+Usage::
+
+    python tool/check_perf.py OLD.json NEW.json [--threshold 0.2]
+        [--min-ms 5] [--tps-threshold 0.2]
+
+Exit 0 = no regression, 1 = regression(s) named on stdout, 2 = bad input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if "stage_self_ms" not in doc and "flood_tps" not in doc:
+        raise ValueError(
+            f"{path}: not a pipeline round artifact "
+            "(expected stage_self_ms and/or flood_tps keys)"
+        )
+    return doc
+
+
+def diff(
+    old: dict,
+    new: dict,
+    threshold: float = 0.2,
+    min_ms: float = 5.0,
+    tps_threshold: float = 0.2,
+) -> tuple[list[str], list[str]]:
+    """Returns (regressions, notes) — regressions nonempty = gate fails."""
+    regressions: list[str] = []
+    notes: list[str] = []
+    old_stages = old.get("stage_self_ms") or {}
+    new_stages = new.get("stage_self_ms") or {}
+    for name in sorted(set(old_stages) | set(new_stages)):
+        o = old_stages.get(name)
+        n = new_stages.get(name)
+        if o is None:
+            notes.append(f"stage added: {name} ({n:.1f} ms)")
+            continue
+        if n is None:
+            notes.append(f"stage removed: {name} (was {o:.1f} ms)")
+            continue
+        if n - o >= min_ms and (o <= 0 or (n / o - 1.0) >= threshold):
+            # o == 0 with a real delta is an unbounded regression, not a
+            # skip — a stage idle last round must not regress for free
+            grew = f"+{(n / o - 1.0) * 100.0:.0f}%" if o > 0 else "from zero"
+            regressions.append(
+                f"stage {name}: self time {o:.1f} -> {n:.1f} ms "
+                f"({grew}, threshold {threshold * 100.0:.0f}%)"
+            )
+        elif o - n >= min_ms and n > 0 and (o / n - 1.0) >= threshold:
+            notes.append(
+                f"stage {name}: improved {o:.1f} -> {n:.1f} ms "
+                f"(-{(1.0 - n / o) * 100.0:.0f}%)"
+            )
+    o_tps, n_tps = old.get("flood_tps"), new.get("flood_tps")
+    if o_tps and n_tps is not None:
+        if n_tps < o_tps * (1.0 - tps_threshold):
+            regressions.append(
+                f"flood TPS: {o_tps:.1f} -> {n_tps:.1f} "
+                f"(-{(1.0 - n_tps / o_tps) * 100.0:.0f}%, threshold "
+                f"{tps_threshold * 100.0:.0f}%)"
+            )
+        elif n_tps > o_tps * (1.0 + tps_threshold):
+            notes.append(
+                f"flood TPS: improved {o_tps:.1f} -> {n_tps:.1f} "
+                f"(+{(n_tps / o_tps - 1.0) * 100.0:.0f}%)"
+            )
+    return regressions, notes
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("old", help="previous round's pipeline artifact (JSON)")
+    ap.add_argument("new", help="this round's pipeline artifact (JSON)")
+    ap.add_argument(
+        "--threshold", type=float, default=0.2,
+        help="relative per-stage self-time regression gate (default 0.20)",
+    )
+    ap.add_argument(
+        "--min-ms", type=float, default=5.0,
+        help="absolute floor: deltas under this many ms never regress",
+    )
+    ap.add_argument(
+        "--tps-threshold", type=float, default=0.2,
+        help="relative flood-TPS drop gate (default 0.20)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        old = load_artifact(args.old)
+        new = load_artifact(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"ERROR: {e}")
+        return 2
+    regressions, notes = diff(
+        old, new, args.threshold, args.min_ms, args.tps_threshold
+    )
+    for n in notes:
+        print(f"note: {n}")
+    if regressions:
+        for r in regressions:
+            print(f"REGRESSION: {r}")
+        print(f"FAIL: {len(regressions)} regression(s) between artifacts")
+        return 1
+    print("PASS: no per-stage self-time or flood-TPS regression")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

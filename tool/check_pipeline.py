@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Pipeline-observatory smoke check (ISSUE 9 CI acceptance).
+
+Floods a 4-node in-process PBFT chain, then asserts:
+
+- ``GET /pipeline`` serves the stage-occupancy document with a saturated
+  stage (busy time recorded) and at least one blocked-on attribution edge
+  (``<stage> blocked_on=<what>``), plus non-empty backpressure watermark
+  timelines;
+- the sampling profiler's top self-time frame lands inside the package
+  while package code is the only thing running;
+- ``tool/check_perf.py`` flags a synthetic 30% stage self-time regression
+  between two artifacts, and passes an unchanged pair.
+
+Runnable locally and from CI::
+
+    python tool/check_pipeline.py [--txs N] [--block-cap N]
+
+Exit 0 on success, 1 with a named failure otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+os.environ.setdefault("FISCO_TEST_BUCKET", "32")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_backend_optimization_level" not in _flags:
+    _flags += (
+        " --xla_backend_optimization_level=0"
+        " --xla_llvm_disable_expensive_passes=true"
+    )
+    os.environ["XLA_FLAGS"] = _flags.strip()
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+)
+sys.path.insert(0, _REPO)
+
+try:  # sitecustomize may pre-import jax on the TPU tunnel; pin CPU
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    raise SystemExit(1)
+
+
+def run_chain(n_txs: int, block_cap: int) -> None:
+    from fisco_bcos_tpu.codec.abi import ABICodec
+    from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+    from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+    from fisco_bcos_tpu.front import InprocGateway
+    from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+    from fisco_bcos_tpu.node import Node, NodeConfig
+    from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+
+    suite = ecdsa_suite()
+    codec = ABICodec(suite.hash)
+    keypairs = [
+        suite.signature_impl.generate_keypair(secret=0x919E + i)
+        for i in range(4)
+    ]
+    cons = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gw = InprocGateway(auto=True)
+    nodes = []
+    for kp in keypairs:
+        cfg = NodeConfig(
+            genesis=GenesisConfig(
+                consensus_nodes=list(cons), tx_count_limit=block_cap
+            )
+        )
+        node = Node(cfg, keypair=kp)
+        gw.connect(node.front)
+        nodes.append(node)
+
+    fac = TransactionFactory(suite)
+    sender = suite.signature_impl.generate_keypair(secret=0x919E99)
+    txs = [
+        fac.create_signed(
+            sender,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500,
+            nonce=f"pipe-{i}",
+            to=DAG_TRANSFER_ADDRESS,
+            input=codec.encode_call("userAdd(string,uint256)", f"p{i}", 1),
+        )
+        for i in range(n_txs)
+    ]
+    entry = nodes[0]
+    results = entry.txpool.submit_batch(txs)
+    rejected = sum(1 for r in results if r.status != 0)
+    if rejected:
+        fail(f"{rejected}/{n_txs} txs rejected at admission")
+    entry.tx_sync.maintain()
+
+    def leader_for_next(height: int):
+        idx = nodes[0].pbft_config.leader_index(height, 0)
+        target = nodes[0].pbft_config.nodes[idx].node_id
+        return next(nd for nd in nodes if nd.node_id == target)
+
+    stalls = 0
+    while entry.txpool.pending_count() > 0 and stalls < 5:
+        leader = leader_for_next(nodes[0].block_number() + 1)
+        if not leader.sealer.seal_and_submit():
+            stalls += 1
+    if entry.txpool.pending_count() > 0:
+        fail(f"chain stalled with {entry.txpool.pending_count()} txs pending")
+    print(
+        f"chain ok: {nodes[0].block_number()} blocks, {n_txs} txs "
+        f"committed on 4 nodes"
+    )
+
+
+def check_pipeline_endpoint() -> None:
+    from fisco_bcos_tpu.observability import profiler
+    from fisco_bcos_tpu.observability.pipeline import PIPELINE, pipeline_doc
+    from fisco_bcos_tpu.rpc.http_server import RpcHttpServer
+
+    PIPELINE.sample_once()
+    server = RpcHttpServer(
+        impl=None, port=0, pipeline=pipeline_doc, profile=profiler.profile
+    )
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/pipeline", timeout=10) as resp:
+            if not resp.headers["Content-Type"].startswith("application/json"):
+                fail("/pipeline content type is not application/json")
+            doc = json.loads(resp.read())
+    finally:
+        server.stop()
+    stages = doc.get("stages") or {}
+    if not stages:
+        fail("/pipeline served no stages after a flood")
+    expected = {"admission", "sealer", "consensus", "execute", "commit"}
+    missing = expected - set(stages)
+    if missing:
+        fail(f"/pipeline missing stages: {sorted(missing)}")
+    busiest, busiest_ms = max(
+        ((s, v["busy_ms"]) for s, v in stages.items()), key=lambda kv: kv[1]
+    )
+    if busiest_ms <= 0:
+        fail("no stage recorded busy time during the flood")
+    edges = [
+        (s, on, ms)
+        for s, v in stages.items()
+        for on, ms in v["blocked_ms"].items()
+    ]
+    if not edges:
+        fail("no blocked-on attribution edge recorded during the flood")
+    if not doc.get("watermarks"):
+        fail("no backpressure watermark timelines recorded")
+    top = max(edges, key=lambda e: e[2])
+    print(
+        f"pipeline ok: {len(stages)} stages, busiest={busiest} "
+        f"({busiest_ms:.0f} ms busy), top edge {top[0]} "
+        f"blocked_on={top[1]} ({top[2]:.1f} ms), "
+        f"{len(doc['watermarks'])} watermark series"
+    )
+
+
+def check_profiler() -> None:
+    """The profiler's top self-time frame must land in the package while a
+    package hot loop is the only work in the process."""
+    from fisco_bcos_tpu.crypto.ref.keccak import keccak256
+    from fisco_bcos_tpu.observability.profiler import SamplingProfiler
+
+    stop = threading.Event()
+
+    def spin():
+        data = b"pipeline-observatory"
+        while not stop.is_set():
+            data = keccak256(data)
+
+    t = threading.Thread(target=spin, daemon=True)
+    t.start()
+    try:
+        p = SamplingProfiler(hz=200.0)
+        p.run_for(1.0)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    report = p.report()
+    if report["samples"] < 50:
+        fail(f"profiler took only {report['samples']} samples in 1s")
+    if not report["self_top"]:
+        fail("profiler folded no package stacks while package code spun")
+    top = report["self_top"][0]["func"]
+    if "fisco_bcos_tpu" not in top:
+        fail(f"profiler top frame outside the package: {top}")
+    if not report["collapsed"]:
+        fail("no collapsed stacks in the profiler report")
+    print(
+        f"profiler ok: {report['samples']} sweeps, top self frame {top} "
+        f"({report['self_top'][0]['pct']}%), duty cycle "
+        f"{report['overhead']['duty_cycle'] * 100:.2f}%"
+    )
+
+
+def check_perf_gate(tmpdir: str) -> None:
+    """check_perf.py must flag a synthetic 30% regression and pass an
+    unchanged pair."""
+    import subprocess
+
+    old = {
+        "flood_tps": 100.0,
+        "stage_self_ms": {"scheduler.execute_block": 100.0, "seal": 40.0},
+    }
+    regressed = {
+        "flood_tps": 98.0,
+        "stage_self_ms": {"scheduler.execute_block": 130.0, "seal": 40.0},
+    }
+    paths = {}
+    for name, doc in (("old", old), ("new", regressed), ("same", old)):
+        paths[name] = os.path.join(tmpdir, f"art_{name}.json")
+        with open(paths[name], "w") as f:
+            json.dump(doc, f)
+    tool = os.path.join(_REPO, "tool", "check_perf.py")
+    rc_bad = subprocess.run(
+        [sys.executable, tool, paths["old"], paths["new"]],
+        capture_output=True,
+    ).returncode
+    if rc_bad == 0:
+        fail("check_perf.py passed a 30% stage self-time regression")
+    rc_ok = subprocess.run(
+        [sys.executable, tool, paths["old"], paths["same"]],
+        capture_output=True,
+    ).returncode
+    if rc_ok != 0:
+        fail(f"check_perf.py failed an identical artifact pair (rc={rc_ok})")
+    print("check_perf ok: 30% synthetic regression flagged, identity passes")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--txs", type=int, default=96)
+    ap.add_argument("--block-cap", type=int, default=32)
+    args = ap.parse_args()
+    run_chain(args.txs, args.block_cap)
+    check_pipeline_endpoint()
+    check_profiler()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        check_perf_gate(tmp)
+    print("PASS: pipeline observatory live end to end")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
