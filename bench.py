@@ -554,6 +554,7 @@ def bench_scenario(name: str) -> None:
     counts and the determinism digest for the seed."""
     from fisco_bcos_tpu.scenario import (
         ScenarioRunner,
+        run_big_committee_bench,
         run_isolation_bench,
         run_proof_storm_bench,
     )
@@ -562,7 +563,39 @@ def bench_scenario(name: str) -> None:
     scale = float(os.environ.get("FISCO_SCENARIO_SCALE", "1") or 1)
     budget = _child_budget_s()
     deadline = max(budget - 20, 30) if budget is not None else None
-    if name == "proof-storm":
+    if name == "big-committee":
+        doc = run_big_committee_bench(seed=seed, scale=scale, deadline_s=deadline)
+        err = doc.get("error")
+        ratio = doc["qc_bytes_ratio_64_vs_4"]
+        # acceptance: committed-QC bytes constant in committee size —
+        # n=64 within 1.1x of n=4 (vs_baseline >= 1.0 passes)
+        _emit(
+            "scenario_big_committee_qc_bytes_ratio", ratio, "x-n4",
+            (1.1 / ratio) if ratio > 0 else 0.0, error=err,
+        )
+        speedup = doc["aggregate_speedup_vs_sequential_n64"]
+        # acceptance: one aggregate verification beats n=64 sequential
+        # per-vote verifies
+        _emit(
+            "scenario_big_committee_agg_speedup_n64", speedup, "x-sequential",
+            speedup / 1.0, error=err,
+        )
+        _emit(
+            "scenario_big_committee_verify_ms_n64",
+            doc["committees"]["64"]["verify_ms_p50"], "ms",
+            1.0 if not err else 0.0, error=err,
+        )
+        print(
+            f"# big-committee: qc_bytes n4={doc['committees']['4']['qc_bytes']} "
+            f"n64={doc['committees']['64']['qc_bytes']} (ratio {ratio}x), "
+            f"verify_ms ratio {doc['verify_ms_ratio_64_vs_4']}x, "
+            f"agg speedup {speedup}x vs sequential, "
+            f"ed25519 bytes {doc['ed25519']}, "
+            f"chain={doc.get('chain', {})}",
+            flush=True,
+        )
+        group_docs = {}
+    elif name == "proof-storm":
         doc = run_proof_storm_bench(seed=seed, scale=scale, deadline_s=deadline)
         err = doc.get("error")
         speedup = doc["speedup_vs_direct"]
@@ -842,7 +875,11 @@ def main() -> None:
     # tracked per round alongside flood TPS. FISCO_BENCH_SCENARIOS=0 opts
     # out; the children ride the same deadline split + kill machinery.
     if os.environ.get("FISCO_BENCH_SCENARIOS", "1") != "0":
-        names += ["scenario:isolation", "scenario:proof-storm"]
+        names += [
+            "scenario:isolation",
+            "scenario:proof-storm",
+            "scenario:big-committee",
+        ]
     for i, name in enumerate(names):
         remaining = total_s - (time.monotonic() - t_start) - 10  # emit reserve
         if remaining < 20:
@@ -952,7 +989,9 @@ def _main_scenario(name: str) -> None:
 
     from fisco_bcos_tpu.scenario import SCENARIOS
 
-    if name not in SCENARIOS and name not in ("isolation", "proof-storm"):
+    if name not in SCENARIOS and name not in (
+        "isolation", "proof-storm", "big-committee",
+    ):
         known = ", ".join(sorted(SCENARIOS))
         print(f"# unknown scenario '{name}' (known: {known})", flush=True)
         raise SystemExit(2)

@@ -99,6 +99,13 @@ class FlatReader:
     def seq(self, read_item) -> list:
         return [read_item(self) for _ in range(self.u32())]
 
+    def at_end(self) -> bool:
+        """True when the buffer is exhausted — the probe optional trailing
+        sections use (fields added after a release decode as absent on old
+        bytes, and absent fields encode to NOTHING, keeping pre-extension
+        encodings byte-identical)."""
+        return self._off == len(self._buf)
+
     def done(self) -> None:
         if self._off != len(self._buf):
             raise ValueError(

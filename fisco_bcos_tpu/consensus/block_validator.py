@@ -15,6 +15,7 @@ from ..crypto.suite import CryptoSuite
 from ..ledger.ledger import ConsensusNode
 from ..protocol.block_header import BlockHeader
 from ..utils.log import get_logger
+from .config import min_quorum
 
 _log = get_logger("block-validator")
 
@@ -38,6 +39,8 @@ class BlockValidator:
         if header.consensus_weights != [n.weight for n in sealers]:
             _log.warning("block %d: weight list mismatch", header.number)
             return False
+        if header.qc:
+            return self._check_qc(header, sealers)
         if not header.signature_list:
             return False
         seen: set[int] = set()
@@ -67,11 +70,56 @@ class BlockValidator:
         if not bool(np.asarray(ok).all()):
             _log.warning("block %d: QC signature verify failed", header.number)
             return False
-        quorum = (2 * sum(n.weight for n in sealers)) // 3 + 1
+        quorum = min_quorum(sum(n.weight for n in sealers))
         weight = sum(sealers[i].weight for i in idxs)
         if weight < quorum:
             _log.warning(
                 "block %d: QC weight %d below quorum %d", header.number, weight, quorum
             )
             return False
+        return True
+
+    def _check_qc(self, header: BlockHeader, sealers: list[ConsensusNode]) -> bool:
+        """Aggregate-certificate header validation: ONE verification for
+        the whole quorum instead of n per-sealer checks — block-sync and
+        lightnode bandwidth/verify cost independent of committee size.
+        A forged bitmap (claiming signers who never signed) fails the
+        aggregate check; out-of-range/duplicate-free indexing is enforced
+        by the bitmap representation itself."""
+        from .qc import QuorumCert, verify_header_cert
+
+        try:
+            cert = QuorumCert.decode(header.qc)
+        except ValueError as e:
+            _log.warning("block %d: undecodable QC record: %s", header.number, e)
+            return False
+        if cert.committee != len(sealers):
+            _log.warning("block %d: QC committee size mismatch", header.number)
+            return False
+        idxs = cert.signers()
+        if not idxs:
+            return False
+        qc_pubs = [n.qc_pub for n in sealers]
+        if any(not qc_pubs[i] for i in idxs):
+            _log.warning(
+                "block %d: QC claims a signer with no registered qc_pub",
+                header.number,
+            )
+            return False
+        quorum = min_quorum(sum(n.weight for n in sealers))
+        weight = sum(sealers[i].weight for i in idxs)
+        if weight < quorum:
+            _log.warning(
+                "block %d: QC weight %d below quorum %d",
+                header.number, weight, quorum,
+            )
+            return False
+        from ..device.plane import device_lane
+
+        with device_lane("consensus"):
+            if not verify_header_cert(cert, qc_pubs, header.hash(self.suite)):
+                _log.warning(
+                    "block %d: aggregate QC verification failed", header.number
+                )
+                return False
         return True

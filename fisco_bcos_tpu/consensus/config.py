@@ -14,6 +14,14 @@ from ..crypto.suite import CryptoSuite, KeyPair
 from ..ledger.ledger import ConsensusNode
 
 
+def min_quorum(total_weight: int) -> int:
+    """Weighted 2f+1: the smallest q with 3q > 2*total (the reference's
+    minRequiredQuorum). THE quorum rule — the engine's vote threshold and
+    the validator's committed-QC check must agree on it, so it lives in
+    exactly one place."""
+    return (2 * total_weight) // 3 + 1
+
+
 @dataclass
 class PBFTConfig:
     suite: CryptoSuite
@@ -46,9 +54,8 @@ class PBFTConfig:
 
     @property
     def quorum(self) -> int:
-        """Weighted 2f+1: smallest q with 3q > 2*total (BlockValidator's
-        minRequiredQuorum)."""
-        return (2 * self.total_weight) // 3 + 1
+        """Weighted 2f+1 (see :func:`min_quorum`)."""
+        return min_quorum(self.total_weight)
 
     def index_of(self, node_id: bytes) -> int | None:
         for i, n in enumerate(self.nodes):
@@ -76,6 +83,42 @@ class PBFTConfig:
 
     def is_leader(self, number: int, view: int) -> bool:
         return self.my_index == self.leader_index(number, view)
+
+    # ------------------------------------------------------------------ QC
+
+    @property
+    def qc_keypair(self):
+        """This node's quorum-certificate keypair, derived from the
+        consensus secret under the active scheme (cached per scheme —
+        FISCO_QC_SCHEME can flip between tests)."""
+        from .qc import derive_qc_keypair, qc_scheme_name
+
+        scheme = qc_scheme_name()
+        cache = getattr(self, "_qc_kp_cache", None)
+        if cache is None or cache[0] != scheme:
+            cache = (scheme, derive_qc_keypair(self.keypair.secret))
+            self._qc_kp_cache = cache
+        return cache[1]
+
+    def qc_pubs(self) -> list[bytes]:
+        """Committee QC pubkeys in sealer order ('' where unregistered)."""
+        return [n.qc_pub for n in self.nodes]
+
+    def qc_ready(self) -> bool:
+        """QC fast path is active: switched on AND every committee member
+        has a registered qc_pub of the active scheme's length. A single
+        legacy member keeps the whole committee on the per-signature path
+        — mixed-mode quorums would need two verification flows for one
+        proposal."""
+        from .qc import get_scheme, qc_enabled
+
+        if not qc_enabled() or not self.nodes:
+            return False
+        try:
+            want = get_scheme().pub_len
+        except ValueError:
+            return False
+        return all(len(n.qc_pub) == want for n in self.nodes)
 
     def reload(self, nodes: list[ConsensusNode], active_at: int | None = None) -> None:
         """Committee change from an s_consensus update (dynamic membership).

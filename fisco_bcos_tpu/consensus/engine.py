@@ -50,8 +50,16 @@ from .messages import (
     PBFTMessage,
     ViewChangePayload,
 )
+from .qc import QuorumCert, QuorumCollector, qc_scheme_name, vote_preimage
 
 _log = get_logger("pbft")
+
+# packets that join quorum certificates: in QC mode they accumulate
+# UNVERIFIED (no per-message signature check on arrival) and are admitted
+# wholesale by one aggregate verification at quorum time
+VOTE_PACKETS = frozenset(
+    (PacketType.PREPARE, PacketType.COMMIT, PacketType.CHECKPOINT)
+)
 
 
 @dataclass
@@ -71,6 +79,9 @@ class ProposalCache:
     prepared: bool = False  # prepare quorum reached
     committed: bool = False  # commit quorum reached (executed)
     stable: bool = False  # checkpoint quorum reached (ledger-committed)
+    # the prepare-quorum certificate (QC mode): what view changes carry
+    # instead of the O(n) encoded-PREPARE proof list
+    prepare_qc: "QuorumCert | None" = None
     # phase timestamps (perf_counter) feeding the per-phase latency
     # histograms and the retroactive pbft.* trace spans
     t_accept: float = 0.0
@@ -128,7 +139,27 @@ class PBFTEngine:
         # so a blocking tx fetch can't stall the gateway reader that must
         # deliver the fetch response; deterministic tests dispatch inline.
         self._worker: Worker | None = None
+        # aggregate-QC vote accumulator (consensus/qc.py): built lazily on
+        # first activation — constructing a scheme at boot would make a
+        # mistyped FISCO_QC_SCHEME crash a node whose operator disabled
+        # the subsystem outright with FISCO_QC=0
+        self.qc: QuorumCollector | None = None
         front.register_module(ModuleID.PBFT, self._on_front_message)
+
+    def _qc_active(self) -> bool:
+        """QC fast path for this committee, re-checked per call (env flips
+        in tests; committee reloads at every commit). A scheme switch
+        rebuilds the collector — stale-scheme votes just fail isolation."""
+        if not self.config.qc_ready():
+            return False
+        if self.qc is None or self.qc.scheme.name != qc_scheme_name():
+            # double-checked: the receive path probes outside the engine
+            # lock; racing initializers must share ONE collector (its
+            # counters and seal memo are the per-quorum bookkeeping)
+            with self._lock:
+                if self.qc is None or self.qc.scheme.name != qc_scheme_name():
+                    self.qc = QuorumCollector(self.suite)
+        return True
 
     # ----------------------------------------------------------------- worker
 
@@ -178,7 +209,22 @@ class PBFTEngine:
 
     def _sign(self, msg: PBFTMessage) -> PBFTMessage:
         msg.generated_from = self.config.my_index if self.config.my_index is not None else -1
-        return msg.sign(self.suite, self.config.keypair)
+        msg.sign(self.suite, self.config.keypair)
+        if msg.packet_type in VOTE_PACKETS and self._qc_active():
+            # the aggregatable vote signature: over the shared preimage
+            # (for checkpoints, the executed header hash itself — that is
+            # what the committed header's certificate must verify against)
+            msg.qc_sig = self.qc.scheme.sign_vote(
+                self.config.qc_keypair, self._vote_msg32(msg)
+            )
+        return msg
+
+    def _vote_msg32(self, msg: PBFTMessage) -> bytes:
+        if msg.packet_type == PacketType.CHECKPOINT:
+            return msg.proposal_hash
+        return vote_preimage(
+            self.suite, msg.packet_type, msg.view, msg.number, msg.proposal_hash
+        )
 
     def _weight(self, votes: dict[int, PBFTMessage]) -> int:
         return sum(self.config.weight_of(i) for i in votes)
@@ -267,13 +313,29 @@ class PBFTEngine:
         node = self.config.node_at(msg.generated_from)
         if node is None:
             return
-        if not msg.verify(self.suite, node.node_id):
+        # QC fast path: vote packets accumulate UNVERIFIED — the quorum
+        # admits them wholesale with one aggregate verification. Packets
+        # from demoted (previously-bad) signers lose the fast path and pay
+        # eager per-message authentication; everything that is not a vote
+        # (pre-prepare, view machinery, recovery) is always verified here.
+        defer_to_qc = (
+            msg.packet_type in VOTE_PACKETS
+            and bool(msg.qc_sig)
+            and self._qc_active()
+            and not self.qc.is_demoted(node.qc_pub)
+        )
+        if not defer_to_qc and not msg.verify(self.suite, node.node_id):
             _log.warning(
                 "bad signature on %s from index %d",
                 msg.packet_type.name,
                 msg.generated_from,
             )
             return
+        # unverified fast-path votes may never EVICT a cached vote (the
+        # handlers enforce it through this marker): with sender
+        # authentication deferred, last-write-wins would let a forged vote
+        # replace a victim's genuine one and get it struck from the quorum
+        msg._authenticated = not defer_to_qc
         with self._lock:
             handler = {
                 PacketType.PRE_PREPARE: self._handle_pre_prepare,
@@ -474,7 +536,12 @@ class PBFTEngine:
             if not self._in_waterline(msg.number) or msg.view != self.view:
                 return
             cache = self._cache_locked(msg.number)
-            cache.prepares[msg.generated_from] = msg  # buffered even pre-proposal
+            # buffered even pre-proposal
+            self._cache_vote(
+                cache.prepares,
+                msg,
+                (int(PacketType.PREPARE), msg.number, msg.view, msg.proposal_hash),
+            )
             self._check_prepared_quorum(msg.number, cache)
 
     def _handle_commit(self, msg: PBFTMessage) -> None:
@@ -482,11 +549,132 @@ class PBFTEngine:
             if not self._in_waterline(msg.number) or msg.view != self.view:
                 return
             cache = self._cache_locked(msg.number)
-            cache.commits[msg.generated_from] = msg
+            self._cache_vote(
+                cache.commits,
+                msg,
+                (int(PacketType.COMMIT), msg.number, msg.view, msg.proposal_hash),
+            )
             self._check_commit_quorum(msg.number, cache)
 
     def _agreeing(self, votes: dict[int, PBFTMessage], proposal_hash: bytes):
         return {i: m for i, m in votes.items() if m.proposal_hash == proposal_hash}
+
+    def _cache_vote(
+        self, votes: dict[int, PBFTMessage], msg: PBFTMessage, key: tuple
+    ) -> None:
+        """Store a vote and mirror its qc_sig into the collector. An
+        UNVERIFIED fast-path vote may not replace a cached vote that
+        differs — on conflict the newcomer is authenticated on the spot
+        (one signature check, paid only under attack), so a genuine vote
+        beats a forged one REGARDLESS of arrival order: forged-first
+        cannot suppress the real vote, genuine-first cannot be evicted.
+        An authenticated sender changing its vote is then equivocation
+        for the _agreeing filter, exactly as before QCs existed."""
+        existing = votes.get(msg.generated_from)
+        if (
+            existing is not None
+            and not getattr(msg, "_authenticated", True)
+            and (
+                existing.proposal_hash != msg.proposal_hash
+                or existing.qc_sig != msg.qc_sig
+            )
+        ):
+            node = self.config.node_at(msg.generated_from)
+            if node is None or not msg.verify(self.suite, node.node_id):
+                return  # unauthenticated conflict: drop the newcomer
+            msg._authenticated = True
+        votes[msg.generated_from] = msg
+        if msg.qc_sig and self.qc is not None:
+            self.qc.add_vote(
+                key, msg.generated_from, msg.qc_sig,
+                replace=getattr(msg, "_authenticated", True),
+            )
+
+    def _admit_vote_quorum(
+        self,
+        packet_type: PacketType,
+        number: int,
+        view: int,
+        msg32: bytes,
+        votes: dict[int, PBFTMessage],
+        agreeing: dict[int, PBFTMessage],
+    ) -> "tuple[bool, QuorumCert | None]":
+        """QC-mode quorum admission over an agreeing vote set: one
+        aggregate verification admits the quorum; bad votes found by
+        isolation are pruned from the engine's vote cache (and struck by
+        the collector). Returns (quorum_admitted, cert)."""
+        qc_votes = {i: m.qc_sig for i, m in agreeing.items() if m.qc_sig}
+        key = (int(packet_type), number, view, msg32)
+
+        def vote_authentic(i: int) -> bool:
+            """Strike gate: was the bad vote's PACKET really sent by the
+            validator it names? Checked lazily — the outer signature is
+            only paid for votes that already failed QC verification."""
+            m = agreeing.get(i)
+            if m is None:
+                return False
+            if getattr(m, "_authenticated", False):
+                return True
+            node = self.config.node_at(i)
+            if node is not None and m.verify(self.suite, node.node_id):
+                m._authenticated = True
+                return True
+            return False
+
+        valid, bad, cert = self.qc.admit(
+            key,
+            msg32 if packet_type == PacketType.CHECKPOINT
+            else vote_preimage(self.suite, packet_type, view, number, msg32),
+            qc_votes,
+            self.config.qc_pubs(),
+            self.config.weight_of,
+            self.config.quorum,
+            authenticated_fn=vote_authentic,
+        )
+        for i in bad:
+            votes.pop(i, None)
+        if cert is not None:
+            return True, cert
+        # votes without a qc_sig were outer-verified on arrival: a pure
+        # legacy quorum (mixed-mode peers) still decides, just without a
+        # certificate to carry
+        noqc = {i: m for i, m in agreeing.items() if not m.qc_sig and i not in bad}
+        noqc_weight = self._weight(noqc)
+        if noqc_weight >= self.config.quorum:
+            return True, None
+        # mixed-mode rescue (rolling upgrades): neither the qc subset nor
+        # the legacy subset is quorate alone, but together they are —
+        # verify the qc votes INDIVIDUALLY and combine, or the chain would
+        # stall at this height forever despite a quorum of verifiable
+        # agreeing votes
+        qc_rest = {
+            i: m.qc_sig
+            for i, m in agreeing.items()
+            if m.qc_sig and i not in bad
+        }
+        if (
+            noqc
+            and qc_rest
+            and noqc_weight + sum(self.config.weight_of(i) for i in qc_rest)
+            >= self.config.quorum
+        ):
+            pre = (
+                msg32
+                if packet_type == PacketType.CHECKPOINT
+                else vote_preimage(self.suite, packet_type, view, number, msg32)
+            )
+            good = self.qc.verify_votes(
+                qc_rest, pre, self.config.qc_pubs(),
+                authenticated_fn=vote_authentic,
+            )
+            for i in set(qc_rest) - good:
+                votes.pop(i, None)
+            if (
+                noqc_weight + sum(self.config.weight_of(i) for i in good)
+                >= self.config.quorum
+            ):
+                return True, None
+        return False, None
 
     def _check_prepared_quorum(self, number: int, cache: ProposalCache) -> None:
         if cache.prepared or cache.pre_prepare is None:
@@ -494,6 +682,18 @@ class PBFTEngine:
         agreeing = self._agreeing(cache.prepares, cache.pre_prepare.proposal_hash)
         if self._weight(agreeing) < self.config.quorum:
             return
+        if self._qc_active():
+            ok, cert = self._admit_vote_quorum(
+                PacketType.PREPARE,
+                number,
+                self.view,
+                cache.pre_prepare.proposal_hash,
+                cache.prepares,
+                agreeing,
+            )
+            if not ok:
+                return
+            cache.prepare_qc = cert
         cache.prepared = True
         cache.t_prepared = time.perf_counter()
         if cache.t_accept:
@@ -536,6 +736,17 @@ class PBFTEngine:
         agreeing = self._agreeing(cache.commits, cache.pre_prepare.proposal_hash)
         if self._weight(agreeing) < self.config.quorum:
             return
+        if self._qc_active():
+            ok, _cert = self._admit_vote_quorum(
+                PacketType.COMMIT,
+                number,
+                self.view,
+                cache.pre_prepare.proposal_hash,
+                cache.commits,
+                agreeing,
+            )
+            if not ok:
+                return
         cache.committed = True
         cache.t_committed = time.perf_counter()
         if cache.t_prepared:
@@ -596,28 +807,59 @@ class PBFTEngine:
             if not self._in_waterline(msg.number):
                 return
             cache = self._cache_locked(msg.number)
-            cache.checkpoints[msg.generated_from] = msg
+            self._cache_vote(
+                cache.checkpoints,
+                msg,
+                (int(PacketType.CHECKPOINT), msg.number, 0, msg.proposal_hash),
+            )
             if cache.stable or cache.executed_header is None:
                 return
             executed_hash = cache.executed_header.hash(self.suite)
-            agreeing = {}
-            for i, m in cache.checkpoints.items():
-                if m.proposal_hash != executed_hash:
-                    continue
-                node = self.config.node_at(i)
-                # the payload must be a valid QC signature over the header hash
-                if node is None or not self.suite.signature_impl.verify(
-                    node.node_id, executed_hash, m.payload
-                ):
-                    continue
-                agreeing[i] = m
-            if self._weight(agreeing) < self.config.quorum:
-                return
-            cache.stable = True
             header = cache.executed_header
-            header.signature_list = [
-                SignatureTuple(i, m.payload) for i, m in sorted(agreeing.items())
-            ]
+            matching = {
+                i: m
+                for i, m in cache.checkpoints.items()
+                if m.proposal_hash == executed_hash
+                and self.config.node_at(i) is not None
+            }
+            cert = None
+            if self._qc_active():
+                # aggregate admission: ONE verification for the whole
+                # checkpoint quorum; the resulting constant-size cert IS
+                # the committed header's QC record
+                ok, cert = self._admit_vote_quorum(
+                    PacketType.CHECKPOINT,
+                    msg.number,
+                    0,  # checkpoint preimage is the header hash — viewless
+                    executed_hash,
+                    cache.checkpoints,
+                    matching,
+                )
+                if not ok:
+                    return
+            if cert is not None:
+                header.signature_list = []
+                header.qc = cert.encode()
+            else:
+                # legacy path (FISCO_QC=0 / non-QC committee / mixed-mode
+                # fallback): per-signer payload verification, O(n) list —
+                # byte-identical to the pre-QC build
+                agreeing = {}
+                for i, m in matching.items():
+                    # the payload must be a valid QC signature over the
+                    # header hash
+                    if not self.suite.signature_impl.verify(
+                        self.config.node_at(i).node_id, executed_hash, m.payload
+                    ):
+                        continue
+                    agreeing[i] = m
+                if self._weight(agreeing) < self.config.quorum:
+                    return
+                header.signature_list = [
+                    SignatureTuple(i, m.payload) for i, m in sorted(agreeing.items())
+                ]
+                header.qc = b""
+            cache.stable = True
             header.clear_hash_cache()
             try:
                 with TRACER.attach(cache.trace_ctx), TRACER.span(
@@ -652,6 +894,8 @@ class PBFTEngine:
             stale = [n for n in self._caches if n <= msg.number]
             for n in stale:
                 self._caches.pop(n)
+            if self.qc is not None:
+                self.qc.reset_below(msg.number)
             if self.cstore is not None:
                 self.cstore.prune_below(msg.number)
             if (
@@ -688,6 +932,7 @@ class PBFTEngine:
         prepared_proposal = b""
         prepared_view = -1
         prepare_proof: list[bytes] = []
+        prepared_qc = b""
         number = self.committed_number + 1
         cache = self._caches.get(number)
         if (
@@ -698,11 +943,16 @@ class PBFTEngine:
         ):
             prepared_proposal = cache.block_data
             prepared_view = cache.pre_prepare.view
-            prepare_proof = [
-                m.encode()
-                for m in cache.prepares.values()
-                if m.proposal_hash == cache.pre_prepare.proposal_hash
-            ]
+            if cache.prepare_qc is not None:
+                # constant-size proof: the prepare-quorum certificate
+                # replaces the O(n) encoded-PREPARE list
+                prepared_qc = cache.prepare_qc.encode()
+            else:
+                prepare_proof = [
+                    m.encode()
+                    for m in cache.prepares.values()
+                    if m.proposal_hash == cache.pre_prepare.proposal_hash
+                ]
         elif (
             self._recovered_prepared is not None
             and self._recovered_prepared[0] == number
@@ -717,6 +967,7 @@ class PBFTEngine:
             prepared_view=prepared_view,
             prepared_proposal=prepared_proposal,
             prepare_proof=prepare_proof,
+            prepared_qc=prepared_qc,
         )
         msg = PBFTMessage(
             packet_type=PacketType.VIEW_CHANGE,
@@ -811,6 +1062,33 @@ class PBFTEngine:
         except Exception:
             return None
         proposal_hash = block.header.hash(self.suite)
+        if payload.prepared_qc and self._qc_active():
+            # QC-mode proof: one aggregate verification over the carried
+            # prepare certificate (committee-size-independent view-change
+            # bandwidth); a bad cert falls through to the message proofs
+            from .qc import verify_header_cert
+
+            try:
+                cert = QuorumCert.decode(payload.prepared_qc)
+            except ValueError as e:
+                note_swallowed("pbft.prepared_qc_decode", e)
+            else:
+                pre = vote_preimage(
+                    self.suite,
+                    PacketType.PREPARE,
+                    payload.prepared_view,
+                    block.header.number,
+                    proposal_hash,
+                )
+                if (
+                    cert.committee == self.config.committee_size
+                    and sum(
+                        self.config.weight_of(i) for i in cert.signers()
+                    )
+                    >= self.config.quorum
+                    and verify_header_cert(cert, self.config.qc_pubs(), pre)
+                ):
+                    return payload.prepared_view, block, proposal_hash
         weight = 0
         seen: set[int] = set()
         for raw in payload.prepare_proof:
@@ -871,6 +1149,8 @@ class PBFTEngine:
         }
         self._view_changes = {v: m for v, m in self._view_changes.items() if v > view}
         self._view_locks = {v: l for v, l in self._view_locks.items() if v >= view}
+        if self.qc is not None:
+            self.qc.reset_view(view)
         _log.info("entered view %d (leader=%s)", view,
                   self.config.leader_index(self.committed_number + 1, view))
 
@@ -906,6 +1186,8 @@ class PBFTEngine:
             stale = [n for n in self._caches if n <= number]
             for n in stale:
                 self._caches.pop(n)
+            if self.qc is not None:
+                self.qc.reset_below(number)
             self.config.reload(
                 self.ledger.consensus_nodes(), active_at=number + 1
             )

@@ -39,6 +39,12 @@ class PBFTMessage:
     proposal_data: bytes = b""
     payload: bytes = b""
     signature: bytes = b""
+    # QC-scheme vote signature over the shared vote preimage
+    # (consensus/qc.vote_preimage; for checkpoints, the header hash) —
+    # OUTSIDE the packet-signed fields, self-authenticating, and encoded
+    # only when present so FISCO_QC=0 wire bytes stay byte-identical to
+    # the pre-QC build
+    qc_sig: bytes = b""
 
     def _signed_fields(self) -> bytes:
         w = FlatWriter()
@@ -70,6 +76,8 @@ class PBFTMessage:
         w = FlatWriter()
         w.bytes_(self._signed_fields())
         w.bytes_(self.signature)
+        if self.qc_sig:
+            w.bytes_(self.qc_sig)
         return w.out()
 
     @classmethod
@@ -87,6 +95,8 @@ class PBFTMessage:
         )
         inner.done()
         msg.signature = r.bytes_()
+        if not r.at_end():
+            msg.qc_sig = r.bytes_()
         r.done()
         return msg
 
@@ -103,6 +113,10 @@ class ViewChangePayload:
     prepared_view: int = -1
     prepared_proposal: bytes = b""  # encoded Block, or empty
     prepare_proof: list[bytes] = field(default_factory=list)  # encoded PREPAREs
+    # constant-size alternative proof (QC mode): the encoded prepare-quorum
+    # QuorumCert — view-change bandwidth independent of committee size.
+    # Optional trailing section; absent = byte-identical legacy encoding.
+    prepared_qc: bytes = b""
 
     def encode(self) -> bytes:
         w = FlatWriter()
@@ -110,12 +124,16 @@ class ViewChangePayload:
         w.i64(self.prepared_view)
         w.bytes_(self.prepared_proposal)
         w.seq(self.prepare_proof, lambda w2, b: w2.bytes_(b))
+        if self.prepared_qc:
+            w.bytes_(self.prepared_qc)
         return w.out()
 
     @classmethod
     def decode(cls, buf: bytes) -> "ViewChangePayload":
         r = FlatReader(buf)
         p = cls(r.i64(), r.i64(), r.bytes_(), r.seq(lambda r2: r2.bytes_()))
+        if not r.at_end():
+            p.prepared_qc = r.bytes_()
         r.done()
         return p
 

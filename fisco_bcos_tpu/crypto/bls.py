@@ -1,0 +1,204 @@
+"""BLSCrypto — the BLS12-381 aggregate-signature scheme in the CryptoSuite
+plugin layer (the QC subsystem's heavy rung).
+
+Single-item sign/verify ride the pure-Python reference
+(:mod:`.ref.bls12_381`) with cached point deserialization — committee
+pubkeys and quorum signatures deserialize once per process, not once per
+check. Aggregate verification — THE hot call: one pairing check admits a
+whole quorum — routes through the shared DevicePlane as the
+``bls_aggregate_verify`` op on whatever lane the caller tagged (consensus
+for QC admission), merging concurrent certificate checks from block-sync /
+lightnode header storms into one jitted pairing program. CPU backends and
+sub-threshold batches take the bit-identical host pairing, exactly the
+``use_native_batch`` contract the other curves follow.
+
+Key model: BLS keypairs are DERIVED (secret scalar mod r) from the node's
+main consensus secret, and the committee's BLS pubkeys are registered in
+the consensus-node table (``ConsensusNode.qc_pub``) — registration is the
+proof-of-possession boundary that makes same-message aggregation
+rogue-key safe (consensus/qc.py docs).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from ..crypto.ref import bls12_381 as ref
+from .suite import SignatureCrypto, KeyPair, use_native_batch
+
+
+@lru_cache(maxsize=4096)
+def _g1_point(pub48: bytes):
+    """Cached, validated pubkey deserialization (None = malformed/out of
+    subgroup). The cache is what makes per-quorum aggregate verification
+    pay only the pairing, not 2f+1 subgroup checks."""
+    try:
+        return ref.decompress_g1(pub48)
+    except ValueError:
+        return None
+
+
+@lru_cache(maxsize=4096)
+def _g2_point(sig96: bytes):
+    try:
+        return ref.decompress_g2(sig96)
+    except ValueError:
+        return None
+
+
+@lru_cache(maxsize=1024)
+def _apk_point(pubs: tuple[bytes, ...]):
+    """Aggregate pubkey for a signer set (quorum bitmaps repeat across
+    rounds, so the G1 additions amortize too)."""
+    acc = None
+    for p in pubs:
+        pt = _g1_point(p)
+        if pt is None:
+            return None
+        acc = ref.ec_add(acc, pt, ref.FP_OPS)
+    return acc
+
+
+def _aggregate_plane_exec(impl: "BLSCrypto"):
+    """Plane executor: merge every queued request's checks into ONE
+    pairing batch; one result row per check, sliced back per request."""
+
+    def run(reqs):
+        checks: list = []
+        for r in reqs:
+            checks.extend(r.payload)
+        ok = impl._aggregate_verify_merged(checks)
+        out, lo = [], 0
+        for r in reqs:
+            out.append(ok[lo : lo + r.n])
+            lo += r.n
+        return out
+
+    return run
+
+
+class BLSCrypto(SignatureCrypto):
+    """Min-pubkey-size BLS: 48-byte G1 pubkeys, 96-byte G2 signatures,
+    same-message aggregation (the QC case)."""
+
+    name = "bls12_381"
+    sig_len = 96
+
+    def generate_keypair(self, secret: int | None = None) -> KeyPair:
+        import secrets as _secrets
+
+        if secret is None:
+            secret = int.from_bytes(_secrets.token_bytes(32), "big")
+        sk, pub = ref.keygen(secret)
+        return KeyPair(sk, pub)
+
+    def sign(self, kp: KeyPair, msg_hash: bytes) -> bytes:
+        return ref.sign(kp.secret, msg_hash)
+
+    def verify(self, pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+        pk = _g1_point(bytes(pub))
+        s = _g2_point(bytes(sig))
+        if pk is None or s is None:
+            return False
+        return ref.pairing_check(
+            [(ref.ec_neg(ref.G1, ref.FP_OPS), s), (pk, ref.hash_to_g2(bytes(msg_hash)))]
+        )
+
+    def recover(self, msg_hash: bytes, sig: bytes) -> bytes:
+        raise ValueError("BLS signatures carry no recoverable public key")
+
+    def batch_verify(self, msg_hashes, pubs, sigs) -> np.ndarray:
+        """Independent-message batch (per-signer isolation fallback): host
+        loop over cached points — distinct messages have no shared pairing
+        structure worth a merged program at QC sizes."""
+        return np.array(
+            [
+                self.verify(bytes(p), bytes(h), bytes(s))
+                for h, p, s in zip(msg_hashes, pubs, sigs)
+            ],
+            dtype=bool,
+        )
+
+    def batch_recover(self, msg_hashes, sigs):
+        raise ValueError("BLS signatures carry no recoverable public key")
+
+    # -- aggregation (the QC surface) ---------------------------------------
+
+    def aggregate(self, sigs: list[bytes]) -> bytes:
+        """Sum the G2 signatures into one 96-byte certificate signature."""
+        acc = None
+        for s in sigs:
+            pt = _g2_point(bytes(s))
+            if pt is None:
+                raise ValueError("malformed signature in aggregate")
+            acc = ref.ec_add(acc, pt, ref.FP2_OPS)
+        return ref.compress_g2(acc)
+
+    def aggregate_verify(
+        self, pubs: list[bytes], msg_hash: bytes, agg_sig: bytes
+    ) -> bool:
+        """One pairing check for the whole signer set (same message)."""
+        return bool(
+            self.aggregate_verify_batch([(tuple(pubs), msg_hash, agg_sig)])[0]
+        )
+
+    def aggregate_verify_batch(self, checks) -> np.ndarray:
+        """checks: [(pubs tuple, msg_hash, agg_sig)] -> bool[B], routed
+        through the DevicePlane (op ``bls_aggregate_verify``) so
+        concurrent QC admissions merge into one pairing program."""
+        checks = [
+            (tuple(bytes(p) for p in pubs), bytes(m), bytes(s))
+            for pubs, m, s in checks
+        ]
+        from ..device.plane import get_plane, plane_route, plane_wait
+
+        if plane_route() and checks:
+            return plane_wait(
+                get_plane().submit(
+                    "bls_aggregate_verify",
+                    checks,
+                    len(checks),
+                    _aggregate_plane_exec(self),
+                )
+            )
+        return self._aggregate_verify_merged(checks)
+
+    def _aggregate_verify_merged(self, checks) -> np.ndarray:
+        """The merged-batch body both dispatch modes share. Deserialization
+        and hash-to-G2 are host-side (cached); the pairing runs on device
+        for large merged batches on accelerator backends, else on the
+        bit-identical host reference."""
+        from ..observability.device import device_span
+        from ..ops.hash_common import bucket_batch
+
+        triples = []
+        for pubs, msg, agg in checks:
+            apk = _apk_point(pubs) if pubs else None
+            sig = _g2_point(agg)
+            hm = ref.hash_to_g2(msg) if apk is not None and sig is not None else None
+            triples.append((apk, sig, hm))
+        n = len(triples)
+        from ..ops import bls12_381 as bls_ops
+
+        if use_native_batch(n):
+            from .suite import _note_dispatch_path
+
+            _note_dispatch_path("bls_aggregate_verify", "native")
+            return bls_ops.host_pairing_check_batch(triples)
+        from .suite import _note_dispatch_path
+
+        _note_dispatch_path("bls_aggregate_verify", "device")
+        with device_span(
+            "bls_aggregate_verify", n, shape_key=bucket_batch(max(n, 1))
+        ):
+            return bls_ops.pairing_check_batch(triples)
+
+
+def bls_suite():
+    """Keccak256 + BLS12-381 — the aggregate-QC suite, registered beside
+    ecdsa_suite/sm_suite (reference: the ProtocolInitializer suite choice)."""
+    from .suite import CryptoSuite, Keccak256
+
+    return CryptoSuite(Keccak256(), BLSCrypto())

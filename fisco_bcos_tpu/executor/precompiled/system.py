@@ -85,13 +85,20 @@ class ConsensusPrecompiled(Precompiled):
 
     def _upsert(self, ctx, node_hex: str, node_type: str, weight: int):
         nid = self._node_id(node_hex)
-        nodes = [n for n in self._nodes(ctx) if n.node_id != nid]
+        prior = self._nodes(ctx)
+        nodes = [n for n in prior if n.node_id != nid]
         if node_type != "consensus_sealer" and not any(
             n.node_type == "consensus_sealer" for n in nodes
         ):
             raise PrecompiledError("cannot demote the last sealer")
+        # a re-added member keeps its registered QC pubkey (the consensus
+        # secret, hence the derived qc_pub, didn't change)
+        kept_qc = next((n.qc_pub for n in prior if n.node_id == nid), b"")
         nodes.append(
-            ConsensusNode(nid, weight, node_type, enable_number=ctx.block_number + 1)
+            ConsensusNode(
+                nid, weight, node_type,
+                enable_number=ctx.block_number + 1, qc_pub=kept_qc,
+            )
         )
         self._store(ctx, nodes)
         return PrecompiledResult(output=ctx.codec.encode_output(["int256"], 0))
@@ -125,7 +132,7 @@ class ConsensusPrecompiled(Precompiled):
             raise PrecompiledError("node not found")
         updated = [
             ConsensusNode(n.node_id, weight if n.node_id == nid else n.weight,
-                          n.node_type, n.enable_number)
+                          n.node_type, n.enable_number, qc_pub=n.qc_pub)
             for n in nodes
         ]
         self._store(ctx, updated)

@@ -86,6 +86,13 @@ class ConsensusNode:
     weight: int = 1
     node_type: str = "consensus_sealer"  # or "consensus_observer"
     enable_number: int = 0
+    # registered quorum-certificate pubkey (consensus/qc.py): 32-byte
+    # ed25519 or 48-byte BLS G1, derived from the member's consensus
+    # secret (qc_pub_for). Empty = member not QC-capable — the engine then
+    # keeps the legacy per-signature path for the whole committee.
+    # Registration here is the proof-of-possession boundary for BLS
+    # rogue-key safety.
+    qc_pub: bytes = b""
 
 
 @dataclass
@@ -132,18 +139,33 @@ def _encode_nodes(nodes: list[ConsensusNode]) -> bytes:
             w2.u64(n.weight),
             w2.str_(n.node_type),
             w2.i64(n.enable_number),
+            w2.bytes_(n.qc_pub),
         ),
     )
     return w.out()
 
 
 def _decode_nodes(buf: bytes) -> list[ConsensusNode]:
-    r = FlatReader(buf)
-    nodes = r.seq(
-        lambda r2: ConsensusNode(r2.bytes_(), r2.u64(), r2.str_(), r2.i64())
-    )
-    r.done()
-    return nodes
+    # current format carries qc_pub per row; fall back to the pre-QC row
+    # shape for tables written by an older build (durable sqlite chains)
+    for with_qc in (True, False):
+        try:
+            r = FlatReader(buf)
+            nodes = r.seq(
+                lambda r2: ConsensusNode(
+                    r2.bytes_(),
+                    r2.u64(),
+                    r2.str_(),
+                    r2.i64(),
+                    qc_pub=r2.bytes_() if with_qc else b"",
+                )
+            )
+            r.done()
+            return nodes
+        except ValueError:
+            if not with_qc:
+                raise
+    raise ValueError("undecodable consensus node table")
 
 
 def _encode_hash_list(hashes: list[bytes]) -> bytes:

@@ -296,10 +296,17 @@ class LightNode:
                 raise ValueError(f"header {n} fails QC verification")
             self.headers[n] = header
             self.head = n
-            # committee handoff: the verified header defines the next epoch
+            # committee handoff: the verified header defines the next epoch.
+            # QC pubkeys carry forward by node_id — headers name sealers,
+            # not their QC keys, so a member NEW to the committee joins
+            # without one and the validator falls back to requiring a
+            # signature_list for subsequent headers (documented limitation:
+            # QC-chain committee additions need out-of-band qc_pub
+            # distribution to light clients, docs/consensus_qc.md)
+            known_qc = {c.node_id: c.qc_pub for c in self.committee}
             weights = header.consensus_weights or [1] * len(header.sealer_list)
             self.committee = [
-                ConsensusNode(nid, weight=wt)
+                ConsensusNode(nid, weight=wt, qc_pub=known_qc.get(nid, b""))
                 for nid, wt in zip(header.sealer_list, weights)
             ]
         return self.head

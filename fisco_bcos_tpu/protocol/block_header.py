@@ -43,6 +43,13 @@ class BlockHeader:
     extra_data: bytes = b""
     consensus_weights: list[int] = field(default_factory=list)
     signature_list: list[SignatureTuple] = field(default_factory=list)
+    # encoded consensus/qc.QuorumCert (opaque at this layer — the protocol
+    # package must not import consensus): the constant-size replacement for
+    # signature_list when aggregate QCs are active. Like signature_list it
+    # sits OUTSIDE the hash preimage (it IS the signature over the hash),
+    # and it encodes only when present, so FISCO_QC=0 headers stay
+    # byte-identical to the pre-QC build.
+    qc: bytes = b""
     _hash: bytes | None = field(default=None, repr=False)
 
     def encode_hash_fields(self) -> bytes:
@@ -72,6 +79,8 @@ class BlockHeader:
             self.signature_list,
             lambda w2, s: (w2.i64(s.index), w2.bytes_(s.signature)),
         )
+        if self.qc:
+            w.bytes_(self.qc)
         return w.out()
 
     @classmethod
@@ -81,6 +90,8 @@ class BlockHeader:
         h.signature_list = r.seq(
             lambda r2: SignatureTuple(r2.i64(), r2.bytes_())
         )
+        if not r.at_end():
+            h.qc = r.bytes_()
         r.done()
         return h
 

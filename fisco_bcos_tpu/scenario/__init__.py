@@ -26,6 +26,7 @@ from .base import (
     get_scenario,
     list_scenarios,
 )
+from .big_committee import run_big_committee_bench
 from .proof_storm import run_proof_storm_bench
 from .runner import ScenarioRunner, run_isolation_bench
 
@@ -37,6 +38,7 @@ __all__ = [
     "WorkloadContext",
     "get_scenario",
     "list_scenarios",
+    "run_big_committee_bench",
     "run_isolation_bench",
     "run_proof_storm_bench",
 ]

@@ -1,0 +1,273 @@
+"""BLS12-381: reference pairing correctness + device-kernel building
+blocks (ISSUE 12).
+
+The reference (crypto/ref/bls12_381.py) is pinned by algebraic facts —
+bilinearity, GT order, aggregation identities — not by transcribed test
+vectors, matching its derive-don't-transcribe design. The device kernels
+(ops/bls12_381.py) are pinned bit-exact against the reference at every
+tower level eagerly (cheap); the full jitted pairing program is compiled
+and cross-checked in the slow tier (tool/check_qc.py --kernel or
+`-m slow`), since one XLA-CPU compile of the Miller loop costs minutes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fisco_bcos_tpu.crypto.ref import bls12_381 as R
+
+MSG = b"\xab" * 32
+
+
+# ---------------------------------------------------------------------------
+# Reference: fields, curves, pairing
+# ---------------------------------------------------------------------------
+
+
+def test_fp2_field_axioms():
+    rng = random.Random(11)
+    for _ in range(4):
+        a = (rng.randrange(R.P), rng.randrange(R.P))
+        b = (rng.randrange(R.P), rng.randrange(R.P))
+        assert R.f2_mul(a, R.f2_inv(a)) == R.F2_ONE
+        assert R.f2_mul(a, b) == R.f2_mul(b, a)
+        sq = R.f2_sqr(a)
+        r = R.f2_sqrt(sq)
+        assert r is not None and R.f2_sqr(r) == sq
+
+
+def test_f12_inverse_and_frobenius():
+    rng = random.Random(12)
+    f = tuple(rng.randrange(R.P) for _ in range(12))
+    assert R.f12_mul(f, R.f12_inv(f)) == R.F12_ONE
+    # Frobenius really is x -> x^p (the matrix is computed, not assumed)
+    assert R.f12_frob(f, 1) == R.f12_pow(f, R.P)
+
+
+def test_jacobian_matches_affine_ladder():
+    rng = random.Random(13)
+    for F, gen in ((R.FP_OPS, R.G1), (R.FP2_OPS, R.G2)):
+        for k in (1, 2, 3, R.R_ORDER - 1, rng.randrange(1, 1 << 255)):
+            assert R.ec_mul(gen, k, F) == R.ec_mul_affine(gen, k, F)
+
+
+def test_pairing_bilinearity_and_gt_order():
+    e = R.pairing(R.G1, R.G2)
+    assert e != R.F12_ONE  # non-degenerate
+    assert R.f12_pow(e, R.R_ORDER) == R.F12_ONE  # lands in GT
+    assert R.pairing(R.ec_mul(R.G1, 5, R.FP_OPS), R.G2) == R.f12_pow(e, 5)
+    assert R.pairing(R.G1, R.ec_mul(R.G2, 7, R.FP2_OPS)) == R.f12_pow(e, 7)
+    assert R.pairing_check([(R.ec_neg(R.G1, R.FP_OPS), R.G2), (R.G1, R.G2)])
+
+
+def test_hash_to_g2_lands_in_subgroup():
+    q = R.hash_to_g2(b"fisco-qc-test")
+    assert R.ec_on_curve(q, R.FP2_OPS)
+    assert R.subgroup_check_g2(q)
+    assert q == R.hash_to_g2(b"fisco-qc-test")  # deterministic
+    assert q != R.hash_to_g2(b"fisco-qc-test2")
+
+
+def test_sign_verify_aggregate():
+    ks = [R.keygen(0xA11CE + i) for i in range(4)]
+    sigs = [R.sign(sk, MSG) for sk, _ in ks]
+    pks = [pk for _, pk in ks]
+    assert R.verify(pks[0], MSG, sigs[0])
+    assert not R.verify(pks[1], MSG, sigs[0])  # wrong key
+    assert not R.verify(pks[0], b"\xcd" * 32, sigs[0])  # wrong message
+    agg = R.aggregate_signatures(sigs)
+    assert len(agg) == 96  # constant-size certificate signature
+    assert R.aggregate_verify(pks, MSG, agg)
+    assert not R.aggregate_verify(pks[:3], MSG, agg)  # bitmap mismatch
+    bad = R.aggregate_signatures(sigs[:3] + [R.sign(ks[3][0], b"\x01" * 32)])
+    assert not R.aggregate_verify(pks, MSG, bad)  # one bad vote
+
+
+def test_compression_roundtrip_and_subgroup_rejection():
+    _, pk = R.keygen(0xF00)
+    pt = R.decompress_g1(pk)
+    assert R.compress_g1(pt) == pk
+    sig = R.sign(7, MSG)
+    pt2 = R.decompress_g2(sig)
+    assert R.compress_g2(pt2) == sig
+    # a curve point OUTSIDE the r-torsion must be rejected at the
+    # deserialization trust boundary
+    raw = R._curve_point_g2(b"not-in-subgroup")
+    with pytest.raises(ValueError):
+        R.decompress_g2(R.compress_g2(raw))
+    with pytest.raises(ValueError):
+        R.decompress_g1(b"\x00" * 48)  # no compression flag
+
+
+# ---------------------------------------------------------------------------
+# Device kernels: tower levels pinned bit-exact against the reference
+# (eager execution — no jit compiles in the fast tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def K():
+    from fisco_bcos_tpu.ops import bls12_381 as K
+
+    return K
+
+
+def _fp_dev(K, vals):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.stack([K._mont(v) for v in vals], axis=1))
+
+
+def _fp_host(K, arr):
+    rows = np.asarray(arr)
+    rinv = pow(K.R384, -1, R.P)
+    return [
+        sum(int(rows[i, j]) << (16 * i) for i in range(24)) * rinv % R.P
+        for j in range(rows.shape[1])
+    ]
+
+
+def test_kernel_fp_montgomery(K):
+    rng = random.Random(21)
+    a = [rng.randrange(R.P) for _ in range(2)]
+    b = [rng.randrange(R.P) for _ in range(2)]
+    assert _fp_host(K, K.Fp.mul(_fp_dev(K, a), _fp_dev(K, b))) == [
+        x * y % R.P for x, y in zip(a, b)
+    ]
+    assert _fp_host(K, K.Fp.sub(_fp_dev(K, a), _fp_dev(K, b))) == [
+        (x - y) % R.P for x, y in zip(a, b)
+    ]
+    assert _fp_host(K, K.Fp.muli(_fp_dev(K, a), 8)) == [x * 8 % R.P for x in a]
+
+
+def test_kernel_fp2_matches_reference(K):
+    rng = random.Random(22)
+    a = [(rng.randrange(R.P), rng.randrange(R.P)) for _ in range(2)]
+    b = [(rng.randrange(R.P), rng.randrange(R.P)) for _ in range(2)]
+
+    def dev(vals):
+        return (_fp_dev(K, [v[0] for v in vals]), _fp_dev(K, [v[1] for v in vals]))
+
+    def host(pair):
+        return list(zip(_fp_host(K, pair[0]), _fp_host(K, pair[1])))
+
+    assert host(K.f2_mul(dev(a), dev(b))) == [
+        R.f2_mul(x, y) for x, y in zip(a, b)
+    ]
+    assert host(K.f2_inv(dev(a))) == [R.f2_inv(x) for x in a]
+    assert host(K.f2_mul_xi(dev(a))) == [R.f2_mul(x, R.XI) for x in a]
+
+
+def _tower_dev(K, flat):
+    """Reference flat w-basis coeffs -> device tower element, T=1 lane."""
+    # flat[k] at w^k; tower coeff (a,b) at v^alpha w^beta maps to
+    # (a - b) at w^(2*alpha+beta) and b at w^(2*alpha+beta+6)
+    g, h = [], []
+    for beta, dst in ((0, g), (1, h)):
+        for alpha in range(3):
+            k = 2 * alpha + beta
+            b = flat[k + 6]
+            a = (flat[k] + b) % R.P
+            dst.append((_fp_dev(K, [a]), _fp_dev(K, [b])))
+    return (tuple(g), tuple(h))
+
+
+def _tower_host(K, f12):
+    g, h = f12
+    flat = [0] * 12
+    for beta, src in ((0, g), (1, h)):
+        for alpha in range(3):
+            a = _fp_host(K, src[alpha][0])[0]
+            b = _fp_host(K, src[alpha][1])[0]
+            k = 2 * alpha + beta
+            flat[k] = (a - b) % R.P
+            flat[k + 6] = b
+    return tuple(flat)
+
+
+@pytest.mark.slow  # ~30-40s of eager limb ops — device-only surface
+def test_kernel_f12_tower_matches_reference_basis(K):
+    rng = random.Random(23)
+    a = tuple(rng.randrange(R.P) for _ in range(12))
+    b = tuple(rng.randrange(R.P) for _ in range(12))
+    assert _tower_host(K, _tower_dev(K, a)) == a  # conversion involutive
+    got = _tower_host(K, K.f12_mul(_tower_dev(K, a), _tower_dev(K, b)))
+    assert got == R.f12_mul(a, b), "tower multiplication diverges"
+    got_sq = _tower_host(K, K.f12_sqr(_tower_dev(K, a)))
+    assert got_sq == R.f12_mul(a, a)
+
+
+@pytest.mark.slow  # ~30-40s of eager limb ops — device-only surface
+def test_kernel_f12_inv_and_frobenius(K):
+    rng = random.Random(24)
+    a = tuple(rng.randrange(R.P) for _ in range(12))
+    got = _tower_host(K, K.f12_inv(_tower_dev(K, a)))
+    assert got == R.f12_inv(a), "tower inversion diverges"
+    for k in (1, 2, 6):
+        got = _tower_host(K, K.f12_frob(_tower_dev(K, a), k))
+        assert got == R.f12_frob(a, k), f"tower frobenius p^{k} diverges"
+
+
+@pytest.mark.slow  # ~30-40s of eager limb ops — device-only surface
+def test_kernel_g2_jacobian_step_matches_reference(K):
+    # one doubling + one mixed add on the twist, Z-normalized back to
+    # affine, against the reference's affine group law
+    q = R.G2
+    X = (_fp_dev(K, [q[0][0]]), _fp_dev(K, [q[0][1]]))
+    Y = (_fp_dev(K, [q[1][0]]), _fp_dev(K, [q[1][1]]))
+    one = K.f2_one(X[0])
+    (X2, Y2, Z2), _line = K._dbl_step((X, Y, one), K.Fp.one(X[0]), K.Fp.one(X[0]))
+
+    def to_affine(X, Y, Z):
+        zi = K.f2_inv(Z)
+        zi2 = K.f2_sqr(zi)
+        xa = K.f2_mul(X, zi2)
+        ya = K.f2_mul(Y, K.f2_mul(zi, zi2))
+        return (
+            (_fp_host(K, xa[0])[0], _fp_host(K, xa[1])[0]),
+            (_fp_host(K, ya[0])[0], _fp_host(K, ya[1])[0]),
+        )
+
+    assert to_affine(X2, Y2, Z2) == R.ec_double(q, R.FP2_OPS)
+    q3 = R.ec_mul(R.G2, 3, R.FP2_OPS)
+    Q3 = (
+        (_fp_dev(K, [q3[0][0]]), _fp_dev(K, [q3[0][1]])),
+        (_fp_dev(K, [q3[1][0]]), _fp_dev(K, [q3[1][1]])),
+    )
+    (X5, Y5, Z5), _l2 = K._add_step(
+        (X2, Y2, Z2), Q3, K.Fp.one(X[0]), K.Fp.one(X[0])
+    )
+    assert to_affine(X5, Y5, Z5) == R.ec_mul(R.G2, 5, R.FP2_OPS)
+
+
+@pytest.mark.slow
+def test_full_pairing_kernel_matches_reference():
+    """Compile the whole jitted pairing program and cross-check it against
+    the host reference on valid/invalid aggregate lanes. The XLA-CPU
+    compile is HOUR-class on a 1-core host (the Miller scan body alone is
+    ~2.5x the repo's biggest EC program) — this test is meant for
+    accelerator hosts / the persistent jit cache; tool/check_qc.py
+    --kernel runs the same check standalone. Every tower level and point
+    op the program composes is pinned bit-exact against the reference by
+    the eager tests above, which do run routinely."""
+    from fisco_bcos_tpu.ops import bls12_381 as K
+
+    hm = R.hash_to_g2(b"\x17" * 32)
+    ks = [R.keygen(777 + i) for i in range(3)]
+    sig_pts = [R.ec_mul(hm, sk, R.FP2_OPS) for sk, _ in ks]
+    agg_sig = None
+    apk = None
+    for (sk, pk), sp in zip(ks, sig_pts):
+        agg_sig = R.ec_add(agg_sig, sp, R.FP2_OPS)
+        apk = R.ec_add(apk, R.decompress_g1(pk), R.FP_OPS)
+    apk_bad = R.ec_add(apk, R.decompress_g1(R.keygen(999)[1]), R.FP_OPS)
+    checks = [
+        (apk, agg_sig, hm),
+        (apk_bad, agg_sig, hm),
+        (R.decompress_g1(ks[0][1]), sig_pts[0], hm),
+        (None, sig_pts[0], hm),
+    ]
+    expect = [True, False, True, False]
+    assert list(K.host_pairing_check_batch(checks)) == expect
+    assert list(K.pairing_check_batch(checks)) == expect

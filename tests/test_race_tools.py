@@ -33,6 +33,7 @@ from fisco_bcos_tpu.analysis.harnesses import (
     DevicePlaneHarness,
     PipelineObsHarness,
     ProofPlaneHarness,
+    QuorumCollectorHarness,
     RacyCounterHarness,
     SchedulerHarness,
 )
@@ -187,7 +188,7 @@ def test_deadlock_schedule_is_reported_not_hung():
 @pytest.mark.parametrize(
     "cls",
     [DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
-     SchedulerHarness, PipelineObsHarness],
+     SchedulerHarness, PipelineObsHarness, QuorumCollectorHarness],
     ids=lambda c: c.name,
 )
 def test_real_harness_seeded_sweep(cls):
@@ -199,7 +200,7 @@ def test_real_harness_seeded_sweep(cls):
 def test_real_harnesses_registry_complete():
     assert set(HARNESSES) == {
         "device-plane", "proof-singleflight", "admission-quotas",
-        "scheduler-commit", "pipeline-obs",
+        "scheduler-commit", "pipeline-obs", "qc-collector",
     }
 
 
