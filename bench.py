@@ -433,6 +433,12 @@ def bench_flood() -> None:
     err = None
     t_child = time.monotonic()
     child_budget = _child_budget_s()
+    if os.environ.get("FISCO_BENCH_TELEMETRY"):
+        # ISSUE 13: compile-ledger hooks must be live BEFORE the warm
+        # (compile) round so cold compiles are measured, not inferred
+        from fisco_bcos_tpu.observability.device import install_observatory
+
+        install_observatory()
 
     def flood_round(txs, deadline: float | None = None):
         nonlocal err
@@ -483,15 +489,25 @@ def bench_flood() -> None:
     # actually spent the flood window; its duty cycle (sample cost /
     # wall) is the honest on/off overhead bound on this 1-core host
     prof = None
+    warm_ledger = None
     if os.environ.get("FISCO_BENCH_TELEMETRY"):
         from fisco_bcos_tpu.observability import critical_path
+        from fisco_bcos_tpu.observability.device import LEDGER
         from fisco_bcos_tpu.observability.pipeline import PIPELINE
         from fisco_bcos_tpu.observability.profiler import SamplingProfiler
 
         # measured-window boundary: drop the warm/compile round's tx index
         # and stage totals so the artifact's per-stage vector covers ONLY
         # the measured flood — otherwise round-over-round check_perf diffs
-        # would be dominated by cold-vs-warm compile variance
+        # would be dominated by cold-vs-warm compile variance. The warm
+        # round's compile ledger is kept for the device artifact (it is
+        # where the cold compiles live by design), then reset so the
+        # measured window's per-op phase vector is compile-clean.
+        warm_ledger = {
+            "ledger": LEDGER.snapshot(),
+            "op_phase_ms": LEDGER.phase_totals(),
+        }
+        LEDGER.reset()
         critical_path.clear_indexes()
         PIPELINE.reset()
         prof = SamplingProfiler(hz=100.0)
@@ -526,6 +542,7 @@ def bench_flood() -> None:
     _emit(M_FLOOD[0], tps, M_FLOOD[1], tps / 10_000.0, error=err)  # vs README.md:10
     if prof is not None:
         _dump_pipeline_artifact("flood", tps, prof, dt)
+        _dump_device_artifact("flood", dt, warm_ledger)
     if plane_enabled():
         plane = get_plane()
         plane.drain(10.0)
@@ -740,6 +757,56 @@ def _dump_pipeline_artifact(tag: str, tps: float, prof, window_s: float) -> None
         f"# pipeline: busiest={busiest} top_blocked=[{top_edge}] "
         f"profiler_samples={report['samples']} "
         f"overhead={overhead_pct:.2f}% -> {path}",
+        flush=True,
+    )
+
+
+def _dump_device_artifact(tag: str, window_s: float, warm_ledger) -> None:
+    """ISSUE 13 round artifact: the device observatory's view of the
+    MEASURED flood window — per-op queue/compile/transfer/execute phase
+    vector (what tool/check_perf.py diffs round over round, execute-phase
+    per op), the measured compile ledger (ideally compile-free: the warm
+    round paid the compiles, kept under ``warm_round``), storm state, and
+    the observatory's own measured bookkeeping overhead (< 5% of flood
+    wall is the acceptance bound)."""
+    from fisco_bcos_tpu.observability.device import LEDGER, compile_counts
+
+    rows = LEDGER.snapshot()
+    doc = {
+        "tag": tag,
+        "window_s": round(window_s, 3),
+        "op_phase_ms": LEDGER.phase_totals(),
+        "ledger": rows,
+        "cold_compiles": sum(r["cold_compiles"] for r in rows),
+        "cache_hits": sum(r["cache_hits"] for r in rows),
+        "compile_counts": compile_counts(),
+        "storm": LEDGER.storm_state(),
+        "obs_overhead_s": round(LEDGER.overhead_seconds(), 6),
+        "warm_round": warm_ledger,
+    }
+    base = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(base, f"bench_telemetry.{tag}.device.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    overhead_pct = doc["obs_overhead_s"] / max(window_s, 1e-9) * 100.0
+    # acceptance: the device observatory must cost < 5% of flood wall —
+    # vs_baseline is allowed/measured so >= 1.0 passes
+    _emit(
+        "flood_device_obs_overhead_pct",
+        overhead_pct,
+        "%",
+        5.0 / max(overhead_pct, 1e-6),
+        error=None if overhead_pct < 5.0 else "device observatory >= 5%",
+    )
+    execs = {
+        op: phases.get("execute", 0.0)
+        for op, phases in doc["op_phase_ms"].items()
+    }
+    top = max(execs.items(), key=lambda kv: kv[1], default=(None, 0.0))
+    print(
+        f"# device: {doc['cold_compiles']} cold compile(s) in the measured "
+        f"window, {doc['cache_hits']} cache load(s), top execute "
+        f"op={top[0]} ({top[1]:.0f}ms) -> {path}",
         flush=True,
     )
 

@@ -105,6 +105,7 @@ def build_node(opts: ChainOptions):
     gw.connect(node.front)
     from .observability import TRACER, profiler
     from .observability.critical_path import trace_tx
+    from .observability.device import device_doc
     from .observability.pipeline import pipeline_doc
     from .resilience import HEALTH
     from .rpc.group_manager import GroupManager, MultiGroupRpc
@@ -125,6 +126,7 @@ def build_node(opts: ChainOptions):
         trace_tx=trace_tx,
         pipeline=pipeline_doc,
         profile=profiler.profile,
+        device=device_doc,
     )
     ws = None
     if opts.ws_listen_port:

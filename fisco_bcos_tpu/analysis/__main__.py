@@ -42,6 +42,11 @@ def main(argv: list[str] | None = None) -> int:
         help="print the registered checkers with one-line descriptions",
     )
     p.add_argument(
+        "--list-jit", action="store_true",
+        help="print the jit-program inventory (what tool/warm_cache.py "
+        "pre-compiles) and exit",
+    )
+    p.add_argument(
         "--no-baseline", action="store_true",
         help="report every finding, ignoring accepted debt",
     )
@@ -50,6 +55,19 @@ def main(argv: list[str] | None = None) -> int:
         help="rewrite the baseline file to the current finding set",
     )
     args = p.parse_args(argv)
+
+    if args.list_jit:
+        from . import jitmap
+
+        progs = jitmap.inventory(args.root)
+        if args.format == "json":
+            print(json.dumps(progs, indent=2))
+        else:
+            for p_ in progs:
+                names = ", ".join(p_["names"])
+                print(f"{p_['file']}:{p_['line']}  {p_['qualname']}  [{names}]")
+            print(f"{len(progs)} jitted program(s)")
+        return 0
 
     from .checkers import ALL_CHECKERS, checker_by_name
 

@@ -153,3 +153,27 @@ def callable_names(jits: list[JitFunc]) -> set[str]:
     for j in jits:
         names.update(j.names)
     return names
+
+
+def inventory(root: str | None = None) -> list[dict]:
+    """The package's jit-program inventory as plain records:
+    ``{"file", "line", "qualname", "names"}`` per traced def, sorted by
+    (file, line).
+
+    This is the walkable form ``python -m fisco_bcos_tpu.analysis
+    --list-jit`` prints and ``tool/warm_cache.py`` drives: every device
+    program a node can compile at runtime — the ISSUE 12 BLS pairing
+    program in ``ops/bls12_381.py`` included — appears here, so a
+    pre-warmer that covers this list covers the node's whole compile
+    surface (tests/test_static_analysis.py pins the count)."""
+    from .core import load_sources
+
+    return [
+        {
+            "file": j.source.relpath,
+            "line": j.node.lineno,
+            "qualname": j.qualname,
+            "names": list(j.names),
+        }
+        for j in collect(load_sources(root))
+    ]

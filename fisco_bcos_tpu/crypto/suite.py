@@ -923,6 +923,7 @@ class CryptoSuite:
         construction — both paths run the same constructor.
         """
         from ..device.plane import get_plane, plane_route, plane_wait
+        from ..observability.device import device_span
 
         leaves = np.asarray(leaves, dtype=np.uint8)
         if plane_route() and len(leaves) > 1:
@@ -936,7 +937,17 @@ class CryptoSuite:
                 len(leaves),
                 _merkle_tree_plane_exec(self.hash_impl.name),
             ))
-        return merkle_ops.MerkleTree(leaves, hasher=self.hash_impl.name)
+        # direct path gets the same span the plane executor wraps builds
+        # in — tree hashing stays attributed with the plane off too
+        with device_span(
+            "merkle_tree",
+            len(leaves),
+            shape_key=(
+                self.hash_impl.name,
+                merkle_ops.bucket_leaves(max(len(leaves), 1)),
+            ),
+        ):
+            return merkle_ops.MerkleTree(leaves, hasher=self.hash_impl.name)
 
 
 def _merkle_tree_plane_exec(hasher: str):

@@ -181,25 +181,21 @@ class DevicePlane:
         starvation_ms: float | None = None,
         autostart: bool = True,
     ):
-        def _env(name: str, default: str) -> float:
-            try:
-                return float(os.environ.get(name, default) or default)
-            except ValueError:
-                return float(default)
+        from ..utils import env_float as _env
 
         if window_ms is not None:
             self.window_ms = float(window_ms)
         elif os.environ.get("FISCO_DEVICE_WINDOW_MS"):
-            self.window_ms = _env("FISCO_DEVICE_WINDOW_MS", "2")
+            self.window_ms = _env("FISCO_DEVICE_WINDOW_MS", 2.0)
         else:
             self.window_ms = self._default_window_ms()
         self.high_water = (
-            int(_env("FISCO_DEVICE_HIGH_WATER", "4096"))
+            int(_env("FISCO_DEVICE_HIGH_WATER", 4096.0))
             if high_water is None
             else int(high_water)
         )
         self.starvation_ms = (
-            _env("FISCO_DEVICE_STARVATION_MS", "50")
+            _env("FISCO_DEVICE_STARVATION_MS", 50.0)
             if starvation_ms is None
             else float(starvation_ms)
         )
@@ -207,7 +203,7 @@ class DevicePlane:
         # scaled by its weight (FISCO_DEVICE_GROUP_WEIGHTS="g0=2,g1=1");
         # deficits persist across dispatches while a group has backlog and
         # reset when it drains (classic DRR)
-        self.group_quantum = max(1, int(_env("FISCO_DEVICE_GROUP_QUANTUM", "256")))
+        self.group_quantum = max(1, int(_env("FISCO_DEVICE_GROUP_QUANTUM", 256.0)))
         self.group_weights: dict[str, float] = {}
         for part in os.environ.get("FISCO_DEVICE_GROUP_WEIGHTS", "").split(","):
             name, _, w = part.strip().partition("=")
@@ -519,16 +515,46 @@ class DevicePlane:
                         lane=r.lane,
                         batch_span=f"{batch_ctx.span_id:016x}",
                     )
+        from ..observability.device import (
+            DEVICE_PHASE_BUCKETS_MS,
+            LEDGER,
+            device_obs_enabled,
+        )
+
+        # ledger attribution rides FISCO_DEVICE_OBS alone — it must keep
+        # working with the metrics registry off (the telemetry A/B leg),
+        # so it runs BEFORE the registry early-return. The queue segment
+        # is labeled with the plane's dispatch op; the kernel spans inside
+        # the executor carry compile/transfer/execute under their program
+        # op names (ISSUE 13 phase decomposition).
+        obs = device_obs_enabled()
+        if obs:
+            t_obs = time.perf_counter()
+            LEDGER.note_phases(
+                op, {"queue": sum((now - r.t_enq) * 1e3 for r in reqs)}
+            )
+            LEDGER.add_overhead(time.perf_counter() - t_obs)
         if not REGISTRY.enabled:
             return
         for r in reqs:
+            wait_ms = (now - r.t_enq) * 1e3
             REGISTRY.observe(
                 "fisco_device_plane_wait_ms",
-                (now - r.t_enq) * 1e3,
+                wait_ms,
                 buckets=WAIT_BUCKETS_MS,
                 help="queue wait from submit to dispatch, per lane",
                 lane=r.lane,
             )
+            if obs:
+                REGISTRY.observe(
+                    "fisco_device_phase_ms",
+                    wait_ms,
+                    buckets=DEVICE_PHASE_BUCKETS_MS,
+                    help="device-plane time attribution per op: "
+                    "queue / compile / transfer / execute segments",
+                    op=op,
+                    phase="queue",
+                )
         REGISTRY.counter_add(
             f'fisco_device_plane_dispatch_total{{op="{op}"}}',
             1.0,

@@ -31,7 +31,7 @@ import numpy as np
 
 from ..codec.flat import FlatReader, FlatWriter
 from ..crypto.suite import CryptoSuite
-from ..ops.merkle import MerkleProofItem, MerkleTree
+from ..ops.merkle import MerkleProofItem
 from ..protocol import Block, BlockHeader, Transaction, TransactionReceipt
 from ..protocol.transaction import hash_transactions_batch
 from ..storage.entry import Entry
@@ -381,7 +381,9 @@ class Ledger:
         except ValueError:
             return None
         leaves = np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
-        tree = MerkleTree(leaves, hasher=self.suite.hash_impl.name)
+        # through the suite seam: plane-routed (or direct-but-spanned) so
+        # the cache-off rebuild stays attributed in the device observatory
+        tree = self.suite.merkle_tree(leaves)
         return tree.proof(idx), idx, len(hashes)
 
     def tx_proof(self, tx_hash: bytes):
@@ -420,7 +422,7 @@ class Ledger:
         if len(rc_hashes) != len(hashes):
             return None
         leaves = np.frombuffer(b"".join(rc_hashes), dtype=np.uint8).reshape(-1, 32)
-        tree = MerkleTree(leaves, hasher=self.suite.hash_impl.name)
+        tree = self.suite.merkle_tree(leaves)
         return tree.proof(idx), idx, len(rc_hashes)
 
     def proof_batch_direct(

@@ -297,6 +297,12 @@ class Node:
                     "proof_plane.pending", self.proof_plane.pending_builds
                 )
             PIPELINE.ensure_sampler()
+        # device observatory (ISSUE 13): jax compile/cache hooks feeding
+        # the compile ledger + the per-device live-buffer watermark probe.
+        # FISCO_DEVICE_OBS=0 refuses the whole installation (noop layer).
+        from ..observability.device import install_observatory
+
+        install_observatory()
         if durable:
             # restart path: re-admit durably-stored pool txs (signatures
             # re-verified on device; Initializer.cpp:188-195 analog)

@@ -15,11 +15,15 @@ Three pieces (ISSUE 1 tentpole):
 - :mod:`.critical_path` — the per-transaction lifecycle stitcher behind
   ``GET /trace/tx/<hash>`` (tx→trace and block→trace indexes, cross-process
   span collection, ordered stage breakdown with the dominant stage named).
-- :mod:`.device` — the per-op device-crypto signal bundle (batch sizes,
-  latency, items/sec, compile-vs-cached counters). Imported directly as
-  ``from ..observability.device import device_span`` by the ops wrappers
-  (kept out of this namespace so importing the package never drags in the
-  metrics registry mid-import).
+- :mod:`.device` — the device observatory (ISSUE 13 on top of the ISSUE 1
+  signal bundle): per-op batch/latency/items metrics, the measured compile
+  ledger (cold compile vs persistent-cache load via JAX's monitoring
+  hooks), queue/compile/transfer/execute phase attribution, device memory
+  watermarks and the recompile-storm detector, served at ``GET /device``.
+  Imported directly as ``from ..observability.device import device_span``
+  by the ops wrappers (kept out of this namespace so importing the package
+  never drags in the metrics registry mid-import);
+  ``FISCO_DEVICE_OBS=0`` noops the observatory layer independently.
 - :mod:`.pipeline` — the pipeline observatory (ISSUE 9): per-stage
   busy/idle/blocked occupancy with blocked-on attribution plus the
   backpressure watermark sampler behind ``GET /pipeline``. Imported

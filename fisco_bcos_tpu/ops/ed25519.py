@@ -350,6 +350,13 @@ def verify_batch(msgs, pubs, sigs) -> np.ndarray:
     """Host API: per-item bytes (message, 32-byte pubkey, 64-byte R‖S) ->
     bool[B]. Challenges are hashed on the host; ALL curve math is one
     device program."""
+    from ..observability.device import device_span
+
     bsz = len(msgs)
-    ok = _verify_xla(*device_inputs(msgs, pubs, sigs))
-    return np.asarray(ok)[:bsz]
+    # challenge hashing (per-message host SHA-512 in device_inputs) stays
+    # OUTSIDE the span: booking host CPU as device execute would be the
+    # exact misattribution the observatory exists to remove
+    inputs = device_inputs(msgs, pubs, sigs)
+    with device_span("ed25519_verify", bsz):  # default key = batch bucket
+        ok = _verify_xla(*inputs)
+        return np.asarray(ok)[:bsz]

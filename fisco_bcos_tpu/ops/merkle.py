@@ -27,16 +27,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .keccak import keccak256_batch, keccak256_blocks
-from .sha256 import sha256_batch
-from .sm3 import sm3_batch
+from .keccak import keccak256_batch_async, keccak256_blocks
+from .sha256 import sha256_batch_async
+from .sm3 import sm3_batch_async
 
 HashBatchFn = Callable[[Sequence[bytes]], np.ndarray]
 
+# span-LESS async entries, resolved eagerly: the per-level hash calls run
+# inside the enclosing merkle device_span (merkle_root / the plane's
+# merkle_tree executor) — a nested per-level hash span would book the same
+# wall twice and misfile a cold hash-program compile as merkle execute
+# remainder (same reasoning as sm2_e_batch)
 _HASHERS: dict[str, HashBatchFn] = {
-    "keccak256": keccak256_batch,
-    "sm3": sm3_batch,
-    "sha256": sha256_batch,
+    "keccak256": lambda msgs: keccak256_batch_async(msgs)(),
+    "sm3": lambda msgs: sm3_batch_async(msgs)(),
+    "sha256": lambda msgs: sha256_batch_async(msgs)(),
 }
 
 
@@ -334,6 +339,8 @@ def merkle_root_async(
     resolve on call (letting the sealing path queue tx root, receipts root
     and state root before paying any device round trip); proofs, small
     trees and other hashers compute eagerly inside this call."""
+    from ..observability.device import device_span
+
     if not isinstance(leaves, jax.Array):
         leaves = np.asarray(leaves, dtype=np.uint8)
     # same validation whichever path runs (MerkleTree re-checks on its path)
@@ -341,32 +348,40 @@ def merkle_root_async(
         raise ValueError("leaves must be [N, 32] uint8")
     if width < 2:
         raise ValueError("width must be >= 2")
-    if hasher == "keccak256" and len(leaves) >= 256 and not _prefer_host_tree():
-        # jax.Array input stays on device — tx/receipt hashes come from the
-        # batch hash kernels, so the hot sealing path never round-trips the
-        # leaf tensor through the host. Padding to the leaf-count bucket
-        # happens OUTSIDE the jit so the tree program's input shape (and
-        # hence its compilation) is shared by every block size in the bucket.
-        n = len(leaves)
-        b = bucket_leaves(n)
-        arr = jnp.asarray(leaves).astype(jnp.uint8)
-        if b > n:
-            arr = jnp.concatenate([arr, jnp.zeros((b - n, 32), jnp.uint8)])
-        dev = _device_root_fn(b, width)(arr)
-        return lambda: bind_root(bytes(np.asarray(dev)), n, hasher)
-    root = MerkleTree(
-        np.asarray(leaves, dtype=np.uint8), width=width, hasher=hasher
-    ).root
-    return lambda: root
+    # the span lives HERE (not in the merkle_root sync wrapper) so the
+    # sealing path's suite.merkle_root_async calls are attributed too; it
+    # covers the dispatch only — the resolver's sync is the caller's wait,
+    # same contract as the hash-plane executor
+    n = len(leaves)
+    key = (hasher, width, bucket_leaves(max(n, 1)))
+    with device_span("merkle_root", n, shape_key=key):
+        if (
+            hasher == "keccak256"
+            and len(leaves) >= 256
+            and not _prefer_host_tree()
+        ):
+            # jax.Array input stays on device — tx/receipt hashes come from
+            # the batch hash kernels, so the hot sealing path never
+            # round-trips the leaf tensor through the host. Padding to the
+            # leaf-count bucket happens OUTSIDE the jit so the tree
+            # program's input shape (and hence its compilation) is shared
+            # by every block size in the bucket.
+            b = bucket_leaves(n)
+            arr = jnp.asarray(leaves).astype(jnp.uint8)
+            if b > n:
+                arr = jnp.concatenate([arr, jnp.zeros((b - n, 32), jnp.uint8)])
+            dev = _device_root_fn(b, width)(arr)
+            return lambda: bind_root(bytes(np.asarray(dev)), n, hasher)
+        root = MerkleTree(
+            np.asarray(leaves, dtype=np.uint8), width=width, hasher=hasher
+        ).root
+        return lambda: root
 
 
 def merkle_root(
     leaves: np.ndarray, width: int = 16, hasher: str = "keccak256"
 ) -> bytes:
-    """Root only (the hot path for block sealing: tx/receipt roots)."""
-    from ..observability.device import device_span
-
-    n = len(leaves)
-    key = (hasher, width, bucket_leaves(max(n, 1)))
-    with device_span("merkle_root", n, shape_key=key):
-        return merkle_root_async(leaves, width=width, hasher=hasher)()
+    """Root only (the hot path for block sealing: tx/receipt roots).
+    The device_span lives in :func:`merkle_root_async` — a second one here
+    would double-count the dispatch."""
+    return merkle_root_async(leaves, width=width, hasher=hasher)()

@@ -267,17 +267,17 @@ def verify_batch(
 
     bsz = len(msg_hashes)
     bb = _bucket(bsz)
-    with device_span("secp256k1_verify", bsz, shape_key=bb):
+    with device_span("secp256k1_verify", bsz, shape_key=bb) as sp:
         z = _pad_rows(bytes_be_to_limbs(msg_hashes), bb)
         r = _pad_rows(bytes_be_to_limbs(rs), bb)
         s = _pad_rows(bytes_be_to_limbs(ss), bb)
         pubkeys = np.asarray(pubkeys, dtype=np.uint8)
         qx = _pad_rows(bytes_be_to_limbs(pubkeys[:, :32]), bb)
         qy = _pad_rows(bytes_be_to_limbs(pubkeys[:, 32:]), bb)
-        out = verify_device(
-            jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(qx),
-            jnp.asarray(qy),
-        )
+        with sp.phase("transfer"):  # host->device staging of the operands
+            za, ra, sa = jnp.asarray(z), jnp.asarray(r), jnp.asarray(s)
+            qxa, qya = jnp.asarray(qx), jnp.asarray(qy)
+        out = verify_device(za, ra, sa, qxa, qya)
         return np.asarray(out)[:bsz]
 
 
@@ -290,15 +290,17 @@ def recover_batch(
 
     bsz = len(msg_hashes)
     bb = _bucket(bsz)
-    with device_span("secp256k1_recover", bsz, shape_key=bb):
+    with device_span("secp256k1_recover", bsz, shape_key=bb) as sp:
         sigs65 = np.asarray(sigs65, dtype=np.uint8)
         z = _pad_rows(bytes_be_to_limbs(msg_hashes), bb)
         r = _pad_rows(bytes_be_to_limbs(sigs65[:, :32]), bb)
         s = _pad_rows(bytes_be_to_limbs(sigs65[:, 32:64]), bb)
         v = _pad_rows(sigs65[:, 64].astype(np.int32), bb)
-        qx, qy, ok = recover_device(
-            jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v)
-        )
+        with sp.phase("transfer"):  # host->device staging of the operands
+            za, ra, sa, va = (
+                jnp.asarray(z), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v)
+            )
+        qx, qy, ok = recover_device(za, ra, sa, va)
         pubs = np.concatenate(
             [limbs_to_bytes_be(np.asarray(qx)), limbs_to_bytes_be(np.asarray(qy))],
             axis=-1,

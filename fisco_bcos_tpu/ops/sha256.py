@@ -96,7 +96,13 @@ def sha256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
 
 def sha256_batch(msgs) -> np.ndarray:
     """Host convenience: list of bytes -> [B, 32] uint8 digests (device batch)."""
-    return sha256_batch_async(msgs)()
+    from ..observability.device import device_span
+
+    # the default shape key is the batch bucket — it approximates the
+    # compiled program (the message-block dim also shapes it, so compile
+    # counts are a lower bound)
+    with device_span("sha256", len(msgs)):
+        return sha256_batch_async(msgs)()
 
 
 def sha256_batch_async(msgs):

@@ -43,7 +43,7 @@ from .ec import (
 from .hash_common import bucket_batch as _bucket
 from .hash_common import pad_rows as _pad_rows
 from .limb import const_rows, eq, is_zero, lt
-from .sm3 import sm3_batch
+from .sm3 import sm3_batch_async
 
 _C = SM2_OPS
 
@@ -136,9 +136,13 @@ def sm2_e_batch(
     za_in = np.concatenate(
         [np.broadcast_to(prefix, (bsz, len(prefix))), pubkeys], axis=1
     )
-    za = sm3_batch([bytes(row) for row in za_in])
+    # the span-less async entry: sm2_e_batch runs INSIDE the caller's
+    # sm2_verify device_span — a nested sm3 span would double-count the
+    # SM3 wall (and misfile its compiles as sm2 execute remainder); the
+    # e-derivation is part of sm2's own phase decomposition
+    za = sm3_batch_async([bytes(row) for row in za_in])()
     e_in = np.concatenate([za, msg_hashes], axis=1)
-    return sm3_batch([bytes(row) for row in e_in])
+    return sm3_batch_async([bytes(row) for row in e_in])()
 
 
 def verify_batch(
@@ -153,7 +157,7 @@ def verify_batch(
 
     bsz = len(msg_hashes)
     bb = _bucket(bsz)
-    with device_span("sm2_verify", bsz, shape_key=bb):
+    with device_span("sm2_verify", bsz, shape_key=bb) as sp:
         e = _pad_rows(
             bytes_be_to_limbs(sm2_e_batch(msg_hashes, pubkeys, user_id)), bb
         )
@@ -162,10 +166,10 @@ def verify_batch(
         pubkeys = np.asarray(pubkeys, dtype=np.uint8)
         qx = _pad_rows(bytes_be_to_limbs(pubkeys[:, :32]), bb)
         qy = _pad_rows(bytes_be_to_limbs(pubkeys[:, 32:]), bb)
-        out = verify_device(
-            jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(qx),
-            jnp.asarray(qy),
-        )
+        with sp.phase("transfer"):  # host->device staging of the operands
+            ea, ra, sa = jnp.asarray(e), jnp.asarray(r), jnp.asarray(s)
+            qxa, qya = jnp.asarray(qx), jnp.asarray(qy)
+        out = verify_device(ea, ra, sa, qxa, qya)
         return np.asarray(out)[:bsz]
 
 

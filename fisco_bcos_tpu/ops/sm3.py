@@ -109,7 +109,13 @@ def sm3_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
 
 def sm3_batch(msgs) -> np.ndarray:
     """Host convenience: list of bytes -> [B, 32] uint8 digests (device batch)."""
-    return sm3_batch_async(msgs)()
+    from ..observability.device import device_span
+
+    # the default shape key is the batch bucket — it approximates the
+    # compiled program (the message-block dim also shapes it, so compile
+    # counts are a lower bound)
+    with device_span("sm3", len(msgs)):
+        return sm3_batch_async(msgs)()
 
 
 def sm3_batch_async(msgs):

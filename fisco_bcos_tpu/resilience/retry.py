@@ -166,7 +166,7 @@ IDEMPOTENT_METHODS: set[str] = {
     "next_block_header", "get_storage", "ctx_floor",
     # registry / telemetry / health
     "register", "heartbeat", "metrics", "trace", "trace_tx", "trace_spans",
-    "health", "pipeline", "profile",
+    "health", "pipeline", "profile", "device",
     # key center (pure transforms of the payload under the master key)
     "encDataKey", "decDataKey",
     # gateway read/connect surface (re-connecting to a live peer is a no-op)

@@ -53,6 +53,7 @@ class RpcHttpServer:
         trace_tx=None,
         pipeline=None,
         profile=None,
+        device=None,
     ):
         self.impl = impl
         # `metrics` needs .render() -> str; `tracer` needs .export_json() ->
@@ -63,14 +64,17 @@ class RpcHttpServer:
         # GET /trace/tx/<hash>; `pipeline` (() -> dict) serves the stage
         # occupancy/watermark document at GET /pipeline; `profile`
         # (seconds -> dict) serves the sampling profiler at
-        # GET /profile?seconds=N. When omitted, a tracer exposing its own
-        # .trace_tx/.pipeline/.profile (RemoteTelemetry) is used.
+        # GET /profile?seconds=N; `device` (() -> dict) serves the device
+        # observatory (compile ledger + phase attribution) at GET /device.
+        # When omitted, a tracer exposing its own
+        # .trace_tx/.pipeline/.profile/.device (RemoteTelemetry) is used.
         self.metrics = metrics
         self.tracer = tracer
         self.health = health
         self.trace_tx = trace_tx or getattr(tracer, "trace_tx", None)
         self.pipeline = pipeline or getattr(tracer, "pipeline", None)
         self.profile = profile or getattr(tracer, "profile", None)
+        self.device = device or getattr(tracer, "device", None)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -152,6 +156,15 @@ class RpcHttpServer:
                     # stage occupancy + blocked-on edges + backpressure
                     # watermark timelines (ISSUE 9 pipeline observatory)
                     data = json.dumps(outer.pipeline(), default=str).encode()
+                    ctype = "application/json"
+                elif (
+                    self.path.split("?", 1)[0] == "/device"
+                    and outer.device is not None
+                ):
+                    # device observatory (ISSUE 13): compile ledger with
+                    # cold-vs-persistent-cache attribution, per-op phase
+                    # totals, memory watermarks, recompile-storm state
+                    data = json.dumps(outer.device(), default=str).encode()
                     ctype = "application/json"
                 elif (
                     self.path.split("?", 1)[0] == "/profile"

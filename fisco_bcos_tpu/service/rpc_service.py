@@ -46,6 +46,7 @@ class RpcFacade:
         self.server.register("trace_tx", self._trace_tx)
         self.server.register("health", self._health)
         self.server.register("pipeline", self._pipeline)
+        self.server.register("device", self._device)
         # concurrent: the profiler blocks for seconds reading only
         # sys._current_frames() — under the dispatch lock one /profile
         # would stall every JSON-RPC call on the split
@@ -102,6 +103,14 @@ class RpcFacade:
         from ..observability.pipeline import pipeline_doc
 
         return json.dumps(pipeline_doc(), default=str).encode()
+
+    def _device(self, _payload: bytes) -> bytes:
+        """The node core's device-observatory document (compile ledger,
+        phase totals, memory watermarks) — the split deployment's
+        GET /device source: compiles happen where the DevicePlane lives."""
+        from ..observability.device import device_doc
+
+        return json.dumps(device_doc(), default=str).encode()
 
     def _profile(self, payload: bytes) -> bytes:
         """Sample THIS process (the node core — where the pipeline burns
@@ -218,6 +227,19 @@ class RemoteTelemetry:
                 "error": f"facade unreachable: {e}",
                 "stages": {},
                 "watermarks": {},
+            }
+
+    def device(self) -> dict:
+        """GET /device over the split: the node core owns the compile
+        ledger; an unreachable core degrades to an explicit error doc."""
+        try:
+            return json.loads(self.client.call("device", b""))
+        except Exception as e:
+            return {
+                "enabled": False,
+                "error": f"facade unreachable: {e}",
+                "ledger": [],
+                "phase_ms": {},
             }
 
     def profile(self, seconds=2.0) -> dict:

@@ -46,23 +46,16 @@ or ``NodeConfig.admission_rate``):
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 
 from ..gateway.ratelimit import TokenBucketRateLimiter
+from ..utils import env_float as _env_f
 from ..utils import metrics as _metrics
 from ..utils.log import get_logger
 
 _log = get_logger("admission-quota")
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
 
 
 class _GroupState:

@@ -1,3 +1,5 @@
+import os
+
 from .bytesutil import (
     h256,
     to_hex,
@@ -9,7 +11,19 @@ from .bytesutil import (
 from .error import BcosError, ErrorCode
 from .log import get_logger, metric
 
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with fallback on unset/empty/malformed — the one
+    parser every tunable shares (quota rates, plane windows, the device
+    observatory's storm bounds)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 __all__ = [
+    "env_float",
     "h256",
     "to_hex",
     "from_hex",

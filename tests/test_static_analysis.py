@@ -264,6 +264,22 @@ def test_repo_jit_inventory_is_substantial():
     assert len(jits) >= 15, [j.qualname for j in jits]
 
 
+def test_repo_jit_inventory_pinned_and_covers_bls():
+    """ISSUE 13 satellite: the inventory includes the PR 12 BLS pairing
+    program (``ops/bls12_381.py``) and the count is PINNED — a new jitted
+    program must update this number (and get a tool/warm_cache.py warmer,
+    which walks the same inventory)."""
+    progs = jitmap.inventory()
+    assert len(progs) == 23, [
+        f"{p['file']}:{p['qualname']}" for p in progs
+    ]
+    bls = [p for p in progs if p["file"] == "fisco_bcos_tpu/ops/bls12_381.py"]
+    assert [p["qualname"] for p in bls] == ["_pairing_check_xla"]
+    # every record is CLI-printable (the --list-jit contract)
+    for p in progs:
+        assert p["line"] > 0 and p["names"], p
+
+
 def test_exception_checker_accepts_observing_handlers():
     ok = _src(
         "def f(log):\n"

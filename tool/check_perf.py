@@ -4,12 +4,18 @@ throughput campaign's "each win proved per stage" acceptance.
 
 ``bench.py --telemetry`` writes ``bench_telemetry.flood.pipeline.json``
 per round: flood TPS plus the per-stage self-time vector aggregated across
-every sampled tx in the flood window (``stage_self_ms``). This tool
-compares two such artifacts (OLD then NEW) and exits nonzero when:
+every sampled tx in the flood window (``stage_self_ms``). Since ISSUE 13
+it also writes ``bench_telemetry.flood.device.json``: the device
+observatory's per-op queue/compile/transfer/execute phase vector
+(``op_phase_ms``). This tool compares two artifacts of EITHER shape (OLD
+then NEW) and exits nonzero when:
 
 - any stage's self time REGRESSED by >= --threshold (default 20%) — with
   an absolute floor (--min-ms, default 5 ms) so microsecond stages can't
   trip the gate on noise; or
+- any device op's EXECUTE phase regressed by the same gates (the compile
+  phase is excluded on purpose: cold-vs-warm cache variance is not a
+  kernel regression — it shows separately as ``cold_compiles``); or
 - flood TPS dropped by >= --tps-threshold (default 20%).
 
 Improvements are reported, never fatal. Stages present in only one
@@ -35,10 +41,12 @@ import sys
 def load_artifact(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
-    if "stage_self_ms" not in doc and "flood_tps" not in doc:
+    if not any(
+        k in doc for k in ("stage_self_ms", "flood_tps", "op_phase_ms")
+    ):
         raise ValueError(
-            f"{path}: not a pipeline round artifact "
-            "(expected stage_self_ms and/or flood_tps keys)"
+            f"{path}: not a round artifact (expected stage_self_ms, "
+            "op_phase_ms and/or flood_tps keys)"
         )
     return doc
 
@@ -53,30 +61,50 @@ def diff(
     """Returns (regressions, notes) — regressions nonempty = gate fails."""
     regressions: list[str] = []
     notes: list[str] = []
-    old_stages = old.get("stage_self_ms") or {}
-    new_stages = new.get("stage_self_ms") or {}
-    for name in sorted(set(old_stages) | set(new_stages)):
-        o = old_stages.get(name)
-        n = new_stages.get(name)
-        if o is None:
-            notes.append(f"stage added: {name} ({n:.1f} ms)")
-            continue
-        if n is None:
-            notes.append(f"stage removed: {name} (was {o:.1f} ms)")
-            continue
-        if n - o >= min_ms and (o <= 0 or (n / o - 1.0) >= threshold):
-            # o == 0 with a real delta is an unbounded regression, not a
-            # skip — a stage idle last round must not regress for free
-            grew = f"+{(n / o - 1.0) * 100.0:.0f}%" if o > 0 else "from zero"
-            regressions.append(
-                f"stage {name}: self time {o:.1f} -> {n:.1f} ms "
-                f"({grew}, threshold {threshold * 100.0:.0f}%)"
-            )
-        elif o - n >= min_ms and n > 0 and (o / n - 1.0) >= threshold:
-            notes.append(
-                f"stage {name}: improved {o:.1f} -> {n:.1f} ms "
-                f"(-{(1.0 - n / o) * 100.0:.0f}%)"
-            )
+
+    def diff_series(kind: str, noun: str, old_map: dict, new_map: dict):
+        for name in sorted(set(old_map) | set(new_map)):
+            o = old_map.get(name)
+            n = new_map.get(name)
+            if o is None:
+                notes.append(f"{kind} added: {name} ({n:.1f} ms)")
+                continue
+            if n is None:
+                notes.append(f"{kind} removed: {name} (was {o:.1f} ms)")
+                continue
+            if n - o >= min_ms and (o <= 0 or (n / o - 1.0) >= threshold):
+                # o == 0 with a real delta is an unbounded regression, not
+                # a skip — a series idle last round must not regress free
+                grew = (
+                    f"+{(n / o - 1.0) * 100.0:.0f}%" if o > 0 else "from zero"
+                )
+                regressions.append(
+                    f"{kind} {name}: {noun} {o:.1f} -> {n:.1f} ms "
+                    f"({grew}, threshold {threshold * 100.0:.0f}%)"
+                )
+            elif o - n >= min_ms and n > 0 and (o / n - 1.0) >= threshold:
+                notes.append(
+                    f"{kind} {name}: improved {o:.1f} -> {n:.1f} ms "
+                    f"(-{(1.0 - n / o) * 100.0:.0f}%)"
+                )
+
+    diff_series(
+        "stage", "self time",
+        old.get("stage_self_ms") or {}, new.get("stage_self_ms") or {},
+    )
+    # device artifacts: gate on the EXECUTE phase per op (compile variance
+    # is cache state, not kernel speed — it has its own cold_compiles row)
+    diff_series(
+        "device op", "execute time",
+        {
+            op: ph.get("execute", 0.0)
+            for op, ph in (old.get("op_phase_ms") or {}).items()
+        },
+        {
+            op: ph.get("execute", 0.0)
+            for op, ph in (new.get("op_phase_ms") or {}).items()
+        },
+    )
     o_tps, n_tps = old.get("flood_tps"), new.get("flood_tps")
     if o_tps and n_tps is not None:
         if n_tps < o_tps * (1.0 - tps_threshold):

@@ -189,10 +189,13 @@ def check_trace(trace: dict) -> None:
 
 def check_http() -> None:
     from fisco_bcos_tpu.observability import TRACER
+    from fisco_bcos_tpu.observability.device import device_doc
     from fisco_bcos_tpu.rpc.http_server import RpcHttpServer
     from fisco_bcos_tpu.utils.metrics import REGISTRY
 
-    server = RpcHttpServer(impl=None, port=0, metrics=REGISTRY, tracer=TRACER)
+    server = RpcHttpServer(
+        impl=None, port=0, metrics=REGISTRY, tracer=TRACER, device=device_doc
+    )
     server.start()
     try:
         base = f"http://127.0.0.1:{server.port}"
@@ -202,9 +205,36 @@ def check_http() -> None:
             if not resp.headers["Content-Type"].startswith("application/json"):
                 fail("/trace content type is not application/json")
             check_trace(json.loads(resp.read()))
+        with urllib.request.urlopen(f"{base}/device", timeout=10) as resp:
+            check_device(json.loads(resp.read()))
     finally:
         server.stop()
-    print("http ok: GET /metrics and GET /trace served")
+    print("http ok: GET /metrics, GET /trace and GET /device served")
+
+
+def check_device(doc: dict) -> None:
+    """ISSUE 13 smoke: the device observatory document is served and the
+    chain run populated it — per-op phase totals with an execute segment,
+    and a ledger whose rows carry cold-vs-cache attribution fields."""
+    for key in ("ledger", "phase_ms", "storm", "totals", "compile_counts"):
+        if key not in doc:
+            fail(f"/device missing {key}")
+    if not doc.get("enabled"):
+        fail("/device reports the observatory disabled")
+    if not doc["phase_ms"]:
+        fail("/device phase_ms empty after a chain run")
+    if not any("execute" in ph for ph in doc["phase_ms"].values()):
+        fail("/device has no execute phase for any op")
+    for row in doc["ledger"]:
+        for field in ("op", "shape", "cold_compiles", "cache_hits",
+                      "last_source"):
+            if field not in row:
+                fail(f"/device ledger row missing {field}: {row}")
+    print(
+        f"device ok: {len(doc['phase_ms'])} op(s) attributed, "
+        f"{doc['totals']['cold_compiles']} cold compile(s), "
+        f"{doc['totals']['cache_hits']} cache load(s)"
+    )
 
 
 def check_split_trace_tx() -> None:
@@ -309,9 +339,18 @@ def check_split_trace_tx() -> None:
         procs = doc.get("processes", 0)
         if procs < 2:
             fail(f"stitched trace spans {procs} process(es), expected >= 2")
+        # the device observatory over the SAME split: the RPC process
+        # forwards /device to the node core's facade (ISSUE 13)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/device", timeout=60
+        ) as resp:
+            dev = json.loads(resp.read())
+        if "ledger" not in dev or "phase_ms" not in dev:
+            fail(f"/device over the split missing ledger/phase_ms: {dev}")
         print(
             f"split trace ok: {len(covered)} lifecycle stages across "
-            f"{procs} processes, dominant={doc.get('dominant')}"
+            f"{procs} processes, dominant={doc.get('dominant')}; "
+            f"/device served {len(dev['phase_ms'])} op(s)"
         )
     finally:
         proc.terminate()
