@@ -283,15 +283,22 @@ class AdmissionQuotasHarness:
 class _FakeSchedHeader:
     def __init__(self, number):
         self.number = number
+        self.state_root = b"\x00" * 32
+        self.txs_root = b"\x00" * 32
+        self.receipts_root = b"\x00" * 32
 
     def hash(self, _suite):
         return b"H%031d" % self.number
+
+    def clear_hash_cache(self):
+        pass
 
 
 class _FakeSchedBlock:
     def __init__(self, header):
         self.header = header
         self.transactions = []
+        self.tx_metadata = []
         self.receipts = []
 
     def tx_hashes(self, _suite):
@@ -403,6 +410,149 @@ class SchedulerHarness:
         # equals the highest booked height (nothing torn by the switch)
         assert committed == sorted(committed), committed
         assert ctx["ledger"].height == (committed[-1] if committed else 0)
+
+
+# -- Pipelined commit: rollback edges ----------------------------------------
+
+
+class _FlakyCommitExecutor(_FakeSchedExecutor):
+    """Commit of a CHOSEN height fails exactly once (the async-commit
+    rollback edge), then succeeds on the re-drive."""
+
+    supports_preexec = True
+
+    def __init__(self, ledger, fail_number: int):
+        super().__init__(ledger)
+        self.fail_number = fail_number
+        self.failed_once = False
+
+    def commit(self, params):
+        if params.number == self.fail_number and not self.failed_once:
+            self.failed_once = True
+            raise ConnectionError("injected commit fault")
+        super().commit(params)
+
+    # speculative-execution stubs (the harness block carries no txs)
+    def next_block_header(self, header, base=None):
+        pass
+
+    def get_hash_async(self):
+        return lambda: b"\x00" * 32
+
+    def block_state(self, number):
+        return object()  # a chained overlay stand-in
+
+
+class _FakePipelineBlock(_FakeSchedBlock):
+    def calculate_txs_root_async(self, _suite):
+        return lambda: b"\x00" * 32
+
+    def calculate_receipts_root_async(self, _suite):
+        return lambda: b"\x00" * 32
+
+
+class PipelinedCommitHarness:
+    """The flood-pipeline rollback edges (ISSUE 14): a committer whose 2PC
+    fails once and re-drives, a committer for the NEXT height queued
+    behind it, a speculative lazy-roots execution chained above both, and
+    a storage-term switcher — the in-flight marker, the pending-root
+    resolvers and the commit order must stay coherent under every
+    interleaving (commit-failure of N with speculative N+1 executed, and
+    a storage switch mid-pipeline)."""
+
+    name = "pipelined-commit"
+
+    def __init__(self):
+        from ..scheduler.scheduler import Scheduler
+
+        self.watch = [
+            (Scheduler, ("term", "_committing_thread", "_commits_queued")),
+        ]
+
+    def setup(self):
+        from ..scheduler.scheduler import ExecutedBlock, Scheduler
+
+        ledger = _FakeSchedLedger()
+        executor = _FlakyCommitExecutor(ledger, fail_number=1)
+        sched = Scheduler(
+            executor, ledger, backend=None, suite=None,
+            notify_worker=_InlineNotify(), commit_worker=_InlineNotify(),
+        )
+        for n in (1, 2):
+            header = _FakeSchedHeader(n)
+            sched._executed[n] = ExecutedBlock(
+                header, _FakePipelineBlock(header), tx_hashes=(),
+                post_state=object(),
+            )
+        committed: list[int] = []
+        outcomes: list[tuple[int, bool]] = []
+        sched.on_committed.append(lambda n, _b: committed.append(n))
+        return {
+            "sched": sched, "ledger": ledger, "committed": committed,
+            "outcomes": outcomes,
+        }
+
+    def threads(self, ctx):
+        from ..scheduler.scheduler import SchedulerError
+
+        sched = ctx["sched"]
+        outcomes = ctx["outcomes"]
+
+        def committer(number):
+            header = _FakeSchedHeader(number)
+
+            def run():
+                for _ in range(50):
+                    try:
+                        sched.commit_block_async(
+                            header,
+                            on_done=lambda n, e: outcomes.append((n, e is None)),
+                        )
+                    except SchedulerError:
+                        if number not in sched._executed:
+                            return  # dropped by the term switch
+                        continue
+                    # inline worker: the 2PC already ran; re-drive until
+                    # this height is durably booked or the switch drops it
+                    if ctx["ledger"].height >= number:
+                        return
+                return
+
+            return run
+
+        def speculator():
+            # lazy-roots speculative execution of N+2 chained on N+1's
+            # post-state, racing the commits and the term switch
+            header = _FakeSchedHeader(3)
+            block = _FakePipelineBlock(header)
+            try:
+                sched.execute_block(block, lazy_roots=True)
+            except SchedulerError:
+                pass  # chain not ready / dropped mid-race: a legal outcome
+
+        def switcher():
+            sched.switch_term()
+
+        return [
+            ("c1", committer(1)), ("c2", committer(2)),
+            ("spec", speculator), ("switch", switcher),
+        ]
+
+    def check(self, ctx):
+        sched = ctx["sched"]
+        committed = ctx["committed"]
+        assert sched.term == 1, f"term switch lost: {sched.term}"
+        assert not sched._committing, f"marker leaked: {sched._committing}"
+        assert sched._commits_queued == 0, sched._commits_queued
+        assert sched._committing_thread is None, "committer identity leaked"
+        assert committed == sorted(committed), committed
+        assert ctx["ledger"].height == (committed[-1] if committed else 0)
+        # a lazily-executed speculation either resolved its roots, was
+        # dropped by the switch, or still holds its resolvers — never a
+        # half-resolved header
+        eb = sched._executed.get(3)
+        if eb is not None and eb.pending_roots is None:
+            assert eb.header.state_root == b"\x00" * 32
 
 
 # -- Pipeline observatory stage machine ---------------------------------------
@@ -596,7 +746,8 @@ class QuorumCollectorHarness:
 HARNESSES = {
     h.name: h
     for h in (DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
-              SchedulerHarness, PipelineObsHarness, QuorumCollectorHarness)
+              SchedulerHarness, PipelinedCommitHarness, PipelineObsHarness,
+              QuorumCollectorHarness)
 }
 
 FIXTURE_HARNESSES = {RacyCounterHarness.name: RacyCounterHarness}

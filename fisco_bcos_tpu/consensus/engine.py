@@ -37,7 +37,7 @@ from ..observability.pipeline import PIPELINE
 from ..utils.metrics import REGISTRY
 from ..protocol.block import Block
 from ..protocol.block_header import SignatureTuple
-from ..scheduler.scheduler import Scheduler, SchedulerError
+from ..scheduler.scheduler import Scheduler, SchedulerError, pipeline_on
 from ..txpool import TxPool
 from ..txpool.validator import batch_admit
 from ..utils.error import ErrorCode
@@ -113,6 +113,12 @@ class PBFTEngine:
         self.view = 0
         self.to_view = 0  # view we are trying to change to
         self.committed_number = ledger.block_number()
+        # the optimistic chain head (pipeline mode): committed_number may
+        # run ahead of the durable ledger while a 2PC is on the commit
+        # worker, and the sealer chains the next proposal on THIS hash
+        self._head_hash = (
+            ledger.block_hash_by_number(self.committed_number) or b""
+        )
         # durable consensus state (pbft/storage/LedgerStorage.cpp analog):
         # restores view + vote guards + the prepared proposal after a crash
         self.cstore = consensus_storage
@@ -203,6 +209,56 @@ class PBFTEngine:
         with self._lock:
             cache = self._caches.get(number)
             return cache is not None and cache.pre_prepare is not None
+
+    def consensus_head(self) -> tuple[int, bytes]:
+        """Optimistic chain head: the highest stable-committed block's
+        (number, header hash) INCLUDING commits whose 2PC is still in
+        flight on the commit worker — what the pipelined sealer chains
+        the next proposal onto (the durable ledger answers only after
+        the 2PC lands)."""
+        with self._lock:
+            return self.committed_number, self._head_hash
+
+    def _async_commit_active(self) -> bool:
+        """The pipelined (worker-driven) commit runs only on live
+        deployments: deterministic tests dispatch messages inline and
+        keep the lock-step commit, exactly like the message-worker
+        split."""
+        return self._worker is not None and pipeline_on()
+
+    def _on_commit_result(self, number: int, exc) -> None:
+        """Commit-worker completion callback. Success needs nothing —
+        consensus already advanced optimistically. A terminal failure
+        rolls the optimistic head back to the durable ledger so block
+        sync / view change can recover from a truthful height (the same
+        position as a node that crashed before its commit)."""
+        if exc is None:
+            return
+        with self._lock:
+            durable = self.ledger.block_number()
+            rolled = self.committed_number > durable
+            if rolled:
+                self.committed_number = durable
+                self._head_hash = (
+                    self.ledger.block_hash_by_number(durable) or b""
+                )
+                REGISTRY.counter_add(
+                    "fisco_pbft_commit_rollback_total",
+                    help="optimistic heads rolled back after an async 2PC "
+                    "failure",
+                )
+        if rolled:
+            _log.error(
+                "async commit of block %d failed (%s): head rolled back "
+                "to %d", number, exc, durable,
+            )
+        else:
+            # a prior failure's callback already rolled the head back (or
+            # nothing ever advanced) — report the failure, not a rollback
+            _log.error(
+                "async commit of block %d failed (%s): head already at "
+                "durable %d", number, exc, durable,
+            )
 
     def _broadcast(self, msg: PBFTMessage) -> None:
         self.front.broadcast(ModuleID.PBFT, msg.encode())
@@ -438,6 +494,12 @@ class PBFTEngine:
             cache.block = block
             cache.block_data = block.encode()  # accept-time snapshot
             cache.t_accept = time.perf_counter()
+            if self._async_commit_active():
+                # pipelined commit: the next height seals before this
+                # block's 2PC lands, so its txs must leave the sealable
+                # set NOW (the reference's asyncMarkTxs on proposal
+                # accept) — on every node, since leadership rotates
+                self.txpool.mark_sealed(block.tx_hashes(self.suite))
             # pre-prepare gate latency: message arrival -> accepted (covers
             # decode, proposal verify, tx fill/straggler fetch)
             REGISTRY.observe(
@@ -468,17 +530,27 @@ class PBFTEngine:
             self._check_commit_quorum(msg.number, cache)
             already_executed = cache.executed_header is not None
             pre_data = cache.block_data
+            pre_txs = list(cache.block.transactions)
         if not already_executed:
             # block pipeline (StateMachine::asyncPreApply): execute while the
             # vote round-trips are in flight; the commit-quorum handler then
             # hits the scheduler's proposal-identity cache. Outside the
             # engine lock — execution takes block-time, votes must flow.
-            # A DECODED COPY runs, never cache.block: execution fills
+            # An EXECUTION VIEW runs, never cache.block: execution fills
             # header roots/receipts in place, and the certificate path
-            # serializes cache state concurrently.
+            # serializes cache state concurrently — but the transaction
+            # objects are shared (immutable once signed), so the view
+            # costs a header decode instead of an N-tx re-decode per
+            # block. lazy_roots: the root programs dispatch but don't
+            # sync — the device computes them while the prepare/commit
+            # votes round-trip, and the commit-quorum cache hit resolves
+            # them (pipeline mode).
             try:
                 with TRACER.attach(bctx):
-                    self.scheduler.execute_block(Block.decode(pre_data))
+                    self.scheduler.execute_block(
+                        Block.execution_view(pre_data, pre_txs),
+                        lazy_roots=True,
+                    )
             except SchedulerError as e:
                 _log.debug("pre-execute %d skipped: %s", msg.number, e)
 
@@ -861,13 +933,23 @@ class PBFTEngine:
                 header.qc = b""
             cache.stable = True
             header.clear_hash_cache()
+            use_async = self._async_commit_active()
             try:
                 with TRACER.attach(cache.trace_ctx), TRACER.span(
                     "pbft.checkpoint_commit", block=msg.number
                 ), PIPELINE.blocked(
                     "commit"
                 ):  # nests scheduler.commit_block, inside the block trace
-                    self.scheduler.commit_block(header)
+                    if use_async:
+                        # pipeline mode: the 2PC runs on the commit
+                        # worker; this engine advances optimistically and
+                        # keeps processing messages — a failed 2PC rolls
+                        # the head back via _on_commit_result
+                        self.scheduler.commit_block_async(
+                            header, on_done=self._on_commit_result
+                        )
+                    else:
+                        self.scheduler.commit_block(header)
             except SchedulerError as e:
                 _log.error("commit block %d failed: %s", msg.number, e)
                 cache.stable = False
@@ -890,6 +972,7 @@ class PBFTEngine:
                     block=msg.number,
                 )
             self.committed_number = msg.number
+            self._head_hash = executed_hash
             self.timeout_state = False
             stale = [n for n in self._caches if n <= msg.number]
             for n in stale:
@@ -904,9 +987,16 @@ class PBFTEngine:
             ):
                 self._recovered_prepared = None
             # committee may have changed at this block; members activate at
-            # their enable_number (block N+1 for a change written at N)
+            # their enable_number (block N+1 for a change written at N).
+            # With the async commit the ledger row may not be durable yet —
+            # read through the committing block's post-state overlay (falls
+            # back to the ledger once the 2PC has booked)
+            staged = (
+                self.scheduler.staged_state(msg.number) if use_async else None
+            )
             self.config.reload(
-                self.ledger.consensus_nodes(), active_at=msg.number + 1
+                self.ledger.consensus_nodes(storage=staged),
+                active_at=msg.number + 1,
             )
             _log.info(
                 "block %d stable-committed, view=%d, committee=%d",
@@ -1143,7 +1233,18 @@ class PBFTEngine:
         self.timeout_state = False
         if self.cstore is not None:
             self.cstore.save_view(view)
-        # votes from older views are void; proposals re-run under the new view
+        # votes from older views are void; proposals re-run under the new
+        # view. Dropped (non-stable) proposals return their txs to the
+        # sealable set — UNLESS the new view is locked to re-proposing
+        # exactly that height's prepared proposal, whose txs must stay
+        # sealed for the re-proposal
+        lock = self._view_locks.get(view)
+        for n, c in self._caches.items():
+            if n > self.committed_number and c.stable:
+                continue
+            if c.block is None or (lock is not None and lock[0] == n):
+                continue
+            self.txpool.unseal(c.block.tx_hashes(self.suite))
         self._caches = {
             n: c for n, c in self._caches.items() if n > self.committed_number and c.stable
         }
@@ -1182,6 +1283,7 @@ class PBFTEngine:
             if number <= self.committed_number:
                 return
             self.committed_number = number
+            self._head_hash = self.ledger.block_hash_by_number(number) or b""
             self.timeout_state = False
             stale = [n for n in self._caches if n <= number]
             for n in stale:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -131,8 +132,13 @@ class TransactionExecutor:
         self._block: BlockContext | None = None
         # live block contexts by height — more than one is outstanding when
         # the scheduler pre-executes proposal N+1 on N's uncommitted state
-        # (the block pipeline; ref SchedulerInterface.h:76 preExecuteBlock)
+        # (the block pipeline; ref SchedulerInterface.h:76 preExecuteBlock).
+        # The guard serializes the current-context switch against the
+        # commit WORKER's cleanup (pipelined commit): without it, commit's
+        # compare-and-null of self._block could interleave with N+1's
+        # next_block_header and null the context mid-execution
         self._blocks: dict[int, BlockContext] = {}
+        self._ctx_guard = threading.Lock()
 
     # the scheduler may chain block N+1's state onto block N's executed,
     # uncommitted overlay (ref BlockExecutive keeps the previous block's
@@ -150,13 +156,15 @@ class TransactionExecutor:
         """Open the execution context for `header.number`. `base` chains the
         new overlay on a previous block's post-state instead of the durable
         backend (speculative pre-execution of N+1 while N commits)."""
-        self._block = BlockContext(
+        ctx = BlockContext(
             number=header.number,
             timestamp=header.timestamp,
             gas_limit=gas_limit,
             storage=StateStorage(base if base is not None else self.backend),
         )
-        self._blocks[header.number] = self._block
+        with self._ctx_guard:
+            self._block = ctx
+            self._blocks[header.number] = ctx
 
     def block_state(self, number: int) -> StateStorage | None:
         """Post-state overlay of an executed-but-uncommitted block."""
@@ -166,10 +174,11 @@ class TransactionExecutor:
     def discard_blocks_above(self, number: int) -> None:
         """Drop speculative contexts built on state that is being replaced
         (a different proposal re-executed at or below their height)."""
-        for n in [n for n in self._blocks if n > number]:
-            ctx = self._blocks.pop(n)
-            if self._block is ctx:
-                self._block = None
+        with self._ctx_guard:
+            for n in [n for n in self._blocks if n > number]:
+                ctx = self._blocks.pop(n)
+                if self._block is ctx:
+                    self._block = None
 
     def align_contexts(self, upto: int) -> None:
         """Raise the block's context-id floor (the DMC scheduler aligns every
@@ -669,16 +678,23 @@ class TransactionExecutor:
     # -- 2PC (prepare:1681 / commit:1745 / rollback:1813) -------------------
 
     def prepare(self, params: TwoPCParams, extra_writes: StorageInterface | None = None) -> None:
-        """Stage the block's state (plus ledger writes merged by the
-        scheduler) into the durable backend."""
+        """Stage the block's state (plus the scheduler's ledger writes)
+        into the durable backend. The ledger rows are CHAINED as a
+        traverse view, never merged into the block overlay: block N+1's
+        speculative execution reads through that overlay while this 2PC
+        is in flight (the pipelined commit), and a mutating merge here
+        would be a torn read under it. Every backend's prepare is a
+        per-key last-wins merge, so the chained order (block rows, then
+        ledger rows) stages identically to the old in-place merge."""
         ctx = self._blocks.get(params.number)
         if ctx is None:
             raise RuntimeError(f"no executed block {params.number} to prepare")
         self._apply_suicides(ctx)  # idempotent; getHash normally ran already
-        writes = ctx.storage
-        if extra_writes is not None:
-            for t, k, e in extra_writes.traverse():
-                writes.set_row(t, k, e)
+        writes = (
+            ctx.storage
+            if extra_writes is None
+            else _StagedWrites(ctx.storage, extra_writes)
+        )
         t0 = time.perf_counter()
         self.backend.prepare(params, writes)
         REGISTRY.observe(
@@ -697,17 +713,35 @@ class TransactionExecutor:
         )
         # the committed overlay may still serve as the parent of block N+1's
         # speculative chain — popping the dict only drops OUR handle
-        ctx = self._blocks.pop(params.number, None)
-        if self._block is ctx:
-            self._block = None
+        with self._ctx_guard:
+            ctx = self._blocks.pop(params.number, None)
+            if self._block is ctx:
+                self._block = None
 
     def rollback(self, params: TwoPCParams) -> None:
         self.backend.rollback(params)
-        ctx = self._blocks.pop(params.number, None)
-        if self._block is ctx:
-            self._block = None
+        with self._ctx_guard:
+            ctx = self._blocks.pop(params.number, None)
+            if self._block is ctx:
+                self._block = None
         # children chained on the rolled-back state are invalid
         self.discard_blocks_above(params.number)
+
+
+class _StagedWrites:
+    """Read-only chained traverse over the 2PC staging layers — the
+    non-mutating replacement for merging the scheduler's ledger rows into
+    the block overlay (later layers win per key in every backend's
+    per-key prepare merge)."""
+
+    __slots__ = ("_layers",)
+
+    def __init__(self, *layers):
+        self._layers = layers
+
+    def traverse(self):
+        for layer in self._layers:
+            yield from layer.traverse()
 
 
 class _ExecFrame:

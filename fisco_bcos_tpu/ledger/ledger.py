@@ -351,8 +351,13 @@ class Ledger:
             return None
         return e.get().decode(), int(e.get("enable_number").decode() or b"0")
 
-    def consensus_nodes(self) -> list[ConsensusNode]:
-        e = self.storage.get_row(SYS_CONSENSUS, b"key")
+    def consensus_nodes(self, storage=None) -> list[ConsensusNode]:
+        """Committee membership. `storage` reads through an alternative
+        layer — the pipelined commit passes the committing block's
+        post-state overlay so the engine sees a committee change at
+        optimistic-advance time, before the 2PC lands."""
+        st = storage if storage is not None else self.storage
+        e = st.get_row(SYS_CONSENSUS, b"key")
         return _decode_nodes(e.get()) if e is not None else []
 
     def ledger_config(self) -> LedgerConfig:

@@ -290,6 +290,9 @@ class Node:
             PIPELINE.add_probe(
                 "scheduler.notify_queue", self.scheduler.notify_depth
             )
+            PIPELINE.add_probe(
+                "scheduler.commit_queue", self.scheduler.commit_depth
+            )
             if plane_enabled():
                 PIPELINE.add_probe("device_plane", get_plane().lane_depths)
             if self.proof_plane is not None:

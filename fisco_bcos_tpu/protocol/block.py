@@ -53,6 +53,20 @@ class Block:
         r.done()
         return blk
 
+    @classmethod
+    def execution_view(cls, buf: bytes, transactions: list) -> "Block":
+        """Run-isolated view of an accepted proposal for (speculative)
+        execution: a PRIVATE header decoded from the accept-time snapshot
+        (execution fills roots/gas/receipts in place while the certificate
+        path serializes the cached original), sharing the already-decoded
+        transaction objects — txs are immutable once signed, so re-decoding
+        N of them per replica per block bought isolation nothing needs."""
+        r = FlatReader(buf)
+        return cls(
+            header=BlockHeader.decode(r.bytes_()),
+            transactions=list(transactions),
+        )
+
     # -- content ------------------------------------------------------------
 
     @property

@@ -48,6 +48,7 @@ class Transaction:
     # caches
     _hash: bytes | None = field(default=None, repr=False)
     _data: bytes | None = field(default=None, repr=False)
+    _wire: bytes | None = field(default=None, repr=False)
     sender: bytes = b""  # recovered 20-byte address ("forceSender" cache)
 
     # -- canonical bytes ----------------------------------------------------
@@ -73,14 +74,21 @@ class Transaction:
         return self._data
 
     def encode(self) -> bytes:
-        """Full wire form: payload + signature + annotations."""
+        """Full wire form: payload + signature + annotations. Cached: a tx
+        is immutable once signed, and the block path serializes it again
+        at pool persistence, gossip, and ledger prewrite — the zero-copy
+        tx path decodes once at admission and every later stage reuses
+        these exact bytes."""
+        if self._wire is not None:
+            return self._wire
         w = FlatWriter()
         w.bytes_(self.encode_data())
         w.bytes_(self.signature)
         w.u32(self.attribute)
         w.i64(self.import_time)
         w.bytes_(self.extra_data)
-        return w.out()
+        self._wire = w.out()
+        return self._wire
 
     @classmethod
     def decode(cls, buf: bytes) -> "Transaction":
@@ -95,15 +103,20 @@ class Transaction:
         tx.import_time = r.i64()
         tx.extra_data = r.bytes_()
         r.done()
+        # the ingress bytes ARE the wire form: re-encoding a gossiped /
+        # persisted tx is free from here on
+        tx._wire = bytes(buf)
         return tx
 
     def invalidate_caches(self) -> None:
-        """Drop the payload/hash caches after mutating a data field (test
-        fixtures forging variants; production txs are immutable once
+        """Drop the payload/hash/wire caches after mutating a data field
+        (test fixtures forging variants; production txs are immutable once
         signed). One helper so no site can null one cache but not the
-        other."""
+        others. Mutating only the signature/annotation section requires
+        dropping just the wire cache — sign() does."""
         self._hash = None
         self._data = None
+        self._wire = None
 
     @classmethod
     def _decode_data(cls, data: bytes) -> "Transaction":
@@ -131,6 +144,7 @@ class Transaction:
     def sign(self, kp: KeyPair, suite: CryptoSuite) -> "Transaction":
         self.signature = suite.signature_impl.sign(kp, self.hash(suite))
         self.sender = suite.calculate_address(kp.pub)
+        self._wire = None  # the signature section changed under the cache
         return self
 
     def verify(self, suite: CryptoSuite) -> bool:

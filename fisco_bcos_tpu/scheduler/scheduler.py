@@ -15,6 +15,7 @@ executed state into one 2PC against the durable backend.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -35,6 +36,15 @@ from ..utils.metrics import REGISTRY
 from ..utils.worker import Worker
 
 _log = get_logger("scheduler")
+
+
+def pipeline_on() -> bool:
+    """The throughput-campaign switch: ``FISCO_PIPELINE=0`` restores the
+    lock-step block loop (execute force-syncs its roots, the checkpoint
+    handler drives the 2PC inline, the sealer chains on the durable
+    ledger head) as a byte-identical passthrough. Read per call so tests
+    can flip it."""
+    return os.environ.get("FISCO_PIPELINE", "1") != "0"
 
 
 class SchedulerError(Exception):
@@ -66,6 +76,11 @@ class ExecutedBlock:
     tx_hashes: tuple[bytes, ...]  # proposal identity (same number ≠ same block)
     post_state: object = None  # StateStorage chained onto by block N+1's
     # speculative pre-execution (ref SchedulerInterface.h:76 preExecuteBlock)
+    # pipeline mode: the three un-synced root resolvers (state, txs,
+    # receipts) of a lazily-executed block — the device programs were
+    # dispatched during execution, the sync is paid at quorum time
+    # (_resolve_roots_locked), overlapping the consensus round-trip
+    pending_roots: tuple | None = None
 
 
 class Scheduler:
@@ -77,6 +92,7 @@ class Scheduler:
         suite: CryptoSuite,
         txpool=None,
         notify_worker=None,
+        commit_worker=None,
     ):
         self.executor = executor
         self.ledger = ledger
@@ -110,10 +126,21 @@ class Scheduler:
             notify_worker if notify_worker is not None else Worker("commit-notify")
         )
         self._notify.start()
+        # pipeline mode: the 2PC legs run on this dedicated worker
+        # (commit_block_async) so the engine thread and the sealer never
+        # idle behind prepare/commit round-trips. `commit_worker` is the
+        # same determinism seam as `notify_worker` (harnesses post inline).
+        self._commits_queued = 0  # guarded by self._lock
+        self._commit_worker = (
+            commit_worker if commit_worker is not None else Worker("commit-2pc")
+        )
+        self._commit_worker.start()
 
     def stop(self) -> None:
-        """Drain + stop the notify worker (queued block notifications are
-        delivered first — Worker.stop posts a sentinel and joins)."""
+        """Drain + stop the commit and notify workers (queued 2PCs land and
+        their notifications deliver first — Worker.stop posts a sentinel
+        and joins)."""
+        self._commit_worker.stop()
         self._notify.stop()
 
     # -- pipeline-observatory probes (observability/pipeline.py) -------------
@@ -134,6 +161,35 @@ class Scheduler:
             return self._notify._queue.qsize()
         except (AttributeError, NotImplementedError):
             return 0
+
+    def commit_depth(self) -> int:
+        """Async commits accepted but not yet durable (queued on the commit
+        worker or mid-2PC) plus any sync commit in flight — the commit
+        stage's backpressure watermark. Lock-free for the same reason as
+        in_flight_commits."""
+        return max(self._commits_queued, len(self._committing))
+
+    def drain_commits(self, timeout: float = 30.0) -> bool:
+        """Block until every queued/in-flight commit has landed (bench and
+        test boundary: the ledger height is only meaningful once the
+        pipelined 2PCs drain). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._commits_queued or self._committing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._commit_done.wait(min(remaining, 0.5))
+        return True
+
+    def staged_state(self, number: int):
+        """Post-state overlay of a block whose commit has not landed yet —
+        lets the engine read block-derived state (committee membership)
+        at optimistic-advance time instead of waiting out the 2PC. None
+        once the commit has booked (the durable ledger is current then)."""
+        with self._lock:
+            eb = self._executed.get(number)
+            return eb.post_state if eb is not None else None
 
     # -- storage failover (SchedulerManager.cpp asyncSwitchTerm) -------------
 
@@ -178,9 +234,16 @@ class Scheduler:
 
     # -- executeBlock:150 ----------------------------------------------------
 
-    def execute_block(self, block: Block, verify: bool = False) -> BlockHeader:
+    def execute_block(
+        self, block: Block, verify: bool = False, lazy_roots: bool = False
+    ) -> BlockHeader:
         """Execute a proposal; returns the filled header. `verify` asserts
-        the proposal's declared roots match execution (sync path)."""
+        the proposal's declared roots match execution (sync path).
+        `lazy_roots` (pipeline mode, speculative pre-execution) returns a
+        header whose roots are still pending device futures — dispatched,
+        not synced — resolved under the lock when the commit-quorum
+        execution hits the cache (or at the commit gate), so the dominant
+        execute-stage device wait overlaps the consensus round-trip."""
         number = block.header.number
         proposal_ident = tuple(block.tx_hashes(self.suite))
         # the lock covers the whole execution: the executor's block context is
@@ -203,10 +266,12 @@ class Scheduler:
                         help="commit-quorum executions served by the "
                         "pre-execution cache",
                     )
-                    return cached.header
+                    if lazy_roots:
+                        return cached.header
+                    return self._resolve_roots_locked(cached)
                 t0 = time.perf_counter()
                 header = self._execute_block_locked(
-                    block, verify, number, proposal_ident
+                    block, verify, number, proposal_ident, lazy_roots
                 )
                 from ..observability.tracer import trace_hex
 
@@ -221,21 +286,34 @@ class Scheduler:
                 return header
 
     def _execute_block_locked(
-        self, block: Block, verify: bool, number: int, proposal_ident
+        self, block: Block, verify: bool, number: int, proposal_ident,
+        lazy_roots: bool = False,
     ) -> BlockHeader:
         timer = StageTimer(_log, f"ExecuteBlock.{number}")
 
-        # An in-flight lock-free 2PC (commit_block) mutates the committing
-        # block's post-state overlay (ledger prewrite merge, suicides) and
-        # flips the durable height mid-apply — executing against either is a
-        # torn read, so executions drain the commit first, exactly as the
-        # old whole-commit lock hold serialized them. The pipeline win is
-        # unaffected: _committing is empty during the commit-QUORUM wait,
-        # which is when proposal N+1 speculatively executes.
+        # An in-flight lock-free 2PC (commit_block) used to mutate the
+        # committing block's post-state overlay (ledger prewrite merge) —
+        # a torn read for anything executing through it, so executions
+        # drained the commit first. The staging is non-mutating now
+        # (executor.prepare chains the ledger rows as a traverse view),
+        # which makes ONE overlap sound: a speculative execution chained
+        # strictly ABOVE every in-flight commit reads only through
+        # overlays the 2PC never writes, so in pipeline mode it proceeds
+        # while the commit worker round-trips — consensus on N+1 overlaps
+        # the commit of N. Re-execution at or below a committing height
+        # (a different proposal would wipe the committing cache entry)
+        # still drains, exactly as the old whole-commit lock hold did.
         if self._committing:
-            with PIPELINE.blocked("2pc_commit"):
-                while self._committing:
-                    self._commit_done.wait()
+            overlap = (
+                pipeline_on()
+                and number > max(self._committing)
+                and self._executed.get(number - 1) is not None
+                and getattr(self.executor, "supports_preexec", False)
+            )
+            if not overlap:
+                with PIPELINE.blocked("2pc_commit"):
+                    while self._committing:
+                        self._commit_done.wait()
 
         # Height gate with block pipelining (preExecuteBlock,
         # SchedulerInterface.h:76 / StateMachine.cpp:47 asyncPreApply): the
@@ -334,23 +412,38 @@ class Scheduler:
         )
         txs_f = block.calculate_txs_root_async(self.suite)
         receipts_f = block.calculate_receipts_root_async(self.suite)
-        state_root = state_f()
-        txs_root = txs_f()
-        receipts_root = receipts_f()
-        if verify and (
-            (header.state_root != state_root)
-            or (header.txs_root != txs_root)
-            or (header.receipts_root != receipts_root)
-        ):
-            raise SchedulerError(
-                ErrorCode.SCHEDULER_INVALID_BLOCK,
-                f"block {number} root mismatch on verify",
+        # pipeline mode, speculative pre-execution: all three programs are
+        # dispatched (above), the sync is deferred to quorum time — the
+        # device computes the roots while the prepare/commit votes
+        # round-trip, instead of parking this thread (the observatory's
+        # headline `execute blocked_on=device_plane` edge)
+        lazy = lazy_roots and not verify and pipeline_on()
+        pending = (state_f, txs_f, receipts_f) if lazy else None
+        if not lazy:
+            state_root = state_f()
+            txs_root = txs_f()
+            receipts_root = receipts_f()
+            if verify and (
+                (header.state_root != state_root)
+                or (header.txs_root != txs_root)
+                or (header.receipts_root != receipts_root)
+            ):
+                raise SchedulerError(
+                    ErrorCode.SCHEDULER_INVALID_BLOCK,
+                    f"block {number} root mismatch on verify",
+                )
+            header.state_root = state_root
+            header.txs_root = txs_root
+            header.receipts_root = receipts_root
+            header.clear_hash_cache()
+            timer.stage("roots", state_root=state_root.hex()[:16])
+        else:
+            REGISTRY.counter_add(
+                "fisco_scheduler_lazy_roots_total",
+                help="speculative executions returning pending (dispatched, "
+                "un-synced) root futures",
             )
-        header.state_root = state_root
-        header.txs_root = txs_root
-        header.receipts_root = receipts_root
-        header.clear_hash_cache()
-        timer.stage("roots", state_root=state_root.hex()[:16])
+            timer.stage("roots", dispatched="lazy")
 
         with self._lock:
             # anything executed ABOVE this height was chained on the state
@@ -367,8 +460,25 @@ class Scheduler:
                 post_state=getattr(self.executor, "block_state", lambda n: None)(
                     number
                 ),
+                pending_roots=pending,
             )
         return header
+
+    def _resolve_roots_locked(self, eb: ExecutedBlock) -> BlockHeader:
+        """Sync a lazily-executed block's pending root futures into its
+        header (runs under self._lock — single resolver). The wait is a
+        device sync, attributed as such for the observatory."""
+        pend = eb.pending_roots
+        if pend is not None:
+            state_f, txs_f, receipts_f = pend
+            header = eb.header
+            with PIPELINE.blocked("device_plane"):
+                header.state_root = state_f()
+                header.txs_root = txs_f()
+                header.receipts_root = receipts_f()
+            header.clear_hash_cache()
+            eb.pending_roots = None
+        return eb.header
 
     # -- commitBlock:390 -----------------------------------------------------
 
@@ -427,9 +537,11 @@ class Scheduler:
                 for n in [n for n in self._executed if n <= number]:
                     self._executed.pop(n)
                 if self.txpool is not None:
+                    # the proposal identity IS the block's tx-hash list —
+                    # re-hashing every tx under the scheduler lock here was
+                    # pure waste (the admission-time digests are in hand)
                     self.txpool.on_block_committed(
-                        number,
-                        [t.hash(self.suite) for t in cached.block.transactions],
+                        number, list(cached.tx_hashes)
                     )
                 # listeners run on the notify worker, never on the caller's
                 # thread: the caller is the PBFT engine holding its own
@@ -473,6 +585,7 @@ class Scheduler:
             raise SchedulerError(
                 ErrorCode.SCHEDULER_INVALID_BLOCK, f"commit of unexecuted block {number}"
             )
+        self._resolve_roots_locked(cached)
         if cached.header.hash(self.suite) != header.hash(self.suite):
             raise SchedulerError(
                 ErrorCode.SCHEDULER_INVALID_BLOCK,
@@ -483,6 +596,60 @@ class Scheduler:
         self._committing.add(number)
         self._committing_thread = threading.current_thread()
         return cached
+
+    # -- async commit (pipeline mode) ----------------------------------------
+
+    def commit_block_async(self, header: BlockHeader, on_done=None) -> None:
+        """Hand the 2PC to the dedicated commit worker and return — the
+        engine advances its head optimistically while prepare/commit
+        round-trip. Validates proposal identity NOW (same SchedulerError
+        contract as commit_block for an unknown/mismatched header);
+        height-order gating and the in-flight marker run on the worker,
+        where the prior commit has already landed (FIFO). ``on_done(number,
+        exc_or_None)`` reports the terminal outcome — a failure means the
+        optimistic head must roll back to the durable ledger."""
+        number = header.number
+        with self._lock:
+            cached = self._executed.get(number)
+            if cached is None:
+                raise SchedulerError(
+                    ErrorCode.SCHEDULER_INVALID_BLOCK,
+                    f"commit of unexecuted block {number}",
+                )
+            self._resolve_roots_locked(cached)
+            if cached.header.hash(self.suite) != header.hash(self.suite):
+                raise SchedulerError(
+                    ErrorCode.SCHEDULER_INVALID_BLOCK,
+                    f"commit header mismatch for block {number}",
+                )
+            self._commits_queued += 1
+        REGISTRY.counter_add(
+            "fisco_async_commits_total",
+            help="block commits handed to the 2PC commit worker",
+        )
+        self._commit_worker.post(lambda: self._run_commit(header, on_done))
+
+    def _run_commit(self, header: BlockHeader, on_done) -> None:
+        """One queued 2PC on the commit worker. Exceptions are reported via
+        ``on_done`` (never kill the worker); the marker/cv cleanup inside
+        commit_block already ran on the failure path, so recovery
+        (block sync, storage-failover re-drive) sees a clean scheduler."""
+        exc = None
+        try:
+            self.commit_block(header)
+        except BaseException as e:  # noqa: BLE001 — reported, not swallowed
+            exc = e
+            REGISTRY.counter_add(
+                "fisco_async_commit_failures_total",
+                help="async 2PCs that failed terminally on the commit worker",
+            )
+            _log.error("async commit of block %d failed: %s", header.number, e)
+        finally:
+            with self._lock:
+                self._commits_queued -= 1
+                self._commit_done.notify_all()
+        if on_done is not None:
+            on_done(header.number, exc)
 
     # -- call:621 ------------------------------------------------------------
 

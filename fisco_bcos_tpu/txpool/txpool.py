@@ -80,10 +80,11 @@ class TxPool:
         self.pstore = persistent_store
         self._txs: dict[bytes, Transaction] = {}
         self._sealed: set[bytes] = set()
-        # sealing-scan rotation state (see seal_txs): the bounded traversal
-        # resumes where the last one stopped so every pooled tx is
-        # eventually scanned even when the pool far exceeds one window
-        self._seal_cursor = 0
+        # sealable FIFO index (insertion-ordered): exactly the pool entries
+        # not yet sealed, so the sealing scan touches only candidates
+        # instead of cursor-skipping sealed entries across the whole pool
+        # — the flood's seal tick was O(pool), now O(scan window)
+        self._unsealed: dict[bytes, Transaction] = {}
         self.seal_scan_cap = 4096
         self._lock = threading.RLock()
         self.pool_nonces = TxPoolNonceChecker()
@@ -306,6 +307,8 @@ class TxPool:
     def _insert(self, tx: Transaction, h: bytes, persist: bool = True) -> None:
         with self._lock:
             self._txs[h] = tx
+            if h not in self._sealed:
+                self._unsealed[h] = tx
         # analysis: allow(guarded-state, TxPoolNonceChecker is internally
         # locked — the pool lock guards _txs, not the nonce set)
         self.pool_nonces.insert(tx.nonce)
@@ -348,7 +351,7 @@ class TxPool:
 
     def unsealed_count(self) -> int:
         with self._lock:
-            return len(self._txs) - len(self._sealed)
+            return len(self._unsealed)
 
     def get(self, h: bytes) -> Transaction | None:
         with self._lock:
@@ -361,56 +364,66 @@ class TxPool:
 
     # -- sealing -------------------------------------------------------------
 
-    def seal_txs(self, limit: int) -> list[Transaction]:
+    def seal_txs(self, limit: int) -> tuple[list[Transaction], list[bytes]]:
         """Pick ≤limit unsealed txs and mark them sealed
-        (asyncSealTxs → batchFetchTxs, MemoryStorage.cpp:619).
+        (asyncSealTxs → batchFetchTxs, MemoryStorage.cpp:619). Returns
+        ``(txs, hashes)`` — the admission-time cached digests ride along
+        so the sealer never re-hashes a tx it is packaging.
 
         Round-robin across senders (arrival order within a sender): the
         reference bounds per-traversal fetches so one flooding sender cannot
-        starve everyone else out of a block. The grouping scan is capped at
-        a multiple of `limit`, and the scan START rotates between calls
-        (the reference's traversal rotates likewise): a fixed start would
-        only ever consider the oldest scan-window entries of a full pool,
-        starving every sender who landed past it. Reaching the rotated
-        start skips `cursor` dict entries at C speed — O(pool) worst case,
-        ~ms at the 135k pool cap — but the Python-level grouping work stays
-        O(scan_cap)."""
+        starve everyone else out of a block. The scan runs over the
+        insertion-ordered UNSEALED index only — oldest-first is the fair
+        order, and there are no sealed entries to cursor-skip, so the
+        whole call is O(scan window) however large the pool grows. The
+        grouping window stays capped at a multiple of `limit`."""
         from collections import deque
-        from itertools import chain, islice
+        from itertools import islice
 
         scan_cap = max(limit * 8, self.seal_scan_cap)
         out: list[Transaction] = []
+        out_hashes: list[bytes] = []
         with self._lock:
-            n = len(self._txs)
-            if n == 0:
-                return out
-            start = self._seal_cursor % n
+            if not self._unsealed:
+                return out, out_hashes
             by_sender: dict[bytes, deque] = {}
-            scanned = visited = 0
-            items = self._txs.items()
-            for h, tx in chain(islice(items, start, None), islice(items, start)):
-                visited += 1
-                if h in self._sealed:
-                    continue
+            for h, tx in islice(self._unsealed.items(), scan_cap):
                 by_sender.setdefault(tx.sender, deque()).append((h, tx))
-                scanned += 1
-                if scanned >= scan_cap:
-                    break
-            self._seal_cursor = (start + visited) % n
             queues = deque(by_sender.values())
             while queues and len(out) < limit:
                 q = queues.popleft()
                 h, tx = q.popleft()
                 self._sealed.add(h)
+                del self._unsealed[h]
                 out.append(tx)
+                out_hashes.append(h)
                 if q:
                     queues.append(q)
-        return out
+        return out, out_hashes
 
     def unseal(self, hashes: list[bytes]) -> None:
-        """Return sealed txs to the pool (failed proposal)."""
+        """Return sealed txs to the pool (failed/abandoned proposal).
+        Re-queued at the tail of the sealable index — order degrades, the
+        txs stay sealable."""
         with self._lock:
-            self._sealed.difference_update(hashes)
+            for h in hashes:
+                if h in self._sealed:
+                    self._sealed.discard(h)
+                    tx = self._txs.get(h)
+                    if tx is not None:
+                        self._unsealed[h] = tx
+
+    def mark_sealed(self, hashes: list[bytes]) -> None:
+        """Mark an ACCEPTED proposal's txs sealed (the reference's
+        asyncMarkTxs). With the pipelined commit a rotated leader seals
+        the next block before the previous 2PC lands — in-flight proposal
+        txs must already be out of every replica's sealable set or the
+        next leader would double-propose them."""
+        with self._lock:
+            for h in hashes:
+                if h in self._txs and h not in self._sealed:
+                    self._sealed.add(h)
+                    self._unsealed.pop(h, None)
 
     # -- proposal verification (consensus path) ------------------------------
 
@@ -479,6 +492,7 @@ class TxPool:
             for h in tx_hashes:
                 tx = self._txs.pop(h, None)
                 self._sealed.discard(h)
+                self._unsealed.pop(h, None)
                 if tx is not None:
                     nonces.append(tx.nonce)
                     self.pool_nonces.remove(tx.nonce)

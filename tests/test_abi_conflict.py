@@ -68,7 +68,7 @@ class Env:
         for t in txs:
             r = self.pool.submit(t)
             assert r.status == 0, r
-        sealed = self.pool.seal_txs(len(txs))
+        sealed, _ = self.pool.seal_txs(len(txs))
         parent = self.ledger.header_by_number(self.ledger.block_number())
         blk = Block(
             header=BlockHeader(

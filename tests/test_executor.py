@@ -71,7 +71,7 @@ class Env:
         for t in txs:
             r = self.pool.submit(t)
             assert r.status == 0, r
-        sealed = self.pool.seal_txs(len(txs))
+        sealed, _ = self.pool.seal_txs(len(txs))
         parent_num = self.ledger.block_number()
         parent = self.ledger.header_by_number(parent_num)
         blk = Block(
@@ -247,7 +247,7 @@ def test_commit_rejects_header_mismatch():
     env = Env()
     t = env.tx(DAG_TRANSFER_ADDRESS, "userAdd(string,uint256)", "x", 1)
     env.pool.submit(t)
-    sealed = env.pool.seal_txs(1)
+    sealed, _ = env.pool.seal_txs(1)
     parent = env.ledger.header_by_number(0)
     blk = Block(
         header=BlockHeader(number=1, parent_info=[ParentInfo(0, parent.hash(SUITE))]),

@@ -1,0 +1,433 @@
+"""Flood-TPS pipelining campaign (ISSUE 14): async roots, overlapped
+commit, zero-copy tx path.
+
+Deterministic halves of the pipeline's contract:
+
+- ``FISCO_PIPELINE=0`` passthrough is byte-identical (committed headers,
+  wire frames) to the pipelined chain;
+- lazy root futures resolve exactly once, at the commit path, to the
+  same roots an eager execution produces;
+- the rollback edges: commit-failure of N with speculative N+1 executed,
+  and a storage switch mid-pipeline (the seeded interleave twin lives in
+  analysis/harnesses.PipelinedCommitHarness);
+- the async commit worker preserves height order and rolls the engine's
+  optimistic head back on terminal 2PC failure;
+- mark-sealed-on-accept closes the double-seal window a rotated leader
+  would otherwise hit while the previous 2PC is still in flight;
+- the sealer prebuilds the next height while a proposal is in flight and
+  returns a stale prebuild's txs to the pool;
+- the zero-copy wire cache survives decode/encode round trips and drops
+  on mutation.
+"""
+
+import sys
+import threading
+import time as _time
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from test_pbft import CODEC, SUITE, leader_of, submit_txs  # noqa: E402
+
+from fisco_bcos_tpu.analysis.harnesses import (  # noqa: E402
+    _FakePipelineBlock,
+    _FakeSchedHeader,
+    _FakeSchedLedger,
+    _FlakyCommitExecutor,
+    _InlineNotify,
+)
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS  # noqa: E402
+from fisco_bcos_tpu.front import InprocGateway  # noqa: E402
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig  # noqa: E402
+from fisco_bcos_tpu.node import Node, NodeConfig  # noqa: E402
+from fisco_bcos_tpu.protocol.block import Block  # noqa: E402
+from fisco_bcos_tpu.protocol.block_header import (  # noqa: E402
+    BlockHeader,
+    ParentInfo,
+)
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory  # noqa: E402
+from fisco_bcos_tpu.scheduler.scheduler import (  # noqa: E402
+    ExecutedBlock,
+    Scheduler,
+    SchedulerError,
+    pipeline_on,
+)
+from fisco_bcos_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+
+def make_chain(n_nodes=4, block_cap=1000, secret_base=77_000):
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=secret_base + i)
+        for i in range(n_nodes)
+    ]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gw = InprocGateway(auto=True)
+    nodes = []
+    for kp in keypairs:
+        cfg = NodeConfig(
+            genesis=GenesisConfig(
+                consensus_nodes=list(committee), tx_count_limit=block_cap
+            )
+        )
+        node = Node(cfg, keypair=kp)
+        gw.connect(node.front)
+        nodes.append(node)
+    return nodes, gw
+
+
+def wait_until(cond, timeout=30.0, tick=0.005):
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        if cond():
+            return True
+        _time.sleep(tick)
+    return cond()
+
+
+def drain_chain(nodes, timeout=30.0):
+    for n in nodes:
+        assert n.scheduler.drain_commits(timeout)
+
+
+# -- FISCO_PIPELINE=0 passthrough byte-identity -------------------------------
+
+
+def _drive_stepwise(nodes, blocks=3, txs_per_block=4):
+    """Submit + seal one block at a time (workers live), recording every
+    broadcast frame; returns (header bytes per height, sorted frames)."""
+    frames: list[tuple[int, bytes]] = []
+    for node in nodes:
+        orig = node.front.broadcast
+
+        def rec(module_id, payload, _orig=orig):
+            frames.append((module_id, bytes(payload)))
+            return _orig(module_id, payload)
+
+        node.front.broadcast = rec
+        node.engine.start_worker()
+    try:
+        for h in range(1, blocks + 1):
+            head = max(n.engine.consensus_head()[0] for n in nodes)
+            assert head == h - 1
+            leader = leader_of(nodes, h)
+            submit_txs(leader, txs_per_block, start=h * 100)
+            assert wait_until(lambda: leader.sealer.seal_and_submit(), 10.0)
+            assert wait_until(
+                lambda: all(n.block_number() == h for n in nodes), 20.0
+            ), f"chain stalled before height {h}"
+        drain_chain(nodes)
+    finally:
+        for node in nodes:
+            node.engine.stop_worker()
+    headers = [
+        nodes[0].ledger.header_by_number(h) for h in range(1, blocks + 1)
+    ]
+    return headers, sorted(frames)
+
+
+@pytest.mark.slow
+def test_passthrough_byte_identity(monkeypatch):
+    """The pipelined chain and the FISCO_PIPELINE=0 passthrough commit
+    byte-identical headers and exchange byte-identical wire frames
+    (timestamps pinned; RFC6979 signing is deterministic)."""
+    import fisco_bcos_tpu.consensus.sealer as sealer_mod
+
+    monkeypatch.setattr(sealer_mod.time, "time", lambda: 1_700_000_000.0)
+    runs = {}
+    for mode in ("1", "0"):
+        monkeypatch.setenv("FISCO_PIPELINE", mode)
+        nodes, _gw = make_chain(secret_base=78_000)
+        runs[mode] = _drive_stepwise(nodes)
+    headers_on, frames_on = runs["1"]
+    headers_off, frames_off = runs["0"]
+    quorum = 3  # 2f+1 of 4
+    for on, off in zip(headers_on, headers_off):
+        # the consensus content — everything the header hash signs — is
+        # byte-identical; the signature_list is whichever valid quorum's
+        # checkpoints arrived first (any quorum cert is equally valid, in
+        # the reference too), so it is checked as a quorum, not as bytes
+        assert on.encode_hash_fields() == off.encode_hash_fields()
+        assert on.hash(SUITE) == off.hash(SUITE)
+        for h in (on, off):
+            assert len(h.signature_list) >= quorum
+            for s in h.signature_list:
+                assert SUITE.signature_impl.verify(
+                    h.sealer_list[s.index], h.hash(SUITE), s.signature
+                )
+    assert frames_on == frames_off, "wire frames diverged"
+
+
+# -- lazy roots ---------------------------------------------------------------
+
+
+def _one_node_block(secret_base):
+    nodes, _gw = make_chain(1, secret_base=secret_base)
+    node = nodes[0]
+    txs = submit_txs(node, 3, start=500)
+    sealed, hashes = node.txpool.seal_txs(10)
+    assert len(sealed) == 3
+    parent = node.ledger.header_by_number(0)
+    blk = Block(
+        header=BlockHeader(
+            number=1,
+            parent_info=[ParentInfo(0, parent.hash(SUITE))],
+            timestamp=12345,
+        ),
+        transactions=sealed,
+    )
+    return node, blk, txs
+
+
+def test_lazy_roots_resolve_to_eager_values():
+    node_a, blk_a, _ = _one_node_block(79_000)
+    eager = node_a.scheduler.execute_block(blk_a)
+    assert eager.state_root != b"\x00" * 32
+
+    node_b, blk_b, _ = _one_node_block(79_000)  # identical genesis + txs
+    sched = node_b.scheduler
+    lazy = sched.execute_block(blk_b, lazy_roots=True)
+    assert sched._executed[1].pending_roots is not None
+    assert lazy.state_root == b"\x00" * 32  # dispatched, not synced
+    # the commit gate resolves the pending futures before hashing
+    sched.commit_block(lazy)
+    assert sched._executed.get(1) is None
+    assert lazy.state_root == eager.state_root
+    assert lazy.txs_root == eager.txs_root
+    assert lazy.receipts_root == eager.receipts_root
+    assert node_b.block_number() == 1
+
+
+def test_lazy_roots_passthrough_is_eager(monkeypatch):
+    monkeypatch.setenv("FISCO_PIPELINE", "0")
+    assert not pipeline_on()
+    node, blk, _ = _one_node_block(79_100)
+    header = node.scheduler.execute_block(blk, lazy_roots=True)
+    assert node.scheduler._executed[1].pending_roots is None
+    assert header.state_root != b"\x00" * 32
+
+
+# -- rollback edges (deterministic twins of PipelinedCommitHarness) -----------
+
+
+def _fake_sched(fail_number=1):
+    ledger = _FakeSchedLedger()
+    executor = _FlakyCommitExecutor(ledger, fail_number=fail_number)
+    sched = Scheduler(
+        executor, ledger, backend=None, suite=None,
+        notify_worker=_InlineNotify(), commit_worker=_InlineNotify(),
+    )
+    committed = []
+    sched.on_committed.append(lambda n, _b: committed.append(n))
+    for n in (1, 2):
+        header = _FakeSchedHeader(n)
+        sched._executed[n] = ExecutedBlock(
+            header, _FakePipelineBlock(header), tx_hashes=(),
+            post_state=object(),
+        )
+    return sched, ledger, committed
+
+
+def test_commit_failure_keeps_speculation_and_redrives():
+    """Commit-failure of N with speculative N+1 executed: the failed 2PC
+    leaves the executed cache intact, the marker clean, and both the
+    re-driven N and the speculative N+1 then commit in order."""
+    sched, ledger, committed = _fake_sched(fail_number=1)
+    h3 = _FakeSchedHeader(3)
+    sched.execute_block(_FakePipelineBlock(h3), lazy_roots=True)
+    assert 3 in sched._executed  # speculation chained above 1 and 2
+
+    with pytest.raises(ConnectionError):
+        sched.commit_block(_FakeSchedHeader(1))
+    assert not sched._committing and sched._committing_thread is None
+    assert 1 in sched._executed, "failed commit must not drop the execution"
+    assert 3 in sched._executed, "failed commit must not drop the speculation"
+    assert ledger.height == 0
+
+    sched.commit_block(_FakeSchedHeader(1))  # re-drive succeeds
+    sched.commit_block(_FakeSchedHeader(2))
+    assert committed == [1, 2] and ledger.height == 2
+    assert 3 in sched._executed  # still executable once 3's quorum lands
+
+
+def test_storage_switch_mid_pipeline_drops_speculation():
+    sched, ledger, committed = _fake_sched(fail_number=99)
+    h3 = _FakeSchedHeader(3)
+    sched.execute_block(_FakePipelineBlock(h3), lazy_roots=True)
+    sched.commit_block(_FakeSchedHeader(1))
+    sched.switch_term()
+    assert sched.term == 1
+    assert sched._executed == {}, "switch must drop in-flight executions"
+    # a commit of the dropped speculation is refused cleanly
+    with pytest.raises(SchedulerError):
+        sched.commit_block(_FakeSchedHeader(2))
+    with pytest.raises(SchedulerError):
+        sched.commit_block_async(_FakeSchedHeader(2))
+    assert committed == [1] and ledger.height == 1
+
+
+def test_async_commit_orders_heights_and_reports():
+    """Two async commits queued back to back land in height order on the
+    worker; outcomes report success; drain_commits observes the end."""
+    sched, ledger, committed = _fake_sched(fail_number=99)
+    outcomes = []
+    sched.commit_block_async(
+        _FakeSchedHeader(1), on_done=lambda n, e: outcomes.append((n, e))
+    )
+    sched.commit_block_async(
+        _FakeSchedHeader(2), on_done=lambda n, e: outcomes.append((n, e))
+    )
+    assert sched.drain_commits(10.0)
+    assert committed == [1, 2] and ledger.height == 2
+    assert outcomes == [(1, None), (2, None)]
+
+
+def test_async_commit_failure_reports_and_engine_rolls_back():
+    """A terminal async 2PC failure reaches on_done; the engine rolls its
+    optimistic head back to the durable ledger."""
+    sched, ledger, _committed = _fake_sched(fail_number=1)
+    outcomes = []
+    sched.commit_block_async(
+        _FakeSchedHeader(1), on_done=lambda n, e: outcomes.append((n, e))
+    )
+    assert sched.drain_commits(10.0)
+    assert len(outcomes) == 1 and outcomes[0][0] == 1
+    assert isinstance(outcomes[0][1], ConnectionError)
+    assert ledger.height == 0
+    assert 1 in sched._executed  # re-drivable
+
+    # engine half: the optimistic head rolls back to the durable ledger
+    nodes, _gw = make_chain(1, secret_base=79_200)
+    engine = nodes[0].engine
+    with engine._lock:
+        engine.committed_number = 5
+        engine._head_hash = b"\xaa" * 32
+    engine._on_commit_result(5, RuntimeError("2pc lost"))
+    assert engine.committed_number == nodes[0].ledger.block_number() == 0
+    assert engine._head_hash == (
+        nodes[0].ledger.block_hash_by_number(0) or b""
+    )
+
+
+# -- mark-sealed-on-accept / sealer prebuild ----------------------------------
+
+
+def test_mark_sealed_closes_double_seal_window():
+    nodes, _gw = make_chain(1, secret_base=79_300)
+    pool = nodes[0].txpool
+    submit_txs(nodes[0], 4, start=700)
+    _txs1, hashes1 = pool.seal_txs(2)
+    # a replica marks an accepted proposal's txs sealed without sealing
+    remaining = [h for h in pool._unsealed]
+    pool.mark_sealed(remaining[:1])
+    assert pool.unsealed_count() == 1
+    txs2, hashes2 = pool.seal_txs(10)
+    assert len(txs2) == 1
+    assert not (set(hashes2) & set(remaining[:1]) | set(hashes2) & set(hashes1))
+    # an abandoned proposal returns its txs
+    pool.unseal(remaining[:1])
+    assert pool.unsealed_count() == 1
+    # idempotent for already-committed hashes
+    pool.on_block_committed(1, hashes1 + remaining[:1] + hashes2)
+    pool.mark_sealed(hashes1)
+    assert pool.unsealed_count() == 0 and pool.pending_count() == 0
+
+
+def test_sealer_prebuild_and_stale_drop(monkeypatch):
+    monkeypatch.setenv("FISCO_PIPELINE", "1")
+    nodes, _gw = make_chain(1, secret_base=79_400)
+    node = nodes[0]
+    sealer = node.sealer
+    submit_txs(node, 5, start=800)
+    sealer._prebuild(2, 3)
+    assert sealer._prebuilt is not None and sealer._prebuilt[0] == 2
+    assert node.txpool.unsealed_count() == 2  # 3 sealed ahead
+    before = node.txpool.unsealed_count()
+    # a stale prebuild (pipeline moved to a different height) unseals
+    sealer._prebuild(3, 3)
+    assert sealer._prebuilt is not None and sealer._prebuilt[0] == 3
+    assert node.txpool.unsealed_count() == before  # old batch returned
+    pb = sealer._take_prebuilt(4)  # mismatched claim drops it
+    assert pb is None and sealer._prebuilt is None
+    assert node.txpool.unsealed_count() == 5
+    # prebuilt batch is actually used for the matching height
+    sealer._prebuild(1, 2)
+    blk = sealer.generate_proposal()
+    assert blk is not None and blk.header.number == 1
+    assert len(blk.tx_metadata) == 2
+    assert REGISTRY.counters_matching("fisco_sealer_prebuilt_hits_total")
+
+
+# -- zero-copy wire cache -----------------------------------------------------
+
+
+def test_transaction_wire_cache_roundtrip():
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=0xCAFE)
+    tx = fac.create_signed(
+        kp, chain_id="chain0", group_id="group0", block_limit=9,
+        nonce="w1", to=DAG_TRANSFER_ADDRESS,
+        input=CODEC.encode_call("userAdd(string,uint256)", "w", 1),
+    )
+    wire = tx.encode()
+    assert tx.encode() is wire  # cached object, no re-serialization
+    rt = tx.decode(wire)
+    assert rt.encode() is rt._wire and rt.encode() == wire
+    assert rt.hash(SUITE) == tx.hash(SUITE)
+    # signature mutation drops ONLY the wire cache (sign() path)
+    rt.sign(kp, SUITE)
+    assert rt._wire is None and rt.encode() == wire  # same key, same bytes
+    # data mutation drops everything
+    tx.input = b"changed"
+    tx.invalidate_caches()
+    assert tx._wire is None and tx._data is None and tx._hash is None
+    assert tx.encode() != wire
+
+
+# -- live overlapped pipeline -------------------------------------------------
+
+
+@pytest.mark.slow
+def test_live_pipelined_chain_overlaps_and_converges(monkeypatch):
+    """A worker-driven 4-node flood runs the full overlapped pipeline
+    (async commit + lazy roots + optimistic sealing) and converges to one
+    chain with every tx committed."""
+    monkeypatch.setenv("FISCO_PIPELINE", "1")
+    nodes, _gw = make_chain(4, block_cap=8, secret_base=79_500)
+    for n in nodes:
+        n.engine.start_worker()
+    try:
+        entry = nodes[0]
+        submit_txs(entry, 32, start=900)
+        before = float(
+            sum(
+                REGISTRY.counters_matching("fisco_async_commits_total").values()
+            )
+        )
+        deadline = _time.monotonic() + 60
+        while entry.txpool.pending_count() > 0 and _time.monotonic() < deadline:
+            head = max(n.engine.consensus_head()[0] for n in nodes)
+            leader = leader_of(nodes, head + 1)
+            if not leader.sealer.seal_and_submit():
+                _time.sleep(0.005)
+        assert entry.txpool.pending_count() == 0, "flood did not drain"
+        assert wait_until(
+            lambda: len({n.block_number() for n in nodes}) == 1, 20.0
+        )
+        drain_chain(nodes)
+        heights = {n.block_number() for n in nodes}
+        assert len(heights) == 1 and heights != {0}
+        roots = {
+            n.ledger.header_by_number(n.block_number()).state_root
+            for n in nodes
+        }
+        assert len(roots) == 1
+        after = float(
+            sum(
+                REGISTRY.counters_matching("fisco_async_commits_total").values()
+            )
+        )
+        assert after > before, "async commit worker never engaged"
+    finally:
+        for n in nodes:
+            n.engine.stop_worker()

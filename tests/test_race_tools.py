@@ -32,6 +32,7 @@ from fisco_bcos_tpu.analysis.harnesses import (
     AdmissionQuotasHarness,
     DevicePlaneHarness,
     PipelineObsHarness,
+    PipelinedCommitHarness,
     ProofPlaneHarness,
     QuorumCollectorHarness,
     RacyCounterHarness,
@@ -188,7 +189,8 @@ def test_deadlock_schedule_is_reported_not_hung():
 @pytest.mark.parametrize(
     "cls",
     [DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
-     SchedulerHarness, PipelineObsHarness, QuorumCollectorHarness],
+     SchedulerHarness, PipelinedCommitHarness, PipelineObsHarness,
+     QuorumCollectorHarness],
     ids=lambda c: c.name,
 )
 def test_real_harness_seeded_sweep(cls):
@@ -200,7 +202,8 @@ def test_real_harness_seeded_sweep(cls):
 def test_real_harnesses_registry_complete():
     assert set(HARNESSES) == {
         "device-plane", "proof-singleflight", "admission-quotas",
-        "scheduler-commit", "pipeline-obs", "qc-collector",
+        "scheduler-commit", "pipelined-commit", "pipeline-obs",
+        "qc-collector",
     }
 
 

@@ -73,6 +73,7 @@ def test_crash_rejoin_catchup_and_pool_reimport(tmp_path):
         if n is not victim:
             n.txpool._txs.pop(solo_hash, None)
             n.txpool._sealed.discard(solo_hash)
+            n.txpool._unsealed.pop(solo_hash, None)
 
     # crash: drop the object without shutdown; only node<i>.db survives
     gw.disconnect(victim.node_id)

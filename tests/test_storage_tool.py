@@ -42,7 +42,7 @@ def _build_chain(db_path: str, blocks: int = 2) -> None:
                 parent_info=[ParentInfo(b - 1, parent.hash(SUITE))],
                 timestamp=1000 + b,
             ),
-            transactions=pool.seal_txs(1),
+            transactions=pool.seal_txs(1)[0],
         )
         sched.commit_block(sched.execute_block(blk))
     sched.stop()
