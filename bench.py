@@ -632,6 +632,7 @@ def bench_scenario(name: str) -> None:
     from fisco_bcos_tpu.scenario import (
         ScenarioRunner,
         run_big_committee_bench,
+        run_byzantine_bench,
         run_isolation_bench,
         run_proof_storm_bench,
     )
@@ -669,6 +670,50 @@ def bench_scenario(name: str) -> None:
             f"agg speedup {speedup}x vs sequential, "
             f"ed25519 bytes {doc['ed25519']}, "
             f"chain={doc.get('chain', {})}",
+            flush=True,
+        )
+        group_docs = {}
+    elif name == "byzantine":
+        doc = run_byzantine_bench(seed=seed, scale=scale, deadline_s=deadline)
+        err = doc.get("error")
+        ratio = doc["liveness_ratio"]
+        # acceptance: honest commit throughput with one byzantine replica
+        # running the full attack catalog holds >= 0.5x the clean flood
+        # (vs_baseline >= 1.0 passes)
+        _emit(
+            "scenario_byzantine_liveness_ratio", ratio, "x-clean",
+            ratio / 0.5, error=err,
+        )
+        detected = sum(1 for r in doc["attacks"] if r["detected"])
+        _emit(
+            "scenario_byzantine_attacks_detected", detected, "attack",
+            1.0 if doc["all_detected"] else 0.0,
+            error=err
+            or (None if doc["all_detected"] else "undetected or unrun attacks"),
+        )
+        # safety is binary: both legs' auditor reports must be clean AND
+        # the adversary must land in the penalty box
+        safe = (
+            doc["audit_clean"]["ok"]
+            and doc["audit_byzantine"]["ok"]
+            and doc["adversary_demoted"]
+        )
+        _emit(
+            "scenario_byzantine_audit_ok", 1.0 if safe else 0.0, "bool",
+            1.0 if safe else 0.0,
+            error=err
+            or (
+                None
+                if safe
+                else "chain-safety audit violations or adversary not demoted"
+            ),
+        )
+        print(
+            f"# byzantine: clean {doc['clean_tps']} tx/s vs attacked "
+            f"{doc['byzantine_tps']} tx/s (liveness {ratio}x), "
+            f"{detected}/{len(doc['attacks'])} attacks detected, "
+            f"demoted={doc['adversary_demoted']}, "
+            f"evidence={doc['evidence_counts']}, audit ok={safe}",
             flush=True,
         )
         group_docs = {}
@@ -1060,6 +1105,7 @@ def main() -> None:
             "scenario:isolation",
             "scenario:proof-storm",
             "scenario:big-committee",
+            "scenario:byzantine",
         ]
     for i, name in enumerate(names):
         remaining = total_s - (time.monotonic() - t_start) - 10  # emit reserve
@@ -1171,7 +1217,7 @@ def _main_scenario(name: str) -> None:
     from fisco_bcos_tpu.scenario import SCENARIOS
 
     if name not in SCENARIOS and name not in (
-        "isolation", "proof-storm", "big-committee",
+        "isolation", "proof-storm", "big-committee", "byzantine",
     ):
         known = ", ".join(sorted(SCENARIOS))
         print(f"# unknown scenario '{name}' (known: {known})", flush=True)

@@ -34,6 +34,11 @@ from ..front.front import FrontService, ModuleID
 from ..ledger import Ledger
 from ..observability import TRACER
 from ..observability.pipeline import PIPELINE
+from ..resilience.crashpoints import (
+    InjectedCrash,
+    crashpoint,
+    ensure_env_crash_plan,
+)
 from ..utils.metrics import REGISTRY
 from ..protocol.block import Block
 from ..protocol.block_header import SignatureTuple
@@ -43,6 +48,7 @@ from ..txpool.validator import batch_admit
 from ..utils.error import ErrorCode
 from ..utils.log import get_logger, note_swallowed
 from ..utils.worker import Worker
+from .audit import EVIDENCE_GROUP, record_evidence, validator_source
 from .config import PBFTConfig
 from .messages import (
     NewViewPayload,
@@ -53,6 +59,8 @@ from .messages import (
 from .qc import QuorumCert, QuorumCollector, qc_scheme_name, vote_preimage
 
 _log = get_logger("pbft")
+
+ensure_env_crash_plan()  # arm FISCO_CRASH_PLAN seams once per process
 
 # packets that join quorum certificates: in QC mode they accumulate
 # UNVERIFIED (no per-message signature check on arrival) and are admitted
@@ -137,6 +145,16 @@ class PBFTEngine:
         self._view_locks: dict[int, tuple[int, bytes]] = {}
         self._lock = threading.RLock()
         self.timeout_state = False
+        # injected-crash containment: once a crash point fires on this
+        # node, its engine is dead — every subsequent message is ignored
+        # exactly as a killed process would ignore it (the harness reboots
+        # a fresh Node over the durable storage)
+        self._crashed = False
+        # node tag for crash-point scoping (Node sets the pubkey prefix so
+        # a multi-node process can kill exactly one replica)
+        self.crash_scope = ""
+        # node_id -> strike-board source tag memo (hot-path demotion probe)
+        self._source_tags: dict[bytes, str] = {}
         # set by node wiring: (hashes, from_node_id) -> list[Transaction|None]
         # (TransactionSync.fetch_missing — the proposal straggler fetch)
         self.fetch_missing_fn = None
@@ -165,7 +183,20 @@ class PBFTEngine:
             with self._lock:
                 if self.qc is None or self.qc.scheme.name != qc_scheme_name():
                     self.qc = QuorumCollector(self.suite)
+                    self.qc.strike_tagger = self._qc_strike_tag
         return True
+
+    def _qc_strike_tag(self, qc_pub: bytes) -> str:
+        """qc_pub -> the member's node-id strike tag, so QC isolation
+        strikes and byzantine-message evidence strikes (audit.py) combine
+        under one board source toward the demotion threshold. Linear scan:
+        strikes/demotion probes are rare (bad votes, non-empty penalty
+        box), and reading the live config tracks committee reloads."""
+        if qc_pub:
+            for node in self.config.nodes:
+                if node.qc_pub == qc_pub:
+                    return validator_source(node.node_id)
+        return ""
 
     # ----------------------------------------------------------------- worker
 
@@ -290,6 +321,18 @@ class PBFTEngine:
     def submit_proposal(self, block: Block) -> bool:
         """Leader entry (asyncSubmitProposal:325): wrap the sealed block in a
         signed PrePrepare, broadcast, and process it locally."""
+        if self._crashed:
+            return False
+        try:
+            return self._submit_proposal(block)
+        except InjectedCrash:
+            # a crash point fired on THIS node's own proposal path: halt
+            # the engine and let the drive boundary (sealer tick / test
+            # harness) observe the kill
+            self._crashed = True
+            raise
+
+    def _submit_proposal(self, block: Block) -> bool:
         # the leader's own pre-prepare (and, single-node, the whole phase
         # chain down to commit) runs here, not through handle_message —
         # same consensus-stage accounting either way
@@ -361,24 +404,50 @@ class PBFTEngine:
             return
         w = self._worker
         if w is not None:
-            w.post(lambda: self.handle_message(msg))
+            w.post(lambda: self.handle_message(msg, src))
         else:
-            self.handle_message(msg)
+            self.handle_message(msg, src)
 
-    def handle_message(self, msg: PBFTMessage) -> None:
+    def _evidence_demoted(self, node) -> bool:
+        """Has the strike board demoted this validator for byzantine
+        *messages* (equivocation/replay/conflicts — audit.py evidence)?
+        Hot path (every QC vote): one LOCK-FREE emptiness peek when
+        nobody is demoted — the locked per-source probe and the source
+        tag only materialize while someone is in the penalty box."""
+        from ..txpool.quota import get_quotas
+
+        quotas = get_quotas()
+        if not quotas.any_demoted(EVIDENCE_GROUP):
+            return False
+        key = bytes(node.node_id)
+        src = self._source_tags.get(key)
+        if src is None:
+            src = self._source_tags[key] = validator_source(key)
+        return quotas.demoted(EVIDENCE_GROUP, src)
+
+    def handle_message(
+        self, msg: PBFTMessage, src: bytes | None = None
+    ) -> None:
+        if self._crashed:
+            return  # a crash point fired: this node is dead until reboot
         node = self.config.node_at(msg.generated_from)
         if node is None:
             return
         # QC fast path: vote packets accumulate UNVERIFIED — the quorum
         # admits them wholesale with one aggregate verification. Packets
-        # from demoted (previously-bad) signers lose the fast path and pay
-        # eager per-message authentication; everything that is not a vote
+        # from demoted (previously-bad) signers — QC isolation strikes or
+        # byzantine-message evidence — lose the fast path and pay eager
+        # per-message authentication; everything that is not a vote
         # (pre-prepare, view machinery, recovery) is always verified here.
+        # Demotion only ever costs the fast path: a demoted validator's
+        # authenticated votes still join quorums (liveness must survive
+        # the penalty box — see audit.py).
         defer_to_qc = (
             msg.packet_type in VOTE_PACKETS
             and bool(msg.qc_sig)
             and self._qc_active()
             and not self.qc.is_demoted(node.qc_pub)
+            and not self._evidence_demoted(node)
         )
         if not defer_to_qc and not msg.verify(self.suite, node.node_id):
             _log.warning(
@@ -403,11 +472,66 @@ class PBFTEngine:
                 PacketType.RECOVER_REQUEST: self._handle_recover_request,
                 PacketType.RECOVER_RESPONSE: self._handle_recover_response,
             }[msg.packet_type]
+            # stale-view replay: a proposal/vote from a view this node has
+            # already moved past, for a height still in flight. Charged to
+            # the TRANSPORT peer that delivered it, not the frame's signer
+            # — replaying a victim's genuine old frames must never let the
+            # replayer get the victim struck (checkpoints are viewless and
+            # exempt; committed-height stragglers are ordinary lag).
+            stale_replay = (
+                msg.packet_type
+                in (PacketType.PRE_PREPARE, PacketType.PREPARE, PacketType.COMMIT)
+                and msg.view < self.view
+                and msg.number > self.committed_number
+            )
+        if stale_replay:
+            peer_idx = self.config.index_of(src) if src else None
+            if src and peer_idx is not None:
+                source = validator_source(src)  # a member replayed: one tag
+            elif src:
+                source = f"peer:{src.hex()[:16]}"
+            else:
+                # no transport peer known (direct/test drive): the record
+                # stays UNATTRIBUTED — charging the frame's signer (in the
+                # source OR the offender index) would let a replayer
+                # defame the victim whose genuine frames it re-injected
+                source = ""
+            # strike=False: an honest replica that MISSED the view change
+            # re-sends its own cached old-view votes through the exact
+            # same signature (the runtime's in-flight rebroadcast), and
+            # the receiver cannot tell lag from malice. Replay evidence is
+            # therefore a visible detection signal only — striking it
+            # would demote honest laggards after every bumpy view change.
+            record_evidence(
+                "stale_view_replay",
+                number=msg.number,
+                view=msg.view,
+                # the offender is the DELIVERING peer when it is a member,
+                # otherwise unknown (-1) — never the frame's signer
+                from_index=peer_idx if peer_idx is not None else -1,
+                source=source,
+                detail=(
+                    f"{msg.packet_type.name} from view {msg.view} "
+                    f"re-injected at view {self.view}"
+                ),
+                strike=False,
+            )
         # the consensus stage is this worker processing one message; the
         # execute/commit legs inside flip it to blocked-on attribution so
-        # PBFT bookkeeping time and downstream-stage time stay separable
-        with PIPELINE.busy("consensus"):
-            handler(msg)
+        # PBFT bookkeeping time and downstream-stage time stay separable.
+        # An injected crash is absorbed HERE — the transport boundary — so
+        # one node's death never unwinds the in-proc gateway's delivery to
+        # its peers; the engine is dead from this instant.
+        try:
+            with PIPELINE.busy("consensus"):
+                handler(msg)
+        except InjectedCrash:
+            self._crashed = True
+            _log.error(
+                "injected crash while handling %s — node halted (reboot "
+                "to recover)",
+                msg.packet_type.name,
+            )
 
     # ------------------------------------------------------------ pre-prepare
 
@@ -424,10 +548,22 @@ class PBFTEngine:
         cache = self._cache_locked(msg.number)
         if cache.pre_prepare is not None:
             # accepting a SECOND proposal for the same (number, view) and
-            # voting again is equivocation — PBFT safety forbids it
+            # voting again is equivocation — PBFT safety forbids it. The
+            # sender is the proven leader (checked above) and the packet
+            # is signature-verified, so the evidence is attributable.
             if cache.pre_prepare.proposal_hash != msg.proposal_hash:
                 _log.warning(
                     "leader equivocation at %d/%d ignored", msg.number, msg.view
+                )
+                node = self.config.node_at(msg.generated_from)
+                record_evidence(
+                    "equivocation",
+                    number=msg.number,
+                    view=msg.view,
+                    from_index=msg.generated_from,
+                    source=validator_source(node.node_id) if node else "",
+                    detail="second pre-prepare with a different proposal "
+                    "hash at one (number, view)",
                 )
             return False
         lock = self._view_locks.get(msg.view)
@@ -655,6 +791,29 @@ class PBFTEngine:
             if node is None or not msg.verify(self.suite, node.node_id):
                 return  # unauthenticated conflict: drop the newcomer
             msg._authenticated = True
+        if (
+            existing is not None
+            and existing.proposal_hash != msg.proposal_hash
+            and getattr(msg, "_authenticated", True)
+            and getattr(existing, "_authenticated", True)
+        ):
+            # one signer, two different votes at the same (number, view),
+            # and BOTH frames authenticated: honest replicas vote once and
+            # only ever re-send the identical frame, so the conflict is
+            # byzantine by construction. An unauthenticated cached vote is
+            # NOT enough — it may be an attacker's forgery under this
+            # signer's index, and charging the genuine newcomer would let
+            # the forger get an honest validator struck (the forged cached
+            # vote itself dies at QC aggregate time, dropped un-struck).
+            node = self.config.node_at(msg.generated_from)
+            record_evidence(
+                "vote_conflict",
+                number=msg.number,
+                view=msg.view,
+                from_index=msg.generated_from,
+                source=validator_source(node.node_id) if node else "",
+                detail=f"conflicting {msg.packet_type.name} votes",
+            )
         votes[msg.generated_from] = msg
         if msg.qc_sig and self.qc is not None:
             self.qc.add_vote(
@@ -791,6 +950,10 @@ class PBFTEngine:
                 cache.block_data,
                 [m.encode() for m in agreeing.values()],
             )
+        # crash window: the prepared proposal is durable, the COMMIT vote
+        # has not broadcast — a reboot must re-offer it via view change
+        # without ever voting a different hash at this (number, view)
+        crashpoint("engine.pre_commit_broadcast", self.crash_scope)
         commit = PBFTMessage(
             packet_type=PacketType.COMMIT,
             view=self.view,
@@ -973,6 +1136,11 @@ class PBFTEngine:
                 )
             self.committed_number = msg.number
             self._head_hash = executed_hash
+            # crash window: the optimistic head just advanced; in pipeline
+            # mode the 2PC may still be queued on the commit worker — a
+            # reboot rebuilds the head from the durable ledger and block
+            # sync re-drives anything the crash stranded
+            crashpoint("engine.post_head_advance", self.crash_scope)
             self.timeout_state = False
             stale = [n for n in self._caches if n <= msg.number]
             for n in stale:
@@ -1219,6 +1387,21 @@ class PBFTEngine:
                 note_swallowed("pbft.viewchange_decode", e)
                 continue
             proven = self._verified_prepared(p)
+            if proven is None and p.prepared_proposal:
+                # a prepared CLAIM whose proof does not verify: honest
+                # replicas only ever offer proposals with their real
+                # prepare quorum attached, so a fabricated cert is an
+                # attempt to steer the new view onto an unprepared block
+                node = self.config.node_at(m.generated_from)
+                record_evidence(
+                    "fabricated_prepared_cert",
+                    number=self.committed_number + 1,
+                    view=m.view,
+                    from_index=m.generated_from,
+                    source=validator_source(node.node_id) if node else "",
+                    detail="view-change prepared claim without a valid "
+                    "prepare quorum",
+                )
             if proven is not None and (best is None or proven[0] > best[0]):
                 best = proven
         if best is None:

@@ -318,6 +318,8 @@ class QuorumCollector:
     def __init__(self, suite, scheme: QCScheme | None = None):
         self.suite = suite
         self.scheme = scheme or get_scheme()
+        # optional qc_pub -> strike-board source tag (see _strike_source)
+        self.strike_tagger = None
         self._lock = threading.Lock()
         self._pending: dict[tuple, _Pending] = {}
         # stats (mutated under _lock; read by stats()/harness)
@@ -392,10 +394,18 @@ class QuorumCollector:
     # keyed by the signer's registered QC pubkey, NOT its committee index:
     # committee reloads reorder the sorted node list at every membership
     # change, and an index-keyed penalty would transfer to whichever node
-    # inherits the index while the offender walks free
+    # inherits the index while the offender walks free. The engine installs
+    # ``strike_tagger`` (qc_pub -> the member's node-id source tag,
+    # audit.validator_source) so QC isolation strikes and byzantine-message
+    # evidence strikes land under ONE board source and combine toward the
+    # demotion threshold; the qc_pub-hex tag is only the standalone fallback.
 
-    @staticmethod
-    def _strike_source(qc_pub: bytes) -> str:
+    def _strike_source(self, qc_pub: bytes) -> str:
+        tagger = self.strike_tagger
+        if tagger is not None:
+            tag = tagger(qc_pub)
+            if tag:
+                return tag
         return f"validator:{bytes(qc_pub).hex()[:16]}"
 
     def _demoted(self, qc_pub: bytes) -> bool:
@@ -403,7 +413,13 @@ class QuorumCollector:
             return False
         from ..txpool.quota import get_quotas
 
-        return get_quotas().demoted(STRIKE_GROUP, self._strike_source(qc_pub))
+        quotas = get_quotas()
+        # hot path (engine probes every QC vote): lock-free emptiness peek;
+        # the locked probe and the tag only materialize while someone is
+        # actually in the penalty box
+        if not quotas.any_demoted(STRIKE_GROUP):
+            return False
+        return quotas.demoted(STRIKE_GROUP, self._strike_source(qc_pub))
 
     def _strike(self, qc_pub: bytes) -> None:
         if not qc_pub:
@@ -531,9 +547,22 @@ class QuorumCollector:
         return set(valid), eager_bad, cert
 
     def _strike_or_drop(self, bad, qc_pubs, authenticated_fn) -> None:
+        from .audit import record_evidence
+
         for idx in bad:
             if authenticated_fn is None or authenticated_fn(idx):
-                self._strike(qc_pubs[idx] if 0 <= idx < len(qc_pubs) else b"")
+                pub = qc_pubs[idx] if 0 <= idx < len(qc_pubs) else b""
+                self._strike(pub)
+                # strike=False: _strike above already filed the quota
+                # strike — evidence records the detection without
+                # double-charging the offender
+                record_evidence(
+                    "bad_qc_vote",
+                    from_index=idx,
+                    source=self._strike_source(pub) if pub else "",
+                    detail="authenticated vote failed QC verification",
+                    strike=False,
+                )
                 _log.warning(
                     "qc: vote from validator %d failed verification (struck)",
                     idx,
@@ -547,6 +576,16 @@ class QuorumCollector:
                     help="fast-path vote packets whose qc signature failed "
                     "AND whose packet signature does not authenticate the "
                     "claimed sender (dropped, victim not struck)",
+                )
+                # unattributable by design (no source, no strike): the
+                # forger hid behind the victim's index — the record keeps
+                # the detection visible without charging anyone
+                record_evidence(
+                    "forged_qc_vote",
+                    from_index=idx,
+                    detail="vote does not authenticate as its claimed "
+                    "sender",
+                    strike=False,
                 )
                 _log.warning(
                     "qc: dropping forged vote claiming validator %d", idx

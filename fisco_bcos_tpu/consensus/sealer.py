@@ -17,6 +17,7 @@ from ..observability import TRACER
 from ..observability.pipeline import PIPELINE
 from ..protocol.block import Block
 from ..protocol.block_header import BlockHeader, ParentInfo
+from ..resilience.crashpoints import crashpoint
 from ..scheduler.scheduler import pipeline_on
 from ..txpool import TxPool
 from ..utils.log import get_logger
@@ -40,6 +41,8 @@ class Sealer:
         self.ledger = ledger
         self.engine = engine
         self.min_seal_txs = 1
+        # node tag for crash-point scoping (Node sets the pubkey prefix)
+        self.crash_scope = ""
         # pipeline mode: (number, txs, hashes, txs-root resolver) sealed
         # AHEAD while a proposal is in flight — sealing of N+2 overlaps
         # consensus on N+1. Sealer state is single-threaded (one runtime
@@ -82,6 +85,10 @@ class Sealer:
             return
         with PIPELINE.busy("sealer"):
             txs, hashes = self.txpool.seal_txs(limit)
+            # crash window: the batch just left the sealable set, no
+            # proposal references it yet — a reboot's reload_persisted
+            # must return every one of these txs to the pool
+            crashpoint("sealer.mid_prebuild", self.crash_scope)
             if len(txs) < self.min_seal_txs:
                 self.txpool.unseal(hashes)
                 return

@@ -19,9 +19,10 @@ from ..ledger import GenesisConfig, Ledger
 from ..scheduler import Scheduler
 from ..storage import MemoryStorage, SQLiteStorage
 from ..sync import BlockSync, TransactionSync
-from ..storage.interfaces import TransactionalStorage
+from ..storage.interfaces import TransactionalStorage, TwoPCParams
 from ..txpool import TxPool
 from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
 
 _log = get_logger("node")
 
@@ -249,6 +250,17 @@ class Node:
             consensus_storage=ConsensusStorage(self.storage) if durable else None,
         )
         self.sealer = Sealer(self.pbft_config, self.txpool, self.ledger, self.engine)
+        # crash-point scoping (resilience/crashpoints.py): tag this node's
+        # consensus/commit seams so a multi-node process can kill exactly
+        # one replica deterministically
+        crash_scope = self.keypair.pub.hex()[:8]
+        self.engine.crash_scope = crash_scope
+        self.sealer.crash_scope = crash_scope
+        self.scheduler.crash_scope = crash_scope
+        # one injected crash anywhere kills the WHOLE node: a commit-worker
+        # death halts the engine (no zombie quorum votes), and block sync
+        # reads the engine's halt state (no durable writes after death)
+        self.scheduler.on_fatal = self._halt_injected
         self.block_validator = BlockValidator(self.suite)
         self.block_sync = BlockSync(
             self.ledger,
@@ -307,9 +319,95 @@ class Node:
 
         install_observatory()
         if durable:
-            # restart path: re-admit durably-stored pool txs (signatures
-            # re-verified on device; Initializer.cpp:188-195 analog)
+            # restart path, order matters: resolve any 2PC slot a crash
+            # stranded BEFORE the pool re-imports (a rolled-back block's
+            # txs must come back as pending), then re-admit durably-stored
+            # pool txs (signatures re-verified on device;
+            # Initializer.cpp:188-195 analog)
+            self._reconcile_pending_2pc(raw_storage)
             self.txpool.reload_persisted()
+
+    def _reconcile_pending_2pc(self, raw_storage) -> None:
+        """Boot-time 2PC reconciliation for single-backend local storage.
+
+        A node killed between ``prepare`` and ``commit`` leaves a durable
+        prepared-but-unresolved slot (sqlite ``pending_2pc``). Without a
+        separate commit witness the slot must be ROLLED BACK, never rolled
+        forward: committing writes consensus never acknowledged could fork
+        the chain, while rolling back merely re-runs work — the prepared
+        proposal survives in ConsensusStorage for the view-change re-offer
+        and block sync re-drives whatever the committee committed without
+        us. Rolling back also kills a subtler poison: a later, *different*
+        proposal at the same height would otherwise 2PC-merge into the
+        stale slot and commit the dead proposal's rows alongside its own.
+        Distributed backends keep their witness-based recovery
+        (``recover_in_flight``) and are excluded here.
+        """
+        if hasattr(raw_storage, "recover_in_flight"):
+            return
+        pending = getattr(self.storage, "pending_numbers", None)
+        if pending is None:
+            return
+        stale = pending()
+        if not stale:
+            return
+        for n in stale:
+            self.storage.rollback(TwoPCParams(number=n))
+        REGISTRY.counter_add(
+            "fisco_2pc_boot_rollbacks_total",
+            float(len(stale)),
+            help="prepared-but-unresolved 2PC slots rolled back at node "
+            "boot (crash recovery)",
+        )
+        _log.warning(
+            "boot recovery: rolled back %d stranded 2PC slot(s) %s "
+            "(ledger at %d; consensus re-drives)",
+            len(stale),
+            stale,
+            self.ledger.block_number(),
+        )
+
+    def _halt_injected(self) -> None:
+        """Whole-node halt on an injected crash outside the engine's own
+        message boundary (the commit worker, the runtime drive loop): the
+        engine goes silent, and block sync's dead-node check follows it —
+        a process-death emulation must not leave a zombie that votes or
+        durably commits."""
+        self.engine._crashed = True
+        _log.error(
+            "injected crash — node %s halted (reboot to recover)",
+            self.node_id.hex()[:8],
+        )
+
+    def stop(self, timeout: float = 30.0, close_storage: bool = True) -> bool:
+        """Clean shutdown: quiesce consensus, DRAIN the commit-2pc worker
+        — every queued/in-flight async 2PC lands durably — then stop the
+        scheduler workers and tear down storage. The drain-before-teardown
+        order is the point: stopping storage under a half-prepared 2PC
+        would strand a slot that previously only the crash path could
+        produce. Returns False if the drain timed out (the stop still
+        completes — an operator kill must not hang forever)."""
+        self.engine.stop_worker()
+        if self.engine._crashed:
+            # an injected crash halted this node — possibly by killing the
+            # commit-2pc worker mid-flight, after which queued commits can
+            # never drain. Don't block the full drain timeout on a node
+            # that is dead by design: boot recovery owns its stranded slots.
+            drained = False
+        else:
+            drained = self.scheduler.drain_commits(timeout)
+            if not drained:
+                _log.error(
+                    "stop: commit worker did not drain within %.0fs — a 2PC "
+                    "may be stranded (boot recovery will resolve it)",
+                    timeout,
+                )
+        self.scheduler.stop()
+        if close_storage:
+            close = getattr(self.storage, "close", None)
+            if close is not None:
+                close()
+        return drained
 
     def warmup(self, batch_sizes: tuple[int, ...] = (8,)) -> None:
         """Pre-compile the batch admission kernels for the given bucket

@@ -50,14 +50,25 @@ class NodeRuntime:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
-        self.node.engine.stop_worker()
-        self.node.scheduler.stop()  # drain pending commit notifications
+        # clean shutdown via Node.stop: drain the commit-2pc worker before
+        # the scheduler workers tear down (a normal stop must never strand
+        # a half-prepared 2PC). Storage stays open — runtime callers
+        # inspect the ledger after stopping.
+        self.node.stop(close_storage=False)
 
     def _run(self) -> None:
+        from ..resilience.crashpoints import InjectedCrash
+
         _log.info("runtime started (node %s)", self.node.node_id.hex()[:8])
         while not self._stop.is_set():
             try:
                 self._tick()
+            except InjectedCrash:
+                # a crash point fired on the drive loop (sealer prebuild,
+                # inline commit): the whole node halts — not just this
+                # thread — so it neither votes nor syncs as a zombie
+                self.node._halt_injected()
+                return
             except Exception:
                 _log.exception("runtime tick failed")
             self._stop.wait(self.sealer_interval)

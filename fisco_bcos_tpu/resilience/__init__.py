@@ -16,6 +16,10 @@ Three pieces:
 - :mod:`.breaker` — ``CircuitBreaker`` (closed/open/half-open) and the
   process-wide ``HEALTH`` :class:`~.breaker.HealthRegistry` served at
   ``GET /health`` and exported as ``fisco_component_health`` gauges.
+- :mod:`.crashpoints` — named deterministic in-process crash points
+  (``CrashPlan`` / ``FISCO_CRASH_PLAN``): the process-death analog of the
+  fault plan's connection ``kill``, planted across the pipelined commit
+  path so kill-and-reboot recovery is testable on demand.
 
 The reference analogs are tars heartbeat/reconnect loops, the
 TarsRemoteExecutorManager reaper and TiKVStorage's switch handler — see
@@ -25,6 +29,15 @@ docs/resilience.md for the knob-by-knob mapping.
 from __future__ import annotations
 
 from .breaker import HEALTH, CircuitBreaker, HealthRegistry  # noqa: F401
+from .crashpoints import (  # noqa: F401
+    CRASH_POINTS,
+    CrashPlan,
+    InjectedCrash,
+    active_crash_plan,
+    clear_crash_plan,
+    crashpoint,
+    install_crash_plan,
+)
 from .faults import (  # noqa: F401
     FaultPlan,
     FaultRule,

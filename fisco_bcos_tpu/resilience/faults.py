@@ -164,7 +164,15 @@ class FaultPlan:
         return self.rule("refuse", "connect", target, **kw)
 
     def kill_after(self, n: int, site: str = "*", target: str = "*", **kw):
-        """Let n matching messages through, then kill the connection."""
+        """Let n matching messages through, then kill the CONNECTION.
+
+        ``kill`` severs the transport link (the peer sees a dead socket
+        and reconnect/retry machinery engages) — the *process* on the
+        other end keeps running with all of its in-memory state. To
+        simulate the process itself dying mid-operation, use the crash
+        points in :mod:`.crashpoints` (``FISCO_CRASH_PLAN``): those raise
+        :class:`~.crashpoints.InjectedCrash` at a named seam so only the
+        durably-written state survives into the rebooted node."""
         return self.rule("kill", site, target, after=n, **kw)
 
     @classmethod

@@ -27,10 +27,20 @@ from .base import (
     list_scenarios,
 )
 from .big_committee import run_big_committee_bench
+from .byzantine import (
+    ATTACK_NAMES,
+    ByzantineHarness,
+    ByzantineReplica,
+    run_byzantine_bench,
+    run_byzantine_scenario,
+)
 from .proof_storm import run_proof_storm_bench
 from .runner import ScenarioRunner, run_isolation_bench
 
 __all__ = [
+    "ATTACK_NAMES",
+    "ByzantineHarness",
+    "ByzantineReplica",
     "SCENARIOS",
     "Scenario",
     "ScenarioRunner",
@@ -39,6 +49,8 @@ __all__ = [
     "get_scenario",
     "list_scenarios",
     "run_big_committee_bench",
+    "run_byzantine_bench",
+    "run_byzantine_scenario",
     "run_isolation_bench",
     "run_proof_storm_bench",
 ]

@@ -28,6 +28,11 @@ from ..observability.pipeline import PIPELINE
 from ..protocol.block import Block
 from ..protocol.block_header import BlockHeader
 from ..protocol.transaction import TransactionAttribute
+from ..resilience.crashpoints import (
+    InjectedCrash,
+    crashpoint,
+    ensure_env_crash_plan,
+)
 from ..storage.interfaces import TransactionalStorage, TwoPCParams
 from ..storage.state_storage import StateStorage
 from ..utils.error import ErrorCode
@@ -36,6 +41,8 @@ from ..utils.metrics import REGISTRY
 from ..utils.worker import Worker
 
 _log = get_logger("scheduler")
+
+ensure_env_crash_plan()  # arm FISCO_CRASH_PLAN seams once per process
 
 
 def pipeline_on() -> bool:
@@ -100,6 +107,11 @@ class Scheduler:
         self.suite = suite
         self.txpool = txpool
         self._executed: dict[int, ExecutedBlock] = {}
+        # node tag for crash-point scoping (Node sets the pubkey prefix),
+        # and the whole-node halt hook an injected crash on the commit
+        # worker fires before killing the thread (Node wires it)
+        self.crash_scope = ""
+        self.on_fatal = None
         # storage-failover term (SchedulerManager.cpp schedulerTerm analog):
         # bumped by switch_term when the storage backend connection is lost
         self.term = 0
@@ -517,6 +529,10 @@ class Scheduler:
                 ), PIPELINE.blocked("2pc_prepare"):
                     self.executor.prepare(params, extra_writes=ledger_writes)
                 timer.stage("prepare")
+                # crash window: the 2PC slot is durably staged, the commit
+                # has not run — a reboot finds the prepared-but-unresolved
+                # slot and must re-drive or roll it back (Node's boot scan)
+                crashpoint("scheduler.mid_2pc", self.crash_scope)
                 with TRACER.span(
                     "scheduler.2pc_commit", block=number
                 ), PIPELINE.blocked("2pc_commit"):
@@ -637,6 +653,17 @@ class Scheduler:
         exc = None
         try:
             self.commit_block(header)
+        except InjectedCrash:
+            # a planted crash on the commit worker IS process death for
+            # this node: let it kill the worker thread (no on_done, no
+            # rollback bookkeeping) — only the durable 2PC slot survives,
+            # exactly what the reboot harness must reconcile. The fatal
+            # hook (Node wiring) halts the REST of the node first — the
+            # engine must not keep voting as a zombie quorum member while
+            # its commit path is dead.
+            if self.on_fatal is not None:
+                self.on_fatal()
+            raise
         except BaseException as e:  # noqa: BLE001 — reported, not swallowed
             exc = e
             REGISTRY.counter_add(

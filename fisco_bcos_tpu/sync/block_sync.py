@@ -23,6 +23,7 @@ from ..consensus.block_validator import BlockValidator
 from ..front.front import FrontService, ModuleID
 from ..ledger import Ledger
 from ..protocol.block import Block
+from ..resilience.crashpoints import InjectedCrash
 from ..scheduler.scheduler import Scheduler, SchedulerError
 from ..utils.log import get_logger
 
@@ -111,6 +112,12 @@ class BlockSync:
 
         self.time_maintenance = NodeTimeMaintenance()
         self._lock = threading.RLock()
+        # injected-crash containment (resilience/crashpoints.py): the sync
+        # commit path reaches the same scheduler seams as consensus; once
+        # a crash point fires ANYWHERE in this node it is dead — stop
+        # syncing (a halted engine must not keep durably committing via
+        # sync), and never unwind the transport's delivery loop
+        self._crashed = False
         self._genesis_hash = ledger.block_hash_by_number(0) or b"\x00" * 32
         front.register_module(ModuleID.BLOCK_SYNC, self._on_message)
 
@@ -136,9 +143,21 @@ class BlockSync:
         )
         self.front.broadcast(ModuleID.BLOCK_SYNC, _encode_status(st))
 
+    def _node_dead(self) -> bool:
+        """Whole-node halt state: this sync's own crash flag OR the
+        engine's (one injected crash anywhere kills the node; sync must
+        not keep writing durable state for a halted consensus)."""
+        if self._crashed:
+            return True
+        return self.consensus is not None and getattr(
+            self.consensus, "_crashed", False
+        )
+
     def maintain(self) -> None:
         """One sync tick: advertise status, request missing blocks from the
         best peer (maintainDownloadingQueue analog)."""
+        if self._node_dead():
+            return  # a crash point fired: this node is dead until reboot
         self.broadcast_status()
         self._request_missing()
 
@@ -218,6 +237,8 @@ class BlockSync:
     # -- inbound -------------------------------------------------------------
 
     def _on_message(self, src: bytes, payload: bytes) -> None:
+        if self._node_dead():
+            return  # a crash point fired: this node is dead until reboot
         try:
             r = FlatReader(payload)
             pkt = SyncPacket(r.u8())
@@ -233,6 +254,18 @@ class BlockSync:
                 blocks = r.seq(lambda r2: r2.bytes_())
                 r.done()
                 self._on_response(src, blocks)
+        except InjectedCrash:
+            # a crash point fired on the sync-commit path (the same
+            # scheduler seams consensus hits): absorb at the transport
+            # boundary — one node's death must never unwind the gateway's
+            # delivery to its peers — and halt this node wholesale
+            self._crashed = True
+            if self.consensus is not None:
+                self.consensus._crashed = True
+            _log.error(
+                "injected crash while syncing — node halted (reboot to "
+                "recover)"
+            )
         except Exception as e:
             _log.warning("bad sync message from %s: %s", src.hex()[:8], e)
 

@@ -177,23 +177,36 @@ class AdmissionQuotas:
             self._maybe_recover(group, st)
         return granted
 
+    def any_demoted(self, group: str) -> bool:
+        """Lock-free fast path for hot callers (the engine probes every
+        QC vote): is ANY source in the penalty box for this group right
+        now? A stale read costs one locked :meth:`demoted` probe or one
+        extra eager verification tick — never correctness."""
+        st = self._groups.get(group)
+        return st is not None and bool(st.demoted_until)
+
     def demoted(self, group: str, source: str) -> bool:
         """Is this source currently demoted for this group? (Gate BEFORE
-        static checks: a demoted source's traffic costs nothing.)"""
+        static checks: a demoted source's traffic costs nothing.) Sweeps
+        EVERY expired penalty in the group, not just the probed source's:
+        an offender that goes silent after its penalty lapses must not
+        keep :meth:`any_demoted` truthy (and hot callers paying the
+        locked probe) forever."""
         now = time.monotonic()
+        swept = False
         with self._lock:
             st = self._groups.get(group)
             if st is None or not st.demoted_until:
                 return False
-            until = st.demoted_until.get(source)
-            if until is None:
-                return False
-            if now < until:
-                return True
-            del st.demoted_until[source]
-            st.strikes.pop(source, None)  # clean slate after the penalty
-        self._maybe_recover(group, st)
-        return False
+            for s, until in list(st.demoted_until.items()):
+                if now >= until:
+                    del st.demoted_until[s]
+                    st.strikes.pop(s, None)  # clean slate after the penalty
+                    swept = True
+            hit = source in st.demoted_until
+        if swept:
+            self._maybe_recover(group, st)
+        return hit
 
     def count_demoted_drop(self, group: str, n: int) -> None:
         """Account txs refused because their source is demoted."""

@@ -64,6 +64,21 @@ def test_strikes_demote_and_expire():
     assert q.snapshot()["g"]["demoted_sources"] == []
 
 
+def test_any_demoted_self_heals_for_silent_sources():
+    """A demoted source that never traffics again must not keep the
+    lock-free ``any_demoted`` peek truthy past its penalty (hot callers
+    would pay the locked probe forever): any ``demoted`` probe — even
+    for a DIFFERENT source — sweeps the group's expired entries."""
+    q = _quotas(demote_s=0.05)
+    for _ in range(3):
+        q.note_invalid("g", "evil", 1)
+    assert q.any_demoted("g")
+    time.sleep(0.07)
+    # "evil" goes silent; a bystander's probe sweeps the expired entry
+    assert not q.demoted("g", "bystander")
+    assert not q.any_demoted("g")
+
+
 def test_strike_window_prunes_old_offenses():
     q = _quotas(strike_window_s=0.05)
     q.note_invalid("g", "meh", 1)

@@ -1,0 +1,501 @@
+"""Crash-point chaos lab: kill a node at every registered seam, reboot it
+from ConsensusStorage + the persisted pool, and assert it reconciles
+(ISSUE 15 restart matrix).
+
+The "kill" is :class:`InjectedCrash` at a named, count-deterministic
+:func:`crashpoint` scoped to one node of the in-proc committee; the
+"reboot" abandons the node's objects, closes its storage handle, and
+constructs a fresh :class:`Node` over the same sqlite file — only durable
+state crosses the boundary, exactly like a process death. The chain-safety
+auditor is every test's final gate.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fisco_bcos_tpu.codec.abi import ABICodec
+from fisco_bcos_tpu.consensus.audit import EVIDENCE, audit_chain
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.executor.precompiled import DAG_TRANSFER_ADDRESS
+from fisco_bcos_tpu.front import InprocGateway
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+from fisco_bcos_tpu.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol.transaction import TransactionFactory
+from fisco_bcos_tpu.resilience.crashpoints import (
+    CRASH_POINTS,
+    CrashPlan,
+    InjectedCrash,
+    active_crash_plan,
+    clear_crash_plan,
+    install_crash_plan,
+)
+
+SUITE = ecdsa_suite()
+CODEC = ABICodec(SUITE.hash)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    clear_crash_plan()
+    EVIDENCE.reset()
+    yield
+    clear_crash_plan()
+    EVIDENCE.reset()
+
+
+# ---------------------------------------------------------------------------
+# plan mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_spec_parse_and_fire_semantics():
+    plan = CrashPlan.from_spec(
+        "scheduler.mid_2pc@ab12,after=2;sealer.mid_prebuild"
+    )
+    # wrong scope never fires
+    plan.hit("scheduler.mid_2pc", "zz99")
+    # matching scope: two pass-throughs, then the kill
+    plan.hit("scheduler.mid_2pc", "ab12cdef")
+    plan.hit("scheduler.mid_2pc", "ab12cdef")
+    with pytest.raises(InjectedCrash):
+        plan.hit("scheduler.mid_2pc", "ab12cdef")
+    # count=1 default: a process only dies once
+    plan.hit("scheduler.mid_2pc", "ab12cdef")
+    assert plan.fired == [("scheduler.mid_2pc", "ab12cdef")]
+    # the wildcard-scope rule fires independently
+    with pytest.raises(InjectedCrash):
+        plan.hit("sealer.mid_prebuild", "anything")
+    assert plan.crashed
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError):
+        CrashPlan().arm("engine.nope")
+    with pytest.raises(ValueError):
+        CrashPlan.from_spec("scheduler.mid_2pc,weird=1")
+
+
+def test_unarmed_is_passthrough():
+    """FISCO_CRASH_PLAN unset: the seams are no-ops and a clean chain
+    raises no evidence and no crash counters (the byte-identical
+    passthrough half of the acceptance criteria)."""
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    def fired_total():
+        return sum(
+            REGISTRY.counters_matching("fisco_crashpoints_fired_total").values()
+        )
+
+    assert active_crash_plan() is None
+    before = fired_total()
+    nodes, _gw = _chain(secret_base=31_000)
+    _flood_block(nodes, tag="clean", count=3)
+    assert all(n.block_number() == 1 for n in nodes)
+    assert EVIDENCE.count() == 0
+    assert fired_total() == before
+    report = audit_chain(nodes)
+    assert report["ok"], report["violations"]
+    _shutdown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# the kill/reboot matrix
+# ---------------------------------------------------------------------------
+
+
+def _chain(tmp_path=None, secret_base=30_000, n=4):
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=secret_base + i)
+        for i in range(n)
+    ]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gateway = InprocGateway(auto=True)
+    nodes = []
+    for i, kp in enumerate(keypairs):
+        cfg = NodeConfig(
+            db_path=str(tmp_path / f"node{i}.db") if tmp_path else ":memory:",
+            genesis=GenesisConfig(consensus_nodes=list(committee)),
+        )
+        node = Node(cfg, keypair=kp)
+        gateway.connect(node.front)
+        nodes.append(node)
+    return nodes, gateway
+
+
+def _leader_of(nodes, number, view=0):
+    idx = nodes[0].pbft_config.leader_index(number, view)
+    target = nodes[0].pbft_config.nodes[idx].node_id
+    return next(n for n in nodes if n.node_id == target)
+
+
+def _replica_of(nodes, number, view=0):
+    """A non-leader committee member (the crash target: its death must
+    not unwind the leader's drive)."""
+    leader = _leader_of(nodes, number, view)
+    return next(n for n in nodes if n is not leader)
+
+
+def _submit(node, count, tag):
+    fac = TransactionFactory(SUITE)
+    kp = SUITE.signature_impl.generate_keypair(secret=0xC4A5)
+    txs = [
+        fac.create_signed(
+            kp,
+            chain_id="chain0",
+            group_id="group0",
+            block_limit=500,
+            nonce=f"{tag}-{i}",
+            to=DAG_TRANSFER_ADDRESS,
+            input=CODEC.encode_call("userAdd(string,uint256)", f"{tag}{i}", 1),
+        )
+        for i in range(count)
+    ]
+    results = node.txpool.submit_batch(txs)
+    assert all(r.status == 0 for r in results)
+    node.tx_sync.maintain()
+    return txs
+
+
+def _flood_block(nodes, tag, count=3):
+    leader = _leader_of(nodes, nodes[0].block_number() + 1)
+    _submit(leader, count, tag)
+    try:
+        leader.sealer.seal_and_submit()
+    except InjectedCrash:
+        pass  # the armed node died mid-cascade; survivors carry on
+    return leader
+
+
+def _kill(gateway, node):
+    """Process death: sever the transport, halt the engine, stop every
+    worker thread (the reboot replaces the node object, so nothing else
+    will), drop the storage handle. Nothing else of the node is reused."""
+    gateway.disconnect(node.node_id)
+    node.engine._crashed = True
+    node.engine.stop_worker()
+    node.scheduler.stop()
+    close = getattr(node.storage, "close", None)
+    if close is not None:
+        close()
+
+
+def _shutdown(nodes):
+    """End-of-test thread hygiene: every surviving/rebooted node's engine
+    and scheduler workers are joined so no daemon thread outlives the
+    test (leaked threads inside native/XLA code can abort the interpreter
+    at exit)."""
+    for n in nodes:
+        n.engine.stop_worker()
+        n.scheduler.drain_commits(10.0)
+        n.scheduler.stop()
+
+
+def _reboot(gateway, tmp_path, idx, keypairs, committee):
+    cfg = NodeConfig(
+        db_path=str(tmp_path / f"node{idx}.db"),
+        genesis=GenesisConfig(consensus_nodes=list(committee)),
+    )
+    node = Node(cfg, keypair=keypairs[idx])
+    gateway.connect(node.front)
+    return node
+
+
+def _converge(nodes, deadline_rounds=30):
+    for _ in range(deadline_rounds):
+        for n in nodes:
+            n.block_sync.maintain()
+        if len({n.block_number() for n in nodes}) == 1:
+            return True
+    return False
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_restart_matrix(point, tmp_path):
+    """Every registered crash point: kill the scoped node there, reboot
+    from durable state, reconcile, auditor green, chain keeps moving."""
+    secret_base = 32_000 + 100 * CRASH_POINTS.index(point)
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=secret_base + i)
+        for i in range(4)
+    ]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gateway = InprocGateway(auto=True)
+    nodes = []
+    for i, kp in enumerate(keypairs):
+        cfg = NodeConfig(
+            db_path=str(tmp_path / f"node{i}.db"),
+            genesis=GenesisConfig(consensus_nodes=list(committee)),
+        )
+        node = Node(cfg, keypair=kp)
+        gateway.connect(node.front)
+        nodes.append(node)
+
+    # one clean block so the crash height is > 1 (parent links audited)
+    _flood_block(nodes, tag="warm")
+    assert all(n.block_number() == 1 for n in nodes)
+    pre_report = audit_chain(nodes)
+    assert pre_report["ok"], pre_report["violations"]
+
+    crash_height = 2
+    if point == "sealer.mid_prebuild":
+        target = _leader_of(nodes, crash_height)
+    else:
+        target = _replica_of(nodes, crash_height)
+    t_idx = nodes.index(target)
+    plan = CrashPlan().arm(point, scope=target.keypair.pub.hex()[:8])
+    install_crash_plan(plan)
+
+    if point == "sealer.mid_prebuild":
+        # the prebuild seam: the batch leaves the sealable set, then the
+        # process dies before any proposal references it
+        n_txs = 4
+        _submit(target, n_txs, tag="pb")
+        assert target.txpool.unsealed_count() == n_txs
+        with pytest.raises(InjectedCrash):
+            target.sealer._prebuild(crash_height, 100)
+        assert plan.crashed
+        assert target.txpool.unsealed_count() == 0  # stranded as sealed
+        _kill(gateway, target)
+        rebooted = _reboot(gateway, tmp_path, t_idx, keypairs, committee)
+        nodes[t_idx] = rebooted
+        # the reboot returned every prebuilt tx to the sealable set
+        assert rebooted.txpool.unsealed_count() == n_txs
+        clear_crash_plan()
+        _flood_block(nodes, tag="after")
+    else:
+        _flood_block(nodes, tag="crash", count=3)
+        assert plan.crashed, f"{point} never fired"
+        assert target.engine._crashed
+        # the survivors committed the block the target died inside
+        others = [n for i, n in enumerate(nodes) if i != t_idx]
+        assert all(n.block_number() == crash_height for n in others)
+        if point == "scheduler.mid_2pc":
+            # the durable half-2PC the crash stranded
+            assert target.storage.pending_numbers() == [crash_height]
+            assert target.block_number() == crash_height - 1
+        _kill(gateway, target)
+        rebooted = _reboot(gateway, tmp_path, t_idx, keypairs, committee)
+        nodes[t_idx] = rebooted
+        # boot reconciliation: no prepared-but-unresolved slot survives
+        assert rebooted.storage.pending_numbers() == []
+        # optimistic head == durable ledger after reboot
+        head_n, _head_h = rebooted.engine.consensus_head()
+        assert head_n == rebooted.block_number()
+        if point == "engine.pre_commit_broadcast":
+            # prepared proposal durable: the restart re-offers it, and the
+            # crash-safe vote guard pins the voted hash
+            assert rebooted.engine._recovered_prepared is not None
+            assert rebooted.engine._recovered_prepared[0] == crash_height
+            assert rebooted.engine.cstore.load_vote(crash_height) is not None
+        clear_crash_plan()
+        # the rebooted node re-drives the in-flight block via block sync
+        assert _converge(nodes), (
+            f"heights diverged after reboot: "
+            f"{[n.block_number() for n in nodes]}"
+        )
+        _flood_block(nodes, tag="after")
+
+    assert _converge(nodes)
+    # prebuild crashed before any proposal existed at crash_height; the
+    # other seams crashed with the block committed by the survivors
+    floor = crash_height if point == "sealer.mid_prebuild" else crash_height + 1
+    assert nodes[0].block_number() >= floor
+    report = audit_chain(nodes, prior_views=pre_report["views"])
+    assert report["ok"], report["violations"]
+    _shutdown(nodes)
+
+
+def test_crash_on_block_sync_commit_path(tmp_path):
+    """The scheduler.mid_2pc seam is reachable through BlockSync's apply
+    path too (a laggard re-driving a committed block): the crash must be
+    absorbed at the SYNC transport boundary — the laggard halts wholesale
+    (engine + sync), the peers' delivery never unwinds, and the committee
+    keeps committing without it."""
+    nodes, gateway = _chain(tmp_path, secret_base=35_000)
+    _flood_block(nodes, tag="warm")
+    assert all(n.block_number() == 1 for n in nodes)
+    # isolate one replica that leads NEITHER height 2 nor 3 (it must miss
+    # block 2, and the committee must be able to commit 3 without it)
+    target = next(
+        n
+        for n in nodes
+        if n is not _leader_of(nodes, 2) and n is not _leader_of(nodes, 3)
+    )
+    gateway.disconnect(target.node_id)
+    _flood_block(nodes, tag="gap")
+    others = [n for n in nodes if n is not target]
+    assert all(n.block_number() == 2 for n in others)
+    assert target.block_number() == 1
+    gateway.connect(target.front)
+    plan = CrashPlan().arm(
+        "scheduler.mid_2pc", scope=target.keypair.pub.hex()[:8]
+    )
+    install_crash_plan(plan)
+    # catch-up: target learns peer statuses, requests block 2, and the
+    # response's apply hits the armed seam inside target._on_message
+    for _ in range(5):
+        if plan.crashed:
+            break
+        for n in nodes:
+            n.block_sync.maintain()
+    assert plan.crashed, "sync apply never hit the crash point"
+    assert target.engine._crashed and target.block_sync._crashed
+    assert target.block_number() == 1  # the commit died mid-2PC
+    # the peers' delivery loop was not unwound: they keep committing
+    clear_crash_plan()
+    number = others[0].block_number() + 1
+    _submit(_leader_of(others, number), 3, tag="after")
+    assert _leader_of(others, number).sealer.seal_and_submit()
+    assert all(n.block_number() == 3 for n in others)
+    # reboot the dead node over its durable state: slot rolled back,
+    # block sync re-drives the gap, auditor green
+    t_idx = nodes.index(target)
+    keypairs = [n.keypair for n in nodes]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    _kill(gateway, target)
+    rebooted = _reboot(gateway, tmp_path, t_idx, keypairs, committee)
+    nodes[t_idx] = rebooted
+    assert rebooted.storage.pending_numbers() == []
+    assert _converge(nodes)
+    report = audit_chain(nodes)
+    assert report["ok"], report["violations"]
+    _shutdown(nodes)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_mid_2pc_crash_on_commit_worker(tmp_path):
+    """Pipeline mode: the commit-2pc worker dies between prepare and
+    commit (a real thread death — the InjectedCrash passes through the
+    worker's exception guard). The reboot rolls the stranded slot back
+    and the node rejoins the committee."""
+    import time
+
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=33_000 + i)
+        for i in range(4)
+    ]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gateway = InprocGateway(auto=True)
+    nodes = []
+    for i, kp in enumerate(keypairs):
+        cfg = NodeConfig(
+            db_path=str(tmp_path / f"node{i}.db"),
+            genesis=GenesisConfig(consensus_nodes=list(committee)),
+        )
+        node = Node(cfg, keypair=kp)
+        gateway.connect(node.front)
+        nodes.append(node)
+    for n in nodes:
+        n.engine.start_worker()
+    try:
+        target = _replica_of(nodes, 1)
+        t_idx = nodes.index(target)
+        plan = CrashPlan().arm(
+            "scheduler.mid_2pc", scope=target.keypair.pub.hex()[:8]
+        )
+        install_crash_plan(plan)
+        leader = _leader_of(nodes, 1)
+        _submit(leader, 3, tag="wk")
+        assert leader.sealer.seal_and_submit()
+        deadline = time.monotonic() + 30
+        while not plan.crashed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert plan.crashed, "commit worker never hit the crash point"
+        # survivors drain their async commits and agree at height 1. Wait
+        # for each survivor's optimistic head FIRST: the head advances
+        # (right after its 2PC is queued) on its engine worker, which may
+        # not have processed the checkpoint quorum yet when the TARGET's
+        # commit worker hit the crash point — draining before the commit
+        # is queued would succeed trivially at height 0.
+        others = [n for i, n in enumerate(nodes) if i != t_idx]
+        deadline = time.monotonic() + 30
+        while (
+            any(n.engine.consensus_head()[0] < 1 for n in others)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        for n in others:
+            assert n.scheduler.drain_commits(30.0)
+        assert all(n.block_number() == 1 for n in others)
+        deadline = time.monotonic() + 10
+        while (
+            target.storage.pending_numbers() != [1]
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert target.storage.pending_numbers() == [1]
+        assert target.block_number() == 0
+        # the engine advanced its optimistic head before the 2PC died: the
+        # crash is exactly the window where consensus_head > durable
+        assert target.engine.consensus_head()[0] == 1
+        # the worker death halted the WHOLE node — no zombie quorum votes,
+        # no durable sync writes (scheduler.on_fatal -> Node._halt_injected)
+        deadline = time.monotonic() + 10
+        while not target.engine._crashed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert target.engine._crashed
+        assert target.block_sync._node_dead()
+        # a crashed node's stop() must not block on the drain timeout:
+        # its commit worker is dead and queued 2PCs can never drain —
+        # boot recovery owns the stranded slot
+        t0 = time.monotonic()
+        assert target.stop(timeout=30.0, close_storage=False) is False
+        assert time.monotonic() - t0 < 5.0, "stop() blocked on a dead drain"
+        _kill(gateway, target)
+        clear_crash_plan()
+        rebooted = _reboot(gateway, tmp_path, t_idx, keypairs, committee)
+        nodes[t_idx] = rebooted
+        assert rebooted.storage.pending_numbers() == []
+        assert rebooted.engine.consensus_head()[0] == 0  # rebuilt from ledger
+        assert _converge(nodes)
+        assert rebooted.block_number() == 1
+        report = audit_chain(nodes)
+        assert report["ok"], report["violations"]
+    finally:
+        clear_crash_plan()
+        _shutdown(nodes)
+
+
+def test_node_stop_drains_async_commits(tmp_path):
+    """Clean-shutdown satellite: Node.stop() drains the commit-2pc worker
+    before tearing down storage — a normal stop strands nothing, and the
+    rebooted node sees the full height with no leftover 2PC slot."""
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=34_000 + i)
+        for i in range(4)
+    ]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gateway = InprocGateway(auto=True)
+    nodes = []
+    for i, kp in enumerate(keypairs):
+        cfg = NodeConfig(
+            db_path=str(tmp_path / f"node{i}.db"),
+            genesis=GenesisConfig(consensus_nodes=list(committee)),
+        )
+        node = Node(cfg, keypair=kp)
+        gateway.connect(node.front)
+        nodes.append(node)
+    for n in nodes:
+        n.engine.start_worker()  # async (worker-driven) commit path
+    leader = _leader_of(nodes, 1)
+    _submit(leader, 3, tag="stop")
+    assert leader.sealer.seal_and_submit()
+    import time
+
+    deadline = time.monotonic() + 30
+    while (
+        any(n.engine.consensus_head()[0] < 1 for n in nodes)
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
+    for n in nodes:
+        gateway.disconnect(n.node_id)
+        assert n.stop(), "stop() failed to drain the commit worker"
+    # reboot one node: the stop left a fully-booked ledger behind
+    rebooted = _reboot(gateway, tmp_path, 0, keypairs, committee)
+    assert rebooted.block_number() == 1
+    assert rebooted.storage.pending_numbers() == []
+    _shutdown([rebooted])

@@ -128,9 +128,17 @@ def run_chain(n_txs: int, block_cap: int) -> None:
             stalls += 1
     if entry.txpool.pending_count() > 0:
         fail(f"chain stalled with {entry.txpool.pending_count()} txs pending")
+    # ISSUE 15: the flood leg ends with the chain-safety auditor —
+    # agreement / integrity / certificates across all four replicas
+    from fisco_bcos_tpu.consensus.audit import audit_chain
+
+    audit = audit_chain(nodes)
+    if not audit["ok"]:
+        fail(f"flood chain-safety audit: {audit['violations']}")
     print(
         f"chain ok: {nodes[0].block_number()} blocks, {n_txs} txs "
-        f"committed on 4 nodes"
+        f"committed on 4 nodes, audit clean "
+        f"({audit['headers_checked']} headers)"
     )
 
 
@@ -204,11 +212,19 @@ def run_pipelined_flood(n_txs: int = 64, block_cap: int = 16) -> None:
             f"sealer sticky-blocked on consensus_quorum for "
             f"{quorum_ms:.0f}ms of a {window_ms:.0f}ms flood"
         )
+    # ISSUE 15: the pipelined leg's overlap (optimistic head, async 2PC,
+    # prebuilds) must still land a chain every replica agrees on
+    from fisco_bcos_tpu.consensus.audit import audit_chain
+
+    audit = audit_chain(nodes)
+    if not audit["ok"]:
+        fail(f"pipelined flood chain-safety audit: {audit['violations']}")
     print(
         f"pipelined flood ok: {nodes[0].block_number()} blocks, "
         f"{n_txs} txs on 4 worker-driven nodes in {window_ms:.0f} ms; "
         f"sealer blocked: consensus_quorum={quorum_ms:.0f}ms "
-        f"2pc_commit={twopc_ms:.0f}ms, final state={sealer['state']}"
+        f"2pc_commit={twopc_ms:.0f}ms, final state={sealer['state']}; "
+        f"audit clean ({audit['headers_checked']} headers)"
     )
 
 
