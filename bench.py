@@ -100,7 +100,11 @@ M_FLOOD = ("e2e_flood_tps", "tx/s")
 # requests per merged device dispatch during the flood (1.0 = no coalescing
 # won; baseline is the plane-less per-caller dispatch, i.e. exactly 1.0)
 M_COALESCE = ("device_plane_coalesce_ratio", "reqs/dispatch")
-ALL_METRICS = [M_SECP, M_LATENCY, M_SM2, M_MERKLE, M_FLOOD, M_COALESCE]
+# p95 inter-node spread of the corrected quorum edge across the measured
+# flood's aligned rounds (fleet observatory; 0 with FISCO_FLEET_OBS=0)
+M_ROUND_SKEW = ("fleet_round_skew_ms_p95", "ms")
+ALL_METRICS = [M_SECP, M_LATENCY, M_SM2, M_MERKLE, M_FLOOD, M_COALESCE,
+               M_ROUND_SKEW]
 
 
 _EMITTED: set[str] = set()
@@ -602,6 +606,10 @@ def bench_flood() -> None:
         # EVERY round so check_perf can diff consecutive rounds even
         # when --telemetry is off (no profiler fold in this shape)
         _dump_flood_round_artifact(tps, dt)
+    # ISSUE 16: the fleet observatory's per-phase round spans + quorum-edge
+    # skew, written every round next to the pipeline artifact (noop and
+    # placeholder-emitting when FISCO_FLEET_OBS=0)
+    _dump_flood_rounds_artifact(nodes, dt)
     _gate_flood_round(prev_round_doc, tps)
     if plane_enabled():
         plane = get_plane()
@@ -850,6 +858,89 @@ def _dump_flood_round_artifact(tps: float, window_s: float) -> None:
     with open(path, "w") as f:
         json.dump(doc, f, indent=1, default=str)
     print(f"# flood round artifact -> {path}", flush=True)
+
+
+def _flood_rounds_artifact_path() -> str:
+    base = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(base, "bench_telemetry.flood.rounds.json")
+
+
+def _dump_flood_rounds_artifact(nodes, window_s: float) -> None:
+    """ISSUE 16 round artifact: the fleet observatory's view of the
+    measured flood — per-consensus-phase span vector aggregated across
+    every aligned round on every replica (``round_phase_ms``, the p95 per
+    phase — what tool/check_perf.py diffs round over round), the
+    inter-node skew percentiles of the quorum edge, and any straggler
+    attributions. Also emits ``fleet_round_skew_ms_p95`` as a metric
+    line. With FISCO_FLEET_OBS=0 the ledgers recorded nothing: emit the
+    disabled placeholder and write no artifact (the switch must stay a
+    no-op on the flood path)."""
+    svc = getattr(nodes[0], "fleet", None)
+    if svc is None:
+        _emit(
+            M_ROUND_SKEW[0], 0.0, M_ROUND_SKEW[1], 0.0,
+            error="fleet observatory disabled (FISCO_FLEET_OBS=0)",
+            measured=False,
+        )
+        return
+    from fisco_bcos_tpu.observability.roundlog import rounds_doc
+
+    # pull every replica's ledger over the wire and align with
+    # record_skew=True — the flood bench is an owning aggregation path
+    # (like /fleet), so the round skews land in fisco_round_skew_ms too
+    ledgers, offsets = svc._peer_ledgers({"last": 64})
+    rounds = rounds_doc(ledgers, offsets, last=64, record_skew=True)
+    phase_samples: dict[str, list[float]] = {}
+    stragglers: dict[str, int] = {}
+    for rd in rounds["rounds"]:
+        for per_node in rd["nodes"].values():
+            for phase, ms in per_node["phases"].items():
+                phase_samples.setdefault(phase, []).append(ms)
+        if "straggler" in rd:
+            key = str(rd["straggler"])
+            stragglers[key] = stragglers.get(key, 0) + 1
+    from fisco_bcos_tpu.observability.roundlog import percentile
+
+    doc = {
+        "tag": "flood",
+        "window_s": round(window_s, 3),
+        "rounds_aligned": len(rounds["rounds"]),
+        "nodes": rounds["nodes"],
+        "round_phase_ms": {
+            phase: round(percentile(v, 95), 3)
+            for phase, v in sorted(phase_samples.items())
+        },
+        "round_phase_detail": {
+            phase: {
+                "n": len(v),
+                "p50": round(percentile(v, 50), 3),
+                "p95": round(percentile(v, 95), 3),
+                "max": round(max(v), 3),
+            }
+            for phase, v in sorted(phase_samples.items())
+        },
+        "skew_ms": rounds["skew_ms"],
+        "stragglers": stragglers,
+        "view_changes": rounds["view_changes"],
+    }
+    path = _flood_rounds_artifact_path()
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    p95 = rounds["skew_ms"]["p95"]
+    # acceptance: the corrected quorum edge across an in-proc fleet must
+    # stay under the skew budget — vs_baseline >= 1.0 passes
+    budget_ms = 250.0
+    _emit(
+        M_ROUND_SKEW[0], p95, M_ROUND_SKEW[1],
+        budget_ms / max(p95, 1e-6),
+        error=None if p95 < budget_ms
+        else f"round skew p95 >= {budget_ms:.0f} ms",
+    )
+    print(
+        f"# fleet rounds: aligned={doc['rounds_aligned']} "
+        f"skew_p95={p95:.2f}ms stragglers={stragglers or '{}'} -> {path}",
+        flush=True,
+    )
 
 
 def _gate_flood_round(prev_doc: dict | None, tps: float) -> None:

@@ -115,6 +115,7 @@ def build_node(opts: ChainOptions):
     # getGroupList/getGroupInfoList aggregate and requests route by group
     manager = GroupManager()
     impl = manager.add_node(node)
+    fleet = node.fleet
     server = RpcHttpServer(
         MultiGroupRpc(manager, default_group=opts.node.group_id),
         host=opts.rpc_listen_ip,
@@ -127,6 +128,9 @@ def build_node(opts: ChainOptions):
         pipeline=pipeline_doc,
         profile=profiler.profile,
         device=device_doc,
+        fleet=fleet.fleet_doc if fleet is not None else None,
+        round_doc=fleet.round_forensics if fleet is not None else None,
+        rounds=fleet.rounds_forensics if fleet is not None else None,
     )
     ws = None
     if opts.ws_listen_port:
@@ -204,6 +208,11 @@ def main(argv: list[str] | None = None) -> int:
 
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
+    # black box (ISSUE 16): a SIGTERM'd node leaves flight_<node>.json
+    # behind — installed over _shutdown so the chain runs flush-then-stop
+    from .observability.flight import install_signal_flush
+
+    install_signal_flush(lambda: node.engine.crash_scope or node.node_id.hex()[:8])
     try:
         while not stop.is_set():
             time.sleep(0.2)

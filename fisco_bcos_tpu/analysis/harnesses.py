@@ -9,7 +9,7 @@ edge and watched-field access, so the schedules these harnesses survive
 include exactly the interleavings production would need OS-scheduler bad
 luck to hit.
 
-The four real harnesses (``HARNESSES``) ride ``tool/check_races.py``'s
+The real harnesses (``HARNESSES``) ride ``tool/check_races.py``'s
 seeded sweep; :class:`RacyCounterHarness` is the *injected race* — the
 canary proving the explorer actually finds and shrinks a data race (it
 must FAIL; the suite asserts it does within a bounded seed budget).
@@ -743,11 +743,135 @@ class QuorumCollectorHarness:
         assert st["fallbacks"] == 0, st
 
 
+# -- Fleet observatory: round ledger + flight ring -----------------------------
+
+
+class FleetObsHarness:
+    """Two engine-side writers drive the SAME round's edges and votes
+    (plus younger rounds and a view change) while the federation
+    aggregator snapshots the ledger and a crash-flush drains the flight
+    ring to disk (ISSUE 16): first-wins edges must survive re-delivery
+    races, quorum votes must never be lost, a snapshot must not tear
+    mid-round, and the flushed black box must parse back whole."""
+
+    name = "fleet-obs"
+
+    def __init__(self):
+        from ..observability.flight import FlightRecorder
+        from ..observability.roundlog import RoundLedger
+
+        self.watch = [
+            (RoundLedger, ("_rounds", "_view_changes")),
+            # "?": the ring rides lock-free GIL-atomic appends by design —
+            # only a reassignment of the ring itself may flag
+            (FlightRecorder, ("?_ring",)),
+        ]
+
+    def setup(self):
+        import tempfile
+
+        from ..observability.flight import FlightRecorder
+        from ..observability.roundlog import RoundLedger
+
+        # deterministic injected clock (the explorer forbids wall clocks)
+        ticks = {"t": 0.0}
+        lock = threading.Lock()
+
+        def clock():
+            with lock:
+                ticks["t"] += 1.0
+                return ticks["t"]
+
+        led = RoundLedger(node_tag="h0", cap=8, clock=clock, emit_metrics=False)
+        fr = FlightRecorder(cap=64, clock=clock, wallclock=clock, enabled=True)
+        return {
+            "led": led, "fr": fr, "snaps": [],
+            "dir": tempfile.mkdtemp(prefix="fleet-obs-"),
+        }
+
+    def threads(self, ctx):
+        led = ctx["led"]
+        fr = ctx["fr"]
+        snaps = ctx["snaps"]
+
+        def engine():
+            # the engine worker: round 5's own edges + its quorum votes
+            led.note(5, 0, "pre_prepare")
+            for i in range(3):
+                led.vote(5, 0, "prepare", i)
+            led.note(5, 0, "prepared")
+            fr.record("engine", "prepared", scope="h0", height=5)
+
+        def transport():
+            # transport threads race the same round (re-delivery included)
+            led.vote(5, 0, "prepare", 3)
+            led.note(5, 0, "pre_prepare")  # re-delivered frame: first wins
+            for h in (6, 7, 8):
+                led.note(h, 0, "pre_prepare")
+            led.view_change(6, 0, 1, "timeout")
+            fr.record("engine", "pre_prepare", scope="h0", height=6)
+
+        def aggregator():
+            snaps.append(led.snapshot())
+            snaps.append(led.snapshot(height=5))
+
+        def flusher():
+            # the crash-flush door: ring + embedded ledger to disk
+            fr.record("halt", "stop", scope="h0")
+            ctx["path"] = fr.flush(
+                "h0", "crash:test", directory=ctx["dir"],
+                rounds=led.snapshot(),
+            )
+
+        return [
+            ("engine", engine), ("transport", transport),
+            ("agg", aggregator), ("flush", flusher),
+        ]
+
+    def check(self, ctx):
+        import json
+        import shutil
+
+        from ..observability.flight import post_mortem
+
+        led = ctx["led"]
+        final = led.snapshot()
+        by_key = {(r["height"], r["view"]): r for r in final["rounds"]}
+        # the lost-update canaries: every edge, every vote, the view change
+        r5 = by_key[(5, 0)]
+        assert {"pre_prepare", "prepared"} <= set(r5["events"]), r5
+        assert set(r5["votes"]["prepare"]) == {"0", "1", "2", "3"}, r5
+        for h in (6, 7, 8):
+            assert (h, 0) in by_key, sorted(by_key)
+        assert [vc["cause"] for vc in final["view_changes"]] == ["timeout"]
+        # no torn snapshot: every observed round is structurally whole
+        for snap in ctx["snaps"]:
+            for r in snap["rounds"]:
+                assert isinstance(r["events"], dict), r
+                assert all(
+                    isinstance(t, float) for vs in r["votes"].values()
+                    for t in vs.values()
+                ), r
+        for r in ctx["snaps"][1::2]:  # the height-filtered snapshots
+            assert all(x["height"] == 5 for x in r["rounds"]), r
+        # the black box parses back whole, wherever the flush interleaved
+        assert ctx.get("path"), "flight flush failed"
+        with open(ctx["path"]) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "crash:test", doc["reason"]
+        names = {(e["category"], e["name"]) for e in doc["events"]}
+        assert ("halt", "stop") in names, sorted(names)
+        assert doc["rounds"]["node"] == "h0", doc["rounds"]
+        pm = post_mortem(ctx["dir"])
+        assert "h0" in pm["nodes"] and pm["timeline"], pm["nodes"]
+        shutil.rmtree(ctx["dir"], ignore_errors=True)
+
+
 HARNESSES = {
     h.name: h
     for h in (DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
               SchedulerHarness, PipelinedCommitHarness, PipelineObsHarness,
-              QuorumCollectorHarness)
+              QuorumCollectorHarness, FleetObsHarness)
 }
 
 FIXTURE_HARNESSES = {RacyCounterHarness.name: RacyCounterHarness}

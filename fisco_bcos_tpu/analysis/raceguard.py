@@ -127,16 +127,25 @@ class RaceGuard:
 
     def watch(self, cls: type, fields) -> None:
         """Patch ``cls`` so reads/writes of ``fields`` report here.
-        Idempotent per class (fields merge into the watched set)."""
+        Idempotent per class (fields merge into the watched set).
+
+        A ``"?name"`` field is a **write-once-publish waiver** (the classic
+        Eraser false positive the module docstring promises a waiver for):
+        lock-free *reads* of a field assigned once at construction are by
+        design — e.g. the flight ring's GIL-atomic ``deque.append`` rides
+        an attribute read — so only *writes* report; a post-publication
+        reassignment from a second thread still flags."""
         fields = frozenset(fields)
+        waived = frozenset(f[1:] for f in fields if f.startswith("?"))
+        fields = frozenset(f for f in fields if not f.startswith("?")) | waived
         with self._mu:
             if cls in self._patched:
-                orig_set, orig_get, fs = self._patched[cls]
-                self._patched[cls] = (orig_set, orig_get, fs | fields)
+                orig_set, orig_get, fs, wv = self._patched[cls]
+                self._patched[cls] = (orig_set, orig_get, fs | fields, wv | waived)
                 return
             orig_set = cls.__setattr__
             orig_get = cls.__getattribute__
-            self._patched[cls] = (orig_set, orig_get, fields)
+            self._patched[cls] = (orig_set, orig_get, fields, waived)
         guard = self
 
         def __setattr__(obj, name, value):
@@ -147,7 +156,7 @@ class RaceGuard:
 
         def __getattribute__(obj, name):
             entry = guard._patched.get(cls)
-            if entry is not None and name in entry[2]:
+            if entry is not None and name in entry[2] and name not in entry[3]:
                 guard._on_access(obj, cls.__name__, name, False)
             return orig_get(obj, name)
 
@@ -157,7 +166,7 @@ class RaceGuard:
     def unwatch_all(self) -> None:
         with self._mu:
             patched, self._patched = self._patched, {}
-        for cls, (orig_set, orig_get, _fields) in patched.items():
+        for cls, (orig_set, orig_get, _fields, _waived) in patched.items():
             cls.__setattr__ = orig_set
             cls.__getattribute__ = orig_get
 
@@ -265,6 +274,15 @@ DEFAULT_WATCHLIST: tuple[tuple[str, str, tuple[str, ...]], ...] = (
     ("fisco_bcos_tpu.observability.pipeline", "StageStats",
      ("busy_ms", "intervals", "blocked_intervals", "n_busy", "n_blocked",
       "state")),
+    # the fleet observatory's shared state (ISSUE 16): the round ledger is
+    # written by the engine worker + transport threads and snapshotted by
+    # the federation aggregator; the flight ring is append-only from every
+    # subsystem and drained by crash-flush
+    ("fisco_bcos_tpu.observability.roundlog", "RoundLedger",
+     ("_rounds", "_view_changes")),
+    # "?": lock-free ring reads are the design (GIL-atomic deque.append);
+    # only a post-publication reassignment of the ring itself may flag
+    ("fisco_bcos_tpu.observability.flight", "FlightRecorder", ("?_ring",)),
 )
 
 _installed = False

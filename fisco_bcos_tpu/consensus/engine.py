@@ -34,6 +34,7 @@ from ..front.front import FrontService, ModuleID
 from ..ledger import Ledger
 from ..observability import TRACER
 from ..observability.pipeline import PIPELINE
+from ..observability.roundlog import NOOP_LEDGER
 from ..resilience.crashpoints import (
     InjectedCrash,
     crashpoint,
@@ -153,6 +154,10 @@ class PBFTEngine:
         # node tag for crash-point scoping (Node sets the pubkey prefix so
         # a multi-node process can kill exactly one replica)
         self.crash_scope = ""
+        # round forensics (ISSUE 16): Node swaps in a real RoundLedger when
+        # the fleet observatory is on; the shared noop keeps every note a
+        # single attribute call otherwise
+        self.roundlog = NOOP_LEDGER
         # node_id -> strike-board source tag memo (hot-path demotion probe)
         self._source_tags: dict[bytes, str] = {}
         # set by node wiring: (hashes, from_node_id) -> list[Transaction|None]
@@ -264,6 +269,7 @@ class PBFTEngine:
         sync / view change can recover from a truthful height (the same
         position as a node that crashed before its commit)."""
         if exc is None:
+            self.roundlog.note_height(number, "durable")
             return
         with self._lock:
             durable = self.ledger.block_number()
@@ -630,6 +636,7 @@ class PBFTEngine:
             cache.block = block
             cache.block_data = block.encode()  # accept-time snapshot
             cache.t_accept = time.perf_counter()
+            self.roundlog.note(msg.number, msg.view, "pre_prepare", t=cache.t_accept)
             if self._async_commit_active():
                 # pipelined commit: the next height seals before this
                 # block's 2PC lands, so its txs must leave the sealable
@@ -660,6 +667,10 @@ class PBFTEngine:
             self._sign(prepare)
             self._broadcast(prepare)
             cache.prepares[prepare.generated_from] = prepare
+            self.roundlog.note(msg.number, msg.view, "prepare_sent")
+            self.roundlog.vote(
+                msg.number, msg.view, "prepare", prepare.generated_from
+            )
             # votes may have arrived ahead of the pre-prepare (depth-first
             # delivery / network reordering — the reference caches them too)
             self._check_prepared_quorum(msg.number, cache)
@@ -750,6 +761,7 @@ class PBFTEngine:
                 msg,
                 (int(PacketType.PREPARE), msg.number, msg.view, msg.proposal_hash),
             )
+            self.roundlog.vote(msg.number, msg.view, "prepare", msg.generated_from)
             self._check_prepared_quorum(msg.number, cache)
 
     def _handle_commit(self, msg: PBFTMessage) -> None:
@@ -762,6 +774,7 @@ class PBFTEngine:
                 msg,
                 (int(PacketType.COMMIT), msg.number, msg.view, msg.proposal_hash),
             )
+            self.roundlog.vote(msg.number, msg.view, "commit", msg.generated_from)
             self._check_commit_quorum(msg.number, cache)
 
     def _agreeing(self, votes: dict[int, PBFTMessage], proposal_hash: bytes):
@@ -927,6 +940,7 @@ class PBFTEngine:
             cache.prepare_qc = cert
         cache.prepared = True
         cache.t_prepared = time.perf_counter()
+        self.roundlog.note(number, self.view, "prepared", t=cache.t_prepared)
         if cache.t_accept:
             REGISTRY.observe(
                 "fisco_pbft_prepare_latency_ms",
@@ -963,6 +977,8 @@ class PBFTEngine:
         self._sign(commit)
         self._broadcast(commit)
         cache.commits[commit.generated_from] = commit
+        self.roundlog.note(number, self.view, "commit_sent")
+        self.roundlog.vote(number, self.view, "commit", commit.generated_from)
         self._check_commit_quorum(number, cache)
 
     def _check_commit_quorum(self, number: int, cache: ProposalCache) -> None:
@@ -984,6 +1000,7 @@ class PBFTEngine:
                 return
         cache.committed = True
         cache.t_committed = time.perf_counter()
+        self.roundlog.note(number, self.view, "committed", t=cache.t_committed)
         if cache.t_prepared:
             REGISTRY.observe(
                 "fisco_pbft_commit_latency_ms",
@@ -1003,6 +1020,7 @@ class PBFTEngine:
         """Commit quorum reached: apply via the scheduler (StateMachine::
         asyncApply) and distribute a checkpoint over the *executed* header."""
         assert cache.block is not None
+        self.roundlog.note(number, self.view, "execute_start")
         try:
             with TRACER.attach(cache.trace_ctx), TRACER.span(
                 "pbft.execute_and_checkpoint", block=number
@@ -1013,6 +1031,7 @@ class PBFTEngine:
         except SchedulerError as e:
             _log.error("execute block %d failed: %s", number, e)
             return
+        self.roundlog.note(number, self.view, "execute_end")
         if cache.t_committed:
             REGISTRY.observe(
                 "fisco_pbft_execute_latency_ms",
@@ -1033,6 +1052,7 @@ class PBFTEngine:
         )
         self._sign(ckpt)
         self._broadcast(ckpt)
+        self.roundlog.note(number, self.view, "checkpoint_sent")
         self._handle_checkpoint(ckpt)
 
     # ------------------------------------------------------------- checkpoint
@@ -1046,6 +1066,9 @@ class PBFTEngine:
                 cache.checkpoints,
                 msg,
                 (int(PacketType.CHECKPOINT), msg.number, 0, msg.proposal_hash),
+            )
+            self.roundlog.vote(
+                msg.number, self.view, "checkpoint", msg.generated_from
             )
             if cache.stable or cache.executed_header is None:
                 return
@@ -1134,6 +1157,12 @@ class PBFTEngine:
                     parent_ctx=cache.trace_ctx,
                     block=msg.number,
                 )
+            self.roundlog.note(msg.number, self.view, "stable", t=now)
+            if not use_async:
+                # lock-step commit: the 2PC landed inside the try above —
+                # the round is durable the instant it is stable (the async
+                # path notes durability from the commit-worker callback)
+                self.roundlog.note_height(msg.number, "durable")
             self.committed_number = msg.number
             self._head_hash = executed_hash
             # crash window: the optimistic head just advanced; in pipeline
@@ -1175,14 +1204,19 @@ class PBFTEngine:
 
     # ------------------------------------------------------------ view change
 
-    def on_timeout(self) -> None:
-        """Consensus timeout: try to move to view+1 (PBFTTimer expiry)."""
+    def on_timeout(self, cause: str = "timeout") -> None:
+        """Consensus timeout: try to move to view+1 (PBFTTimer expiry).
+        ``cause`` attributes the round-forensics record — the catch-up path
+        re-enters here with ``catchup``."""
         with self._lock:
             self.timeout_state = True
             self.to_view = max(self.to_view, self.view) + 1
             REGISTRY.counter_add(
                 "fisco_pbft_view_change_total",
                 help="view changes initiated (consensus timeouts + catch-ups)",
+            )
+            self.roundlog.view_change(
+                self.committed_number + 1, self.view, self.to_view, cause
             )
             self._send_view_change()
 
@@ -1252,7 +1286,7 @@ class PBFTEngine:
                 and msg.view > self.to_view
             ):
                 self.to_view = msg.view - 1
-                self.on_timeout()
+                self.on_timeout(cause="catchup")
                 return
             if self._weight(votes) < self.config.quorum:
                 return
@@ -1411,6 +1445,9 @@ class PBFTEngine:
         self._view_locks[view] = (block.header.number, proposal_hash)
 
     def _enter_view_locked(self, view: int) -> None:
+        self.roundlog.view_change(
+            self.committed_number + 1, self.view, view, "entered"
+        )
         self.view = view
         self.to_view = view
         self.timeout_state = False

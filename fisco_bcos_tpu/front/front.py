@@ -37,6 +37,9 @@ class ModuleID(IntEnum):
     # batched proof fetch (ISSUE 7 read path): one round trip carries N
     # tx/receipt proofs, served from the full node's ProofPlane cache
     LIGHTNODE_GET_PROOFS = 4006
+    # federated telemetry pull (ISSUE 16): any node asks a peer for its
+    # metrics snapshot / round ledger / clock probe over the same mesh
+    FLEET_TELEMETRY = 4007
     SYNC_PUSH_TRANSACTION = 5000
 
 # callback(from_node_id: bytes, payload: bytes) -> None

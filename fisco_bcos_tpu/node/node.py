@@ -257,6 +257,17 @@ class Node:
         self.engine.crash_scope = crash_scope
         self.sealer.crash_scope = crash_scope
         self.scheduler.crash_scope = crash_scope
+        # fleet observatory (ISSUE 16): per-node round ledger on the engine
+        # + the ModuleID 4007 federation endpoint. FISCO_FLEET_OBS=0 leaves
+        # the engine on the shared noop ledger and registers nothing.
+        from ..observability.roundlog import RoundLedger, fleet_obs_enabled
+
+        self.fleet = None
+        if fleet_obs_enabled():
+            self.engine.roundlog = RoundLedger(node_tag=crash_scope)
+            from ..observability.fleet import FleetService
+
+            self.fleet = FleetService(self)
         # one injected crash anywhere kills the WHOLE node: a commit-worker
         # death halts the engine (no zombie quorum votes), and block sync
         # reads the engine's halt state (no durable writes after death)
@@ -374,6 +385,14 @@ class Node:
         a process-death emulation must not leave a zombie that votes or
         durably commits."""
         self.engine._crashed = True
+        # black box: the whole-node halt is a death door — flush the flight
+        # ring (the crash point's own flush may predate the halt reason)
+        from ..observability.flight import FLIGHT, flush_node
+
+        FLIGHT.record(
+            "halt", "fatal_injected", scope=self.engine.crash_scope
+        )
+        flush_node(self, "fatal_halt")
         _log.error(
             "injected crash — node %s halted (reboot to recover)",
             self.node_id.hex()[:8],
@@ -387,6 +406,10 @@ class Node:
         would strand a slot that previously only the crash path could
         produce. Returns False if the drain timed out (the stop still
         completes — an operator kill must not hang forever)."""
+        from ..observability.flight import FLIGHT, flush_node
+
+        FLIGHT.record("halt", "stop", scope=self.engine.crash_scope)
+        flush_node(self, "stop")
         self.engine.stop_worker()
         if self.engine._crashed:
             # an injected crash halted this node — possibly by killing the

@@ -126,6 +126,7 @@ def main(argv: list[str] | None = None) -> int:
         metrics=bind_node_metrics(node),
         tracer=TRACER,
         health=HEALTH,
+        fleet=node.fleet,
     )
     facade.start()
 

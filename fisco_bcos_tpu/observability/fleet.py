@@ -1,0 +1,304 @@
+"""Fleet observatory — telemetry federation over the real wire (ISSUE 16
+tentpole, part 2).
+
+A new gateway module (``ModuleID.FLEET_TELEMETRY`` = 4007) lets ANY node
+pull its committee peers' telemetry over the existing TcpGateway/in-proc
+mesh: metrics counters, health rows, evidence-board totals, chain heads
+(optimistic vs durable), and the round-forensics ledger — plus a clock
+probe whose RTT-halved offset lets :mod:`.roundlog`'s aligner compare
+monotonic timestamps across machines.
+
+Request/response ride the one-way front exactly like the lightnode
+protocol: ``u64 req_id | u8 is_response | json payload``; every node's
+:class:`FleetService` is client and server at once. Pulls run under a
+per-peer :class:`~..resilience.retry.Deadline`, and repeated failures
+strike the peer (the resilience-layer pattern): a struck peer's next pull
+gets a quartered budget so one dead replica cannot park the whole fleet
+merge, and its document entry degrades to ``status: unreachable`` —
+degraded, never missing.
+
+``GET /fleet`` (and the Pro/Max facade's ``fleet`` method, registered
+``concurrent=True``) merges everything into one cluster document;
+``GET /round/<height>`` / ``GET /rounds?last=N`` serve the aligned round
+forensics. ``FISCO_FLEET_OBS=0``: the service is never constructed
+(``build_fleet`` returns None) — no module registration, no wire traffic.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from ..codec.flat import FlatReader, FlatWriter
+from ..front import ModuleID
+from ..resilience.retry import Deadline
+from ..utils.log import get_logger, note_swallowed
+from ..utils.metrics import REGISTRY
+from .roundlog import fleet_obs_enabled, round_doc, rounds_doc
+
+_log = get_logger("fleet")
+
+PULL_TIMEOUT_S = 2.0
+STRIKE_LIMIT = 3  # consecutive failures before the peer's budget shrinks
+STRUCK_BUDGET_FACTOR = 0.25
+
+
+class FleetService:
+    """One node's federation endpoint: serves this node's telemetry to
+    peers and pulls/merges theirs. Registered on the node's front at
+    construction; both roles share one dispatcher."""
+
+    def __init__(self, node, timeout: float = PULL_TIMEOUT_S):
+        self.node = node
+        self.timeout = float(timeout)
+        self._ids = itertools.count(1)
+        self._pending: dict[int, dict | None] = {}
+        self._cv = threading.Condition()
+        # peer node_id -> consecutive pull failures (reset on success)
+        self._strikes: dict[bytes, int] = {}
+        # peer node_id -> (offset_s, rtt_s) from the last clock probe
+        self._offsets: dict[bytes, tuple[float, float]] = {}
+        node.front.register_module(ModuleID.FLEET_TELEMETRY, self._on_message)
+
+    # -- local documents -------------------------------------------------
+
+    def local_snapshot(self) -> dict:
+        """This node's row of the cluster document: identity, heads,
+        health, evidence totals, and the metrics counter families."""
+        from ..consensus.audit import EVIDENCE
+        from ..resilience import HEALTH
+
+        node = self.node
+        opt_head, _ = node.engine.consensus_head()
+        try:
+            health = json.loads(HEALTH.to_json())
+        except ValueError:
+            health = {"status": "unknown", "components": {}}
+        return {
+            "node": node.engine.crash_scope or node.node_id.hex()[:8],
+            "node_id": node.node_id.hex(),
+            "height_durable": node.block_number(),
+            "height_optimistic": opt_head,
+            "view": node.engine.view,
+            "crashed": node.engine._crashed,
+            "pool_pending": node.txpool.pending_count(),
+            "health": health,
+            "evidence": dict(EVIDENCE.counts()),
+            "metrics": REGISTRY.counters_matching("fisco_"),
+            "status": "ok",
+        }
+
+    def _serve(self, kind: str, args: dict) -> dict:
+        if kind == "probe":
+            return {"t_peer": self.node.engine.roundlog.probe()}
+        if kind == "rounds":
+            return {
+                "t_peer": self.node.engine.roundlog.probe(),
+                "ledger": self.node.engine.roundlog.snapshot(
+                    last=args.get("last"), height=args.get("height")
+                ),
+            }
+        if kind == "snapshot":
+            return {"snapshot": self.local_snapshot()}
+        return {"error": f"unknown kind {kind!r}"}
+
+    # -- wire ------------------------------------------------------------
+
+    def _on_message(self, src: bytes, payload: bytes) -> None:
+        try:
+            r = FlatReader(payload)
+            req_id = r.u64()
+            is_response = r.u8()
+            body = json.loads(r.bytes_())
+        except Exception as e:
+            note_swallowed("fleet.frame", e)
+            return
+        if is_response:
+            with self._cv:
+                if req_id in self._pending:
+                    self._pending[req_id] = body
+                    self._cv.notify_all()
+            return
+        try:
+            doc = self._serve(body.get("kind", ""), body.get("args") or {})
+        except Exception as e:  # a broken probe must not kill the dispatcher
+            note_swallowed("fleet.serve", e)
+            doc = {"error": str(e)}
+        w = FlatWriter()
+        w.u64(req_id)
+        w.u8(1)
+        w.bytes_(json.dumps(doc, default=str).encode())
+        self.node.front.send_message(ModuleID.FLEET_TELEMETRY, src, w.out())
+
+    def pull(
+        self, peer: bytes, kind: str, args: dict | None = None,
+        deadline: Deadline | None = None,
+    ) -> dict:
+        """One request/response round trip to ``peer``. A struck peer
+        (>= STRIKE_LIMIT consecutive failures) gets a quartered budget;
+        success clears its strikes."""
+        budget = self.timeout
+        if self._strikes.get(peer, 0) >= STRIKE_LIMIT:
+            budget *= STRUCK_BUDGET_FACTOR
+        if deadline is None:
+            deadline = Deadline.after(budget)
+        req_id = next(self._ids)
+        w = FlatWriter()
+        w.u64(req_id)
+        w.u8(0)
+        w.bytes_(json.dumps({"kind": kind, "args": args or {}}).encode())
+        with self._cv:
+            self._pending[req_id] = None
+        try:
+            self.node.front.send_message(ModuleID.FLEET_TELEMETRY, peer, w.out())
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._pending[req_id] is not None,
+                    deadline.clamp(budget),
+                )
+                doc = self._pending.pop(req_id)
+        except BaseException:
+            with self._cv:
+                self._pending.pop(req_id, None)
+            raise
+        if doc is None:
+            self._strikes[peer] = self._strikes.get(peer, 0) + 1
+            raise TimeoutError(
+                f"fleet pull {kind!r} from {peer.hex()[:8]} timed out "
+                f"(strikes={self._strikes[peer]})"
+            )
+        self._strikes.pop(peer, None)
+        return doc
+
+    def probe_offset(self, peer: bytes) -> tuple[float, float]:
+        """Clock-probe exchange: returns (offset, rtt) seconds where
+        offset = peer_monotonic - local_monotonic at the same instant
+        (midpoint correction). Cached per peer for the merge paths."""
+        clock = self.node.engine.roundlog.clock
+        t0 = clock()
+        doc = self.pull(peer, "probe")
+        t1 = clock()
+        offset = float(doc.get("t_peer", 0.0)) - (t0 + t1) / 2.0
+        self._offsets[peer] = (offset, t1 - t0)
+        return self._offsets[peer]
+
+    # -- fleet merge -------------------------------------------------------
+
+    def _peers(self) -> list:
+        """Committee peers (ConsensusNode rows), self excluded."""
+        return [
+            n for n in self.node.pbft_config.nodes
+            if n.node_id != self.node.node_id
+        ]
+
+    def _pull_peer_row(self, peer) -> tuple[str, dict]:
+        label = peer.node_id.hex()[:8]
+        try:
+            snap = self.pull(peer.node_id, "snapshot")["snapshot"]
+            snap["status"] = "ok"
+            return label, snap
+        except (TimeoutError, OSError, KeyError) as e:
+            # degraded, never missing: the merged document must show every
+            # committee member, including the one that cannot answer
+            return label, {
+                "node": label,
+                "node_id": peer.node_id.hex(),
+                "status": "unreachable",
+                "error": str(e),
+                "strikes": self._strikes.get(peer.node_id, 0),
+            }
+
+    def _peer_ledgers(self, args: dict) -> tuple[dict, dict]:
+        """Pull every reachable peer's round ledger (+ probe offsets);
+        returns (ledgers-by-label, offsets-by-label) with the local ledger
+        under its own label at offset 0."""
+        local_label = self.node.engine.crash_scope or self.node.node_id.hex()[:8]
+        ledgers = {
+            local_label: self.node.engine.roundlog.snapshot(
+                last=args.get("last"), height=args.get("height")
+            )
+        }
+        offsets = {local_label: 0.0}
+        peers = self._peers()
+        if not peers:
+            return ledgers, offsets
+        def one(peer):
+            label = peer.node_id.hex()[:8]
+            try:
+                offset, _rtt = (
+                    self._offsets.get(peer.node_id) or self.probe_offset(peer.node_id)
+                )
+                doc = self.pull(peer.node_id, "rounds", args)
+                return label, doc.get("ledger"), offset
+            except (TimeoutError, OSError) as e:
+                note_swallowed("fleet.rounds_pull", e)
+                return label, None, 0.0
+        with ThreadPoolExecutor(max_workers=min(8, len(peers))) as pool:
+            for label, ledger, offset in pool.map(one, peers):
+                if ledger is not None:
+                    ledgers[label] = ledger
+                    offsets[label] = offset
+        return ledgers, offsets
+
+    def fleet_doc(self) -> dict:
+        """The merged cluster document behind ``GET /fleet``: every
+        committee member's health/heights/evidence (or its degraded row),
+        fleet evidence totals, and round-skew percentiles over the last
+        aligned rounds."""
+        rows = {}
+        local = self.local_snapshot()
+        rows[local["node"]] = local
+        peers = self._peers()
+        if peers:
+            with ThreadPoolExecutor(max_workers=min(8, len(peers))) as pool:
+                for label, row in pool.map(self._pull_peer_row, peers):
+                    rows[label] = row
+        evidence_total: dict[str, int] = {}
+        for row in rows.values():
+            for k, v in row.get("evidence", {}).items():
+                evidence_total[k] = evidence_total.get(k, 0) + int(v)
+        ledgers, offsets = self._peer_ledgers({"last": 32})
+        rounds = rounds_doc(ledgers, offsets, last=32, record_skew=True)
+        reachable = sum(1 for r in rows.values() if r.get("status") == "ok")
+        return {
+            "enabled": True,
+            "generated_by": local["node"],
+            "committee_size": self.node.pbft_config.committee_size,
+            "quorum": self.node.pbft_config.quorum,
+            "reachable": reachable,
+            "nodes": rows,
+            "heights": {
+                label: {
+                    "durable": r.get("height_durable"),
+                    "optimistic": r.get("height_optimistic"),
+                }
+                for label, r in rows.items()
+            },
+            "evidence_total": evidence_total,
+            "round_skew_ms": rounds["skew_ms"],
+            "view_changes": rounds["view_changes"],
+        }
+
+    def round_forensics(self, height: int) -> dict:
+        """The ``GET /round/<height>`` document: that height's rounds
+        aligned across every reachable peer, straggler named."""
+        ledgers, offsets = self._peer_ledgers({"height": height})
+        return round_doc(ledgers, offsets, height=height)
+
+    def rounds_forensics(self, last: int = 32) -> dict:
+        """The ``GET /rounds?last=N`` document."""
+        ledgers, offsets = self._peer_ledgers({"last": last})
+        return rounds_doc(ledgers, offsets, last=last)
+
+
+DISABLED_DOC = {"enabled": False, "reason": "FISCO_FLEET_OBS=0"}
+
+
+def build_fleet(node) -> FleetService | None:
+    """Construct the node's federation endpoint — or nothing at all when
+    the observatory is switched off (no module registration, no state)."""
+    if not fleet_obs_enabled():
+        return None
+    return FleetService(node)

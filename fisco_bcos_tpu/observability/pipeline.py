@@ -120,9 +120,19 @@ class StageStats:
     """One stage's accumulators + aggregate state machine. Every field is
     mutated under the owning recorder's lock; snapshots copy under it."""
 
-    def __init__(self, name: str, now: float, timeline_cap: int = TIMELINE_CAP):
+    def __init__(
+        self,
+        name: str,
+        now: float,
+        timeline_cap: int = TIMELINE_CAP,
+        flight=None,
+    ):
         self.name = name
         self.created = now
+        # black box feed (ISSUE 16): aggregate transitions double as flight
+        # events; None for harness/test recorders so only the process
+        # recorder writes the process ring
+        self._flight = flight
         self.busy_ms = 0.0
         self.blocked_ms: dict[str, float] = {}  # on -> thread-ms
         self.intervals = 0  # completed busy intervals
@@ -156,6 +166,8 @@ class StageStats:
         if (state, on) != (self.state, self.state_on):
             self.state, self.state_on = state, on
             self.timeline.append((now, state, on))
+            if self._flight is not None:
+                self._flight.record("stage", state, stage=self.name, on=on)
 
     def _close_sticky_locked(self, now: float) -> None:
         if self._sticky is None:
@@ -229,6 +241,18 @@ class PipelineRecorder:
         self._marks: dict[str, deque] = {}  # name -> deque[(t, value)]
         self._sampler: threading.Thread | None = None
         self._sampler_stop = threading.Event()
+        # flight feed only from the process recorder (emit_metrics is the
+        # existing "I am the real one" discriminator); lazy import keeps
+        # roundlog->flight->(this module unused) cycles impossible
+        self._flight = None
+        if emit_metrics:
+            try:
+                from .flight import FLIGHT
+
+                if FLIGHT.enabled:
+                    self._flight = FLIGHT
+            except ImportError:  # pragma: no cover - partial-import window
+                pass
         self.t0 = self.clock()
 
     # -- internals -----------------------------------------------------------
@@ -242,7 +266,9 @@ class PipelineRecorder:
     def _stage_locked(self, name: str, now: float) -> StageStats:
         st = self._stages.get(name)
         if st is None:
-            st = self._stages[name] = StageStats(name, now, self.timeline_cap)
+            st = self._stages[name] = StageStats(
+                name, now, self.timeline_cap, flight=self._flight
+            )
             if self.emit_metrics:
                 self._register_gauge(name)
         return st

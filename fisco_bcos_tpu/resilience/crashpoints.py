@@ -171,6 +171,20 @@ class CrashPlan:
             help="injected process deaths by crash point",
         )
         _log.error("crash point %s fired at scope %r — node dies here", name, scope)
+        # black box (ISSUE 16): the firing is the death certificate — record
+        # it and flush BEFORE raising, while this "process" still runs; the
+        # dying node's last events must not depend on anyone catching the
+        # crash. Lazy import: resilience must stay importable without the
+        # observability layer mid-boot.
+        try:
+            from ..observability.flight import FLIGHT
+
+            FLIGHT.record("crash", "fired", scope=scope, point=name)
+            FLIGHT.flush(scope or "node", f"crash:{name}")
+        except Exception as e:
+            from ..utils.log import note_swallowed
+
+            note_swallowed("crashpoints.flight", e)
         raise InjectedCrash(f"injected crash at {name} (scope {scope!r})")
 
 
@@ -184,6 +198,19 @@ def install_crash_plan(plan: CrashPlan | None) -> None:
     """Explicit arming (tests / harnesses). ``None`` clears."""
     global _PLAN
     _PLAN = plan
+    if plan is not None:
+        try:
+            from ..observability.flight import FLIGHT
+
+            for r in plan._rules:
+                FLIGHT.record(
+                    "crash", "armed", point=r.name, scope_filter=r.scope,
+                    after=r.after, count=r.count,
+                )
+        except Exception as e:
+            from ..utils.log import note_swallowed
+
+            note_swallowed("crashpoints.arm_flight", e)
 
 
 def clear_crash_plan() -> None:
@@ -213,5 +240,5 @@ def ensure_env_crash_plan() -> None:
     _env_checked = True
     spec = os.environ.get("FISCO_CRASH_PLAN")
     if spec:
-        _PLAN = CrashPlan.from_spec(spec)
+        install_crash_plan(CrashPlan.from_spec(spec))
         _log.warning("crash plan active from FISCO_CRASH_PLAN: %s", spec)

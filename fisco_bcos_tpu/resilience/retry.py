@@ -167,6 +167,10 @@ IDEMPOTENT_METHODS: set[str] = {
     # registry / telemetry / health
     "register", "heartbeat", "metrics", "trace", "trace_tx", "trace_spans",
     "health", "pipeline", "profile", "device",
+    # fleet observatory (ISSUE 16): pure reads — the facade's merged
+    # cluster/round docs and the 4007 peer telemetry pull (a re-pulled
+    # snapshot/ledger/probe only re-reads the peer's in-memory state)
+    "fleet", "round", "rounds", "fleet_pull",
     # key center (pure transforms of the payload under the master key)
     "encDataKey", "decDataKey",
     # gateway read/connect surface (re-connecting to a live peer is a no-op)

@@ -54,6 +54,9 @@ class RpcHttpServer:
         pipeline=None,
         profile=None,
         device=None,
+        fleet=None,
+        round_doc=None,
+        rounds=None,
     ):
         self.impl = impl
         # `metrics` needs .render() -> str; `tracer` needs .export_json() ->
@@ -75,6 +78,13 @@ class RpcHttpServer:
         self.pipeline = pipeline or getattr(tracer, "pipeline", None)
         self.profile = profile or getattr(tracer, "profile", None)
         self.device = device or getattr(tracer, "device", None)
+        # fleet observatory (ISSUE 16): `fleet` (() -> dict) merges every
+        # peer's telemetry into one cluster doc at GET /fleet; `round_doc`
+        # (height -> dict) serves per-round forensics at GET /round/<h>;
+        # `rounds` (last -> dict) the recent-rounds sweep at GET /rounds
+        self.fleet = fleet or getattr(tracer, "fleet", None)
+        self.round_doc = round_doc or getattr(tracer, "round_doc", None)
+        self.rounds = rounds or getattr(tracer, "rounds", None)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -182,6 +192,51 @@ class RpcHttpServer:
                     ctype = "application/json"
                     if doc.get("error"):
                         code = 503
+                elif (
+                    self.path.split("?", 1)[0] == "/fleet"
+                    and outer.fleet is not None
+                ):
+                    # federated cluster document (ISSUE 16): this node pulls
+                    # every committee peer's snapshot + round ledger over
+                    # the gateway mesh and merges them — unreachable peers
+                    # appear as degraded rows, never vanish
+                    data = json.dumps(outer.fleet(), default=str).encode()
+                    ctype = "application/json"
+                elif (
+                    self.path.startswith("/round/")
+                    and outer.round_doc is not None
+                ):
+                    # cross-node forensics for one consensus height: aligned
+                    # phase spans, per-signer vote arrivals, straggler
+                    try:
+                        height = int(
+                            self.path.split("?", 1)[0].rsplit("/", 1)[1]
+                        )
+                    except ValueError:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    doc = outer.round_doc(height)
+                    data = json.dumps(doc, default=str).encode()
+                    ctype = "application/json"
+                    if not doc.get("found"):
+                        code = 404
+                elif (
+                    self.path.split("?", 1)[0] == "/rounds"
+                    and outer.rounds is not None
+                ):
+                    # recent rounds with skew percentiles; ?last=N bounds it
+                    from urllib.parse import parse_qs, urlsplit
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        last = int((qs.get("last") or ["32"])[0])
+                    except ValueError:
+                        last = 32
+                    data = json.dumps(
+                        outer.rounds(last), default=str
+                    ).encode()
+                    ctype = "application/json"
                 elif self.path == "/health" and outer.health is not None:
                     # degraded-mode registry (resilience.HEALTH or the
                     # split-mode RemoteTelemetry proxy). 503 ONLY on

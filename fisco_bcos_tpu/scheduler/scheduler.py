@@ -24,6 +24,7 @@ from ..crypto.suite import CryptoSuite
 from ..executor.executor import TransactionExecutor
 from ..ledger import Ledger
 from ..observability import TRACER
+from ..observability.flight import FLIGHT
 from ..observability.pipeline import PIPELINE
 from ..protocol.block import Block
 from ..protocol.block_header import BlockHeader
@@ -524,6 +525,9 @@ class Scheduler:
                 params = TwoPCParams(number=number)
                 # the 2PC legs as spans: on a remote executor/storage split
                 # these parent the service-side svc.*.prepare/commit spans
+                FLIGHT.record(
+                    "2pc", "prepare", scope=self.crash_scope, height=number
+                )
                 with TRACER.span(
                     "scheduler.2pc_prepare", block=number
                 ), PIPELINE.blocked("2pc_prepare"):
@@ -533,11 +537,17 @@ class Scheduler:
                 # has not run — a reboot finds the prepared-but-unresolved
                 # slot and must re-drive or roll it back (Node's boot scan)
                 crashpoint("scheduler.mid_2pc", self.crash_scope)
+                FLIGHT.record(
+                    "2pc", "commit", scope=self.crash_scope, height=number
+                )
                 with TRACER.span(
                     "scheduler.2pc_commit", block=number
                 ), PIPELINE.blocked("2pc_commit"):
                     self.executor.commit(params)
                 timer.stage("commit")
+                FLIGHT.record(
+                    "2pc", "booked", scope=self.crash_scope, height=number
+                )
             except BaseException:
                 # failed commit: clear the marker so recovery can re-drive
                 with self._lock:

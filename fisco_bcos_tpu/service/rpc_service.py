@@ -31,7 +31,7 @@ class RpcFacade:
 
     def __init__(
         self, impl, host: str = "127.0.0.1", port: int = 0, metrics=None,
-        tracer=None, health=None,
+        tracer=None, health=None, fleet=None,
     ):
         self.impl = impl
         self.metrics = metrics
@@ -39,6 +39,9 @@ class RpcFacade:
         # degraded-mode registry (resilience.HEALTH shape: .to_json());
         # served to the RPC process for GET /health
         self.health = health
+        # fleet observatory (ISSUE 16): the node core owns the FleetService
+        # (mesh access + round ledger); the RPC process only forwards
+        self.fleet = fleet
         self.server = ServiceServer("rpc-facade", host, port)
         self.server.register("handle", self._handle)
         self.server.register("metrics", self._metrics)
@@ -51,6 +54,11 @@ class RpcFacade:
         # sys._current_frames() — under the dispatch lock one /profile
         # would stall every JSON-RPC call on the split
         self.server.register("profile", self._profile, concurrent=True)
+        # concurrent: a fleet merge waits out per-peer deadlines against
+        # dead peers (seconds) — it must never serialize JSON-RPC traffic
+        self.server.register("fleet", self._fleet, concurrent=True)
+        self.server.register("round", self._round, concurrent=True)
+        self.server.register("rounds", self._rounds, concurrent=True)
         self.host, self.port = self.server.host, self.server.port
 
     def start(self) -> None:
@@ -125,6 +133,37 @@ class RpcFacade:
             seconds = 2.0
         return json.dumps(
             profiler.profile(min(seconds, 8.0)), default=str
+        ).encode()
+
+    def _fleet(self, _payload: bytes) -> bytes:
+        """The merged cluster document — the split deployment's GET /fleet
+        source: the node core holds the mesh connection to every peer."""
+        if self.fleet is None:
+            from ..observability.fleet import DISABLED_DOC
+
+            return json.dumps(DISABLED_DOC).encode()
+        return json.dumps(self.fleet.fleet_doc(), default=str).encode()
+
+    def _round(self, payload: bytes) -> bytes:
+        if self.fleet is None:
+            return b'{"found": false, "reason": "FISCO_FLEET_OBS=0"}'
+        try:
+            height = int(payload.decode() or "0")
+        except ValueError:
+            height = 0
+        return json.dumps(
+            self.fleet.round_forensics(height), default=str
+        ).encode()
+
+    def _rounds(self, payload: bytes) -> bytes:
+        if self.fleet is None:
+            return b'{"rounds": [], "reason": "FISCO_FLEET_OBS=0"}'
+        try:
+            last = int(payload.decode() or "32")
+        except ValueError:
+            last = 32
+        return json.dumps(
+            self.fleet.rounds_forensics(last), default=str
         ).encode()
 
 
@@ -256,6 +295,37 @@ class RemoteTelemetry:
             )
         except Exception as e:
             return {"error": f"facade unreachable: {e}"}
+
+    def fleet(self) -> dict:
+        """GET /fleet over the split: the node core runs the federation
+        pull; an unreachable core degrades to an explicit error doc."""
+        try:
+            return json.loads(self.client.call("fleet", b""))
+        except Exception as e:
+            return {
+                "enabled": False,
+                "error": f"facade unreachable: {e}",
+                "nodes": {},
+            }
+
+    def round_doc(self, height) -> dict:
+        """GET /round/<h> over the split — cross-node forensics for one
+        consensus height, assembled by the node core."""
+        try:
+            return json.loads(self.client.call("round", str(int(height)).encode()))
+        except Exception as e:
+            return {"found": False, "error": f"facade unreachable: {e}"}
+
+    def rounds(self, last=32) -> dict:
+        """GET /rounds over the split — recent rounds + skew percentiles."""
+        try:
+            last = int(last)
+        except (TypeError, ValueError):
+            last = 32
+        try:
+            return json.loads(self.client.call("rounds", str(last).encode()))
+        except Exception as e:
+            return {"rounds": [], "error": f"facade unreachable: {e}"}
 
     def to_json(self) -> str:
         """Health JSON for GET /health. An unreachable node core IS a

@@ -33,6 +33,13 @@ os.environ.setdefault("FISCO_TEST_BUCKET", "32")
 # still coalesce while the worker is busy, which is what the dedicated
 # plane tests pin with explicit windows.
 os.environ.setdefault("FISCO_DEVICE_WINDOW_MS", "0")
+# Flight-recorder dumps (observability/flight.py) land in FISCO_FLIGHT_DIR
+# (default cwd). Every Node.stop() across the suite flushes one — point
+# them at a per-session temp dir so test runs don't litter the repo.
+if "FISCO_FLIGHT_DIR" not in os.environ:
+    import tempfile as _tempfile
+
+    os.environ["FISCO_FLIGHT_DIR"] = _tempfile.mkdtemp(prefix="fisco-flight-")
 
 import pytest  # noqa: E402
 

@@ -35,6 +35,28 @@ SUITE = ecdsa_suite()
 CODEC = ABICodec(SUITE.hash)
 
 
+def _flight_doc(directory, node, point):
+    """The dead node's black box (ISSUE 16): the crash plan flushed
+    ``flight_<scope>.json`` BEFORE raising — it must exist and show the
+    armed point firing."""
+    import json
+
+    path = directory / f"flight_{node.engine.crash_scope}.json"
+    assert path.exists(), f"{point}: crash left no flight dump"
+    doc = json.loads(path.read_text())
+    # the whole-node halt (on_fatal) may re-flush after the crash point's
+    # own flush — either way the dump explains the death
+    assert doc["reason"] in (f"crash:{point}", "fatal_halt")
+    names = {(e["category"], e["name"]) for e in doc["events"]}
+    assert ("crash", "armed") in names and ("crash", "fired") in names
+    fired = [
+        e for e in doc["events"]
+        if e["category"] == "crash" and e["name"] == "fired"
+    ]
+    assert fired[-1]["detail"]["point"] == point
+    return doc
+
+
 @pytest.fixture(autouse=True)
 def _clean_plan():
     clear_crash_plan()
@@ -211,9 +233,11 @@ def _converge(nodes, deadline_rounds=30):
 
 
 @pytest.mark.parametrize("point", CRASH_POINTS)
-def test_restart_matrix(point, tmp_path):
+def test_restart_matrix(point, tmp_path, monkeypatch):
     """Every registered crash point: kill the scoped node there, reboot
-    from durable state, reconcile, auditor green, chain keeps moving."""
+    from durable state, reconcile, auditor green, chain keeps moving —
+    and the death leaves a flight dump explaining itself."""
+    monkeypatch.setenv("FISCO_FLIGHT_DIR", str(tmp_path))
     secret_base = 32_000 + 100 * CRASH_POINTS.index(point)
     keypairs = [
         SUITE.signature_impl.generate_keypair(secret=secret_base + i)
@@ -255,6 +279,7 @@ def test_restart_matrix(point, tmp_path):
         with pytest.raises(InjectedCrash):
             target.sealer._prebuild(crash_height, 100)
         assert plan.crashed
+        _flight_doc(tmp_path, target, point)
         assert target.txpool.unsealed_count() == 0  # stranded as sealed
         _kill(gateway, target)
         rebooted = _reboot(gateway, tmp_path, t_idx, keypairs, committee)
@@ -267,6 +292,7 @@ def test_restart_matrix(point, tmp_path):
         _flood_block(nodes, tag="crash", count=3)
         assert plan.crashed, f"{point} never fired"
         assert target.engine._crashed
+        _flight_doc(tmp_path, target, point)
         # the survivors committed the block the target died inside
         others = [n for i, n in enumerate(nodes) if i != t_idx]
         assert all(n.block_number() == crash_height for n in others)
@@ -306,12 +332,13 @@ def test_restart_matrix(point, tmp_path):
     _shutdown(nodes)
 
 
-def test_crash_on_block_sync_commit_path(tmp_path):
+def test_crash_on_block_sync_commit_path(tmp_path, monkeypatch):
     """The scheduler.mid_2pc seam is reachable through BlockSync's apply
     path too (a laggard re-driving a committed block): the crash must be
     absorbed at the SYNC transport boundary — the laggard halts wholesale
     (engine + sync), the peers' delivery never unwinds, and the committee
     keeps committing without it."""
+    monkeypatch.setenv("FISCO_FLIGHT_DIR", str(tmp_path))
     nodes, gateway = _chain(tmp_path, secret_base=35_000)
     _flood_block(nodes, tag="warm")
     assert all(n.block_number() == 1 for n in nodes)
@@ -341,6 +368,7 @@ def test_crash_on_block_sync_commit_path(tmp_path):
             n.block_sync.maintain()
     assert plan.crashed, "sync apply never hit the crash point"
     assert target.engine._crashed and target.block_sync._crashed
+    _flight_doc(tmp_path, target, "scheduler.mid_2pc")
     assert target.block_number() == 1  # the commit died mid-2PC
     # the peers' delivery loop was not unwound: they keep committing
     clear_crash_plan()

@@ -1,0 +1,583 @@
+"""Fleet observatory campaign (ISSUE 16): consensus round forensics,
+telemetry federation over the mesh, and the crash flight recorder.
+
+Deterministic halves of the observatory's contract:
+
+- the round ledger's state machine under an injected clock: first-wins
+  edges and votes, phase spans, cap eviction, height-addressed notes;
+- cross-node alignment: probe-offset correction, inter-node skew, and
+  straggler attribution (largest mean vote lateness behind first arrival);
+- federation over the in-proc mesh: one dead + one slow peer — the merged
+  document degrades rows, never drops them, and strikes quarter a dead
+  peer's budget;
+- the flight recorder's four death doors (InjectedCrash, Node.stop, the
+  fatal-halt path, SIGTERM) each leave a parseable black box;
+- ``FISCO_FLEET_OBS=0`` pins: noop ledger on the engine, no federation
+  endpoint, disabled recorder;
+- the Pro-split front door serves /fleet, /round/<h>, /rounds through the
+  facade (RemoteTelemetry), like /metrics and /health.
+"""
+
+import json
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from test_pipeline import make_chain  # noqa: E402
+
+from fisco_bcos_tpu.front import ModuleID  # noqa: E402
+from fisco_bcos_tpu.observability import flight as flight_mod  # noqa: E402
+from fisco_bcos_tpu.observability.flight import (  # noqa: E402
+    FLIGHT,
+    FlightRecorder,
+    install_signal_flush,
+    post_mortem,
+)
+from fisco_bcos_tpu.observability.roundlog import (  # noqa: E402
+    NOOP_LEDGER,
+    ROUND_PHASE_BUCKETS_MS,
+    ROUND_SKEW_BUCKETS_MS,
+    RoundLedger,
+    align_rounds,
+    fleet_obs_enabled,
+    phase_spans,
+    round_doc,
+    rounds_doc,
+)
+from fisco_bcos_tpu.resilience.crashpoints import (  # noqa: E402
+    CrashPlan,
+    InjectedCrash,
+    clear_crash_plan,
+    crashpoint,
+    install_crash_plan,
+)
+from fisco_bcos_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+
+class Ticker:
+    """Deterministic injected clock."""
+
+    def __init__(self, t=0.0, step=0.0):
+        self.t = t
+        self.step = step
+
+    def __call__(self):
+        self.t += self.step
+        return self.t
+
+
+def _ledger(**kw):
+    kw.setdefault("clock", Ticker())
+    kw.setdefault("emit_metrics", False)
+    return RoundLedger(node_tag=kw.pop("node_tag", "n0"), **kw)
+
+
+# -- the ledger state machine -------------------------------------------------
+
+
+def test_round_ledger_records_full_round():
+    led = _ledger()
+    led.note(5, 0, "pre_prepare", t=1.0)
+    for i, t in ((0, 1.001), (1, 1.002), (2, 1.010)):
+        led.vote(5, 0, "prepare", i, t=t)
+    led.note(5, 0, "prepared", t=1.011)
+    led.note(5, 0, "commit_sent", t=1.012)
+    for i, t in ((0, 1.013), (1, 1.014), (2, 1.020)):
+        led.vote(5, 0, "commit", i, t=t)
+    led.note(5, 0, "committed", t=1.021)
+    led.note(5, 0, "execute_start", t=1.022)
+    led.note(5, 0, "execute_end", t=1.030)
+    led.note(5, 0, "stable", t=1.040)
+    led.note(5, 0, "durable", t=1.050)
+    snap = led.snapshot()
+    assert snap["node"] == "n0"
+    (rd,) = snap["rounds"]
+    assert rd["height"] == 5 and rd["view"] == 0
+    assert set(rd["votes"]["prepare"]) == {"0", "1", "2"}
+    spans = phase_spans(rd)
+    assert spans["prepare"] == pytest.approx(11.0)  # pre_prepare -> prepared
+    assert spans["commit"] == pytest.approx(10.0)
+    assert spans["execute"] == pytest.approx(8.0)
+    assert spans["checkpoint"] == pytest.approx(19.0)
+    assert spans["durable"] == pytest.approx(10.0)
+
+
+def test_round_ledger_first_occurrence_wins():
+    """Re-delivered frames must not move an edge or rewrite a vote."""
+    led = _ledger()
+    led.note(5, 0, "pre_prepare", t=1.0)
+    led.note(5, 0, "pre_prepare", t=99.0)
+    led.vote(5, 0, "prepare", 0, t=2.0)
+    led.vote(5, 0, "prepare", 0, t=99.0)
+    (rd,) = led.snapshot()["rounds"]
+    assert rd["events"]["pre_prepare"] == 1.0
+    assert rd["votes"]["prepare"]["0"] == 2.0
+
+
+def test_round_ledger_cap_evicts_oldest():
+    led = _ledger(cap=2)
+    for h in (1, 2, 3):
+        led.note(h, 0, "pre_prepare", t=float(h))
+    heights = [r["height"] for r in led.snapshot()["rounds"]]
+    assert heights == [2, 3]
+
+
+def test_note_height_targets_newest_view():
+    """The async-commit durable callback knows the height, not the view —
+    it must land on the newest round at that height (the re-proposal)."""
+    led = _ledger()
+    led.note(5, 0, "pre_prepare", t=1.0)
+    led.note(5, 1, "pre_prepare", t=2.0)
+    led.note_height(5, "durable", t=3.0)
+    by_view = {r["view"]: r for r in led.snapshot()["rounds"]}
+    assert "durable" in by_view[1]["events"]
+    assert "durable" not in by_view[0]["events"]
+
+
+def test_snapshot_filters_last_and_height():
+    led = _ledger()
+    for h in (1, 2, 3, 4):
+        led.note(h, 0, "pre_prepare", t=float(h))
+    led.view_change(3, 0, 1, "timeout", t=3.5)
+    assert [r["height"] for r in led.snapshot(last=2)["rounds"]] == [3, 4]
+    assert [r["height"] for r in led.snapshot(height=2)["rounds"]] == [2]
+    (vc,) = led.snapshot()["view_changes"]
+    assert vc["cause"] == "timeout" and vc["from_view"] == 0
+
+
+def test_quorum_edges_emit_round_metrics():
+    led = RoundLedger(node_tag="m0", clock=Ticker(), emit_metrics=True)
+    led.note(7, 0, "pre_prepare", t=1.0)
+    led.vote(7, 0, "prepare", 0, t=1.001)
+    led.vote(7, 0, "prepare", 1, t=1.004)
+    led.note(7, 0, "prepared", t=1.005)
+    out = REGISTRY.render()
+    assert 'fisco_round_phase_ms_bucket{phase="prepare"' in out
+    assert 'fisco_vote_arrival_spread_ms_bucket{kind="prepare"' in out
+
+
+def test_bucket_constants_are_sane():
+    for buckets in (ROUND_PHASE_BUCKETS_MS, ROUND_SKEW_BUCKETS_MS):
+        assert list(buckets) == sorted(buckets)
+        assert len(set(buckets)) == len(buckets)
+
+
+# -- cross-node alignment -----------------------------------------------------
+
+
+def _snap(label, rounds, clock=100.0):
+    return {"node": label, "clock": clock, "rounds": rounds,
+            "view_changes": []}
+
+
+def test_align_rounds_offset_correction():
+    """Node B's monotonic clock runs 100 s ahead; with the probe offset the
+    skew collapses from ~100 s to the real 20 ms."""
+    a = {"height": 4, "view": 0,
+         "events": {"pre_prepare": 10.000, "prepared": 10.005,
+                    "stable": 10.030},
+         "votes": {}}
+    b = {"height": 4, "view": 0,
+         "events": {"pre_prepare": 110.000, "prepared": 110.004,
+                    "stable": 110.050},
+         "votes": {}}
+    ledgers = {"A": _snap("A", [a]), "B": _snap("B", [b])}
+    (doc,) = align_rounds(ledgers, offsets={"A": 0.0, "B": 100.0})
+    assert doc["skew_edge"] == "stable"
+    assert doc["skew_ms"] == pytest.approx(20.0, abs=1e-6)
+    (uncorrected,) = align_rounds(ledgers)
+    assert uncorrected["skew_ms"] > 50_000
+    # per-node spans are offset-independent (intra-node deltas)
+    assert doc["nodes"]["A"]["phases"]["prepare"] == pytest.approx(5.0)
+    assert doc["nodes"]["B"]["phases"]["prepare"] == pytest.approx(4.0)
+    assert doc["phases"]["prepare"] == {
+        "min_ms": pytest.approx(4.0), "max_ms": pytest.approx(5.0)
+    }
+
+
+def test_align_rounds_names_straggler():
+    """Signer 2's votes trail the first arrival at BOTH observers — it is
+    the straggler; offsets cancel because lateness is intra-node."""
+    def votes(base):
+        return {"prepare": {"0": base, "1": base + 0.001, "2": base + 0.040}}
+
+    a = {"height": 9, "view": 0, "events": {"stable": 2.0},
+         "votes": votes(1.0)}
+    b = {"height": 9, "view": 0, "events": {"stable": 502.0},
+         "votes": votes(501.0)}
+    (doc,) = align_rounds(
+        {"A": _snap("A", [a]), "B": _snap("B", [b])},
+        offsets={"A": 0.0, "B": 500.0},
+    )
+    assert doc["straggler"] == 2
+    assert doc["straggler_lateness_ms"] == pytest.approx(40.0)
+    assert doc["vote_lateness_ms"]["0"] == 0.0
+
+
+def test_round_doc_and_rounds_doc_shapes():
+    a = {"height": 3, "view": 0, "events": {"stable": 1.0}, "votes": {}}
+    ledgers = {"A": _snap("A", [a])}
+    doc = round_doc(ledgers, height=3)
+    assert doc["found"] and doc["rounds"][0]["height"] == 3
+    assert not round_doc(ledgers, height=99)["found"]
+    rr = rounds_doc(ledgers, last=5)
+    assert rr["nodes"] == ["A"] and rr["skew_ms"]["n"] == 0
+
+
+# -- federation over the in-proc mesh -----------------------------------------
+
+
+def _label(node):
+    return node.node_id.hex()[:8]
+
+
+def _inject_round(node, height, base):
+    led = node.engine.roundlog
+    led.note(height, 0, "pre_prepare", t=base)
+    for i in range(3):
+        led.vote(height, 0, "prepare", i, t=base + 0.001 * (i + 1))
+    led.note(height, 0, "prepared", t=base + 0.005)
+    led.note(height, 0, "stable", t=base + 0.010)
+
+
+def test_federation_merges_dead_and_slow_peers():
+    """GET /fleet with one dead and one slow replica: every committee
+    member appears (the dead one degraded), strikes accumulate on the dead
+    peer, and the aligned rounds still merge the reachable ledgers."""
+    nodes, _gw = make_chain(4)
+    try:
+        svc = nodes[0].fleet
+        assert svc is not None
+        svc.timeout = 0.25  # keep the dead peer's budget cheap
+        for n in nodes:
+            _inject_round(n, 1, base=10.0)
+        # dead replica: frames vanish into a black hole
+        dead = nodes[3]
+        dead.front.register_module(
+            ModuleID.FLEET_TELEMETRY, lambda src, payload: None
+        )
+        # slow replica: answers, but late (still inside the budget)
+        slow = nodes[2]
+        orig = slow.fleet._on_message
+
+        def slow_handler(src, payload):
+            time.sleep(0.05)
+            orig(src, payload)
+
+        slow.front.register_module(ModuleID.FLEET_TELEMETRY, slow_handler)
+
+        doc = svc.fleet_doc()
+        assert doc["enabled"] and doc["committee_size"] == 4
+        assert set(doc["nodes"]) == {_label(n) for n in nodes}
+        assert doc["nodes"][_label(dead)]["status"] == "unreachable"
+        assert doc["nodes"][_label(slow)]["status"] == "ok"
+        assert doc["reachable"] == 3
+        assert doc["heights"][_label(slow)]["durable"] == 0
+        # degraded, never missing: the dead peer still has a heights row
+        assert _label(dead) in doc["heights"]
+        # the reachable ledgers aligned: round 1 exists with 3+ observers
+        rd = svc.round_forensics(1)
+        assert rd["found"]
+        assert len(rd["rounds"][0]["nodes"]) >= 3
+        assert _label(dead) not in rd["rounds"][0]["nodes"]
+        # strikes: every failed pull counts; after STRIKE_LIMIT the budget
+        # quarters (pin the counter, the budget math is unit-level)
+        svc.fleet_doc()
+        assert svc._strikes.get(dead.node_id, 0) >= 3
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_probe_offset_corrects_shifted_clock():
+    """A peer whose monotonic clock sits 5 s ahead still aligns: the probe
+    measures the shift and the aligner subtracts it."""
+    nodes, _gw = make_chain(2)
+    try:
+        shifted = nodes[1].engine.roundlog
+        shifted.clock = lambda: time.perf_counter() + 5.0
+        now0 = time.perf_counter()
+        _inject_round(nodes[0], 1, base=now0)
+        _inject_round(nodes[1], 1, base=now0 + 5.0)  # same wall instant
+        svc = nodes[0].fleet
+        offset, rtt = svc.probe_offset(nodes[1].node_id)
+        assert offset == pytest.approx(5.0, abs=0.5)
+        assert rtt < 2.0
+        rd = svc.round_forensics(1)
+        (aligned,) = rd["rounds"]
+        assert aligned["skew_ms"] < 1000.0, aligned  # ~5000 uncorrected
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_idempotency_classification():
+    from fisco_bcos_tpu.resilience.retry import is_idempotent
+
+    for m in ("fleet", "round", "rounds", "fleet_pull"):
+        assert is_idempotent(m), m
+    assert not is_idempotent("handle")
+
+
+# -- the flight recorder's death doors ----------------------------------------
+
+
+def test_flight_flush_on_injected_crash(tmp_path, monkeypatch):
+    """Door 1: the crash plan flushes the ring BEFORE raising — the dying
+    node's black box shows the armed point firing."""
+    monkeypatch.setenv("FISCO_FLIGHT_DIR", str(tmp_path))
+    install_crash_plan(CrashPlan().arm("scheduler.mid_2pc", scope="aa11bb22"))
+    try:
+        with pytest.raises(InjectedCrash):
+            crashpoint("scheduler.mid_2pc", scope="aa11bb22")
+    finally:
+        clear_crash_plan()
+    doc = json.loads((tmp_path / "flight_aa11bb22.json").read_text())
+    assert doc["reason"] == "crash:scheduler.mid_2pc"
+    names = {(e["category"], e["name"]) for e in doc["events"]}
+    assert ("crash", "armed") in names and ("crash", "fired") in names
+    fired = [e for e in doc["events"]
+             if e["category"] == "crash" and e["name"] == "fired"]
+    assert fired[-1]["detail"]["point"] == "scheduler.mid_2pc"
+
+
+def test_flight_flush_on_stop_and_fatal_halt(tmp_path, monkeypatch):
+    """Doors 2+3: Node.stop and the whole-node fatal halt each flush, with
+    the round ledger embedded so one file explains the death."""
+    monkeypatch.setenv("FISCO_FLIGHT_DIR", str(tmp_path))
+    nodes, _gw = make_chain(1)
+    node = nodes[0]
+    _inject_round(node, 1, base=1.0)
+    scope = node.engine.crash_scope
+    node._halt_injected()
+    doc = json.loads((tmp_path / f"flight_{scope}.json").read_text())
+    assert doc["reason"] == "fatal_halt"
+    names = {(e["category"], e["name"]) for e in doc["events"]}
+    assert ("halt", "fatal_injected") in names
+    node.stop()
+    doc = json.loads((tmp_path / f"flight_{scope}.json").read_text())
+    assert doc["reason"] == "stop"
+    assert any(r["height"] == 1 for r in doc["rounds"]["rounds"])
+    pm = post_mortem(str(tmp_path))
+    assert pm["nodes"][scope]["reason"] == "stop"
+    assert any(e["category"] == "round" for e in pm["timeline"])
+
+
+def test_flight_flush_on_sigterm_chains_previous_handler(tmp_path):
+    """Door 4: SIGTERM flushes, then chains to the pre-existing handler —
+    an operator kill leaves a black box without losing its shutdown."""
+    hits = []
+    old = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        install_signal_flush(lambda: "sigt-node", directory=str(tmp_path))
+        signal.raise_signal(signal.SIGTERM)
+        assert hits == [signal.SIGTERM]
+        doc = json.loads((tmp_path / "flight_sigt-node.json").read_text())
+        assert doc["reason"] == "sigterm"
+        names = {(e["category"], e["name"]) for e in doc["events"]}
+        assert ("halt", "sigterm") in names
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        flight_mod._prev_sigterm = None
+
+
+def test_flight_ring_is_bounded_and_flush_is_atomic(tmp_path):
+    fr = FlightRecorder(cap=8, clock=Ticker(step=1.0),
+                        wallclock=Ticker(1000.0), enabled=True)
+    for i in range(50):
+        fr.record("t", f"e{i}")
+    events = fr.snapshot()
+    assert len(events) == 8
+    assert events[-1]["name"] == "e49"
+    path = fr.flush("ringtest", "test", directory=str(tmp_path))
+    assert path and not (tmp_path / "flight_ringtest.json.tmp").exists()
+    doc = json.loads((tmp_path / "flight_ringtest.json").read_text())
+    assert len(doc["events"]) == 8 and doc["node"] == "ringtest"
+
+
+def test_post_mortem_places_events_on_wall_clock(tmp_path):
+    """wall = wall_at_flush - (mono_at_flush - t): two nodes with wildly
+    different monotonic origins land on one comparable timeline."""
+    a = FlightRecorder(clock=Ticker(10.0, step=1.0),
+                       wallclock=lambda: 1000.0, enabled=True)
+    b = FlightRecorder(clock=Ticker(5000.0, step=1.0),
+                       wallclock=lambda: 1001.0, enabled=True)
+    a.record("t", "a-event")
+    b.record("t", "b-event")
+    a.flush("nodeA", "test", directory=str(tmp_path))
+    b.flush("nodeB", "test", directory=str(tmp_path))
+    pm = post_mortem(str(tmp_path))
+    by_node = {e["node"]: e["wall"] for e in pm["timeline"]}
+    # a: event t=11, anchor (12, 1000) -> wall 999; b: t=5001, (5002, 1001)
+    assert by_node["nodeA"] == pytest.approx(999.0)
+    assert by_node["nodeB"] == pytest.approx(1000.0)
+    assert [e["node"] for e in pm["timeline"]] == ["nodeA", "nodeB"]
+
+
+# -- FISCO_FLEET_OBS=0: the observatory vanishes ------------------------------
+
+
+def test_fleet_obs_off_is_noop(monkeypatch):
+    monkeypatch.setenv("FISCO_FLEET_OBS", "0")
+    assert not fleet_obs_enabled()
+    from fisco_bcos_tpu.observability.fleet import build_fleet
+
+    assert build_fleet(object()) is None
+    nodes, _gw = make_chain(1, secret_base=88_100)
+    try:
+        node = nodes[0]
+        assert node.fleet is None
+        assert node.engine.roundlog is NOOP_LEDGER
+        # every note is swallowed, nothing allocates
+        node.engine.roundlog.note(1, 0, "pre_prepare")
+        node.engine.roundlog.vote(1, 0, "prepare", 0)
+        assert node.engine.roundlog.snapshot()["rounds"] == []
+        fr = FlightRecorder()  # enabled=None reads the env
+        fr.record("t", "e")
+        assert fr.snapshot() == []
+        assert fr.flush("off", "test") is None
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_fleet_module_not_registered_when_off(monkeypatch):
+    monkeypatch.setenv("FISCO_FLEET_OBS", "0")
+    nodes, _gw = make_chain(1, secret_base=88_200)
+    try:
+        assert int(ModuleID.FLEET_TELEMETRY) not in nodes[0].front._dispatch
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+# -- endpoints: air node + Pro split ------------------------------------------
+
+
+def test_http_endpoints_direct():
+    from fisco_bcos_tpu.rpc.http_server import RpcHttpServer
+
+    srv = RpcHttpServer(
+        None, port=0,
+        fleet=lambda: {"enabled": True, "nodes": {"x": {}}},
+        round_doc=lambda h: {"found": h == 5, "height": h},
+        rounds=lambda last: {"rounds": [], "last": last},
+    )
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/fleet", timeout=10) as resp:
+            assert json.loads(resp.read())["enabled"] is True
+        with urllib.request.urlopen(f"{base}/round/5", timeout=10) as resp:
+            assert json.loads(resp.read())["height"] == 5
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/round/6", timeout=10)
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/round/nan", timeout=10)
+        assert ei.value.code == 404
+        with urllib.request.urlopen(f"{base}/rounds?last=7", timeout=10) as resp:
+            assert json.loads(resp.read())["last"] == 7
+    finally:
+        srv.stop()
+
+
+def test_fleet_endpoints_over_pro_split():
+    """The RPC front door serves /fleet, /round/<h> and /rounds by
+    forwarding to the node core's facade (RemoteTelemetry) — the node core
+    owns the mesh connection to every peer."""
+    from fisco_bcos_tpu.service.rpc_service import RpcFacade, RpcService
+
+    nodes, _gw = make_chain(1, secret_base=88_300)
+    facade = rpc = None
+    try:
+        node = nodes[0]
+        _inject_round(node, 2, base=4.0)
+        facade = RpcFacade(None, fleet=node.fleet)
+        facade.start()
+        rpc = RpcService(facade.host, facade.port)
+        rpc.start()
+        base = f"http://127.0.0.1:{rpc.port}"
+        with urllib.request.urlopen(f"{base}/fleet", timeout=15) as resp:
+            doc = json.loads(resp.read())
+        assert doc["enabled"] and doc["reachable"] == 1
+        assert doc["committee_size"] == 1
+        with urllib.request.urlopen(f"{base}/round/2", timeout=15) as resp:
+            rd = json.loads(resp.read())
+        assert rd["found"] and rd["rounds"][0]["height"] == 2
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/round/777", timeout=15)
+        assert ei.value.code == 404
+        with urllib.request.urlopen(f"{base}/rounds?last=4", timeout=15) as resp:
+            rr = json.loads(resp.read())
+        assert len(rr["rounds"]) == 1
+    finally:
+        if rpc is not None:
+            rpc.stop()
+        if facade is not None:
+            facade.stop()
+        for n in nodes:
+            n.stop()
+
+
+def test_fleet_split_degrades_without_fleet_service():
+    """A facade wired without a fleet service (FISCO_FLEET_OBS=0 topology)
+    still answers /fleet — with the explicit disabled document."""
+    from fisco_bcos_tpu.service.rpc_service import RpcFacade, RpcService
+
+    facade = RpcFacade(None)
+    facade.start()
+    rpc = RpcService(facade.host, facade.port)
+    rpc.start()
+    try:
+        base = f"http://127.0.0.1:{rpc.port}"
+        with urllib.request.urlopen(f"{base}/fleet", timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["enabled"] is False and "FISCO_FLEET_OBS" in doc["reason"]
+    finally:
+        rpc.stop()
+        facade.stop()
+
+
+# -- live rounds through the real engine --------------------------------------
+
+
+def test_engine_hooks_populate_ledger_on_live_chain():
+    """Drive one block through real PBFT and read the forensics: every
+    phase edge lands, every committee vote arrives, the fleet doc merges
+    all four nodes and names a straggler."""
+    from test_pbft import leader_of, submit_txs
+    from test_pipeline import drain_chain
+
+    nodes, _gw = make_chain(4, secret_base=88_400)
+    try:
+        leader = leader_of(nodes, 1)
+        submit_txs(leader, 2, start=100)
+        assert leader.sealer.seal_and_submit()
+        assert all(n.block_number() == 1 for n in nodes)
+        drain_chain(nodes)
+        svc = nodes[0].fleet
+        rd = svc.round_forensics(1)
+        assert rd["found"]
+        (aligned,) = [r for r in rd["rounds"] if r["view"] == 0]
+        assert len(aligned["nodes"]) == 4
+        assert "straggler" in aligned
+        for phases in (n["phases"] for n in aligned["nodes"].values()):
+            assert "prepare" in phases and "commit" in phases, phases
+        doc = svc.fleet_doc()
+        assert doc["reachable"] == 4
+        assert all(
+            h["durable"] == 1 for h in doc["heights"].values()
+        ), doc["heights"]
+        assert doc["round_skew_ms"]["n"] >= 1
+    finally:
+        for n in nodes:
+            n.stop()

@@ -7,8 +7,12 @@ per round: flood TPS plus the per-stage self-time vector aggregated across
 every sampled tx in the flood window (``stage_self_ms``). Since ISSUE 13
 it also writes ``bench_telemetry.flood.device.json``: the device
 observatory's per-op queue/compile/transfer/execute phase vector
-(``op_phase_ms``). This tool compares two artifacts of EITHER shape (OLD
-then NEW) and exits nonzero when:
+(``op_phase_ms``). Since ISSUE 16 it also writes
+``bench_telemetry.flood.rounds.json``: the fleet observatory's aligned
+consensus-round view — per-phase span p95 across every replica and round
+(``round_phase_ms``: prepare/commit/execute/checkpoint/durable) plus the
+quorum-edge skew percentiles (``skew_ms``). This tool compares two
+artifacts of ANY of these shapes (OLD then NEW) and exits nonzero when:
 
 - any stage's self time REGRESSED by >= --threshold (default 20%) — with
   an absolute floor (--min-ms, default 5 ms) so microsecond stages can't
@@ -16,6 +20,8 @@ then NEW) and exits nonzero when:
 - any device op's EXECUTE phase regressed by the same gates (the compile
   phase is excluded on purpose: cold-vs-warm cache variance is not a
   kernel regression — it shows separately as ``cold_compiles``); or
+- any consensus phase's round-span p95 regressed by the same gates, or
+  the fleet's quorum-edge skew p95 did; or
 - flood TPS dropped by >= --tps-threshold (default 20%).
 
 Improvements are reported, never fatal. Stages present in only one
@@ -42,11 +48,12 @@ def load_artifact(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
     if not any(
-        k in doc for k in ("stage_self_ms", "flood_tps", "op_phase_ms")
+        k in doc
+        for k in ("stage_self_ms", "flood_tps", "op_phase_ms", "round_phase_ms")
     ):
         raise ValueError(
             f"{path}: not a round artifact (expected stage_self_ms, "
-            "op_phase_ms and/or flood_tps keys)"
+            "op_phase_ms, round_phase_ms and/or flood_tps keys)"
         )
     return doc
 
@@ -104,6 +111,21 @@ def diff(
             op: ph.get("execute", 0.0)
             for op, ph in (new.get("op_phase_ms") or {}).items()
         },
+    )
+    # fleet-round artifacts: per-consensus-phase span p95 across every
+    # replica and aligned round, plus the quorum-edge skew p95 (ISSUE 16)
+    diff_series(
+        "round phase", "span p95",
+        old.get("round_phase_ms") or {}, new.get("round_phase_ms") or {},
+    )
+    diff_series(
+        "fleet", "skew p95",
+        {
+            "quorum_edge_skew": (old.get("skew_ms") or {}).get("p95", 0.0)
+        } if "round_phase_ms" in old else {},
+        {
+            "quorum_edge_skew": (new.get("skew_ms") or {}).get("p95", 0.0)
+        } if "round_phase_ms" in new else {},
     )
     o_tps, n_tps = old.get("flood_tps"), new.get("flood_tps")
     if o_tps and n_tps is not None:

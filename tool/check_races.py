@@ -7,9 +7,11 @@
 - the interleave explorer is bit-deterministic (same seed, same digest);
 - the injected fixture race is found within a bounded seed budget and
   shrunk to a stable minimal schedule digest;
-- the four REAL harnesses — DevicePlane coalescer, ProofPlane
-  singleflight, AdmissionQuotas, scheduler commit markers — survive a
-  seeded sweep (default 256 seeds each; ``--seeds N`` to rescale).
+- every registered REAL harness (``analysis/harnesses.py HARNESSES`` —
+  DevicePlane coalescer, ProofPlane singleflight, AdmissionQuotas,
+  scheduler commit markers, QC collector, pipeline observatory,
+  pipelined commit, fleet observatory) survives a seeded sweep
+  (default 256 seeds each; ``--seeds N`` to rescale).
 
 Usage::
 
@@ -104,7 +106,7 @@ def main() -> int:
         f"{small.digest} ({small.steps} steps)",
     )
 
-    # 5. the four real harnesses survive the seeded sweep
+    # 5. every registered real harness survives the seeded sweep
     for name, cls in HARNESSES.items():
         t0 = time.time()
         outs, bad = sweep(lambda c=cls: c(), range(args.seeds))
