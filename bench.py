@@ -725,6 +725,58 @@ def bench_scenario(name: str) -> None:
             flush=True,
         )
         group_docs = {}
+    elif name == "byzantine-wire":
+        from fisco_bcos_tpu.scenario import run_wire_bench
+
+        doc = run_wire_bench(seed=seed, scale=scale, deadline_s=deadline)
+        err = doc.get("error")
+        ratio = doc["liveness_ratio"]
+        # acceptance: same 0.5x liveness floor as the in-proc catalog, but
+        # measured over real TCP sockets (connect/flood/redial included)
+        _emit(
+            "scenario_byzantine_wire_liveness_ratio", ratio, "x-clean",
+            ratio / 0.5, error=err,
+        )
+        detected = sum(1 for r in doc.get("attacks", ()) if r["detected"])
+        _emit(
+            "scenario_byzantine_wire_attacks_detected", detected, "attack",
+            1.0 if doc["all_detected"] else 0.0,
+            error=err
+            or (None if doc["all_detected"] else "undetected or unrun attacks"),
+        )
+        # committee-wide demotion: every honest node confirmed the
+        # offender via gossiped evidence, within this many settle rounds
+        rounds = doc["convergence_rounds_max"]
+        _emit(
+            "scenario_byzantine_wire_convergence_rounds", rounds, "round",
+            1.0 if doc["gossip_converged"] else 0.0,
+            error=err
+            or (None if doc["gossip_converged"] else "gossip never converged"),
+        )
+        safe = (
+            doc.get("audit_clean", {}).get("ok", False)
+            and doc.get("audit_byzantine", {}).get("ok", False)
+            and doc["adversary_demoted"]
+        )
+        _emit(
+            "scenario_byzantine_wire_audit_ok", 1.0 if safe else 0.0, "bool",
+            1.0 if safe else 0.0,
+            error=err
+            or (
+                None
+                if safe
+                else "chain-safety audit violations or adversary not demoted"
+            ),
+        )
+        print(
+            f"# byzantine-wire: clean {doc['clean_tps']} tx/s vs attacked "
+            f"{doc['byzantine_tps']} tx/s (liveness {ratio}x), "
+            f"{detected} attacks detected, gossip converged="
+            f"{doc['gossip_converged']} (rounds<={rounds}), "
+            f"demoted={doc['adversary_demoted']}, audit ok={safe}",
+            flush=True,
+        )
+        group_docs = {}
     elif name == "proof-storm":
         doc = run_proof_storm_bench(seed=seed, scale=scale, deadline_s=deadline)
         err = doc.get("error")
@@ -1309,6 +1361,7 @@ def _main_scenario(name: str) -> None:
 
     if name not in SCENARIOS and name not in (
         "isolation", "proof-storm", "big-committee", "byzantine",
+        "byzantine-wire",
     ):
         known = ", ".join(sorted(SCENARIOS))
         print(f"# unknown scenario '{name}' (known: {known})", flush=True)

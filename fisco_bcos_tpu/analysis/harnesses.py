@@ -867,11 +867,210 @@ class FleetObsHarness:
         shutil.rmtree(ctx["dir"], ignore_errors=True)
 
 
+# -- PBFT engine: off-lock QC admission (torn quorum) --------------------------
+
+
+class _TornStubSig:
+    """Deterministic outer-signature impl (the packet signature): pure
+    string check, no crypto — the contention under test is the engine's
+    verify queue, not the algebra."""
+
+    @staticmethod
+    def sign(kp, msg):
+        return b"wire:" + kp.pub[:8] + msg[:8]
+
+    @staticmethod
+    def verify(pub, msg, sig):
+        return sig == b"wire:" + pub[:8] + msg[:8]
+
+
+class _TornStubSuite:
+    name = "stub"
+    signature_impl = _TornStubSig()
+
+    @staticmethod
+    def hash(data: bytes) -> bytes:
+        return hashlib.sha256(data).digest()
+
+
+class _TornKP:
+    def __init__(self, pub: bytes, secret: int = 0):
+        self.pub = pub
+        self.secret = secret
+
+
+class _TornQCScheme(_StubQCScheme):
+    """The collector stub plus ``sign_vote`` (the engine signs its own
+    votes through the scheme) and the registered ed25519 pub length so
+    ``qc_ready()`` sees a fully-registered committee."""
+
+    pub_len = 32
+
+    def sign_vote(self, kp, msg32: bytes) -> bytes:
+        return self._expect(kp.pub, msg32)
+
+
+class TornQuorumHarness:
+    """Concurrent PREPARE deliveries race the engine's OFF-LOCK aggregate
+    QC admission (snapshot under the lock -> verify without it -> re-check
+    the gate before completing) while a duplicate pre-prepare contends on
+    the engine lock. A torn quorum — two completions, a completion against
+    a stale snapshot, or a lost/duplicated verify job — is the bug class
+    the double-gate re-check must exclude under EVERY interleaving."""
+
+    name = "torn-quorum"
+
+    def __init__(self):
+        from ..consensus.engine import PBFTEngine, ProposalCache
+
+        self.watch = [
+            (PBFTEngine, ("_verify_jobs", "_verify_keys", "view")),
+            (ProposalCache, ("prepared", "prepare_qc", "committed")),
+        ]
+
+    def setup(self):
+        from ..consensus.audit import EVIDENCE
+        from ..consensus.config import PBFTConfig
+        from ..consensus.engine import PBFTEngine
+        from ..consensus.messages import PacketType, PBFTMessage
+        from ..consensus.qc import QuorumCollector, vote_preimage
+        from ..front.front import FrontService
+        from ..ledger.ledger import ConsensusNode
+        from ..protocol.block import Block
+        from ..protocol.block_header import BlockHeader
+        from ..scheduler.scheduler import SchedulerError
+        from ..txpool.quota import get_quotas
+
+        get_quotas().reset()  # strikes from prior seeds must not leak in
+        EVIDENCE.reset()
+        suite = _TornStubSuite()
+        scheme = _TornQCScheme()
+        kps = [_TornKP(b"np_%d_" % i * 8, secret=i) for i in range(4)]
+        qc_pubs = [bytes([0xA0 + i]) * 32 for i in range(4)]
+        committee = [
+            ConsensusNode(kp.pub, weight=1, qc_pub=qc_pubs[i])
+            for i, kp in enumerate(kps)
+        ]
+        config = PBFTConfig(suite=suite, keypair=kps[0], nodes=committee)
+        # pre-seed the QC keypair memo: the real derivation hashes the
+        # consensus secret through the registered scheme — stubbed here
+        config._qc_kp_cache = ("ed25519", _TornKP(qc_pubs[0]))
+
+        class _Ledger:
+            @staticmethod
+            def block_number():
+                return 0
+
+            @staticmethod
+            def block_hash_by_number(_n):
+                return b"\x11" * 32
+
+        class _Scheduler:
+            @staticmethod
+            def execute_block(_block, lazy_roots=False):
+                from ..utils.error import ErrorCode
+
+                raise SchedulerError(
+                    ErrorCode.SCHEDULER_INVALID_BLOCK,
+                    "stub: no execution in the harness",
+                )
+
+        class _TxPool:
+            @staticmethod
+            def mark_sealed(_hashes):
+                pass
+
+        eng = PBFTEngine(
+            config, _Scheduler(), _TxPool(), _Ledger(), FrontService(kps[0].pub)
+        )
+        eng.qc = QuorumCollector(suite=None, scheme=scheme)
+        eng.qc.strike_tagger = eng._qc_strike_tag
+
+        completions = []
+        real_complete = eng._complete_prepared
+
+        def counting_complete(number, cache, agreeing, cert):
+            completions.append(number)
+            real_complete(number, cache, agreeing, cert)
+
+        eng._complete_prepared = counting_complete
+
+        # leader of (number=1, view=0) is index 1; this engine is index 0
+        block = Block(header=BlockHeader(number=1))
+        h = block.header.hash(suite)
+        pp = PBFTMessage(
+            packet_type=PacketType.PRE_PREPARE,
+            view=0,
+            number=1,
+            proposal_hash=h,
+            proposal_data=block.encode(),
+        )
+        pp.generated_from = 1
+        pp.sign(suite, kps[1])
+
+        def prepare_from(i):
+            m = PBFTMessage(
+                packet_type=PacketType.PREPARE, view=0, number=1,
+                proposal_hash=h,
+            )
+            m.generated_from = i
+            m.sign(suite, kps[i])
+            m.qc_sig = scheme._expect(
+                qc_pubs[i], vote_preimage(suite, PacketType.PREPARE, 0, 1, h)
+            )
+            return m
+
+        # accept the proposal (our own PREPARE joins the cache) and bank
+        # the leader's vote: 2 of quorum-3 in hand, the crossing vote
+        # arrives on the contending threads
+        eng.handle_message(pp)
+        eng.handle_message(prepare_from(1))
+        return {
+            "eng": eng, "pp": pp, "completions": completions,
+            "prepares": [prepare_from(2), prepare_from(3)],
+        }
+
+    def threads(self, ctx):
+        eng = ctx["eng"]
+        p2, p3 = ctx["prepares"]
+
+        def deliver(m):
+            def run():
+                eng.handle_message(m)
+
+            return run
+
+        return [
+            ("v2", deliver(p2)),
+            ("v3", deliver(p3)),
+            ("pp-dup", deliver(ctx["pp"])),
+        ]
+
+    def check(self, ctx):
+        from ..consensus.audit import EVIDENCE
+
+        eng = ctx["eng"]
+        cache = eng._caches.get(1)
+        assert cache is not None, "proposal cache vanished"
+        assert ctx["completions"] == [1], (
+            f"torn quorum: completions={ctx['completions']}"
+        )
+        assert cache.prepared, "quorum never admitted"
+        assert cache.prepare_qc is not None, "no certificate sealed"
+        assert len(cache.prepare_qc.signers()) >= 3, cache.prepare_qc.signers()
+        assert 0 in cache.commits, "own COMMIT vote lost"
+        assert not cache.committed, "committed on 1 commit vote"
+        assert not eng._verify_jobs and not eng._verify_keys, (
+            f"verify queue leaked: {list(eng._verify_jobs)}"
+        )
+        assert EVIDENCE.count() == 0, EVIDENCE.counts()
+
+
 HARNESSES = {
     h.name: h
     for h in (DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
               SchedulerHarness, PipelinedCommitHarness, PipelineObsHarness,
-              QuorumCollectorHarness, FleetObsHarness)
+              QuorumCollectorHarness, FleetObsHarness, TornQuorumHarness)
 }
 
 FIXTURE_HARNESSES = {RacyCounterHarness.name: RacyCounterHarness}

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..front.front import FrontService, ModuleID
@@ -173,6 +174,21 @@ class PBFTEngine:
         # mistyped FISCO_QC_SCHEME crash a node whose operator disabled
         # the subsystem outright with FISCO_QC=0
         self.qc: QuorumCollector | None = None
+        # off-lock quorum admission (the pre-prepare double-gate pattern
+        # applied to votes): quorate phases enqueue a verify job here; the
+        # OUTERMOST dispatch frame on each thread drains the queue AFTER
+        # releasing the engine lock, runs the aggregate check lock-free,
+        # then re-acquires and re-checks the gate before admitting. A slow
+        # pairing (or a slow wire delaying vote batches) therefore never
+        # parks handle_message.
+        self._verify_mu = threading.Lock()
+        self._verify_jobs: deque[tuple[str, int]] = deque()
+        self._verify_keys: set[tuple[str, int]] = set()
+        self._dispatch_tls = threading.local()
+        # committee-wide evidence propagation (consensus/gossip.py): Node
+        # wires an EvidenceGossip here; detection sites offer their
+        # offending frames so EVERY honest node can re-verify and strike
+        self.gossip = None
         front.register_module(ModuleID.PBFT, self._on_front_message)
 
     def _qc_active(self) -> bool:
@@ -202,6 +218,182 @@ class PBFTEngine:
                 if node.qc_pub == qc_pub:
                     return validator_source(node.node_id)
         return ""
+
+    # -------------------------------------------------- off-lock QC admission
+
+    def _enter_dispatch(self) -> None:
+        tls = self._dispatch_tls
+        tls.depth = getattr(tls, "depth", 0) + 1
+
+    def _exit_dispatch(self) -> None:
+        tls = self._dispatch_tls
+        tls.depth -= 1
+        if tls.depth == 0:
+            self._drive_verify_jobs()
+
+    def _enqueue_verify(self, kind: str, number: int) -> None:
+        """Queue one aggregate-verification job (deduped per phase+height).
+        Jobs carry only (kind, number): every other input is re-derived
+        from live state when the job runs, so a stale job is harmless."""
+        key = (kind, number)
+        with self._verify_mu:
+            if key not in self._verify_keys:
+                self._verify_keys.add(key)
+                self._verify_jobs.append(key)
+
+    def _drive_verify_jobs(self) -> None:
+        """Drain pending verify jobs. Called at every dispatch exit once
+        the engine lock is released; nested dispatch frames (the in-proc
+        gateway delivers broadcasts synchronously under the sender's
+        lock) defer to the outermost frame on their thread, so the slow
+        aggregate check genuinely runs off-lock."""
+        if self._crashed or not self._verify_jobs:
+            return
+        tls = self._dispatch_tls
+        if getattr(tls, "driving", False):
+            return  # re-entered from a completion's broadcast: outer loop drains
+        tls.driving = True
+        try:
+            while True:
+                with self._verify_mu:
+                    if not self._verify_jobs:
+                        return
+                    kind, number = self._verify_jobs.popleft()
+                    self._verify_keys.discard((kind, number))
+                try:
+                    self._run_verify_job(kind, number)
+                except InjectedCrash:
+                    # completion paths carry crash points; absorb here —
+                    # the transport boundary already returned, and a
+                    # crash must never unwind a peer's delivery
+                    self._crashed = True
+                    _log.error(
+                        "injected crash in %s verify job at %d — node "
+                        "halted (reboot to recover)", kind, number,
+                    )
+                    return
+        finally:
+            tls.driving = False
+
+    _VERIFY_PACKETS = {
+        "prepare": PacketType.PREPARE,
+        "commit": PacketType.COMMIT,
+        "checkpoint": PacketType.CHECKPOINT,
+    }
+
+    def _verify_snapshot(
+        self, kind: str, number: int
+    ) -> "tuple[PacketType, int, bytes, dict[int, PBFTMessage]] | None":
+        """Gate + input snapshot for one verify job, under the engine
+        lock. None when the gate closed (phase already admitted, cache
+        pruned, view moved, quorum no longer agrees) — the job dies."""
+        cache = self._caches.get(number)
+        if cache is None:
+            return None
+        if kind == "prepare":
+            if cache.prepared or cache.pre_prepare is None:
+                return None
+            agreeing = self._agreeing(
+                cache.prepares, cache.pre_prepare.proposal_hash
+            )
+            view, msg32 = self.view, cache.pre_prepare.proposal_hash
+        elif kind == "commit":
+            if cache.committed or not cache.prepared or cache.pre_prepare is None:
+                return None
+            agreeing = self._agreeing(
+                cache.commits, cache.pre_prepare.proposal_hash
+            )
+            view, msg32 = self.view, cache.pre_prepare.proposal_hash
+        else:  # checkpoint
+            if cache.stable or cache.executed_header is None:
+                return None
+            msg32 = cache.executed_header.hash(self.suite)
+            agreeing = {
+                i: m
+                for i, m in cache.checkpoints.items()
+                if m.proposal_hash == msg32
+                and self.config.node_at(i) is not None
+            }
+            view = 0  # checkpoint preimage is the header hash — viewless
+        if self._weight(agreeing) < self.config.quorum:
+            return None
+        return self._VERIFY_PACKETS[kind], view, msg32, dict(agreeing)
+
+    def _run_verify_job(self, kind: str, number: int) -> None:
+        """One off-lock admission: snapshot under the lock, verify the
+        aggregate WITHOUT the lock, then re-acquire and re-run the gate
+        before mutating any consensus state (the pre-prepare handler's
+        double-gate re-check pattern)."""
+        with self._lock:
+            snap = self._verify_snapshot(kind, number)
+        if snap is None:
+            return
+        packet_type, view, msg32, agreeing = snap
+        # the expensive pairing/aggregate check — engine lock NOT held
+        ok, cert, bad = self._verify_quorum_offlock(
+            packet_type, number, view, msg32, agreeing
+        )
+        with self._lock:
+            cache = self._caches.get(number)
+            if cache is None:
+                return
+            votes = {
+                "prepare": cache.prepares,
+                "commit": cache.commits,
+                "checkpoint": cache.checkpoints,
+            }[kind]
+            for i in bad:
+                m = votes.get(i)
+                if m is not None and m is agreeing.get(i):
+                    # prune exactly the frame we judged — a fresh
+                    # (re-sent) vote that arrived mid-verify survives
+                    votes.pop(i, None)
+                    self._offer_bad_vote_evidence(m)
+            recheck = self._verify_snapshot(kind, number)
+            if recheck is None:
+                return
+            if recheck[1] != view or recheck[2] != msg32:
+                # the world moved under the verification (view change /
+                # re-execution): what we verified is no longer what the
+                # gate would admit — verify again against live state
+                self._enqueue_verify(kind, number)
+                return
+            if not ok:
+                # not quorate after pruning: future vote arrivals re-run
+                # the phase check and re-enqueue
+                return
+            if kind == "prepare":
+                self._complete_prepared(number, cache, agreeing, cert)
+            elif kind == "commit":
+                self._complete_committed(number, cache)
+            else:
+                self._complete_stable_locked(number, cache, cert)
+
+    def _offer_bad_vote_evidence(self, m: PBFTMessage) -> None:
+        """Gossip a pruned bad QC vote when the frame is self-attributing
+        (outer signature verified: the named signer really sent the
+        invalid aggregate signature)."""
+        if not getattr(m, "_authenticated", False):
+            return
+        self._gossip_offer(
+            "bad_qc_vote",
+            number=m.number,
+            view=m.view,
+            offender=m.generated_from,
+            frames=[m],
+            detail=f"invalid qc_sig on {m.packet_type.name}",
+        )
+
+    def _gossip_offer(self, kind: str, **kw) -> None:
+        """Publish a local byzantine detection to the committee (no-op when
+        gossip is not wired). Gossip is best-effort side channel: a publish
+        failure must never disturb the consensus path that detected it."""
+        if self.gossip is None:
+            return
+        try:
+            self.gossip.offer(kind, **kw)
+        except Exception as e:
+            note_swallowed("pbft.gossip_offer", e)
 
     # ----------------------------------------------------------------- worker
 
@@ -329,6 +521,7 @@ class PBFTEngine:
         signed PrePrepare, broadcast, and process it locally."""
         if self._crashed:
             return False
+        self._enter_dispatch()
         try:
             return self._submit_proposal(block)
         except InjectedCrash:
@@ -337,6 +530,8 @@ class PBFTEngine:
             # harness) observe the kill
             self._crashed = True
             raise
+        finally:
+            self._exit_dispatch()
 
     def _submit_proposal(self, block: Block) -> bool:
         # the leader's own pre-prepare (and, single-node, the whole phase
@@ -432,6 +627,19 @@ class PBFTEngine:
         return quotas.demoted(EVIDENCE_GROUP, src)
 
     def handle_message(
+        self, msg: PBFTMessage, src: bytes | None = None
+    ) -> None:
+        """Transport entry. Tracks dispatch depth so queued aggregate-QC
+        verification jobs drain only at the OUTERMOST frame on this
+        thread — i.e. after the engine lock is released and nested
+        in-proc deliveries have unwound (off-lock double-gate)."""
+        self._enter_dispatch()
+        try:
+            self._handle_message(msg, src)
+        finally:
+            self._exit_dispatch()
+
+    def _handle_message(
         self, msg: PBFTMessage, src: bytes | None = None
     ) -> None:
         if self._crashed:
@@ -570,6 +778,14 @@ class PBFTEngine:
                     source=validator_source(node.node_id) if node else "",
                     detail="second pre-prepare with a different proposal "
                     "hash at one (number, view)",
+                )
+                self._gossip_offer(
+                    "equivocation",
+                    number=msg.number,
+                    view=msg.view,
+                    offender=msg.generated_from,
+                    frames=[cache.pre_prepare, msg],
+                    detail="two signed pre-prepares at one (number, view)",
                 )
             return False
         lock = self._view_locks.get(msg.view)
@@ -806,6 +1022,43 @@ class PBFTEngine:
             msg._authenticated = True
         if (
             existing is not None
+            and getattr(msg, "_authenticated", True)
+            and not getattr(existing, "_authenticated", False)
+            and (
+                existing.proposal_hash != msg.proposal_hash
+                or existing.qc_sig != msg.qc_sig
+            )
+        ):
+            # An authenticated newcomer is about to evict a cached
+            # UNVERIFIED fast-path frame that disagrees with it. Judge the
+            # loser now instead of discarding it silently: over a real
+            # wire the genuine vote usually heals the slot before any
+            # quorum snapshot runs, and the aggregate path only judges
+            # frames still cached at snapshot time — silent eviction would
+            # let a forgery vanish unrecorded. The signature check is paid
+            # only under attack; honest re-sends are byte-identical.
+            node = self.config.node_at(existing.generated_from)
+            if node is not None and existing.verify(self.suite, node.node_id):
+                existing._authenticated = True  # genuine: conflict below
+            else:
+                REGISTRY.counter_add(
+                    "fisco_qc_forged_votes_total",
+                    1.0,
+                    help="fast-path vote packets whose qc signature failed "
+                    "AND whose packet signature does not authenticate the "
+                    "claimed sender (dropped, victim not struck)",
+                )
+                record_evidence(
+                    "forged_qc_vote",
+                    number=msg.number,
+                    view=msg.view,
+                    from_index=msg.generated_from,
+                    detail="evicted cached vote does not authenticate as "
+                    "its claimed sender",
+                    strike=False,
+                )
+        if (
+            existing is not None
             and existing.proposal_hash != msg.proposal_hash
             and getattr(msg, "_authenticated", True)
             and getattr(existing, "_authenticated", True)
@@ -827,6 +1080,14 @@ class PBFTEngine:
                 source=validator_source(node.node_id) if node else "",
                 detail=f"conflicting {msg.packet_type.name} votes",
             )
+            self._gossip_offer(
+                "vote_conflict",
+                number=msg.number,
+                view=msg.view,
+                offender=msg.generated_from,
+                frames=[existing, msg],
+                detail=f"conflicting {msg.packet_type.name} votes",
+            )
         votes[msg.generated_from] = msg
         if msg.qc_sig and self.qc is not None:
             self.qc.add_vote(
@@ -834,19 +1095,21 @@ class PBFTEngine:
                 replace=getattr(msg, "_authenticated", True),
             )
 
-    def _admit_vote_quorum(
+    def _verify_quorum_offlock(
         self,
         packet_type: PacketType,
         number: int,
         view: int,
         msg32: bytes,
-        votes: dict[int, PBFTMessage],
         agreeing: dict[int, PBFTMessage],
-    ) -> "tuple[bool, QuorumCert | None]":
-        """QC-mode quorum admission over an agreeing vote set: one
+    ) -> "tuple[bool, QuorumCert | None, set[int]]":
+        """QC-mode quorum admission over an agreeing-vote SNAPSHOT: one
         aggregate verification admits the quorum; bad votes found by
-        isolation are pruned from the engine's vote cache (and struck by
-        the collector). Returns (quorum_admitted, cert)."""
+        isolation are struck by the collector and reported back for the
+        caller to prune UNDER the engine lock. Runs without the engine
+        lock (the collector carries its own synchronization) so a slow
+        pairing never parks handle_message. Returns
+        (quorum_admitted, cert, bad_signers)."""
         qc_votes = {i: m.qc_sig for i, m in agreeing.items() if m.qc_sig}
         key = (int(packet_type), number, view, msg32)
 
@@ -875,17 +1138,16 @@ class PBFTEngine:
             self.config.quorum,
             authenticated_fn=vote_authentic,
         )
-        for i in bad:
-            votes.pop(i, None)
+        bad = set(bad)
         if cert is not None:
-            return True, cert
+            return True, cert, bad
         # votes without a qc_sig were outer-verified on arrival: a pure
         # legacy quorum (mixed-mode peers) still decides, just without a
         # certificate to carry
         noqc = {i: m for i, m in agreeing.items() if not m.qc_sig and i not in bad}
         noqc_weight = self._weight(noqc)
         if noqc_weight >= self.config.quorum:
-            return True, None
+            return True, None, bad
         # mixed-mode rescue (rolling upgrades): neither the qc subset nor
         # the legacy subset is quorate alone, but together they are —
         # verify the qc votes INDIVIDUALLY and combine, or the chain would
@@ -911,14 +1173,13 @@ class PBFTEngine:
                 qc_rest, pre, self.config.qc_pubs(),
                 authenticated_fn=vote_authentic,
             )
-            for i in set(qc_rest) - good:
-                votes.pop(i, None)
+            bad |= set(qc_rest) - good
             if (
                 noqc_weight + sum(self.config.weight_of(i) for i in good)
                 >= self.config.quorum
             ):
-                return True, None
-        return False, None
+                return True, None, bad
+        return False, None, bad
 
     def _check_prepared_quorum(self, number: int, cache: ProposalCache) -> None:
         if cache.prepared or cache.pre_prepare is None:
@@ -927,16 +1188,24 @@ class PBFTEngine:
         if self._weight(agreeing) < self.config.quorum:
             return
         if self._qc_active():
-            ok, cert = self._admit_vote_quorum(
-                PacketType.PREPARE,
-                number,
-                self.view,
-                cache.pre_prepare.proposal_hash,
-                cache.prepares,
-                agreeing,
-            )
-            if not ok:
-                return
+            # the aggregate check is the slow part: queue it for the
+            # off-lock driver at dispatch exit instead of pairing here
+            # with the engine lock held
+            self._enqueue_verify("prepare", number)
+            return
+        self._complete_prepared(number, cache, agreeing, None)
+
+    def _complete_prepared(
+        self,
+        number: int,
+        cache: ProposalCache,
+        agreeing: dict[int, PBFTMessage],
+        cert: "QuorumCert | None",
+    ) -> None:
+        """Prepare quorum ADMITTED (gate re-checked under the lock by the
+        caller): record the QC, persist the prepared proof, broadcast our
+        COMMIT."""
+        if cert is not None:
             cache.prepare_qc = cert
         cache.prepared = True
         cache.t_prepared = time.perf_counter()
@@ -988,16 +1257,13 @@ class PBFTEngine:
         if self._weight(agreeing) < self.config.quorum:
             return
         if self._qc_active():
-            ok, _cert = self._admit_vote_quorum(
-                PacketType.COMMIT,
-                number,
-                self.view,
-                cache.pre_prepare.proposal_hash,
-                cache.commits,
-                agreeing,
-            )
-            if not ok:
-                return
+            self._enqueue_verify("commit", number)
+            return
+        self._complete_committed(number, cache)
+
+    def _complete_committed(self, number: int, cache: ProposalCache) -> None:
+        """Commit quorum ADMITTED (gate re-checked under the lock by the
+        caller): execute and distribute the checkpoint."""
         cache.committed = True
         cache.t_committed = time.perf_counter()
         self.roundlog.note(number, self.view, "committed", t=cache.t_committed)
@@ -1070,137 +1336,151 @@ class PBFTEngine:
             self.roundlog.vote(
                 msg.number, self.view, "checkpoint", msg.generated_from
             )
-            if cache.stable or cache.executed_header is None:
-                return
+            self._check_checkpoint_quorum(msg.number, cache)
+
+    def _check_checkpoint_quorum(self, number: int, cache: ProposalCache) -> None:
+        if cache.stable or cache.executed_header is None:
+            return
+        if self._qc_active():
+            # aggregate admission: ONE verification for the whole
+            # checkpoint quorum; the resulting constant-size cert IS the
+            # committed header's QC record. The cheap weight pregate runs
+            # here (valid votes are a subset of matching ones, so a
+            # sub-quorum matching set can never admit); the pairing
+            # itself goes to the off-lock driver.
             executed_hash = cache.executed_header.hash(self.suite)
-            header = cache.executed_header
             matching = {
                 i: m
                 for i, m in cache.checkpoints.items()
                 if m.proposal_hash == executed_hash
                 and self.config.node_at(i) is not None
             }
-            cert = None
-            if self._qc_active():
-                # aggregate admission: ONE verification for the whole
-                # checkpoint quorum; the resulting constant-size cert IS
-                # the committed header's QC record
-                ok, cert = self._admit_vote_quorum(
-                    PacketType.CHECKPOINT,
-                    msg.number,
-                    0,  # checkpoint preimage is the header hash — viewless
-                    executed_hash,
-                    cache.checkpoints,
-                    matching,
-                )
-                if not ok:
-                    return
-            if cert is not None:
-                header.signature_list = []
-                header.qc = cert.encode()
-            else:
-                # legacy path (FISCO_QC=0 / non-QC committee / mixed-mode
-                # fallback): per-signer payload verification, O(n) list —
-                # byte-identical to the pre-QC build
-                agreeing = {}
-                for i, m in matching.items():
-                    # the payload must be a valid QC signature over the
-                    # header hash
-                    if not self.suite.signature_impl.verify(
-                        self.config.node_at(i).node_id, executed_hash, m.payload
-                    ):
-                        continue
-                    agreeing[i] = m
-                if self._weight(agreeing) < self.config.quorum:
-                    return
-                header.signature_list = [
-                    SignatureTuple(i, m.payload) for i, m in sorted(agreeing.items())
-                ]
-                header.qc = b""
-            cache.stable = True
-            header.clear_hash_cache()
-            use_async = self._async_commit_active()
-            try:
-                with TRACER.attach(cache.trace_ctx), TRACER.span(
-                    "pbft.checkpoint_commit", block=msg.number
-                ), PIPELINE.blocked(
-                    "commit"
-                ):  # nests scheduler.commit_block, inside the block trace
-                    if use_async:
-                        # pipeline mode: the 2PC runs on the commit
-                        # worker; this engine advances optimistically and
-                        # keeps processing messages — a failed 2PC rolls
-                        # the head back via _on_commit_result
-                        self.scheduler.commit_block_async(
-                            header, on_done=self._on_commit_result
-                        )
-                    else:
-                        self.scheduler.commit_block(header)
-            except SchedulerError as e:
-                _log.error("commit block %d failed: %s", msg.number, e)
-                cache.stable = False
+            if self._weight(matching) < self.config.quorum:
                 return
-            now = time.perf_counter()
-            if cache.t_committed:
-                from ..observability.tracer import trace_hex
+            self._enqueue_verify("checkpoint", number)
+            return
+        self._complete_stable_locked(number, cache, None)
 
-                REGISTRY.observe(
-                    "fisco_pbft_checkpoint_latency_ms",
-                    (now - cache.t_committed) * 1e3,
-                    help="executed to checkpoint quorum + ledger commit",
-                    exemplar=trace_hex(cache.trace_ctx),
-                )
-                TRACER.record(
-                    "pbft.checkpoint",
-                    cache.t_committed,
-                    now - cache.t_committed,
-                    parent_ctx=cache.trace_ctx,
-                    block=msg.number,
-                )
-            self.roundlog.note(msg.number, self.view, "stable", t=now)
-            if not use_async:
-                # lock-step commit: the 2PC landed inside the try above —
-                # the round is durable the instant it is stable (the async
-                # path notes durability from the commit-worker callback)
-                self.roundlog.note_height(msg.number, "durable")
-            self.committed_number = msg.number
-            self._head_hash = executed_hash
-            # crash window: the optimistic head just advanced; in pipeline
-            # mode the 2PC may still be queued on the commit worker — a
-            # reboot rebuilds the head from the durable ledger and block
-            # sync re-drives anything the crash stranded
-            crashpoint("engine.post_head_advance", self.crash_scope)
-            self.timeout_state = False
-            stale = [n for n in self._caches if n <= msg.number]
-            for n in stale:
-                self._caches.pop(n)
-            if self.qc is not None:
-                self.qc.reset_below(msg.number)
-            if self.cstore is not None:
-                self.cstore.prune_below(msg.number)
-            if (
-                self._recovered_prepared is not None
-                and self._recovered_prepared[0] <= msg.number
-            ):
-                self._recovered_prepared = None
-            # committee may have changed at this block; members activate at
-            # their enable_number (block N+1 for a change written at N).
-            # With the async commit the ledger row may not be durable yet —
-            # read through the committing block's post-state overlay (falls
-            # back to the ledger once the 2PC has booked)
-            staged = (
-                self.scheduler.staged_state(msg.number) if use_async else None
+    def _complete_stable_locked(
+        self, number: int, cache: ProposalCache, cert: "QuorumCert | None"
+    ) -> None:
+        """Checkpoint quorum ADMITTED (gate re-checked under the lock by
+        the caller): stamp the header's QC record, commit the block, and
+        advance the head."""
+        header = cache.executed_header
+        executed_hash = header.hash(self.suite)
+        if cert is not None:
+            header.signature_list = []
+            header.qc = cert.encode()
+        else:
+            # legacy path (FISCO_QC=0 / non-QC committee / mixed-mode
+            # fallback): per-signer payload verification, O(n) list —
+            # byte-identical to the pre-QC build
+            matching = {
+                i: m
+                for i, m in cache.checkpoints.items()
+                if m.proposal_hash == executed_hash
+                and self.config.node_at(i) is not None
+            }
+            agreeing = {}
+            for i, m in matching.items():
+                # the payload must be a valid QC signature over the
+                # header hash
+                if not self.suite.signature_impl.verify(
+                    self.config.node_at(i).node_id, executed_hash, m.payload
+                ):
+                    continue
+                agreeing[i] = m
+            if self._weight(agreeing) < self.config.quorum:
+                return
+            header.signature_list = [
+                SignatureTuple(i, m.payload) for i, m in sorted(agreeing.items())
+            ]
+            header.qc = b""
+        cache.stable = True
+        header.clear_hash_cache()
+        use_async = self._async_commit_active()
+        try:
+            with TRACER.attach(cache.trace_ctx), TRACER.span(
+                "pbft.checkpoint_commit", block=number
+            ), PIPELINE.blocked(
+                "commit"
+            ):  # nests scheduler.commit_block, inside the block trace
+                if use_async:
+                    # pipeline mode: the 2PC runs on the commit
+                    # worker; this engine advances optimistically and
+                    # keeps processing messages — a failed 2PC rolls
+                    # the head back via _on_commit_result
+                    self.scheduler.commit_block_async(
+                        header, on_done=self._on_commit_result
+                    )
+                else:
+                    self.scheduler.commit_block(header)
+        except SchedulerError as e:
+            _log.error("commit block %d failed: %s", number, e)
+            cache.stable = False
+            return
+        now = time.perf_counter()
+        if cache.t_committed:
+            from ..observability.tracer import trace_hex
+
+            REGISTRY.observe(
+                "fisco_pbft_checkpoint_latency_ms",
+                (now - cache.t_committed) * 1e3,
+                help="executed to checkpoint quorum + ledger commit",
+                exemplar=trace_hex(cache.trace_ctx),
             )
-            self.config.reload(
-                self.ledger.consensus_nodes(storage=staged),
-                active_at=msg.number + 1,
+            TRACER.record(
+                "pbft.checkpoint",
+                cache.t_committed,
+                now - cache.t_committed,
+                parent_ctx=cache.trace_ctx,
+                block=number,
             )
-            _log.info(
-                "block %d stable-committed, view=%d, committee=%d",
-                msg.number,
-                self.view,
-                self.config.committee_size,
-            )
+        self.roundlog.note(number, self.view, "stable", t=now)
+        if not use_async:
+            # lock-step commit: the 2PC landed inside the try above —
+            # the round is durable the instant it is stable (the async
+            # path notes durability from the commit-worker callback)
+            self.roundlog.note_height(number, "durable")
+        self.committed_number = number
+        self._head_hash = executed_hash
+        # crash window: the optimistic head just advanced; in pipeline
+        # mode the 2PC may still be queued on the commit worker — a
+        # reboot rebuilds the head from the durable ledger and block
+        # sync re-drives anything the crash stranded
+        crashpoint("engine.post_head_advance", self.crash_scope)
+        self.timeout_state = False
+        stale = [n for n in self._caches if n <= number]
+        for n in stale:
+            self._caches.pop(n)
+        if self.qc is not None:
+            self.qc.reset_below(number)
+        if self.cstore is not None:
+            self.cstore.prune_below(number)
+        if (
+            self._recovered_prepared is not None
+            and self._recovered_prepared[0] <= number
+        ):
+            self._recovered_prepared = None
+        # committee may have changed at this block; members activate at
+        # their enable_number (block N+1 for a change written at N).
+        # With the async commit the ledger row may not be durable yet —
+        # read through the committing block's post-state overlay (falls
+        # back to the ledger once the 2PC has booked)
+        staged = (
+            self.scheduler.staged_state(number) if use_async else None
+        )
+        self.config.reload(
+            self.ledger.consensus_nodes(storage=staged),
+            active_at=number + 1,
+        )
+        _log.info(
+            "block %d stable-committed, view=%d, committee=%d",
+            number,
+            self.view,
+            self.config.committee_size,
+        )
 
     # ------------------------------------------------------------ view change
 
@@ -1208,6 +1488,13 @@ class PBFTEngine:
         """Consensus timeout: try to move to view+1 (PBFTTimer expiry).
         ``cause`` attributes the round-forensics record — the catch-up path
         re-enters here with ``catchup``."""
+        self._enter_dispatch()
+        try:
+            self._on_timeout(cause)
+        finally:
+            self._exit_dispatch()
+
+    def _on_timeout(self, cause: str) -> None:
         with self._lock:
             self.timeout_state = True
             self.to_view = max(self.to_view, self.view) + 1
@@ -1435,6 +1722,15 @@ class PBFTEngine:
                     source=validator_source(node.node_id) if node else "",
                     detail="view-change prepared claim without a valid "
                     "prepare quorum",
+                )
+                self._gossip_offer(
+                    "fabricated_prepared_cert",
+                    number=self.committed_number + 1,
+                    view=m.view,
+                    offender=m.generated_from,
+                    frames=[m],
+                    detail="prepared claim whose proof fails quorum "
+                    "re-verification",
                 )
             if proven is not None and (best is None or proven[0] > best[0]):
                 best = proven

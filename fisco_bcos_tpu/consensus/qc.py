@@ -304,14 +304,13 @@ class QuorumCollector:
     """Accumulates unverified vote signatures and admits whole quorums by
     aggregate verification, isolating bad votes when an aggregate fails.
 
-    Thread-safe on its own lock (the engine calls it under the engine
-    lock, but view-change resets and the race harness drive it
-    concurrently). Scheme verification runs OUTSIDE the collector's lock;
-    note the ENGINE currently holds its own lock across quorum admission,
-    so a slow pairing check still parks that engine's message handling —
-    moving aggregate verification off the engine lock (with the
-    pre-prepare handler's double-gate re-check pattern) is a named
-    ROADMAP frontier, not solved here."""
+    Thread-safe on its own lock (view-change resets and the race harness
+    drive it concurrently). Scheme verification runs OUTSIDE the
+    collector's lock, and — since the engine moved quorum admission onto
+    its off-lock verify queue (snapshot under the engine lock, aggregate
+    check without it, double-gate re-check before completion; see
+    ``PBFTEngine._run_verify_job``) — outside the engine lock too: a
+    slow pairing never parks ``handle_message``."""
 
     MAX_KEYS = 4096  # waterline backstop (engine prunes by number anyway)
 

@@ -40,6 +40,9 @@ class ModuleID(IntEnum):
     # federated telemetry pull (ISSUE 16): any node asks a peer for its
     # metrics snapshot / round ledger / clock probe over the same mesh
     FLEET_TELEMETRY = 4007
+    # byzantine-evidence gossip (ISSUE 17): signed, self-attributing
+    # evidence records re-broadcast so demotion converges committee-wide
+    EVIDENCE_GOSSIP = 4008
     SYNC_PUSH_TRANSACTION = 5000
 
 # callback(from_node_id: bytes, payload: bytes) -> None

@@ -48,7 +48,9 @@ import zlib
 
 from ..front.front import FrontService, GatewayInterface
 from ..resilience import faults
+from ..resilience.retry import RetryPolicy
 from ..utils.log import get_logger, note_swallowed
+from ..utils.metrics import REGISTRY
 from .router import MAX_DISTANCE, RouterTable
 from .tls import NODE_ID_URI_SCHEME
 
@@ -111,11 +113,16 @@ def _cert_node_id(sock) -> bytes | None:
 
 
 class _Peer:
-    def __init__(self, sock: socket.socket, addr):
+    def __init__(self, sock: socket.socket, addr, local_host: str = ""):
         self.sock = sock
         self.addr = addr
         # fault-plan scope: rules target a peer link by remote endpoint
         self.scope = f"gw:{addr[0]}:{addr[1]}"
+        # partition consults need BOTH endpoints of the link
+        self.local_host = local_host
+        # outbound dials remember their endpoint so the gateway can redial
+        # through its RetryPolicy after a drop (accepted peers redial us)
+        self.dialed = False
         self.node_id: bytes | None = None
         self.wlock = threading.Lock()
         # failure detection (Service::heartBeat analog)
@@ -137,6 +144,11 @@ class _Peer:
     def send(self, frame: bytes) -> bool:
         plan = faults._PLAN
         try:
+            if plan is not None and plan.blocked(self.local_host, self.addr[0]):
+                # an active partition severs the link mid-flight: the
+                # caller drops the peer and the redial path (which the
+                # partition also refuses) restores it after the heal
+                return False
             if plan is not None:
                 chunks, kill = plan.on_send(self.scope, frame)
                 with self.wlock:
@@ -170,11 +182,23 @@ class TcpGateway(GatewayInterface):
         client_ssl_context=None,
         rate_limiter=None,
         heartbeat_interval: float = 10.0,
+        reconnect_policy: "RetryPolicy | None" = None,
     ):
         self.node_id = node_id
         # liveness probing (0 disables; tests drive heartbeats manually)
         self.heartbeat_interval = heartbeat_interval
         self._hb_timer = None
+        # dropped outbound links redial through capped-exponential backoff
+        # with jitter seeded from the node id: the whole fleet replays the
+        # same delay sequence in fault-injected tests, yet no two nodes
+        # share one (no reconnect thundering herd after a partition heals)
+        self.reconnect_policy = reconnect_policy or RetryPolicy(
+            max_attempts=8,
+            base_delay=0.05,
+            max_delay=2.0,
+            seed=int.from_bytes(node_id[:4] or b"\x01", "little"),
+        )
+        self._redialing: set[tuple[str, int]] = set()
         self._ssl = ssl_context
         self._cli_ssl = client_ssl_context
         # outbound bandwidth policing (gateway/ratelimit.py; libratelimit)
@@ -253,6 +277,14 @@ class TcpGateway(GatewayInterface):
         self._stop.set()
         if self._hb_timer is not None:
             self._hb_timer.stop()
+        # shutdown() before close(): close() alone does NOT wake a thread
+        # parked in accept()/recv() on the same socket, so the accept and
+        # reader threads would survive stop() and die mid-syscall at
+        # interpreter teardown (observed as an abort on exit)
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -262,24 +294,48 @@ class TcpGateway(GatewayInterface):
             self._peers.clear()
         for p in peers:
             try:
+                # wrapper sockets (SM-TLS) may not expose shutdown
+                p.sock.shutdown(socket.SHUT_RDWR)
+            except (OSError, AttributeError):
+                pass
+            try:
                 p.sock.close()
             except OSError:
                 pass
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def connect_peer(self, host: str, port: int) -> bool:
         """Dial a peer (the static nodes list of config.ini [p2p])."""
         try:
             plan = faults._PLAN
             if plan is not None:
+                if plan.blocked(self.host, host):
+                    raise faults.InjectedFault(
+                        f"partition refuses dial {self.host} -> {host}"
+                    )
                 plan.on_connect(f"gw:{host}:{port}")
-            sock = socket.create_connection((host, port), timeout=5)
+            # bind the source to our listen address: the accept side then
+            # sees the dialer's HOST identity, which is what partition cuts
+            # and the multi-loopback wire harness key on (a wildcard bind
+            # keeps the kernel's default source selection)
+            src = (
+                (self.host, 0)
+                if self.host not in ("", "0.0.0.0", "::") else None
+            )
+            sock = socket.create_connection(
+                (host, port), timeout=5, source_address=src
+            )
             if self._cli_ssl is not None:
                 sock = self._cli_ssl.wrap_socket(sock)  # mutual-TLS handshake
             sock.settimeout(None)  # timeout applies to the dial only, not reads
         except (OSError, ValueError) as e:
             _log.warning("dial %s:%d failed: %s", host, port, e)
             return False
-        peer = _Peer(sock, (host, port))
+        peer = _Peer(sock, (host, port), local_host=self.host)
+        peer.dialed = True
         peer.send(_pack_frame(_KIND_HANDSHAKE, 0, 0, self.node_id, b"\x00" * 64, b""))
         t = threading.Thread(
             target=self._read_loop, args=(peer,), name="gw-peer", daemon=True
@@ -431,7 +487,17 @@ class TcpGateway(GatewayInterface):
                 except OSError:
                     pass
                 return
-        peer = _Peer(sock, addr)
+        plan = faults._PLAN
+        if plan is not None and plan.blocked(self.host, addr[0]):
+            # partitioned dialer reached our accept queue: refuse it here
+            # too (its own connect consult already blocks plan-sharing
+            # processes; this closes the cut for plan-free dialers)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        peer = _Peer(sock, addr, local_host=self.host)
         peer.send(_pack_frame(_KIND_HANDSHAKE, 0, 0, self.node_id, b"\x00" * 64, b""))
         self._read_loop(peer)
 
@@ -462,6 +528,8 @@ class TcpGateway(GatewayInterface):
             body = self._recv_exact(peer.sock, length)
             plan = faults._PLAN
             if plan is not None and body is not None:
+                if plan.blocked(self.host, peer.addr[0]):
+                    break  # partition severed the link under us
                 try:
                     body = plan.on_recv(peer.scope, body)
                 except faults.InjectedFault:
@@ -605,3 +673,46 @@ class TcpGateway(GatewayInterface):
             _log.info("peer %s disconnected", peer.node_id.hex()[:8])
             if self.router.peer_disconnected(peer.node_id):
                 self._advertise_routes()
+        if peer.dialed and not self._stop.is_set():
+            self._schedule_redial(peer.addr[0], peer.addr[1])
+
+    def _schedule_redial(self, host: str, port: int) -> None:
+        """One background redial loop per dropped outbound endpoint,
+        pacing through :class:`RetryPolicy` (capped exponential backoff +
+        seeded jitter — never a fixed-sleep redial)."""
+        with self._lock:
+            if (host, port) in self._redialing:
+                return
+            self._redialing.add((host, port))
+        t = threading.Thread(
+            target=self._redial, args=(host, port), name="gw-redial", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+
+    def _redial(self, host: str, port: int) -> None:
+        policy = self.reconnect_policy
+        try:
+            for attempt in range(policy.max_attempts):
+                if self._stop.is_set():
+                    return
+                time.sleep(policy.delay(attempt))
+                if self._stop.is_set():
+                    return
+                REGISTRY.counter_add(
+                    f'fisco_gateway_reconnects_total{{peer="{host}:{port}"}}',
+                    help="outbound peer redial attempts after a dropped link",
+                )
+                if self.connect_peer(host, port):
+                    _log.info(
+                        "redial %s:%d succeeded (attempt %d)",
+                        host, port, attempt + 1,
+                    )
+                    return
+            _log.warning(
+                "redial %s:%d abandoned after %d attempts",
+                host, port, policy.max_attempts,
+            )
+        finally:
+            with self._lock:
+                self._redialing.discard((host, port))

@@ -8,6 +8,7 @@ seam where this framework's batch suites plug in).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 from ..consensus import BlockValidator, PBFTConfig, PBFTEngine, Sealer
@@ -268,6 +269,16 @@ class Node:
             from ..observability.fleet import FleetService
 
             self.fleet = FleetService(self)
+        # evidence gossip (ISSUE 17): byzantine detections re-broadcast as
+        # signed, self-attributing records on ModuleID 4008 so demotion
+        # converges on every honest node. FISCO_EVIDENCE_GOSSIP=0 leaves
+        # engine.gossip unwired (detections stay local, as before).
+        if os.environ.get("FISCO_EVIDENCE_GOSSIP", "1") != "0":
+            from ..consensus.gossip import EvidenceGossip
+
+            self.engine.gossip = EvidenceGossip(
+                self.engine, self.front, self.keypair
+            )
         # one injected crash anywhere kills the WHOLE node: a commit-worker
         # death halts the engine (no zombie quorum votes), and block sync
         # reads the engine's halt state (no durable writes after death)

@@ -86,6 +86,14 @@ class FleetService:
             "pool_pending": node.txpool.pending_count(),
             "health": health,
             "evidence": dict(EVIDENCE.counts()),
+            # per-node gossip convergence row (ISSUE 17): which offenders
+            # THIS node has locally confirmed — the fleet view shows the
+            # committee-wide demotion converge (or fail to)
+            "gossip": (
+                node.engine.gossip.snapshot()
+                if getattr(node.engine, "gossip", None) is not None
+                else None
+            ),
             "metrics": REGISTRY.counters_matching("fisco_"),
             "status": "ok",
         }
@@ -277,9 +285,22 @@ class FleetService:
                 for label, r in rows.items()
             },
             "evidence_total": evidence_total,
+            # demotion convergence (ISSUE 17): offender -> how many
+            # reachable nodes have locally confirmed (gossip or direct
+            # detection). Converged == every count reaches `reachable`.
+            "gossip_convergence": self._gossip_convergence(rows),
             "round_skew_ms": rounds["skew_ms"],
             "view_changes": rounds["view_changes"],
         }
+
+    @staticmethod
+    def _gossip_convergence(rows: dict) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for row in rows.values():
+            g = row.get("gossip") or {}
+            for offender in g.get("offenders", ()):
+                counts[offender] = counts.get(offender, 0) + 1
+        return counts
 
     def round_forensics(self, height: int) -> dict:
         """The ``GET /round/<height>`` document: that height's rounds

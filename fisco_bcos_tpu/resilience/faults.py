@@ -8,11 +8,19 @@ get the same failure sequence on every run.
 
 A :class:`FaultPlan` is a list of rules. Each rule names an *action*
 (``drop``/``delay``/``duplicate``/``truncate``/``corrupt``/``refuse``/
-``kill``), a
+``kill``/``partition``), a
 *site* (``connect``/``send``/``recv``/``*``) and a *target* substring
 matched against the transport's scope string (service clients use
 ``"host:port"``, servers ``"svc:<name>"``, the gateway ``"gw:<port>"``), so
 one plan can flap a single storage shard while everything else runs clean.
+
+``partition`` is the one two-endpoint action: its target names two host
+sets (``hostA+hostB|hostC``) and the TCP gateway consults
+:meth:`FaultPlan.blocked` with BOTH endpoints of every dial/send/recv —
+traffic *within* a side flows, traffic *across* the cut is refused until
+the rule's ``ms`` heal delay elapses (or :meth:`FaultPlan.heal_partitions`
+heals it on demand). Severed links re-establish through the gateway's
+:class:`~fisco_bcos_tpu.resilience.retry.RetryPolicy` redial.
 
 Determinism: probabilistic rules (``p < 1``) draw from one
 ``random.Random(seed)`` owned by the plan, and counters (``after``/
@@ -31,7 +39,8 @@ environment spec parsed once at transport import (:func:`ensure_env_plan`):
 Spec grammar: ``;``-separated clauses; ``seed=N`` may appear once; every
 other clause is ``action@site:target[,key=val...]`` with keys ``p`` (float
 probability), ``count`` (max firings), ``after`` (pass N matching events
-first), ``ms`` (delay milliseconds), ``keep`` (truncate: bytes kept),
+first), ``ms`` (delay milliseconds; for ``partition`` the heal delay,
+0/absent = manual heal), ``keep`` (truncate: bytes kept),
 ``bits`` (corrupt: bit flips per frame).
 
 ``corrupt`` flips ``bits`` seeded-random bits in the frame *body* (never
@@ -63,7 +72,10 @@ class InjectedFault(OSError):
     existing connection-loss handling absorbs it unchanged)."""
 
 
-_ACTIONS = ("drop", "delay", "duplicate", "truncate", "corrupt", "refuse", "kill")
+_ACTIONS = (
+    "drop", "delay", "duplicate", "truncate", "corrupt", "refuse", "kill",
+    "partition",
+)
 _SITES = ("connect", "send", "recv", "*")
 
 
@@ -72,7 +84,7 @@ class FaultRule:
 
     __slots__ = (
         "action", "site", "target", "p", "count", "after",
-        "delay_ms", "keep", "bits", "fired", "seen",
+        "delay_ms", "keep", "bits", "fired", "seen", "sides", "heal_at",
     )
 
     def __init__(
@@ -83,7 +95,7 @@ class FaultRule:
         p: float = 1.0,
         count: int | None = None,
         after: int = 0,
-        delay_ms: float = 10.0,
+        delay_ms: float | None = None,
         keep: int = 2,
         bits: int = 3,
     ):
@@ -97,11 +109,40 @@ class FaultRule:
         self.p = float(p)
         self.count = count  # None = unlimited firings
         self.after = int(after)  # pass this many matching events first
-        self.delay_ms = float(delay_ms)
+        # for partition rules `ms` is the heal delay (None/0 = manual heal);
+        # for every other action it is the injected latency (default 10 ms)
+        self.delay_ms = 10.0 if delay_ms is None else float(delay_ms)
         self.keep = int(keep)  # truncate: wire bytes that still go out
         self.bits = int(bits)  # corrupt: bit flips per frame body
         self.fired = 0
         self.seen = 0
+        # partition: the two host sets of the cut, parsed from
+        # ``target = "hostA+hostB|hostC"``, and the monotonic heal time
+        # (armed at rule creation — the cut begins when the plan does)
+        self.sides: tuple[list[str], list[str]] | None = None
+        self.heal_at: float | None = None
+        if action == "partition":
+            a, _, b = target.partition("|")
+            side_a = [s for s in a.split("+") if s]
+            side_b = [s for s in b.split("+") if s]
+            if not side_a or not side_b:
+                raise ValueError(
+                    "partition target must name two '|'-separated host "
+                    f"sets ('h1+h2|h3'), got {target!r}"
+                )
+            self.sides = (side_a, side_b)
+            if delay_ms is not None and delay_ms > 0:
+                self.heal_at = time.monotonic() + delay_ms / 1e3
+
+    def crosses(self, local: str, remote: str) -> bool:
+        """Partition-rule test: does (local, remote) span the cut (either
+        direction — the refuse is bidirectional)?"""
+        if self.sides is None:
+            return False
+        a, b = self.sides
+        in_a = lambda s: any(h in s for h in a)  # noqa: E731
+        in_b = lambda s: any(h in s for h in b)  # noqa: E731
+        return (in_a(local) and in_b(remote)) or (in_b(local) and in_a(remote))
 
     def matches(self, site: str, scope: str) -> bool:
         if self.site != "*" and self.site != site:
@@ -163,6 +204,31 @@ class FaultPlan:
     def refuse_connect(self, target: str = "*", **kw):
         return self.rule("refuse", "connect", target, **kw)
 
+    def partition(
+        self,
+        side_a: list[str] | tuple[str, ...],
+        side_b: list[str] | tuple[str, ...],
+        heal_ms: float = 0.0,
+    ) -> "FaultPlan":
+        """Bidirectional refuse between two host sets with a timed heal.
+
+        Every dial, send and receive whose (local, remote) endpoints span
+        the cut is refused/severed until ``heal_ms`` milliseconds have
+        elapsed (0 = no auto-heal; :meth:`heal_partitions` heals on
+        demand). The gateway consults :meth:`blocked` with BOTH endpoint
+        scopes, so the cut isolates whole hosts — the grammar spelling is
+        ``partition@*:hostA+hostB|hostC,ms=2000``."""
+        target = "+".join(side_a) + "|" + "+".join(side_b)
+        return self.add(FaultRule("partition", "*", target, delay_ms=heal_ms))
+
+    def heal_partitions(self) -> None:
+        """Heal every partition rule NOW (deterministic heal for tests
+        that must not sleep out a wall-clock timer)."""
+        with self._lock:
+            for r in self._rules:
+                if r.action == "partition":
+                    r.heal_at = 0.0
+
     def kill_after(self, n: int, site: str = "*", target: str = "*", **kw):
         """Let n matching messages through, then kill the CONNECTION.
 
@@ -208,10 +274,31 @@ class FaultPlan:
 
     # -- firing --------------------------------------------------------------
 
+    def blocked(self, local: str, remote: str) -> bool:
+        """Partition consult (the TCP gateway calls this at connect, send
+        AND recv with both endpoint scopes): True while an unhealed
+        partition rule cuts (local, remote). Unlike :meth:`_fire` this
+        needs BOTH endpoints — a single-scope rule cannot express 'A may
+        not talk to B while everyone else talks to both'."""
+        now = time.monotonic()
+        with self._lock:
+            for r in self._rules:
+                if r.action != "partition":
+                    continue
+                if r.heal_at is not None and now >= r.heal_at:
+                    continue  # healed: traffic flows again
+                if r.crosses(local, remote):
+                    r.fired += 1
+                    self.injected += 1
+                    return True
+        return False
+
     def _fire(self, site: str, scope: str) -> FaultRule | None:
         """The first rule that matches AND fires for this event."""
         with self._lock:
             for r in self._rules:
+                if r.action == "partition":
+                    continue  # two-endpoint rules fire via blocked()
                 if not r.matches(site, scope):
                     continue
                 r.seen += 1

@@ -36,6 +36,13 @@ from .byzantine import (
 )
 from .proof_storm import run_proof_storm_bench
 from .runner import ScenarioRunner, run_isolation_bench
+from .wire import (
+    WireHarness,
+    run_wire_bench,
+    run_wire_catalog,
+    run_wire_colluders,
+    run_wire_partition,
+)
 
 __all__ = [
     "ATTACK_NAMES",
@@ -45,6 +52,7 @@ __all__ = [
     "Scenario",
     "ScenarioRunner",
     "SubmitTxs",
+    "WireHarness",
     "WorkloadContext",
     "get_scenario",
     "list_scenarios",
@@ -53,4 +61,8 @@ __all__ = [
     "run_byzantine_scenario",
     "run_isolation_bench",
     "run_proof_storm_bench",
+    "run_wire_bench",
+    "run_wire_catalog",
+    "run_wire_colluders",
+    "run_wire_partition",
 ]

@@ -153,6 +153,29 @@ def _raceguard_enforcement():
     )
 
 
+_EXIT_STATUS = [None]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _EXIT_STATUS[0] = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    """Skip interpreter finalization: jaxlib's C++ static destructors race
+    daemon threads that touched XLA during the suite (device-plane worker,
+    engine workers of harnesses the tests leave running) and flakily call
+    std::terminate AFTER the summary is printed — turning a fully green
+    run into rc=134. By unconfigure time every report is flushed; exiting
+    here hands the real pytest status to the caller deterministically."""
+    if _EXIT_STATUS[0] is None:
+        return  # the session never ran (usage error): normal teardown
+    import sys
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_EXIT_STATUS[0])
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-wall-clock end-to-end tests"

@@ -1,0 +1,252 @@
+"""ISSUE 17: evidence gossip — committee-wide demotion convergence.
+
+The acceptance pins:
+
+- a byzantine detection made on ONE honest node converges (via signed,
+  self-attributing gossip records) onto EVERY honest node's local
+  confirmed-offender set;
+- forgery safety: a fabricated record naming an honest victim strikes
+  NOBODY — records only count when the embedded offending frames
+  re-verify locally;
+- amplification is bounded: the seen-set limits every node to at most
+  one forward per record, and duplicate deliveries die at the dedup.
+"""
+
+import json
+
+import pytest
+
+from fisco_bcos_tpu.consensus.audit import (
+    EVIDENCE,
+    EVIDENCE_GROUP,
+    validator_source,
+)
+from fisco_bcos_tpu.consensus.messages import PacketType, PBFTMessage
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.front.front import InprocGateway, ModuleID
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+from fisco_bcos_tpu.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol.block import Block
+from fisco_bcos_tpu.protocol.block_header import BlockHeader
+from fisco_bcos_tpu.txpool.quota import get_quotas
+
+SUITE = ecdsa_suite()
+BASE = 91_000
+
+
+@pytest.fixture(autouse=True)
+def _fresh_boards():
+    get_quotas().reset()
+    EVIDENCE.reset()
+    yield
+    get_quotas().reset()
+    EVIDENCE.reset()
+
+
+def make_net(n=4):
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=BASE + i) for i in range(n)
+    ]
+    committee = [ConsensusNode(kp.pub, weight=1) for kp in keypairs]
+    gateway = InprocGateway(auto=True)
+    nodes = []
+    for kp in keypairs:
+        cfg = NodeConfig(genesis=GenesisConfig(consensus_nodes=list(committee)))
+        node = Node(cfg, keypair=kp)
+        gateway.connect(node.front)
+        nodes.append(node)
+    return nodes, keypairs, gateway
+
+
+def stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+def _pre_prepare(number, view, leader_idx, leader_kp, timestamp):
+    block = Block(header=BlockHeader(number=number, timestamp=timestamp))
+    msg = PBFTMessage(
+        packet_type=PacketType.PRE_PREPARE,
+        view=view,
+        number=number,
+        proposal_hash=block.header.hash(SUITE),
+        proposal_data=block.encode(),
+    )
+    msg.generated_from = leader_idx
+    msg.sign(SUITE, leader_kp)
+    return msg
+
+
+def _leader(nodes, keypairs, number, view=0):
+    cfg = nodes[0].pbft_config
+    idx = cfg.leader_index(number, view)
+    leader_id = cfg.nodes[idx].node_id
+    kp = next(k for k in keypairs if k.pub == leader_id)
+    return idx, leader_id, kp
+
+
+def test_detection_on_one_node_converges_on_all(monkeypatch):
+    """Only ONE honest node witnesses the equivocation; gossip carries the
+    offending frames to everyone else, each of whom re-verifies and
+    confirms independently."""
+    nodes, keypairs, gateway = make_net(4)
+    try:
+        idx, leader_id, leader_kp = _leader(nodes, keypairs, 1)
+        witness = next(n for n in nodes if n.node_id != leader_id)
+
+        pp1 = _pre_prepare(1, 0, idx, leader_kp, timestamp=1)
+        pp2 = _pre_prepare(1, 0, idx, leader_kp, timestamp=2)
+        assert pp1.proposal_hash != pp2.proposal_hash
+        witness.engine.handle_message(pp1)
+        witness.engine.handle_message(pp2)  # the equivocation, seen HERE only
+
+        assert witness.engine.gossip.stats["published"] == 1
+        for node in nodes:
+            g = node.engine.gossip
+            assert leader_id.hex() in g.confirmed_offenders, (
+                f"demotion did not converge on {node.node_id.hex()[:8]}"
+            )
+            if node is not witness:
+                assert g.stats["confirmed"] >= 1
+        # one evidence record per confirming node (never more: the
+        # offense-key dedup), all attributed to the leader
+        recs = [r for r in EVIDENCE.snapshot() if r["kind"] == "equivocation"]
+        assert 1 <= len(recs) <= len(nodes)
+        assert all(r["source"] == validator_source(leader_id) for r in recs)
+        # the fleet row federates the convergence witness
+        snap = witness.engine.gossip.snapshot()
+        assert snap["offenders"] == [leader_id.hex()]
+    finally:
+        stop_all(nodes)
+
+
+def _forged_envelope(reporter_kp, kind, offender_id, frames, number=1, view=0):
+    body = {
+        "kind": kind,
+        "number": number,
+        "view": view,
+        "offender": offender_id.hex(),
+        "reporter": bytes(reporter_kp.pub).hex(),
+        "frames": [m.encode().hex() for m in frames],
+        "detail": "fabricated",
+    }
+    blob = json.dumps(body, sort_keys=True).encode()
+    sig = SUITE.signature_impl.sign(reporter_kp, SUITE.hash(blob))
+    return json.dumps(
+        {"body": blob.hex(), "sig": sig.hex(), "ttl": 3}
+    ).encode()
+
+
+def test_forged_record_naming_honest_victim_strikes_nobody():
+    """Acceptance pin: a committee member fabricates an equivocation
+    record against an honest victim. The embedded frames cannot carry the
+    victim's signature, so re-verification fails everywhere — nobody
+    strikes, nobody confirms."""
+    nodes, keypairs, gateway = make_net(4)
+    try:
+        idx, victim_id, _victim_kp = _leader(nodes, keypairs, 1)
+        fabricator = next(n for n in nodes if n.node_id != victim_id)
+        fab_kp = next(k for k in keypairs if k.pub == fabricator.node_id)
+
+        # frames signed by the FABRICATOR but claiming the victim's index
+        f1 = _pre_prepare(1, 0, idx, fab_kp, timestamp=1)
+        f2 = _pre_prepare(1, 0, idx, fab_kp, timestamp=2)
+        env = _forged_envelope(fab_kp, "equivocation", victim_id, [f1, f2])
+        fabricator.front.broadcast(ModuleID.EVIDENCE_GOSSIP, env)
+
+        for node in nodes:
+            if node is fabricator:
+                continue
+            g = node.engine.gossip
+            assert victim_id.hex() not in g.confirmed_offenders
+            assert g.stats["confirmed"] == 0
+            assert g.stats["rejected"] >= 1
+            assert g.stats["forwarded"] == 0  # rejected records never spread
+        assert EVIDENCE.count() == 0
+        assert not get_quotas().demoted(
+            EVIDENCE_GROUP, validator_source(victim_id)
+        )
+    finally:
+        stop_all(nodes)
+
+
+def test_forged_vote_conflict_record_strikes_nobody():
+    """Same pin for the vote family: conflicting PREPAREs not actually
+    signed by the named offender are worthless as evidence."""
+    nodes, keypairs, gateway = make_net(4)
+    try:
+        victim_id = nodes[0].pbft_config.nodes[2].node_id
+        fabricator = next(n for n in nodes if n.node_id != victim_id)
+        fab_kp = next(k for k in keypairs if k.pub == fabricator.node_id)
+        votes = []
+        for h in (b"\xaa" * 32, b"\xbb" * 32):
+            m = PBFTMessage(
+                packet_type=PacketType.PREPARE, view=0, number=1,
+                proposal_hash=h,
+            )
+            m.generated_from = 2  # the victim's index
+            m.sign(SUITE, fab_kp)  # ...but the fabricator's signature
+            votes.append(m)
+        env = _forged_envelope(fab_kp, "vote_conflict", victim_id, votes)
+        fabricator.front.broadcast(ModuleID.EVIDENCE_GOSSIP, env)
+        for node in nodes:
+            assert victim_id.hex() not in node.engine.gossip.confirmed_offenders
+        assert EVIDENCE.count() == 0
+    finally:
+        stop_all(nodes)
+
+
+def test_rebroadcast_amplification_bounded_by_seen_set():
+    """Counter-pin: one genuine offense produces at most one origin
+    broadcast plus one forward per confirming node; replaying the record
+    afterwards dies at the dedup with zero new strikes or forwards."""
+    nodes, keypairs, gateway = make_net(4)
+    sent = []
+    real_broadcast = gateway.broadcast
+
+    def counting(module_id, src, payload, group=""):
+        if module_id == ModuleID.EVIDENCE_GOSSIP:
+            sent.append(payload)
+        real_broadcast(module_id, src, payload, group=group)
+
+    gateway.broadcast = counting
+    try:
+        idx, leader_id, leader_kp = _leader(nodes, keypairs, 1)
+        witness = next(n for n in nodes if n.node_id != leader_id)
+        pp1 = _pre_prepare(1, 0, idx, leader_kp, timestamp=1)
+        pp2 = _pre_prepare(1, 0, idx, leader_kp, timestamp=2)
+        witness.engine.handle_message(pp1)
+        witness.engine.handle_message(pp2)
+
+        # origin + at most one forward per other node — never echo storms
+        assert 1 <= len(sent) <= len(nodes)
+        for node in nodes:
+            assert node.engine.gossip.stats["forwarded"] <= 1
+        before = EVIDENCE.count("equivocation")
+        strikes_before = [n.engine.gossip.stats["confirmed"] for n in nodes]
+
+        # replay the original record into everyone: pure duplicates
+        replayed = sent[0]
+        sent.clear()
+        witness.front.broadcast(ModuleID.EVIDENCE_GOSSIP, replayed)
+        assert len(sent) == 1  # the replay itself; nobody forwarded it
+        assert EVIDENCE.count("equivocation") == before
+        for node, prev in zip(nodes, strikes_before):
+            assert node.engine.gossip.stats["confirmed"] == prev
+            if node is not witness:
+                assert node.engine.gossip.stats["duplicates"] >= 1
+
+        # re-detecting the SAME offense locally publishes nothing new
+        witness.engine.handle_message(pp2)
+        assert witness.engine.gossip.stats["published"] == 1
+    finally:
+        stop_all(nodes)
+
+
+def test_gossip_unwired_when_disabled(monkeypatch):
+    monkeypatch.setenv("FISCO_EVIDENCE_GOSSIP", "0")
+    nodes, _keypairs, _gateway = make_net(2)
+    try:
+        assert all(n.engine.gossip is None for n in nodes)
+    finally:
+        stop_all(nodes)

@@ -1,0 +1,222 @@
+"""ISSUE 17: aggregate QC verification runs OFF the engine lock.
+
+The pin: a slow aggregate check (stubbed pairing) must never park
+``handle_message`` — pre-prepares delivered concurrently with a stalled
+quorum admission return promptly, and the stalled admission still
+completes correctly through the double-gate re-check afterwards. The
+interleave-side coverage (torn quorum under every schedule) lives in
+``analysis/harnesses.py::TornQuorumHarness`` and rides
+``tool/check_races.py``.
+"""
+
+import threading
+import time
+
+import pytest
+
+from fisco_bcos_tpu.consensus.messages import PacketType, PBFTMessage
+from fisco_bcos_tpu.consensus.qc import (
+    derive_qc_keypair,
+    get_scheme,
+    qc_pub_for,
+    vote_preimage,
+)
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite
+from fisco_bcos_tpu.ledger import ConsensusNode, GenesisConfig
+from fisco_bcos_tpu.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol.block import Block
+from fisco_bcos_tpu.protocol.block_header import BlockHeader
+from fisco_bcos_tpu.txpool.quota import get_quotas
+
+SUITE = ecdsa_suite()
+BASE = 88_000
+
+
+@pytest.fixture(autouse=True)
+def _fresh_quotas():
+    get_quotas().reset()
+    yield
+    get_quotas().reset()
+
+
+def make_solo_victim(monkeypatch, n=4):
+    """One REAL node in an n-member QC committee; the other members exist
+    only as keypairs the test signs frames with. No gateway: broadcasts
+    drop, deliveries are handcrafted. Returns the node plus the committee
+    as (keypair, qc_secret) pairs in SEALER order (the config sorts
+    members by node_id, so construction order is not sealer order)."""
+    monkeypatch.setenv("FISCO_QC", "1")
+    monkeypatch.setenv("FISCO_QC_SCHEME", "ed25519")
+    keypairs = [
+        SUITE.signature_impl.generate_keypair(secret=BASE + i) for i in range(n)
+    ]
+    committee = [
+        ConsensusNode(kp.pub, weight=1, qc_pub=qc_pub_for(BASE + i, "ed25519"))
+        for i, kp in enumerate(keypairs)
+    ]
+    cfg = NodeConfig(genesis=GenesisConfig(consensus_nodes=list(committee)))
+    victim = Node(cfg, keypair=keypairs[0])
+    by_pub = {kp.pub: (kp, BASE + i) for i, kp in enumerate(keypairs)}
+    members = [by_pub[node.node_id] for node in victim.pbft_config.nodes]
+    return victim, members
+
+
+def _replica_heights(config, count=2):
+    """Heights this node does NOT lead (the pre-prepare must come from a
+    foreign leader). Acceptance only needs the waterline, not contiguity."""
+    my = config.my_index
+    picked = []
+    h = 1
+    while len(picked) < count:
+        if config.leader_index(h, 0) != my:
+            picked.append(h)
+        h += 1
+    return picked
+
+
+def _pre_prepare(number, config, members, view=0):
+    leader_kp, _ = members[config.leader_index(number, view)]
+    block = Block(header=BlockHeader(number=number))
+    msg = PBFTMessage(
+        packet_type=PacketType.PRE_PREPARE,
+        view=view,
+        number=number,
+        proposal_hash=block.header.hash(SUITE),
+        proposal_data=block.encode(),
+    )
+    msg.generated_from = config.leader_index(number, view)
+    msg.sign(SUITE, leader_kp)
+    return msg
+
+
+def _prepare(number, i, proposal_hash, members, view=0):
+    kp, qc_secret = members[i]
+    msg = PBFTMessage(
+        packet_type=PacketType.PREPARE,
+        view=view,
+        number=number,
+        proposal_hash=proposal_hash,
+    )
+    msg.generated_from = i
+    msg.sign(SUITE, kp)
+    msg.qc_sig = get_scheme("ed25519").sign_vote(
+        derive_qc_keypair(qc_secret, "ed25519"),
+        vote_preimage(SUITE, PacketType.PREPARE, view, number, proposal_hash),
+    )
+    return msg
+
+
+def test_slow_aggregate_check_never_parks_handle_message(monkeypatch):
+    victim, members = make_solo_victim(monkeypatch)
+    eng = victim.engine
+    cfg = victim.pbft_config
+    my = cfg.my_index
+    try:
+        h1, h2 = _replica_heights(cfg, 2)
+        voters = [i for i in range(len(members)) if i != my][:2]
+
+        pp1 = _pre_prepare(h1, cfg, members)
+        eng.handle_message(pp1)
+        cache = eng._caches[h1]
+        assert cache.pre_prepare is not None and my in cache.prepares
+        assert eng.qc is not None  # lazily built on the vote path
+
+        started, release = threading.Event(), threading.Event()
+        orig_admit = eng.qc.admit
+        stalls = []
+
+        def slow_admit(*a, **kw):
+            # stall exactly ONCE (the quorum admission under test); any
+            # re-verify triggered later must not re-block the test
+            if not stalls:
+                stalls.append(1)
+                started.set()
+                assert release.wait(10), "aggregate check never released"
+            return orig_admit(*a, **kw)
+
+        monkeypatch.setattr(eng.qc, "admit", slow_admit)
+
+        # background: the quorum-crossing PREPAREs — the deliverer's own
+        # dispatch exit runs the (stalled) aggregate check off-lock
+        def cross_quorum():
+            for i in voters:
+                eng.handle_message(_prepare(h1, i, pp1.proposal_hash, members))
+
+        bg = threading.Thread(target=cross_quorum, daemon=True)
+        bg.start()
+        assert started.wait(10), "aggregate check never started"
+
+        # the engine lock must be FREE while the pairing stalls: a
+        # duplicate pre-prepare and a fresh proposal at another height
+        # both need the lock and must return promptly
+        t0 = time.perf_counter()
+        eng.handle_message(pp1)  # duplicate: gate turns it away, no vote
+        eng.handle_message(_pre_prepare(h2, cfg, members))
+        elapsed = time.perf_counter() - t0
+        assert not release.is_set()
+        assert elapsed < 2.0, (
+            f"handle_message parked {elapsed:.1f}s behind the aggregate check"
+        )
+        assert my in eng._caches[h2].prepares  # h2 accepted + voted
+        assert not cache.prepared  # admission still pending
+
+        release.set()
+        bg.join(timeout=10)
+        assert not bg.is_alive()
+        # the stalled admission completed through the double-gate re-check
+        assert cache.prepared and cache.prepare_qc is not None
+        assert len(cache.prepare_qc.signers()) >= 3
+        assert my in cache.commits  # our COMMIT broadcast followed
+        assert not eng._verify_jobs and not eng._verify_keys
+    finally:
+        victim.stop()
+
+
+def test_concurrent_quorum_crossings_complete_once(monkeypatch):
+    """Racing deliveries of the quorum-crossing votes admit the prepare
+    phase exactly once (the double-gate re-check under the lock)."""
+    victim, members = make_solo_victim(monkeypatch)
+    eng = victim.engine
+    cfg = victim.pbft_config
+    my = cfg.my_index
+    try:
+        (h1,) = _replica_heights(cfg, 1)
+        pp = _pre_prepare(h1, cfg, members)
+        eng.handle_message(pp)
+        cache = eng._caches[h1]
+
+        completions = []
+        real_complete = eng._complete_prepared
+
+        def counting(number, c, agreeing, cert):
+            completions.append(number)
+            real_complete(number, c, agreeing, cert)
+
+        monkeypatch.setattr(eng, "_complete_prepared", counting)
+
+        votes = [
+            _prepare(h1, i, pp.proposal_hash, members)
+            for i in range(len(members))
+            if i != my
+        ]
+        barrier = threading.Barrier(len(votes))
+
+        def deliver(m):
+            barrier.wait(5)
+            eng.handle_message(m)
+
+        threads = [
+            threading.Thread(target=deliver, args=(m,), daemon=True)
+            for m in votes
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive()
+
+        assert completions == [h1], f"torn quorum: {completions}"
+        assert cache.prepared and cache.prepare_qc is not None
+        assert not eng._verify_jobs and not eng._verify_keys
+    finally:
+        victim.stop()

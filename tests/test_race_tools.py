@@ -203,7 +203,7 @@ def test_real_harnesses_registry_complete():
     assert set(HARNESSES) == {
         "device-plane", "proof-singleflight", "admission-quotas",
         "scheduler-commit", "pipelined-commit", "pipeline-obs",
-        "qc-collector", "fleet-obs",
+        "qc-collector", "fleet-obs", "torn-quorum",
     }
 
 

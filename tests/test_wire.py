@@ -1,0 +1,144 @@
+"""ISSUE 17: the byzantine catalog over real TCP sockets.
+
+Acceptance pins for the real-wire chaos mesh:
+
+- the full attack catalog detects 5/5 on a TcpGateway mesh, the offender
+  demoted on EVERY honest node via gossiped evidence (convergence
+  measured in rounds), audit_chain clean on the survivors;
+- partition/heal: the cut minority stalls, the majority keeps committing
+  through view changes past stranded leaders, laggards block-sync on
+  heal, the auditor passes end-to-end;
+- n=7, f=1 boundary: two COLLUDING adversaries (equivocation + forged QC
+  votes) cannot break agreement; demoting both never costs quorum
+  membership;
+- the scenario plane still detects with the observability planes off
+  (gossip + fleet disabled), losing only the committee-wide convergence.
+"""
+
+import pytest
+
+from fisco_bcos_tpu.consensus.audit import EVIDENCE
+from fisco_bcos_tpu.resilience import HEALTH
+from fisco_bcos_tpu.resilience.faults import clear_fault_plan
+from fisco_bcos_tpu.scenario.wire import (
+    WireHarness,
+    run_wire_catalog,
+    run_wire_colluders,
+    run_wire_partition,
+)
+from fisco_bcos_tpu.txpool.quota import get_quotas
+
+
+@pytest.fixture(autouse=True)
+def _fresh_boards():
+    get_quotas().reset()
+    HEALTH.reset()
+    EVIDENCE.reset()
+    clear_fault_plan()
+    yield
+    get_quotas().reset()
+    HEALTH.reset()
+    EVIDENCE.reset()
+    clear_fault_plan()
+
+
+def test_wire_mesh_boots_and_commits():
+    """A 4-node committee on real sockets commits clean blocks with zero
+    evidence and a green auditor — the byzantine-off passthrough."""
+    h = WireHarness(seed=0, hosts=4)
+    try:
+        for gw in h.gateways:
+            assert len(gw.peers()) == 3  # full mesh, live handshakes
+        assert h.commit_block(3)
+        assert h.commit_block(3)
+        assert h.height() == 2
+        assert EVIDENCE.count() == 0
+        assert h.audit()["ok"]
+    finally:
+        h.stop()
+
+
+def test_wire_equivocation_gossip_demotes_on_every_honest_node():
+    """One attack over TCP: every honest node ends with the offender in
+    its local confirmed set (own detection or re-verified gossip), and
+    the fleet document federates the convergence."""
+    h = WireHarness(seed=0, hosts=4)
+    try:
+        assert h.commit_block(2)
+        r = h.run_attack("equivocation")
+        assert r["detected"], r
+        offender = h.adversary.node.node_id
+        rounds = h.await_convergence(offender)
+        assert rounds >= 0, "gossip demotion did not converge"
+        conv = h.gossip_convergence(offender)
+        assert conv["all"], conv
+        assert h.adversary_demoted()
+        # federated view (PR 16 fleet endpoints): the merged document
+        # counts every reachable node as confirming this offender
+        fleet = h.honest[0].fleet
+        if fleet is not None:
+            doc = fleet.fleet_doc()
+            assert doc["gossip_convergence"].get(offender.hex()) == doc[
+                "reachable"
+            ], doc["gossip_convergence"]
+        assert h.commit_block(2)  # demotion never stalls the committee
+        h.catch_up()
+        assert h.audit()["ok"]
+    finally:
+        h.stop()
+
+
+def test_wire_catalog_all_attacks_detected():
+    doc = run_wire_catalog(seed=0)
+    assert doc["all_detected"], [
+        r for r in doc["attacks"] if not r["detected"]
+    ]
+    assert doc["gossip_converged"], doc["attacks"]
+    assert doc["convergence_rounds_max"] >= 0
+    assert doc["adversary_demoted"]
+    assert doc["audit"]["ok"], doc["audit"]
+    assert doc["honest_height"] > 0
+
+
+def test_wire_partition_heal_minority_resyncs():
+    doc = run_wire_partition(seed=0)
+    assert doc["majority_committed"] >= 1, doc
+    assert doc["minority_stalled"], doc
+    assert doc["resynced"], doc["heights"]
+    assert doc["post_heal_commit"], doc
+    assert doc["audit"]["ok"], doc["audit"]
+    assert len(set(doc["heights"])) == 1
+
+
+def test_wire_colluders_n7_cannot_break_agreement():
+    """The f=1 boundary with n=7: equivocation + forged QC votes from two
+    cooperating members. Agreement and liveness hold, both are demoted,
+    no honest member is ever struck, quorum membership survives."""
+    doc = run_wire_colluders(seed=0)
+    assert doc["all_detected"], doc["attacks"]
+    assert doc["both_demoted"], doc["demoted"]
+    assert doc["honest_undemoted"]
+    assert doc["liveness_after_demotion"]
+    assert doc["convergence_rounds"]["a"] >= 0
+    assert doc["convergence_rounds"]["b"] >= 0
+    assert doc["audit"]["ok"], doc["audit"]
+
+
+def test_wire_detection_survives_observability_off(monkeypatch):
+    """FISCO_EVIDENCE_GOSSIP=0 + FISCO_FLEET_OBS=0: detection and
+    demotion still work on the witnessing nodes — only the committee-wide
+    convergence plane is gone."""
+    monkeypatch.setenv("FISCO_EVIDENCE_GOSSIP", "0")
+    monkeypatch.setenv("FISCO_FLEET_OBS", "0")
+    h = WireHarness(seed=0, hosts=4)
+    try:
+        assert all(n.engine.gossip is None for n in h.nodes)
+        assert h.commit_block(2)
+        r = h.run_attack("equivocation")
+        assert r["detected"], r
+        assert h.adversary_demoted()
+        assert h.commit_block(2)
+        h.catch_up()
+        assert h.audit()["ok"]
+    finally:
+        h.stop()

@@ -408,6 +408,132 @@ def check_crash_flight() -> None:
             n.stop()
 
 
+def check_wire_mesh() -> None:
+    """The same forensics contract over REAL TCP sockets (ISSUE 17): a
+    5-node :class:`WireHarness` committee where one replica's monotonic
+    clock is skewed +250 ms — the clock probe must MEASURE that offset
+    over the wire — and one replica receives every PBFT frame ~20 ms
+    late while a fifth is partitioned off so the 4-of-5 quorum needs the
+    late votes. With the probed correction applied to the skewed
+    observer's ledger, the aligner must still name the true laggard
+    (20 ms real delay), not the node whose uncorrected timeline is off
+    by an order of magnitude more."""
+    import queue
+
+    from fisco_bcos_tpu.consensus.audit import EVIDENCE
+    from fisco_bcos_tpu.front import ModuleID
+    from fisco_bcos_tpu.resilience import HEALTH
+    from fisco_bcos_tpu.resilience.faults import clear_fault_plan
+    from fisco_bcos_tpu.scenario.wire import WireHarness
+    from fisco_bcos_tpu.txpool.quota import get_quotas
+
+    get_quotas().reset()
+    HEALTH.reset()
+    EVIDENCE.reset()
+    clear_fault_plan()
+    h = WireHarness(seed=0x17A, hosts=5)
+    try:
+        if not h.commit_block(4):
+            fail("wire mesh: warm block over TCP failed")
+        observer = h.nodes[0]
+        svc = observer.fleet
+        if svc is None:
+            fail("wire mesh: fleet service missing with FISCO_FLEET_OBS unset")
+
+        # leg A: nonzero measured offset correction over real sockets —
+        # skew one peer's roundlog clock by a known amount and require
+        # the midpoint-corrected probe to measure it through the RTT
+        skewed = h.nodes[1]
+        skew_s = 0.25
+        base_clock = skewed.engine.roundlog.clock
+        skewed.engine.roundlog.clock = lambda: base_clock() + skew_s
+        offset, rtt = svc.probe_offset(skewed.node_id)
+        if not (0.6 * skew_s < offset < 1.4 * skew_s):
+            fail(
+                f"wire mesh: probe measured {offset * 1e3:.1f} ms for an "
+                f"injected {skew_s * 1e3:.0f} ms skew (rtt {rtt * 1e3:.1f} ms)"
+            )
+
+        # leg B: straggler naming through the correction — partition one
+        # uninvolved replica off (4-of-5 quorum now NEEDS the laggard's
+        # votes; late votes for committed heights fall outside the
+        # waterline) and delay the laggard's PBFT delivery by ~20 ms
+        number = h.height() + 1
+        leader = h.leader_for(number)
+        pool = [n for n in h.nodes if n not in (leader, observer, skewed)]
+        lag, extra = pool[0], pool[1]
+        lag_index = next(
+            i for i, c in enumerate(observer.pbft_config.nodes)
+            if c.node_id == lag.node_id
+        )
+        plan = h.cut([extra])
+        frames: queue.Queue = queue.Queue()
+        orig_on_receive = lag.front.on_receive
+
+        def worker():
+            while True:
+                item = frames.get()
+                if item is None:
+                    return
+                time.sleep(0.02)
+                orig_on_receive(*item)
+
+        def tardy_on_receive(module_id, src, payload):
+            if int(module_id) == int(ModuleID.PBFT):
+                frames.put((module_id, src, payload))
+            else:
+                orig_on_receive(module_id, src, payload)
+
+        lag.front.on_receive = tardy_on_receive
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        alive = [n for n in h.nodes if n is not extra]
+        try:
+            if not h.commit_block_among(alive, n_txs=4):
+                fail("wire mesh: laggard round stalled over TCP")
+            height = max(n.block_number() for n in alive)
+        finally:
+            frames.put(None)
+            t.join(5.0)
+            del lag.front.on_receive  # restore the class method
+        h.heal(plan)
+        h.catch_up()
+
+        doc = svc.round_forensics(height)
+        if not doc.get("found"):
+            fail(f"wire mesh: round {height} not found in any ledger: {doc}")
+        aligned = doc["rounds"][0]
+        # the partitioned replica never saw the round — 4 observers min
+        if len(aligned["nodes"]) < 4:
+            fail(
+                f"wire mesh: round {height} aligned only "
+                f"{len(aligned['nodes'])} observers"
+            )
+        if aligned.get("straggler") != lag_index:
+            fail(
+                f"wire mesh: straggler not named over TCP: got "
+                f"{aligned.get('straggler')} "
+                f"(lateness {aligned.get('vote_lateness_ms')}), want "
+                f"laggard index {lag_index} — a miss here usually means "
+                f"the {skew_s * 1e3:.0f} ms clock skew leaked through the "
+                f"offset correction"
+            )
+        print(
+            f"ok: wire mesh — 5 nodes on TCP sockets, probe measured "
+            f"{offset * 1e3:.1f} ms of {skew_s * 1e3:.0f} ms injected skew "
+            f"(rtt {rtt * 1e3:.2f} ms), /round/{height} straggler=index "
+            f"{lag_index} (lateness "
+            f"{aligned['straggler_lateness_ms']:.1f} ms) despite the "
+            f"skewed observer"
+        )
+    finally:
+        h.stop()
+        get_quotas().reset()
+        HEALTH.reset()
+        EVIDENCE.reset()
+        clear_fault_plan()
+
+
 def check_obs_off() -> None:
     """FISCO_FLEET_OBS=0: no federation endpoint, the engine rides the
     noop ledger, and the chain still commits — zero-overhead off switch."""
@@ -448,6 +574,7 @@ def main() -> None:
     check_laggard_forensics(args.txs)
     check_byzantine_evidence()
     check_crash_flight()
+    check_wire_mesh()
     check_obs_off()
     print("check_fleet: all checks passed")
 

@@ -10,7 +10,8 @@
 - every registered REAL harness (``analysis/harnesses.py HARNESSES`` —
   DevicePlane coalescer, ProofPlane singleflight, AdmissionQuotas,
   scheduler commit markers, QC collector, pipeline observatory,
-  pipelined commit, fleet observatory) survives a seeded sweep
+  pipelined commit, fleet observatory, and the engine's off-lock QC
+  admission torn-quorum harness) survives a seeded sweep
   (default 256 seeds each; ``--seeds N`` to rescale).
 
 Usage::
