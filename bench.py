@@ -797,13 +797,36 @@ def bench_scenario(name: str) -> None:
             "scenario_proof_storm_flood_tps_ratio", ratio, "x-solo",
             ratio / 0.7, error=err,
         )
+        # ISSUE 18 succinct lanes: state membership proofs/sec off the
+        # StatePlane snapshot (zero tolerated verify failures) and the
+        # headers/sec of ONE aggregate multi-pairing admission vs the old
+        # per-header pairing loop (>= 1x acceptance: aggregation must not
+        # cost more than the loop it replaces)
+        state = doc.get("state_proofs") or {}
+        if state.get("proofs_served"):
+            _emit(
+                "scenario_proof_storm_state_proofs_per_s",
+                state["proofs_per_s"], "proof/s",
+                0.0 if state["verify_failures"] else 1.0, error=err,
+            )
+        sync = doc.get("header_sync") or {}
+        if sync.get("headers_per_s"):
+            _emit(
+                "scenario_proof_storm_sync_headers_per_s",
+                sync["headers_per_s"], "header/s",
+                sync["speedup_vs_per_header"], error=err,
+            )
         print(
             f"# proof-storm: {doc['proofs_served']} proofs to "
             f"{doc['queued_clients']} queued clients, "
             f"p95={doc['proof_batch_latency_ms_p95']}ms/batch, "
             f"steady {doc['proofs_per_s_steady']}/s vs direct "
             f"{doc['direct_baseline_proofs_per_s']}/s (speedup {speedup}x), "
-            f"verify_failures={doc['verify_failures']}",
+            f"verify_failures={doc['verify_failures']}, "
+            f"state {state.get('proofs_per_s', 0)}/s over "
+            f"{state.get('committed_keys', 0)} keys, header sync "
+            f"{sync.get('headers_per_s', 0)}/s aggregate "
+            f"({sync.get('speedup_vs_per_header', 0)}x vs per-header)",
             flush=True,
         )
         group_docs = {}

@@ -27,7 +27,7 @@ from ..core import Checker, Finding, Source, qualnames
 # device-kernel modules: importing these implies device dispatch
 DEVICE_MODULES = {
     "keccak", "sha256", "sm3", "sm2", "secp256k1", "ed25519",
-    "merkle", "address", "pallas_ec", "bls12_381",
+    "merkle", "address", "pallas_ec", "bls12_381", "poseidon",
 }
 # names importable from device modules that are host-side only
 HOST_SAFE_NAMES = {

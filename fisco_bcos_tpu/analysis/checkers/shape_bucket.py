@@ -12,7 +12,9 @@ Rule: a function that (a) calls a name from the package-wide jit inventory
 length (``np.zeros``/``np.array``/``jnp.asarray``/... or ``len()``) must
 (c) also call one of the bucketing/padding helpers (``bucket_batch``,
 ``_bucket``, ``bucket_leaves``, ``bucket_ladder``, ``pad_rows``,
-``pad_keccak``, ``pad_md64``) somewhere in its body. Functions that merely
+``pad_keccak``, ``pad_md64``, ``multi_pairing_pad`` — the last is the
+pairing product's power-of-two lane ladder, log₂-many shapes rather than
+the hash bucket ladder) somewhere in its body. Functions that merely
 pass through already-padded tensors (no array construction) are exempt —
 the shape decision was made upstream where the rule already applied.
 """
@@ -26,7 +28,7 @@ from ..core import Checker, Finding, Source, qualnames
 
 BUCKET_HELPERS = {
     "bucket_batch", "_bucket", "bucket_leaves", "bucket_ladder",
-    "pad_rows", "pad_keccak", "pad_md64",
+    "pad_rows", "pad_keccak", "pad_md64", "multi_pairing_pad",
 }
 ARRAY_BUILDERS = {
     "zeros", "empty", "ones", "full", "array", "asarray", "frombuffer",

@@ -79,6 +79,68 @@ class BlockValidator:
             return False
         return True
 
+    def qc_check_inputs(
+        self, header: BlockHeader, nodes: list[ConsensusNode]
+    ) -> tuple[tuple[bytes, ...], bytes, bytes] | None:
+        """Everything :meth:`check_block` checks EXCEPT the pairing, for
+        callers that fold many headers' pairings into one aggregate program
+        (succinct header sync).
+
+        Returns ``(signer qc_pubs, header hash, agg_sig)`` — the triple a
+        BLS aggregate check consumes — when the header is aggregatable;
+        ``None`` when it simply is not (genesis, signature-list headers,
+        non-BLS QC schemes — the caller falls back to
+        :meth:`check_block`); raises ``ValueError`` when a structural check
+        FAILS outright (the header is definitively invalid, no fallback
+        will save it)."""
+        from .qc import QuorumCert
+
+        if header.number == 0 or not header.qc:
+            return None
+        sealers = sorted(
+            (n for n in nodes if n.node_type == "consensus_sealer"),
+            key=lambda n: n.node_id,
+        )
+        if header.sealer_list != [n.node_id for n in sealers]:
+            raise ValueError(f"block {header.number}: sealer list mismatch")
+        if header.consensus_weights != [n.weight for n in sealers]:
+            raise ValueError(f"block {header.number}: weight list mismatch")
+        try:
+            cert = QuorumCert.decode(header.qc)
+        except ValueError as e:
+            raise ValueError(
+                f"block {header.number}: undecodable QC record: {e}"
+            ) from None
+        if cert.scheme != "bls":
+            return None  # ed25519 certs have no shared pairing structure
+        if cert.committee != len(sealers):
+            raise ValueError(
+                f"block {header.number}: QC committee size mismatch"
+            )
+        idxs = cert.signers()
+        if not idxs:
+            raise ValueError(f"block {header.number}: QC names no signers")
+        if len(cert.agg_sig) != 96:
+            raise ValueError(f"block {header.number}: malformed BLS agg sig")
+        qc_pubs = [n.qc_pub for n in sealers]
+        if any(not qc_pubs[i] for i in idxs):
+            raise ValueError(
+                f"block {header.number}: QC claims a signer with no "
+                "registered qc_pub"
+            )
+        quorum = min_quorum(sum(n.weight for n in sealers))
+        weight = sum(sealers[i].weight for i in idxs)
+        if weight < quorum:
+            raise ValueError(
+                f"block {header.number}: QC weight {weight} below quorum "
+                f"{quorum}"
+            )
+        return (
+            tuple(qc_pubs[i] for i in idxs),
+            header.hash(self.suite),
+            cert.agg_sig,
+        )
+
     def _check_qc(self, header: BlockHeader, sealers: list[ConsensusNode]) -> bool:
         """Aggregate-certificate header validation: ONE verification for
         the whole quorum instead of n per-sealer checks — block-sync and

@@ -196,6 +196,84 @@ class BLSCrypto(SignatureCrypto):
             return bls_ops.pairing_check_batch(triples)
 
 
+    # -- succinct header sync (the multi-pairing surface) -------------------
+
+    def multi_pairing_verify(self, checks) -> bool:
+        """ONE accept/reject for a whole set of aggregate checks.
+
+        ``checks`` is the same ``[(pubs tuple, msg_hash, agg_sig)]`` shape as
+        :meth:`aggregate_verify_batch`, but instead of K independent pairing
+        checks the set folds into a single K+1-pair product via a
+        Fiat-Shamir random linear combination: scalars ``r_k`` are drawn
+        from a hash transcript over every ``(msg, sig)`` AFTER all of them
+        are fixed, and
+
+            e(-G1, sum_k r_k*sig_k) * prod_k e(r_k*apk_k, Hm_k) == 1
+
+        holds for random r_k iff every per-check equation holds (soundness
+        error ~2^-128 — an adversary would have to predict the transcript).
+        The succinct header-sync payoff: K header QCs cost ONE shared
+        squaring chain in the Miller stage and ONE final exponentiation
+        instead of K full pairing checks. Callers that need to know WHICH
+        check failed fall back to :meth:`aggregate_verify_batch`.
+        """
+        import hashlib
+
+        checks = [
+            (tuple(bytes(p) for p in pubs), bytes(m), bytes(s))
+            for pubs, m, s in checks
+        ]
+        if not checks:
+            return True
+        triples = []
+        for pubs, msg, agg in checks:
+            apk = _apk_point(pubs) if pubs else None
+            sig = _g2_point(agg)
+            if apk is None or sig is None:
+                return False
+            triples.append((apk, sig, ref.hash_to_g2(msg)))
+        # transcript binds every message and signature before any scalar
+        # is drawn — the Fiat-Shamir ordering that makes the RLC sound
+        tr = hashlib.sha256()
+        for (_, msg, agg) in checks:
+            tr.update(len(msg).to_bytes(4, "big"))
+            tr.update(msg)
+            tr.update(agg)
+        seed = tr.digest()
+        scalars = [
+            max(
+                1,
+                int.from_bytes(
+                    hashlib.sha256(seed + k.to_bytes(8, "big")).digest()[:16],
+                    "big",
+                ),
+            )
+            for k in range(len(triples))
+        ]
+        sig_acc = None
+        pairs = []
+        for r, (apk, sig, hm) in zip(scalars, triples):
+            sig_acc = ref.ec_add(
+                sig_acc, ref.ec_mul(sig, r, ref.FP2_OPS), ref.FP2_OPS
+            )
+            pairs.append((ref.ec_mul(apk, r, ref.FP_OPS), hm))
+        pairs.insert(0, (ref.ec_neg(ref.G1, ref.FP_OPS), sig_acc))
+
+        from ..observability.device import device_span
+        from ..ops import bls12_381 as bls_ops
+        from .suite import _note_dispatch_path
+
+        n = len(pairs)
+        if use_native_batch(n):
+            _note_dispatch_path("bls_multi_pairing", "native")
+            return bool(bls_ops.host_multi_pairing_check(pairs))
+        _note_dispatch_path("bls_multi_pairing", "device")
+        with device_span(
+            "bls_multi_pairing", n, shape_key=bls_ops.multi_pairing_pad(n)
+        ):
+            return bool(bls_ops.multi_pairing_check(pairs))
+
+
 def bls_suite():
     """Keccak256 + BLS12-381 — the aggregate-QC suite, registered beside
     ecdsa_suite/sm_suite (reference: the ProtocolInitializer suite choice)."""

@@ -182,6 +182,49 @@ class Sha256(HashImpl):
         return sha256_ops.sha256_batch_async(msgs)
 
 
+class Poseidon(HashImpl):
+    """SNARK-friendly hash lane (ISSUE 18): the succinct state plane's
+    selectable commitment hasher. Single-item path is the pure-Python
+    reference (no native core exists); batch path is the jitted sponge.
+    Imports are lazy — deriving the Grain/Cauchy constant tables costs
+    ~0.2 s and only nodes running `FISCO_STATE_HASH=poseidon` pay it."""
+
+    name = "poseidon"
+
+    def hash(self, data: bytes) -> bytes:
+        from .ref.poseidon import poseidon_hash
+
+        return poseidon_hash(data)
+
+    def _batch_direct(self, msgs) -> np.ndarray:
+        from ..ops import poseidon as poseidon_ops
+
+        return poseidon_ops.poseidon_batch(msgs)
+
+    def _batch_async_direct(self, msgs):
+        from ..ops import poseidon as poseidon_ops
+
+        return poseidon_ops.poseidon_batch_async(msgs)
+
+
+_HASH_IMPLS: dict[str, type[HashImpl]] = {
+    "keccak256": Keccak256,
+    "sm3": SM3,
+    "sha256": Sha256,
+    "poseidon": Poseidon,
+}
+
+
+def hash_impl_by_name(name: str) -> HashImpl:
+    """Hash impl registry lookup (`FISCO_STATE_HASH` selection seam). An
+    unknown name raises — one node silently falling back to a different
+    hasher than its peers would fork the state commitment."""
+    try:
+        return _HASH_IMPLS[name]()
+    except KeyError:
+        raise KeyError(f"unknown hash impl: {name!r}") from None
+
+
 # ---------------------------------------------------------------------------
 # Key pairs
 # ---------------------------------------------------------------------------

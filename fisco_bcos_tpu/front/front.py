@@ -43,6 +43,9 @@ class ModuleID(IntEnum):
     # byzantine-evidence gossip (ISSUE 17): signed, self-attributing
     # evidence records re-broadcast so demotion converges committee-wide
     EVIDENCE_GOSSIP = 4008
+    # batched state-membership proofs (ISSUE 18 succinct plane): N
+    # (table, key) proofs against one height's header state commitment
+    LIGHTNODE_GET_STATE_PROOFS = 4009
     SYNC_PUSH_TRANSACTION = 5000
 
 # callback(from_node_id: bytes, payload: bytes) -> None

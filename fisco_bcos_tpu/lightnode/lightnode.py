@@ -45,6 +45,7 @@ _REQ_MODULES = (
     ModuleID.LIGHTNODE_SEND_TRANSACTION,
     ModuleID.LIGHTNODE_CALL,
     ModuleID.LIGHTNODE_GET_PROOFS,
+    ModuleID.LIGHTNODE_GET_STATE_PROOFS,
 )
 
 
@@ -170,6 +171,40 @@ class LightNodeService:
                     _write_proof(pw, (items, idx, count))
                 entries.append(pw.out())
             w.seq(entries, lambda w2, b: w2.bytes_(b))
+        elif module == ModuleID.LIGHTNODE_GET_STATE_PROOFS:
+            # state-membership proof frame (ISSUE 18): u8 has_number (+u64
+            # height — 0 = committed head) + N (table, key) pairs in; per
+            # pair out: u8 found + the two chained proofs + the row bytes
+            # the client re-hashes into the leaf. Served from the node's
+            # StatePlane (frozen per-height snapshots) — absent plane or
+            # height yields per-entry not-found, never a protocol error.
+            number = r.u64() if r.u8() else None
+            reqs = r.seq(lambda r2: (r2.str_(), r2.bytes_()))
+            r.done()
+            from ..succinct import MAX_STATE_PROOF_BATCH
+
+            if len(reqs) > MAX_STATE_PROOF_BATCH:
+                # shared cap with the tx/receipt proof frame — same
+                # one-client-buys-a-storm reasoning
+                raise ValueError(
+                    f"state proof batch over {MAX_STATE_PROOF_BATCH} keys"
+                )
+            plane = getattr(node, "state_plane", None)
+            results = (
+                plane.state_proof_batch(reqs, number)
+                if plane is not None
+                else [None] * len(reqs)
+            )
+            entries = []
+            for res in results:
+                pw = FlatWriter()
+                if res is None:
+                    pw.u8(0)
+                else:
+                    pw.u8(1)
+                    _write_state_proof(pw, res)
+                entries.append(pw.out())
+            w.seq(entries, lambda w2, b: w2.bytes_(b))
         else:
             raise ValueError(f"unknown lightnode module {module}")
 
@@ -204,6 +239,52 @@ def _read_proof(r: FlatReader):
     return items, idx, count
 
 
+def _write_items(w: FlatWriter, items) -> None:
+    w.seq(
+        list(items),
+        lambda w2, it: (
+            w2.seq(list(it.group), lambda w3, g: w3.fixed(g, 32)),
+            w2.u64(it.index),
+        ),
+    )
+
+
+def _read_items(r: FlatReader) -> list[MerkleProofItem]:
+    return r.seq(
+        lambda r2: MerkleProofItem(
+            group=tuple(r2.seq(lambda r3: r3.fixed(32))), index=r2.u64()
+        )
+    )
+
+
+def _write_state_proof(w: FlatWriter, res) -> None:
+    w.u64(res.number)
+    w.u64(res.page)
+    w.u64(res.n_pages)
+    w.u64(res.leaf_index)
+    w.u64(res.n_leaves)
+    w.bytes_(res.entry_bytes)
+    _write_items(w, res.page_items)
+    _write_items(w, res.top_items)
+    w.fixed(res.commitment, 32)
+
+
+def _read_state_proof(r: FlatReader):
+    from ..succinct import StateProofResult
+
+    return StateProofResult(
+        number=r.u64(),
+        page=r.u64(),
+        n_pages=r.u64(),
+        leaf_index=r.u64(),
+        n_leaves=r.u64(),
+        entry_bytes=r.bytes_(),
+        page_items=_read_items(r),
+        top_items=_read_items(r),
+        commitment=r.fixed(32),
+    )
+
+
 def _proof_batch(node, hashes: list[bytes], kind: str):
     """Serve N proofs through the node's ProofPlane (one tree per height);
     per-hash direct rebuilds only when the plane is disabled."""
@@ -224,6 +305,11 @@ class LightNode:
         self.suite = suite
         self.validator = BlockValidator(suite)
         self.committee = list(genesis_committee)
+        from ..succinct import HeaderRangeAccumulator
+
+        # running commitment over every verified header range (two clients
+        # compare one digest to agree on what they verified)
+        self.accumulator = HeaderRangeAccumulator(suite)
         self.headers: dict[int, BlockHeader] = {}
         self.head = 0
         self._pending: dict[int, Any] = {}
@@ -273,42 +359,129 @@ class LightNode:
         r.done()
         return n
 
-    def sync_headers(self, to: int | None = None) -> int:
-        """Verify + adopt headers (head, to]; returns the new head."""
-        target = self.remote_head() if to is None else to
-        for n in range(self.head + 1, target + 1):
-            r = self._request(
-                ModuleID.LIGHTNODE_GET_BLOCK,
-                lambda w, n=n: (w.u64(n), w.u8(0)),
+    def _fetch_header(self, n: int) -> BlockHeader:
+        """Fetch header ``n`` and chain-check it against what we hold (or
+        this sync pass's tail) — linkage is the cheap host-side admission;
+        signatures are bought in bulk by the aggregate check."""
+        r = self._request(
+            ModuleID.LIGHTNODE_GET_BLOCK,
+            lambda w: (w.u64(n), w.u8(0)),
+        )
+        blk = Block.decode(r.bytes_())
+        r.done()
+        header = blk.header
+        if header.number != n:
+            raise ValueError(
+                f"full node returned header {header.number} != {n}"
             )
-            blk = Block.decode(r.bytes_())
-            r.done()
-            header = blk.header
-            if header.number != n:
-                raise ValueError(f"full node returned header {header.number} != {n}")
-            if n > 1 and header.parent_info:
-                parent = self.headers.get(n - 1)
-                if parent is not None and header.parent_info[0].hash != parent.hash(
-                    self.suite
-                ):
-                    raise ValueError(f"header {n} breaks the hash chain")
-            if not self.validator.check_block(header, self.committee):
-                raise ValueError(f"header {n} fails QC verification")
-            self.headers[n] = header
-            self.head = n
-            # committee handoff: the verified header defines the next epoch.
-            # QC pubkeys carry forward by node_id — headers name sealers,
-            # not their QC keys, so a member NEW to the committee joins
-            # without one and the validator falls back to requiring a
-            # signature_list for subsequent headers (documented limitation:
-            # QC-chain committee additions need out-of-band qc_pub
-            # distribution to light clients, docs/consensus_qc.md)
-            known_qc = {c.node_id: c.qc_pub for c in self.committee}
-            weights = header.consensus_weights or [1] * len(header.sealer_list)
-            self.committee = [
-                ConsensusNode(nid, weight=wt, qc_pub=known_qc.get(nid, b""))
-                for nid, wt in zip(header.sealer_list, weights)
-            ]
+        if n > 1 and header.parent_info:
+            parent = self.headers.get(n - 1)
+            if parent is not None and header.parent_info[0].hash != parent.hash(
+                self.suite
+            ):
+                raise ValueError(f"header {n} breaks the hash chain")
+        return header
+
+    def _adopt(self, header: BlockHeader) -> None:
+        """Adopt a VERIFIED header: advance head, hand the committee off.
+        QC pubkeys carry forward by node_id — headers name sealers, not
+        their QC keys, so a member NEW to the committee joins without one
+        and the validator falls back to requiring a signature_list for
+        subsequent headers (documented limitation: QC-chain committee
+        additions need out-of-band qc_pub distribution to light clients,
+        docs/consensus_qc.md)."""
+        self.headers[header.number] = header
+        self.head = header.number
+        known_qc = {c.node_id: c.qc_pub for c in self.committee}
+        weights = header.consensus_weights or [1] * len(header.sealer_list)
+        self.committee = [
+            ConsensusNode(nid, weight=wt, qc_pub=known_qc.get(nid, b""))
+            for nid, wt in zip(header.sealer_list, weights)
+        ]
+
+    def sync_headers(self, to: int | None = None, batch: int | None = None) -> int:
+        """Verify + adopt headers (head, to]; returns the new head.
+
+        Succinct sync (ISSUE 18): headers are admitted in CHUNKS — up to
+        ``batch`` (``FISCO_SYNC_HEADER_BATCH``, default 64) chain-linked
+        headers fold into ONE multi-pairing aggregate verification
+        (:func:`fisco_bcos_tpu.succinct.sync.verify_header_batch`) instead
+        of one pairing check each. A chunk breaks early on a sealer-list
+        change (each epoch verifies against its own committee). Chunks the
+        aggregate rejects — and non-aggregatable ones (signature-list mode,
+        ed25519 certs) — fall back to the per-header ``check_block`` walk,
+        which names the culprit. Every adopted range folds into
+        ``self.accumulator``, the client's running commitment over what it
+        verified."""
+        import os
+
+        from ..succinct.sync import verify_header_batch
+
+        target = self.remote_head() if to is None else to
+        if batch is None:
+            try:
+                batch = int(os.environ.get("FISCO_SYNC_HEADER_BATCH", "64"))
+            except ValueError:
+                batch = 64
+        batch = max(1, batch)
+        carry: BlockHeader | None = None
+        n = self.head + 1
+        while n <= target:
+            chunk: list[BlockHeader] = []
+            if carry is not None:
+                chunk.append(carry)
+                carry = None
+            while len(chunk) < batch and n + len(chunk) <= target:
+                header = self._fetch_header(n + len(chunk))
+                if chunk and header.sealer_list != chunk[0].sealer_list:
+                    carry = header  # next epoch starts the next chunk
+                    break
+                chunk.append(header)
+            # a carried header was fetched before its parent was adopted —
+            # re-run the linkage check now that the parent is in hand
+            first = chunk[0]
+            parent = self.headers.get(first.number - 1)
+            if (
+                first.number > 1
+                and first.parent_info
+                and parent is not None
+                and first.parent_info[0].hash != parent.hash(self.suite)
+            ):
+                raise ValueError(
+                    f"header {first.number} breaks the hash chain"
+                )
+            for k in range(1, len(chunk)):
+                if chunk[k].parent_info and chunk[k].parent_info[0].hash != chunk[
+                    k - 1
+                ].hash(self.suite):
+                    raise ValueError(
+                        f"header {chunk[k].number} breaks the hash chain"
+                    )
+            ok = verify_header_batch(chunk, self.committee, self.validator)
+            if ok:
+                for header in chunk:
+                    self._adopt(header)
+            else:
+                if ok is False:
+                    _log.warning(
+                        "aggregate header verification rejected blocks "
+                        "%d..%d: re-verifying individually",
+                        chunk[0].number, chunk[-1].number,
+                    )
+                # per-header fallback: non-aggregatable chunks, and naming
+                # the culprit inside a rejected aggregate
+                for header in chunk:
+                    if not self.validator.check_block(header, self.committee):
+                        raise ValueError(
+                            f"header {header.number} fails QC verification"
+                        )
+                    self._adopt(header)
+            self.accumulator.fold(
+                chunk[0].number,
+                chunk[-1].number,
+                chunk[-1].hash(self.suite),
+            )
+            n = chunk[-1].number + 1
         return self.head
 
     # -- verified reads (LightNodeRPC.h) --------------------------------------
@@ -423,6 +596,77 @@ class LightNode:
                     "verified root"
                 )
             out[h] = (number, rc)
+        return out
+
+    def get_state_proofs(
+        self,
+        reqs: list[tuple[str, bytes]],
+        number: int | None = None,
+    ) -> dict[tuple[str, bytes], tuple]:
+        """N state-membership proofs in ONE round trip
+        (LIGHTNODE_GET_STATE_PROOFS), each verified against the
+        ``state_commitment`` of a locally-synced, QC-verified header.
+
+        Returns ``(table, key) -> (block_number, entry_bytes)`` for every
+        row the full node proved; keys the node reported not-found are
+        simply absent (the fixed-page commitment carries no absence
+        proofs). Raises ``ValueError`` on ANY proof that fails
+        verification, references an unsynced header, or lands on a header
+        that carries no commitment — a partially-lying full node taints
+        the whole batch, exactly like :meth:`get_proof_batch`."""
+        from ..succinct import (
+            MAX_STATE_PROOF_BATCH,
+            state_hash_name,
+            state_pages,
+            verify_state_proof,
+        )
+
+        reqs = [(t, bytes(k)) for t, k in reqs]
+        if len(reqs) > MAX_STATE_PROOF_BATCH:
+            # fail fast: the server rejects oversize batches without a
+            # response frame, which would surface here as a blind timeout
+            raise ValueError(
+                f"state proof batch over {MAX_STATE_PROOF_BATCH} keys"
+            )
+        r = self._request(
+            ModuleID.LIGHTNODE_GET_STATE_PROOFS,
+            lambda w: (
+                w.u8(0 if number is None else 1),
+                w.u64(number) if number is not None else None,
+                w.seq(reqs, lambda w2, tk: (w2.str_(tk[0]), w2.bytes_(tk[1]))),
+            ),
+        )
+        entries = r.seq(lambda r2: r2.bytes_())
+        r.done()
+        if len(entries) != len(reqs):
+            raise ValueError("full node answered a different batch size")
+        hasher, n_pages = state_hash_name(), state_pages()
+        out: dict[tuple[str, bytes], tuple] = {}
+        for (table, key), raw in zip(reqs, entries):
+            pr = FlatReader(raw)
+            if not pr.u8():
+                pr.done()
+                continue  # not found on the full node
+            res = _read_state_proof(pr)
+            pr.done()
+            header = self.headers.get(res.number)
+            if header is None:
+                raise ValueError(
+                    f"state proof references unsynced header {res.number}"
+                )
+            if not header.state_commitment:
+                raise ValueError(
+                    f"header {res.number} carries no state commitment"
+                )
+            if not verify_state_proof(
+                table, key, res, header.state_commitment,
+                hasher=hasher, n_pages=n_pages,
+            ):
+                raise ValueError(
+                    f"state proof for {table}:{key.hex()[:16]} fails "
+                    "against the verified commitment"
+                )
+            out[(table, key)] = (res.number, res.entry_bytes)
         return out
 
     def send_transaction(self, tx: Transaction) -> tuple[int, bytes]:

@@ -219,6 +219,28 @@ class Node:
             if hasattr(raw_storage, "on_rollback"):
                 raw_storage.on_rollback.append(self.proof_plane.on_rolled_back)
             HEALTH.ok("proof-plane", "frozen-tree proof cache up")
+        # succinct state plane (succinct/state_plane.py): incremental merkle
+        # commitment over the whole KeyPage state, carried in the header and
+        # served as membership proofs. FISCO_STATE_PROOF=0 (default) creates
+        # nothing — headers stay byte-identical to the pre-succinct build.
+        from ..succinct import state_proof_enabled
+
+        self.state_plane = None
+        if state_proof_enabled():
+            from ..succinct import StatePlane
+
+            self.state_plane = StatePlane(
+                self.ledger, self.suite, backend=raw_storage
+            )
+            self.scheduler.state_plane = self.state_plane
+            self.ledger.state_plane = self.state_plane
+            if hasattr(raw_storage, "on_rollback"):
+                raw_storage.on_rollback.append(self.state_plane.on_rolled_back)
+            HEALTH.ok(
+                "state-plane",
+                f"state commitments up (hasher={self.state_plane.hasher}, "
+                f"pages={self.state_plane.n_pages})",
+            )
         # storage failover seam (Initializer.cpp:225-235): backend loss
         # drops the in-flight scheduler term instead of wedging consensus
         # (and clears the proof cache — the recovered backend may disagree
@@ -229,6 +251,8 @@ class Node:
                 self.scheduler.switch_term()
                 if self.proof_plane is not None:
                     self.proof_plane.on_failover()
+                if self.state_plane is not None:
+                    self.state_plane.on_failover()
 
             raw_storage.set_switch_handler(_on_storage_switch)
         # injected front = multi-group hosting (gateway/group.py GroupGateway

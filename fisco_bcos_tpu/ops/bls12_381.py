@@ -527,6 +527,31 @@ def _miller2(p1, q1, p2, q2):
     return f12_frob(carry[0], 6)  # x < 0 -> conjugate
 
 
+def _miller1(p, q):
+    """f_{|x|}(P, Q), conjugated for the negative parameter — the single-
+    pair Miller loop the multi-pairing product program is built from
+    (same dbl/add steps as :func:`_miller2`, one accumulator per lane)."""
+    like = p[0]
+    one = f12_one(like)
+
+    def body(carry, bit):
+        f, t = carry
+        f = f12_sqr(f)
+        tn, l = _dbl_step(t, p[0], p[1])
+        f = f12_mul_line(f, *l)
+        ta, la = _add_step(tn, q, p[0], p[1])
+        f_add = f12_mul_line(f, *la)
+        take = bit == 1
+        f = select(take, f_add, f)
+        t = select(take, ta, tn)
+        return (f, t), None
+
+    carry, _ = lax.scan(
+        body, (one, (q[0], q[1], f2_one(like))), limb.dev_vec(_X_ABS_BITS)
+    )
+    return f12_frob(carry[0], 6)  # x < 0 -> conjugate
+
+
 def _final_exp(f):
     """Easy part (p^6-1)(p^2+1) then the hard part as square-and-multiply
     over the static bits of 3(p^4-p^2+1)/r — compile-lean (one small scan
@@ -568,6 +593,32 @@ def _pairing_check_xla(apk_x, apk_y, sx0, sx1, sy0, sy1, hx0, hx1, hy0, hy1):
         apk_x.T, apk_y.T, sx0.T, sx1.T, sy0.T, sy1.T,
         hx0.T, hx1.T, hy0.T, hy1.T,
     )
+
+
+@jax.jit
+def _multi_pairing_xla(px, py, qx0, qx1, qy0, qy1, valid):
+    """ok[1] for ∏_i e(P_i, Q_i) == 1 over [B, 24] Montgomery limb inputs
+    (B a power of two; P_i in G1, Q_i affine Fp2 on the twist).
+
+    B lane-parallel Miller loops, then a log₂-depth ``f12_mul`` halving
+    tree over the lane axis, then ONE final exponentiation — K pairs cost
+    K/lanes of a Miller loop plus a single hard part, which is where the
+    constant-work header sync gets its per-device speedup.
+
+    ``valid`` is a DEVICE argument, not host-side post-masking: an invalid
+    or padding lane multiplies into the product, so it must become the
+    Fp12 identity before the tree — a host mask after the fact could not
+    undo its contribution."""
+    f = _miller1((px.T, py.T), ((qx0.T, qx1.T), (qy0.T, qy1.T)))
+    f = select(valid, f, f12_one(px.T))
+    n = px.shape[0]
+    while n > 1:
+        half = n // 2
+        lo = jax.tree_util.tree_map(lambda x: x[:, :half], f)
+        hi = jax.tree_util.tree_map(lambda x: x[:, half:], f)
+        f = f12_mul(lo, hi)
+        n = half
+    return f12_eq_one(_final_exp(f))
 
 
 # ---------------------------------------------------------------------------
@@ -635,6 +686,57 @@ def host_pairing_check_batch(checks) -> np.ndarray:
             [(ref.ec_neg(ref.G1, ref.FP_OPS), sig), (apk, hm)]
         )
     return out
+
+
+# non-verifying substitute pair for multi-pairing padding lanes: e(G1, G2)
+# != 1, so even a masking bug cannot make a padding lane contribute the
+# identity — it would flip the product to a REJECT, never an accept
+_SUB_PAIR = (ref.G1, ref.G2)
+
+
+def multi_pairing_pad(n: int) -> int:
+    """Lane count the multi-pairing program pads an n-pair product to: the
+    next power of two (the halving tree's shape), min 1 — the compiled-
+    shape ladder is the log₂ sequence, not the batch bucket ladder."""
+    b = 1
+    while b < max(n, 1):
+        b *= 2
+    return b
+
+
+def multi_pairing_check(pairs) -> bool:
+    """True iff ∏ e(P_i, Q_i) == 1 for a list of (g1_pt, g2_pt) affine
+    reference points. One jitted device program: lane-parallel Miller
+    loops, an on-device product tree, ONE final exponentiation. ``None``
+    members make their pair an identity contribution — the
+    :func:`ref.pairing_check` convention."""
+    if not pairs:
+        return True
+    bb = multi_pairing_pad(len(pairs))
+    cols: list[list[int]] = [[] for _ in range(6)]
+    valid = np.zeros(bb, dtype=bool)
+    for i in range(bb):
+        if (
+            i < len(pairs)
+            and pairs[i][0] is not None
+            and pairs[i][1] is not None
+        ):
+            p, q = pairs[i]
+            valid[i] = True
+        else:
+            p, q = _SUB_PAIR
+        vals = [p[0], p[1], q[0][0], q[0][1], q[1][0], q[1][1]]
+        for c, v in zip(cols, vals):
+            c.append(v)
+    arrays = [_mont_col(c) for c in cols]
+    ok = np.asarray(_multi_pairing_xla(*arrays, jnp.asarray(valid)))
+    return bool(ok[0])
+
+
+def host_multi_pairing_check(pairs) -> bool:
+    """Bit-identical host fallback: ONE reference Miller product + ONE
+    final exponentiation (ref.pairing_check over the same pair list)."""
+    return ref.pairing_check(list(pairs))
 
 
 def hash_to_g2(msg: bytes):

@@ -38,10 +38,19 @@ HashBatchFn = Callable[[Sequence[bytes]], np.ndarray]
 # merkle_tree executor) — a nested per-level hash span would book the same
 # wall twice and misfile a cold hash-program compile as merkle execute
 # remainder (same reasoning as sm2_e_batch)
+def _poseidon_batch(msgs: Sequence[bytes]) -> np.ndarray:
+    # lazy: deriving the Grain/Cauchy constant tables costs ~0.2 s at
+    # ops.poseidon import, and only the succinct state plane pays it
+    from .poseidon import poseidon_batch_async
+
+    return poseidon_batch_async(msgs)()
+
+
 _HASHERS: dict[str, HashBatchFn] = {
     "keccak256": lambda msgs: keccak256_batch_async(msgs)(),
     "sm3": lambda msgs: sm3_batch_async(msgs)(),
     "sha256": lambda msgs: sha256_batch_async(msgs)(),
+    "poseidon": _poseidon_batch,
 }
 
 
@@ -64,6 +73,12 @@ def _host_hash(hasher: str, data: bytes) -> bytes:
         from ..crypto.ref.sm3 import sm3 as ref
 
         return native_bind.sm3(data) or ref(data)
+    if hasher == "poseidon":
+        # no native core: the pure-Python reference IS the host path (bit-
+        # identical to the jitted sponge by the ops/poseidon.py import pin)
+        from ..crypto.ref.poseidon import poseidon_hash as ref_poseidon
+
+        return ref_poseidon(data)
     from ..crypto.ref.sha2 import sha256 as ref
 
     return native_bind.sha256(data) or ref(data)

@@ -1,0 +1,199 @@
+"""Batch Poseidon on TPU — the SNARK-friendly hash lane.
+
+Same shape discipline as :mod:`fisco_bcos_tpu.ops.keccak`: the host pads a
+whole batch into a dense bucketed block tensor plus per-lane block counts,
+and ONE jitted program sponges every lane in parallel — the permutation is a
+``lax.scan`` over the 65 rounds, multi-block messages scan over block slots
+with per-lane masking.
+
+Field arithmetic rides :mod:`fisco_bcos_tpu.ops.limb`'s ``MontField`` (BN254
+scalar field < 2^256, so the 16×16-bit limb machinery applies unchanged);
+state words live in the Montgomery domain end to end — the host encodes
+absorbed chunks once and decodes the single squeezed word once, so no
+per-round domain conversions.
+
+Every constant is DERIVED from :mod:`fisco_bcos_tpu.crypto.ref.poseidon`
+(Grain LFSR round constants, Cauchy MDS) and re-asserted over plain ints at
+import — the ops/bls12_381.py discipline: no transcribed magic tables, and a
+corrupted constant fails the import, not a consensus round.
+
+The round scan is UNIFORM: every round computes all three S-boxes and a
+per-round flag selects the full-round result or the partial-round one
+(state word 0 only). That trades ~2× S-box work for a single compiled scan
+body — the same masking trade the keccak absorb loop makes, and on the VPU
+the S-box is 3 of the 12 muls a round pays anyway (the MDS mix is 9).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..crypto.ref import poseidon as ref
+from . import limb
+from .hash_common import bucket_batch
+from .limb import LIMBS, const_rows, make_mont_field, rows_to_ints, select
+
+FR = ref.FR
+T = ref.T
+RATE = ref.RATE
+BLOCK_BYTES = ref.BLOCK_BYTES
+N_ROUNDS = ref.N_ROUNDS
+
+F = make_mont_field(FR)
+
+# ---------------------------------------------------------------------------
+# Derived constant tables (Montgomery domain), asserted against the
+# reference derivation over plain ints at import.
+# ---------------------------------------------------------------------------
+
+_REF_RC = ref.round_constants()
+_REF_MDS = ref.mds_matrix()
+
+assert len(_REF_RC) == N_ROUNDS and all(len(r) == T for r in _REF_RC)
+assert all(0 <= c < FR for row in _REF_RC for c in row)
+for _i in range(T):
+    for _j in range(T):
+        # the Cauchy property IS the derivation: M[i][j] = 1/(x_i + y_j)
+        assert _REF_MDS[_i][_j] * (_i + T + _j) % FR == 1
+
+# [N_ROUNDS, T, 16] Montgomery-encoded round constants
+_RC_MONT = np.stack(
+    [np.stack([F.enc(c) for c in row]) for row in _REF_RC]
+)
+# [T][T] -> [16] Montgomery-encoded MDS entries (host constants)
+_MDS_MONT = [[F.enc(_REF_MDS[i][j]) for j in range(T)] for i in range(T)]
+# per-round full/partial S-box flag (1 = all words, 0 = word 0 only)
+_HALF = ref.R_FULL // 2
+_FULL_FLAG = np.array(
+    [
+        1 if (r < _HALF or r >= _HALF + ref.R_PARTIAL) else 0
+        for r in range(N_ROUNDS)
+    ],
+    dtype=np.uint32,
+)
+
+# Montgomery round-trip spot check: decoding the encoded table recovers the
+# reference int (guards a silent enc/limb-layout regression)
+_rinv = pow(1 << 256, FR - 2, FR)
+assert (
+    sum(int(_RC_MONT[0, 0, k]) << (16 * k) for k in range(LIMBS)) * _rinv % FR
+    == _REF_RC[0][0]
+)
+del _rinv
+
+
+def _sbox(x: jax.Array) -> jax.Array:
+    """x^5 = (x^2)^2 * x — 2 squarings + 1 mul."""
+    x2 = F.sqr(x)
+    return F.mul(F.sqr(x2), x)
+
+
+def _round(state: tuple, rc: jax.Array, full: jax.Array) -> tuple:
+    """One Poseidon round over a T-tuple of [16, B] Montgomery words."""
+    t = state[0].shape[1]
+    s = [
+        F.add(state[i], jnp.broadcast_to(rc[i][:, None], (LIMBS, t)))
+        for i in range(T)
+    ]
+    boxed = [_sbox(x) for x in s]
+    cond = jnp.broadcast_to(full != 0, (t,))
+    s = [boxed[0]] + [select(cond, boxed[i], s[i]) for i in range(1, T)]
+    out = []
+    for i in range(T):
+        acc = F.mul(s[0], const_rows(_MDS_MONT[i][0], t))
+        for j in range(1, T):
+            acc = F.add(acc, F.mul(s[j], const_rows(_MDS_MONT[i][j], t)))
+        out.append(acc)
+    return tuple(out)
+
+
+def permute_lanes(state: tuple) -> tuple:
+    """The full permutation: lax.scan over the 65 uniform rounds."""
+
+    def body(st, xs):
+        rc, full = xs
+        return _round(st, rc, full), None
+
+    state, _ = lax.scan(
+        body, state, (jnp.asarray(_RC_MONT), jnp.asarray(_FULL_FLAG))
+    )
+    return state
+
+
+@jax.jit
+def poseidon_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """Sponge over pre-padded, Montgomery-encoded blocks.
+
+    blocks: [B, M, RATE, 16] uint32, nblocks: [B] int32.
+    Returns the squeezed word as [16, B] PLAIN-domain limbs.
+    """
+    bsz, m_max, _rate, _limbs = blocks.shape
+    zeros = jnp.zeros((LIMBS, bsz), jnp.uint32)
+    state0 = (zeros,) * T
+
+    def absorb(state, xs):
+        blk, idx = xs  # blk [RATE, 16, B]
+        s = list(state)
+        for j in range(RATE):
+            s[j] = F.add(s[j], blk[j])
+        new = permute_lanes(tuple(s))
+        active = idx < nblocks
+        return tuple(select(active, n, o) for n, o in zip(new, state)), None
+
+    # one up-front transpose so every absorbed word is a contiguous [16, B]
+    state, _ = lax.scan(
+        absorb,
+        state0,
+        (jnp.moveaxis(blocks, 0, -1), jnp.arange(m_max, dtype=jnp.int32)),
+    )
+    return F.to_plain(state[0])
+
+
+def pad_poseidon(msgs) -> tuple[np.ndarray, np.ndarray]:
+    """Sponge padding + Montgomery encoding for a batch.
+
+    Returns (blocks [B', M, RATE, 16] uint32, nblocks [B'] int32) with BOTH
+    dims bucketed like :func:`fisco_bcos_tpu.ops.hash_common.pad_keccak`;
+    padding rows are the padded empty message."""
+    b_pad = bucket_batch(max(len(msgs), 1))
+    nblocks = np.array(
+        [len(m) // BLOCK_BYTES + 1 for m in msgs] + [1] * (b_pad - len(msgs)),
+        dtype=np.int32,
+    )
+    m_max = bucket_batch(int(nblocks.max()))
+    blocks = np.zeros((b_pad, m_max, RATE, LIMBS), dtype=np.uint32)
+    for i, m in enumerate(msgs):
+        elems = ref.absorb_elements(m)
+        for k, v in enumerate(elems):
+            blocks[i, k // RATE, k % RATE] = F.enc(v)
+    if b_pad > len(msgs):
+        empty = [F.enc(v) for v in ref.absorb_elements(b"")]
+        for j in range(RATE):
+            blocks[len(msgs) :, 0, j] = empty[j]
+    return blocks, nblocks
+
+
+def poseidon_batch_async(msgs):
+    """Dispatch the device batch and defer the sync: () -> [B, 32] uint8."""
+    n = len(msgs)
+    blocks, nblocks = pad_poseidon(msgs)
+    words = poseidon_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
+
+    def resolve() -> np.ndarray:
+        ints = rows_to_ints(np.asarray(words))
+        raw = b"".join(v.to_bytes(32, "big") for v in ints[:n])
+        return np.frombuffer(raw, dtype=np.uint8).reshape(n, 32).copy()
+
+    return resolve
+
+
+def poseidon_batch(msgs) -> np.ndarray:
+    """Host convenience: list of bytes -> [B, 32] uint8 digests."""
+    from ..observability.device import device_span
+
+    n = len(msgs)
+    with device_span("poseidon", n, shape_key=bucket_batch(n)):
+        return poseidon_batch_async(msgs)()

@@ -50,6 +50,11 @@ class BlockHeader:
     # and it encodes only when present, so FISCO_QC=0 headers stay
     # byte-identical to the pre-QC build.
     qc: bytes = b""
+    # succinct state-plane commitment (merkle over the KeyPage state) — part
+    # of the hash preimage, but encoded only when present so
+    # FISCO_STATE_PROOF=0 headers stay byte-identical to the pre-succinct
+    # build (the same optional-trailing-section pattern as `qc`)
+    state_commitment: bytes = b""
     _hash: bytes | None = field(default=None, repr=False)
 
     def encode_hash_fields(self) -> bytes:
@@ -70,6 +75,8 @@ class BlockHeader:
         w.seq(self.sealer_list, lambda w2, s: w2.bytes_(s))
         w.bytes_(self.extra_data)
         w.seq(self.consensus_weights, lambda w2, x: w2.u64(x))
+        if self.state_commitment:
+            w.bytes_(self.state_commitment)
         return w.out()
 
     def encode(self) -> bytes:
@@ -112,6 +119,8 @@ class BlockHeader:
             extra_data=r.bytes_(),
             consensus_weights=r.seq(lambda r2: r2.u64()),
         )
+        if not r.at_end():
+            h.state_commitment = r.bytes_()
         r.done()
         return h
 

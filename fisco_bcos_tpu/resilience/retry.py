@@ -175,6 +175,9 @@ IDEMPOTENT_METHODS: set[str] = {
     "encDataKey", "decDataKey",
     # gateway read/connect surface (re-connecting to a live peer is a no-op)
     "peers", "connect_peer",
+    # succinct state plane (ISSUE 18): pure reads off frozen per-height
+    # snapshots — a re-served batch rebuilds at most a cached page tree
+    "getStateProof", "state_proof", "state_proof_batch",
 }
 
 NON_IDEMPOTENT_METHODS: set[str] = {

@@ -129,6 +129,13 @@ def _header_json(h: BlockHeader, suite) -> dict:
             {"index": s.index, "signature": to_hex(s.signature)}
             for s in h.signature_list
         ],
+        # only when the succinct state plane carried one (FISCO_STATE_PROOF):
+        # the anchor getStateProof results verify against
+        **(
+            {"stateCommitment": to_hex(h.state_commitment)}
+            if h.state_commitment
+            else {}
+        ),
     }
 
 
@@ -153,6 +160,7 @@ class JsonRpcImpl:
             "getTransaction": self.get_transaction,
             "getTransactionReceipt": self.get_transaction_receipt,
             "getProofBatch": self.get_proof_batch,
+            "getStateProof": self.get_state_proof,
             "getBlockByHash": self.get_block_by_hash,
             "getBlockByNumber": self.get_block_by_number,
             "getBlockHashByNumber": self.get_block_hash_by_number,
@@ -293,6 +301,50 @@ class JsonRpcImpl:
             doc["blockNumber"] = number
             proofs.append(doc)
         return {"kind": kind, "proofs": proofs}
+
+    def get_state_proof(
+        self, group: str = "", node_name: str = "",
+        keys: list | None = None, number: int | None = None,
+    ) -> dict:
+        """StatePlane batch surface (ISSUE 18): ``keys`` is a list of
+        ``{"table": str, "key": hex}`` rows; the node answers membership
+        proofs against the ``state_commitment`` of block ``number``
+        (default: the committed head). Each proof doc carries the row
+        bytes plus the two chained wide-merkle paths (page subtree, then
+        top tree) in the shared index/leaves/path shape."""
+        from ..succinct import MAX_STATE_PROOF_BATCH
+
+        reqs = [(str(k["table"]), from_hex(k["key"])) for k in (keys or [])]
+        if len(reqs) > MAX_STATE_PROOF_BATCH:
+            raise JsonRpcError(
+                -32602, f"state proof batch over {MAX_STATE_PROOF_BATCH} keys"
+            )
+        plane = getattr(self.node, "state_plane", None)
+        if plane is None:
+            raise JsonRpcError(
+                -32602, "state plane disabled (FISCO_STATE_PROOF=0)"
+            )
+        results = plane.state_proof_batch(
+            reqs, None if number is None else int(number)
+        )
+        proofs = []
+        for res in results:
+            if res is None:
+                proofs.append(None)
+                continue
+            doc = {
+                "blockNumber": res.number,
+                "page": res.page,
+                "pages": res.n_pages,
+                "entry": to_hex(res.entry_bytes),
+                "commitment": to_hex(res.commitment),
+                "pageProof": _proof_json(
+                    res.page_items, res.leaf_index, res.n_leaves
+                ),
+                "topProof": _proof_json(res.top_items, res.page, res.n_pages),
+            }
+            proofs.append(doc)
+        return {"proofs": proofs}
 
     # -- block methods -------------------------------------------------------
 

@@ -18,6 +18,12 @@ Three legs, one artifact:
    ``Ledger.tx_proof`` full rebuilds on a bare (plane-less) ledger over
    the same chain. ``speedup_vs_direct`` is the acceptance number
    (criterion: >= 50x at 10^5 queued clients).
+4. **Succinct lanes (ISSUE 18)** — the state-proof lane: membership
+   proofs/sec off a `StatePlane` snapshot bootstrapped from the storm
+   chain's durable storage, every sampled proof client-verified against
+   the commitment; and the header-sync figure: headers/sec through ONE
+   aggregate multi-pairing admission of a BLS-QC'd chain vs the old
+   one-pairing-check-per-header loop (``FISCO_BENCH_SYNC_HEADERS``).
 
 Read traffic needs no bit-determinism (it never touches chain state); the
 flood events keep the scenario lab's seed contract via the shared
@@ -249,6 +255,123 @@ def _direct_baseline(node, feed, budget_s: float = 3.0) -> float:
     return done / dt if dt > 0 and done else 0.0
 
 
+def _state_proof_lane(node, batch: int, budget_s: float = 2.5) -> dict | None:
+    """ISSUE 18 state lane: membership proofs/sec off a StatePlane snapshot
+    bootstrapped from the storm chain's durable storage (the succinct read
+    surface next to the tx/receipt lanes), sampled proofs client-verified
+    against the commitment."""
+    from ..succinct.state_plane import (
+        EXCLUDED_TABLES,
+        StatePlane,
+        verify_state_proof,
+    )
+
+    if not hasattr(node.storage, "traverse"):
+        return None
+    plane = StatePlane(node.ledger, node.suite, backend=node.storage)
+    keys = [
+        (t, bytes(k))
+        for t, k, e in node.storage.traverse()
+        if not e.deleted and t not in EXCLUDED_TABLES
+    ]
+    head = plane.head_commitment()
+    if not keys or head is None:
+        return None
+    rng = random.Random(0x57A7E)
+    served = verified = failures = 0
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        reqs = [
+            keys[rng.randrange(len(keys))]
+            for _ in range(min(batch, len(keys)))
+        ]
+        tb = time.perf_counter()
+        results = plane.state_proof_batch(reqs)
+        lat.append((time.perf_counter() - tb) * 1e3)
+        for (table, key), res in zip(reqs, results):
+            if res is None:
+                continue
+            served += 1
+            if served % _VERIFY_EVERY == 0:
+                verified += 1
+                if not verify_state_proof(
+                    table, key, res, head,
+                    hasher=plane.hasher, n_pages=plane.n_pages,
+                ):
+                    failures += 1
+    dt = time.perf_counter() - t0
+    return {
+        "committed_keys": len(keys),
+        "proofs_served": served,
+        "proofs_per_s": round(served / dt, 2) if dt > 0 and served else 0.0,
+        "batch_latency_ms_p50": round(_pctl(lat, 0.50), 3),
+        "batch_latency_ms_p95": round(_pctl(lat, 0.95), 3),
+        "verified": verified,
+        "verify_failures": failures,
+        "plane": plane.stats(),
+    }
+
+
+def _header_sync_lane(suite, n_headers: int | None = None) -> dict:
+    """ISSUE 18 sync lane: headers/sec through ONE aggregate multi-pairing
+    admission vs the old per-header pairing loop, over a freshly signed
+    single-sealer BLS-QC'd chain (the cheapest aggregatable shape)."""
+    from ..consensus.block_validator import BlockValidator
+    from ..consensus.qc import get_scheme
+    from ..ledger.ledger import ConsensusNode
+    from ..protocol.block_header import BlockHeader, ParentInfo
+    from ..succinct.sync import verify_header_batch
+
+    if n_headers is None:
+        n_headers = int(
+            os.environ.get("FISCO_BENCH_SYNC_HEADERS", "16") or 16
+        )
+    scheme = get_scheme("bls")
+    kp = scheme.derive_keypair(0xBE7C4)
+    node_id = b"\x5b" * 64
+    committee = [ConsensusNode(node_id, weight=1, qc_pub=kp.pub)]
+    headers = []
+    prev = suite.hash(b"proof-storm-sync")
+    for i in range(1, n_headers + 1):
+        h = BlockHeader(
+            number=i,
+            parent_info=[ParentInfo(i - 1, prev)],
+            sealer_list=[node_id],
+            consensus_weights=[1],
+            timestamp=1_000 + i,
+        )
+        h.qc = scheme.build_cert(
+            {0: scheme.sign_vote(kp, h.hash(suite))}, 1
+        ).encode()
+        headers.append(h)
+        prev = h.hash(suite)
+    validator = BlockValidator(suite)
+    t0 = time.perf_counter()
+    agg_ok = verify_header_batch(headers, committee, validator)
+    agg_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    seq_ok = all(
+        verify_header_batch([h], committee, validator) for h in headers
+    )
+    seq_s = time.perf_counter() - t0
+    doc = {
+        "headers": n_headers,
+        "aggregate_s": round(agg_s, 3),
+        "headers_per_s": round(n_headers / agg_s, 2) if agg_s > 0 else 0.0,
+        "sequential_s": round(seq_s, 3),
+        "headers_per_s_sequential": round(n_headers / seq_s, 2)
+        if seq_s > 0
+        else 0.0,
+        "speedup_vs_per_header": round(seq_s / agg_s, 2)
+        if agg_s > 0
+        else 0.0,
+    }
+    if not (agg_ok and seq_ok):
+        doc["error"] = "an honest BLS header chain was rejected"
+    return doc
+
+
 def run_proof_storm_bench(
     seed: int = 0,
     hosts: int = 4,
@@ -339,6 +462,23 @@ def run_proof_storm_bench(
     steady_pps = _steady_state_pps(node0, feed, batch)
     direct_pps = _direct_baseline(node0, feed)
 
+    # -- leg 4 (ISSUE 18): succinct state lane + constant-work header sync ----
+    # (skipped, flagged, when the wall-clock budget is already gone)
+    state_lane = None
+    sync_lane = None
+    if deadline is None or time.perf_counter() < deadline:
+        state_lane = _state_proof_lane(node0, batch)
+        sync_lane = _header_sync_lane(node0.suite)
+        if state_lane and state_lane.get("verify_failures"):
+            error = error or (
+                f"{state_lane['verify_failures']} state proofs failed "
+                "client-side verification"
+            )
+        if sync_lane.get("error"):
+            error = error or f"header sync lane: {sync_lane['error']}"
+    else:
+        error = error or "succinct lanes skipped at wall-clock deadline"
+
     plane = node0.proof_plane
     window = hammer.window_s()
     pps = hammer.served / window if window > 0 else 0.0
@@ -365,6 +505,8 @@ def run_proof_storm_bench(
         "speedup_vs_direct": round(steady_pps / direct_pps, 2)
         if direct_pps > 0
         else 0.0,
+        "state_proofs": state_lane,
+        "header_sync": sync_lane,
         "flood": {
             "solo_tps": solo_tps,
             "with_proofs_tps": round(combined_tps, 2),

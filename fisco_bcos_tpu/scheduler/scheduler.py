@@ -118,6 +118,10 @@ class Scheduler:
         self.term = 0
         # block-commit listeners: cb(number, committed Block-with-receipts)
         self.on_committed: list = []
+        # succinct state plane (Node wires it when FISCO_STATE_PROOF=1):
+        # execute-time previews feed header.state_commitment, commit-time
+        # promotes freeze the height for proof serving
+        self.state_plane = None
         self._lock = threading.RLock()
         # heights whose 2PC is in flight lock-free (see commit_block);
         # the cv serializes committers without holding the lock across IO.
@@ -458,6 +462,35 @@ class Scheduler:
             )
             timer.stage("roots", dispatched="lazy")
 
+        if self.state_plane is not None:
+            # incremental commitment update from THIS block's write set
+            # (delta over touched pages — never a full state recompute).
+            # Independent of the root futures, so the lazy path computes it
+            # here too: the commitment is part of the hash preimage and must
+            # be in place before anyone hashes the header.
+            post = getattr(self.executor, "block_state", lambda n: None)(number)
+            if post is not None:
+                commitment = self.state_plane.preview(
+                    number, list(post.traverse())
+                )
+                if verify:
+                    # only judge proposals that CARRY a commitment — a peer
+                    # with the plane off seals none, and inventing one here
+                    # would change the header hash out from under its QC
+                    if (
+                        header.state_commitment
+                        and header.state_commitment != commitment
+                    ):
+                        raise SchedulerError(
+                            ErrorCode.SCHEDULER_INVALID_BLOCK,
+                            f"block {number} state commitment mismatch on "
+                            "verify",
+                        )
+                else:
+                    header.state_commitment = commitment
+                    header.clear_hash_cache()
+                timer.stage("stateCommit")
+
         with self._lock:
             # anything executed ABOVE this height was chained on the state
             # this execution just replaced — drop those speculations
@@ -568,6 +601,12 @@ class Scheduler:
                     # pure waste (the admission-time digests are in hand)
                     self.txpool.on_block_committed(
                         number, list(cached.tx_hashes)
+                    )
+                if self.state_plane is not None:
+                    # the height's preview becomes the new base + a served
+                    # height (cheap dict swaps; promote never throws)
+                    self.state_plane.promote(
+                        number, cached.block.header.hash(self.suite)
                     )
                 # listeners run on the notify worker, never on the caller's
                 # thread: the caller is the PBFT engine holding its own

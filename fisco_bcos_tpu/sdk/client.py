@@ -105,6 +105,20 @@ class Client:
         each doc carrying blockNumber/index/leaves/path."""
         return self._grouped("getProofBatch", list(tx_hashes), kind)
 
+    def get_state_proof(
+        self, keys: list[tuple[str, str]], number: int | None = None
+    ) -> dict:
+        """N state-membership proofs in one round trip (served from the
+        node's StatePlane): ``keys`` is ``[(table, key_hex), ...]``;
+        returns ``{"proofs": [doc|None]}``, each doc carrying the row
+        bytes, the header commitment, and the chained pageProof/topProof
+        in the shared index/leaves/path shape."""
+        return self._grouped(
+            "getStateProof",
+            [{"table": t, "key": k} for t, k in keys],
+            number,
+        )
+
     def get_code(self, address: str) -> str:
         return self._grouped("getCode", address)
 

@@ -1,0 +1,80 @@
+"""Succinct state plane — KeyPage state commitments, state proofs and
+constant-work header sync.
+
+Three connected layers (ISSUE 18):
+
+- :mod:`.state_plane` — an incrementally-maintained merkle commitment over
+  the whole KeyPage state (leaf = hash(table ‖ key ‖ value), keys bucketed
+  into fixed pages, pages as subtrees), carried in the block header behind
+  ``FISCO_STATE_PROOF`` and served as membership proofs through the
+  frozen-tree cache machinery the ProofPlane pioneered.
+- :mod:`fisco_bcos_tpu.ops.poseidon` — a jitted Poseidon sponge selectable
+  as the commitment hasher (``FISCO_STATE_HASH=poseidon``), making the
+  commitment SNARK-friendly.
+- :mod:`.sync` — the light client's batched header verification: many
+  header QCs fold into ONE multi-pairing aggregate program plus an
+  accumulated commitment over the verified range.
+
+Default OFF: with ``FISCO_STATE_PROOF`` unset (or ``0``) no plane is
+created, headers carry no commitment and stay byte-identical to the
+pre-succinct build (``BlockHeader.state_commitment`` encodes only when
+present — the same optional-trailing-section pattern as ``qc``).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .state_plane import (  # noqa: F401
+    MAX_STATE_PROOF_BATCH,
+    STATE_COMMIT_BUCKETS_MS,
+    StatePlane,
+    StateProofResult,
+    reference_state_commitment,
+    state_leaf,
+    state_page_of,
+    verify_state_proof,
+)
+from .sync import (  # noqa: F401
+    SYNC_HEADERS_BUCKETS,
+    HeaderRangeAccumulator,
+    verify_header_batch,
+)
+
+# FISCO_STATE_HASH accepts the short spellings the issue names; they map
+# onto the registered HashImpl names (crypto/suite.hash_impl_by_name)
+_HASH_ALIASES = {
+    "keccak": "keccak256",
+    "keccak256": "keccak256",
+    "sm3": "sm3",
+    "poseidon": "poseidon",
+}
+
+
+def state_proof_enabled() -> bool:
+    """``FISCO_STATE_PROOF=1`` arms the state plane (default off — headers
+    stay byte-identical to the pre-succinct wire format)."""
+    return os.environ.get("FISCO_STATE_PROOF", "0") != "0"
+
+
+def state_hash_name() -> str:
+    """The commitment hasher (``FISCO_STATE_HASH=keccak|sm3|poseidon``).
+    Unknown names raise at plane construction, not at first hash — one node
+    silently falling back to a different hasher is a consensus divergence."""
+    raw = os.environ.get("FISCO_STATE_HASH", "keccak").strip().lower()
+    try:
+        return _HASH_ALIASES[raw]
+    except KeyError:
+        raise ValueError(
+            f"FISCO_STATE_HASH={raw!r} (know: {sorted(set(_HASH_ALIASES))})"
+        ) from None
+
+
+def state_pages() -> int:
+    """Fixed page-bucket count (``FISCO_STATE_PAGES``, default 64). Part of
+    the commitment's shape — all nodes must agree on it."""
+    try:
+        n = int(os.environ.get("FISCO_STATE_PAGES", "64"))
+    except ValueError:
+        n = 64
+    return max(1, n)

@@ -1,0 +1,121 @@
+"""Constant-work header sync — many header QCs, ONE pairing program.
+
+The light client's old loop paid one aggregate pairing check per header.
+Pairings don't get cheaper with committee size once QCs are aggregate, but
+they DO share structure across headers: K checks
+
+    e(-G1, sig_k) * e(apk_k, Hm_k) == 1        (k = 1..K)
+
+fold into a single (K+1)-pair product via a Fiat-Shamir random linear
+combination (``BLSCrypto.multi_pairing_verify``), which the device kernel
+evaluates with one shared Miller-loop squaring chain and ONE final
+exponentiation — the per-header marginal cost is a couple of lane
+multiplies instead of a full pairing. :func:`verify_header_batch` does the
+structural admission per header on the host
+(``BlockValidator.qc_check_inputs``: sealer/weight lists, bitmap, quorum
+weight, registered qc_pubs) and then buys the whole chunk with one
+aggregate accept.
+
+The accept is all-or-nothing — a single bad header rejects the chunk
+without naming itself, so the light client falls back to per-header
+:meth:`check_block` on rejection (and for non-aggregatable headers:
+genesis, signature-list mode, ed25519 certs). Honest-path work is
+constant-ish per chunk; the adversary can only force the fallback it
+would have gotten anyway.
+
+:class:`HeaderRangeAccumulator` is the client's running commitment over
+everything it verified: a hash chain over (range, last hash) records,
+so two light clients can compare one 32-byte digest to agree they
+verified the same prefix the same way.
+"""
+
+from __future__ import annotations
+
+from ..codec.flat import FlatWriter
+from ..utils.metrics import REGISTRY
+
+# headers folded into one aggregate pairing call (the succinct-sync payoff
+# metric: honest sync should sit in the top buckets)
+SYNC_HEADERS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
+
+def verify_header_batch(headers, nodes, validator) -> bool | None:
+    """One accept/reject for a whole chunk of QC'd headers.
+
+    ``headers`` must already be parent-hash chained by the caller (chain
+    linkage is the caller's cheap host-side check; this function buys the
+    signatures). Returns ``True`` when the chunk is admitted by one
+    multi-pairing check, ``False`` when the aggregate rejects (some header
+    is bad — re-verify individually to name it), and ``None`` when the
+    chunk is not aggregatable (any non-BLS / signature-list / genesis
+    header in it — fall back to per-header ``check_block``). Structurally
+    invalid headers (``qc_check_inputs`` raising) also return ``False``:
+    no fallback can save those.
+    """
+    from ..consensus.qc import get_scheme
+
+    if not headers:
+        return True
+    checks = []
+    for header in headers:
+        try:
+            triple = validator.qc_check_inputs(header, nodes)
+        except ValueError:
+            return False
+        if triple is None:
+            return None
+        checks.append(triple)
+    scheme = get_scheme("bls")
+    from ..device.plane import device_lane
+
+    ok = None
+    try:
+        # header admission gates sync — same plane lane as check_block's
+        with device_lane("consensus"):
+            ok = bool(scheme._impl.multi_pairing_verify(checks))
+        return ok
+    finally:
+        REGISTRY.observe(
+            "fisco_succinct_sync_headers_per_call",
+            float(len(checks)),
+            buckets=SYNC_HEADERS_BUCKETS,
+            help="headers folded into one multi-pairing aggregate "
+            "verification during succinct header sync",
+            accepted=str(bool(ok)).lower(),
+        )
+
+
+class HeaderRangeAccumulator:
+    """Running commitment over verified header ranges.
+
+    Each admitted chunk folds as ``acc = H(acc ‖ i64 first ‖ i64 last ‖
+    last_header_hash)`` — the last header's hash transitively commits to
+    the whole chained range, so the digest pins exactly which headers were
+    verified and in what order without retaining any of them.
+    """
+
+    def __init__(self, suite):
+        self.suite = suite
+        self.digest = b"\x00" * 32
+        self.headers = 0  # headers covered
+        self.ranges = 0  # fold calls (aggregate chunks + fallback singles)
+
+    def fold(self, first: int, last: int, last_hash: bytes) -> bytes:
+        if last < first:
+            raise ValueError("empty header range")
+        w = FlatWriter()
+        w.fixed(self.digest, 32)
+        w.i64(first)
+        w.i64(last)
+        w.fixed(last_hash, 32)
+        self.digest = self.suite.hash(w.out())
+        self.headers += last - first + 1
+        self.ranges += 1
+        return self.digest
+
+    def stats(self) -> dict:
+        return {
+            "digest": self.digest.hex(),
+            "headers": self.headers,
+            "ranges": self.ranges,
+        }

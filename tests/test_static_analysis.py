@@ -270,11 +270,15 @@ def test_repo_jit_inventory_pinned_and_covers_bls():
     program must update this number (and get a tool/warm_cache.py warmer,
     which walks the same inventory)."""
     progs = jitmap.inventory()
-    assert len(progs) == 23, [
+    assert len(progs) == 25, [
         f"{p['file']}:{p['qualname']}" for p in progs
     ]
     bls = [p for p in progs if p["file"] == "fisco_bcos_tpu/ops/bls12_381.py"]
-    assert [p["qualname"] for p in bls] == ["_pairing_check_xla"]
+    assert [p["qualname"] for p in bls] == [
+        "_pairing_check_xla", "_multi_pairing_xla"
+    ]
+    pos = [p for p in progs if p["file"] == "fisco_bcos_tpu/ops/poseidon.py"]
+    assert [p["qualname"] for p in pos] == ["poseidon_blocks"]
     # every record is CLI-printable (the --list-jit contract)
     for p in progs:
         assert p["line"] > 0 and p["names"], p

@@ -1,0 +1,596 @@
+"""Succinct state plane (ISSUE 18): incremental KeyPage state commitments
+vs an independent full-recompute reference, state-proof verification and
+tamper rejection, frozen-height cache invalidation, the batched
+(multi-pairing) header sync, and the live-chain / RPC / lightnode surfaces.
+
+Synthetic tests stage rows through a fake ledger/backend pair (no signing,
+no consensus) so churn stays cheap; live tests ride the standard 4-node
+in-proc chain with FISCO_STATE_PROOF=1.
+"""
+
+import os
+import random
+import sys
+from dataclasses import replace
+
+sys.path.insert(0, "tests")
+
+import pytest  # noqa: E402
+from test_pbft import leader_of, make_chain, submit_txs  # noqa: E402
+
+from fisco_bcos_tpu.consensus import BlockValidator  # noqa: E402
+from fisco_bcos_tpu.consensus.qc import QuorumCert, get_scheme  # noqa: E402
+from fisco_bcos_tpu.crypto.suite import ecdsa_suite  # noqa: E402
+from fisco_bcos_tpu.ledger.ledger import ConsensusNode  # noqa: E402
+from fisco_bcos_tpu.protocol.block_header import (  # noqa: E402
+    BlockHeader,
+    ParentInfo,
+)
+from fisco_bcos_tpu.storage.entry import Entry, EntryStatus  # noqa: E402
+from fisco_bcos_tpu.succinct import (  # noqa: E402
+    MAX_STATE_PROOF_BATCH,
+    HeaderRangeAccumulator,
+    StatePlane,
+    reference_state_commitment,
+    verify_state_proof,
+)
+from fisco_bcos_tpu.succinct.state_plane import EXCLUDED_TABLES  # noqa: E402
+from fisco_bcos_tpu.succinct.sync import (  # noqa: E402
+    SYNC_HEADERS_BUCKETS,
+    verify_header_batch,
+)
+from fisco_bcos_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+SUITE = ecdsa_suite()
+
+
+class FakeLedger:
+    def __init__(self):
+        self.hashes = {0: b"\x11" * 32}
+        self.number = 0
+
+    def block_number(self):
+        return self.number
+
+    def block_hash_by_number(self, n):
+        return self.hashes.get(n)
+
+
+class FakeBackend:
+    def __init__(self):
+        self.rows = {}
+
+    def traverse(self):
+        for (t, k), e in self.rows.items():
+            yield t, k, e.copy()
+
+
+def _make_plane(n_seed=40, n_pages=8):
+    ledger, backend = FakeLedger(), FakeBackend()
+    for i in range(n_seed):
+        backend.rows[("t_seed", f"k{i}".encode())] = Entry().set(f"v{i}".encode())
+    plane = StatePlane(
+        ledger, SUITE, backend=backend, hasher="keccak256", n_pages=n_pages
+    )
+    return ledger, backend, plane
+
+
+def _churn(rng, live, backend, n_writes):
+    """Random inserts/updates/deletes; returns the block's write set."""
+    writes = []
+    for _ in range(n_writes):
+        t = rng.choice(["t_a", "t_b", "t_seed"])
+        k = f"k{rng.randrange(30)}".encode()
+        if rng.random() < 0.25 and (t, k) in live:
+            e = Entry(status=EntryStatus.DELETED)
+            live.pop((t, k), None)
+            backend.rows.pop((t, k), None)
+        else:
+            e = Entry().set(os.urandom(8))
+            live[(t, k)] = e
+            backend.rows[(t, k)] = e
+        writes.append((t, k, e))
+    return writes
+
+
+# -- incremental == independent full recompute --------------------------------
+
+
+def test_incremental_matches_reference_over_churn():
+    """After EVERY block of seeded churn (inserts, updates, deletes) the
+    delta-updated commitment equals the independent plain-loop walker's
+    full recompute — the acceptance oracle shares no tree code with the
+    plane."""
+    rng = random.Random(7)
+    ledger, backend, plane = _make_plane()
+    live = dict(backend.rows)
+    ref0 = reference_state_commitment(
+        [(t, k, e) for (t, k), e in live.items()], "keccak256", 8
+    )
+    assert plane.head_commitment() == ref0
+    for blk in range(1, 7):
+        writes = _churn(rng, live, backend, rng.randint(1, 12))
+        c = plane.preview(blk, writes)
+        refc = reference_state_commitment(
+            [(t, k, e) for (t, k), e in live.items()], "keccak256", 8
+        )
+        assert c == refc, f"block {blk}: incremental != full recompute"
+        bh = os.urandom(32)
+        ledger.hashes[blk] = bh
+        ledger.number = blk
+        plane.promote(blk, bh)
+    st = plane.stats()
+    assert st["previews"] == 6 and st["promotes"] == 6
+    assert st["base_number"] == 6
+
+
+def test_reference_walker_is_order_independent():
+    rows = [
+        ("t_x", b"k%d" % i, Entry().set(b"v%d" % i)) for i in range(17)
+    ] + [("t_x", b"dead", Entry(status=EntryStatus.DELETED))]
+    a = reference_state_commitment(rows, "keccak256", 8)
+    b = reference_state_commitment(list(reversed(rows)), "keccak256", 8)
+    assert a == b
+    # the deleted row contributed nothing
+    assert a == reference_state_commitment(rows[:-1], "keccak256", 8)
+
+
+# -- proof serve + verify + tamper rejection ----------------------------------
+
+
+def test_state_proofs_verify_and_reject_tamper():
+    _ledger, backend, plane = _make_plane()
+    head_c = plane.head_commitment()
+    some = [("t_seed", f"k{i}".encode()) for i in (0, 7, 23)]
+    res = plane.state_proof_batch(some)
+    for (t, k), r in zip(some, res):
+        assert r is not None
+        assert r.entry_bytes == backend.rows[(t, k)].encode()
+        assert verify_state_proof(t, k, r, head_c, "keccak256", 8)
+    t, k = some[0]
+    r = res[0]
+    # flipped value byte
+    bad = replace(
+        r, entry_bytes=r.entry_bytes[:-1] + bytes([r.entry_bytes[-1] ^ 1])
+    )
+    assert not verify_state_proof(t, k, bad, head_c, "keccak256", 8)
+    # a sound proof presented for a DIFFERENT key
+    t2, k2 = some[1]
+    assert not verify_state_proof(t2, k2, r, head_c, "keccak256", 8)
+    # truncated page path / truncated top path
+    if r.page_items:
+        assert not verify_state_proof(
+            t, k, replace(r, page_items=r.page_items[:-1]), head_c,
+            "keccak256", 8,
+        )
+    assert r.top_items
+    assert not verify_state_proof(
+        t, k, replace(r, top_items=r.top_items[:-1]), head_c, "keccak256", 8
+    )
+    # wrong commitment
+    assert not verify_state_proof(t, k, r, os.urandom(32), "keccak256", 8)
+    # unknown key -> None (no absence proofs in a fixed-page commitment)
+    assert plane.state_proof("t_seed", b"nope") is None
+
+
+def test_excluded_tables_never_enter_the_commitment():
+    ledger, _backend, plane = _make_plane()
+    before = plane.head_commitment()
+    writes = [
+        (t, b"42", Entry().set(b"chain-data")) for t in sorted(EXCLUDED_TABLES)
+    ]
+    c = plane.preview(1, writes)
+    assert c == before  # chain-data tables are filtered out
+    ledger.hashes[1] = os.urandom(32)
+    ledger.number = 1
+    plane.promote(1, ledger.hashes[1])
+    assert plane.state_proof("s_number_2_header", b"42") is None
+
+
+def test_state_proof_batch_cap():
+    _ledger, _backend, plane = _make_plane(n_seed=2)
+    with pytest.raises(ValueError, match="over"):
+        plane.state_proof_batch(
+            [("t", b"%d" % i) for i in range(MAX_STATE_PROOF_BATCH + 1)]
+        )
+
+
+# -- frozen-height invalidation ------------------------------------------------
+
+
+def test_identity_drift_rollback_and_failover_evict():
+    rng = random.Random(11)
+    ledger, backend, plane = _make_plane()
+    live = dict(backend.rows)
+    for blk in range(1, 5):
+        plane.preview(blk, _churn(rng, live, backend, 6))
+        ledger.hashes[blk] = os.urandom(32)
+        ledger.number = blk
+        plane.promote(blk, ledger.hashes[blk])
+    # historical heights serve; identity drift (re-driven block) must not
+    assert plane.state_proof("t_seed", b"k1", number=3) is not None
+    ledger.hashes[3] = os.urandom(32)
+    assert plane.state_proof("t_seed", b"k1", number=3) is None
+    assert plane.stats()["evictions"].get("identity", 0) == 1
+    # rollback declaring height 2+ dead evicts and rebuilds the base
+    plane.on_rolled_back(2)
+    st = plane.stats()
+    assert st["evictions"].get("rollback", 0) >= 1
+    assert st["base_number"] == ledger.number  # rebuilt from the backend
+    assert plane.head_commitment() == reference_state_commitment(
+        [(t, k, e) for (t, k), e in backend.rows.items()], "keccak256", 8
+    )
+    # storage failover drops every frozen height
+    plane.on_failover()
+    st = plane.stats()
+    assert st["evictions"].get("failover", 0) >= 1
+    assert st["base_number"] == ledger.number
+    counts = REGISTRY.counters_matching("fisco_state_plane_evictions_total")
+    assert sum(counts.values()) >= 3
+
+
+# -- batched header sync -------------------------------------------------------
+
+
+def _bls_chain(n_headers, secret=55_001, tag=b"succinct"):
+    """A single-sealer BLS-QC'd header chain + its committee: the cheapest
+    shape that exercises the aggregate multi-pairing admission."""
+    scheme = get_scheme("bls")
+    kp = scheme.derive_keypair(secret)
+    node_id = b"\x5a" * 64
+    committee = [ConsensusNode(node_id, weight=1, qc_pub=kp.pub)]
+    headers = []
+    prev = SUITE.hash(tag)
+    for i in range(1, n_headers + 1):
+        h = BlockHeader(
+            number=i,
+            parent_info=[ParentInfo(i - 1, prev)],
+            sealer_list=[node_id],
+            consensus_weights=[1],
+            timestamp=1_000 + i,
+        )
+        sig = scheme.sign_vote(kp, h.hash(SUITE))
+        h.qc = scheme.build_cert({0: sig}, 1).encode()
+        headers.append(h)
+        prev = h.hash(SUITE)
+    return headers, committee, kp, scheme
+
+
+def _stub_light(headers, committee):
+    """A LightNode wired to a header dict instead of a network — sync's
+    chunking, linkage, aggregate admission and adoption run unmodified."""
+    from fisco_bcos_tpu.front import FrontService
+    from fisco_bcos_tpu.lightnode import LightNode
+
+    front = FrontService(SUITE.signature_impl.generate_keypair(secret=0x33333).pub)
+    light = LightNode(front, SUITE, committee)
+    by_number = {h.number: h for h in headers}
+    light._fetch_header = lambda n: by_number[n]
+    light.remote_head = lambda: max(by_number)
+    return light
+
+
+def _sync_hist():
+    return REGISTRY.histogram(
+        "fisco_succinct_sync_headers_per_call", SYNC_HEADERS_BUCKETS
+    ).snapshot()
+
+
+def test_sync_headers_64_per_aggregate_call():
+    """64 chain-linked headers admitted by ONE multi-pairing call (the
+    acceptance floor), measured through the per-call histogram."""
+    headers, committee, _kp, _ = _bls_chain(64)
+    light = _stub_light(headers, committee)
+    before = _sync_hist().get((("accepted", "true"),), ((), 0.0, 0))
+    assert light.sync_headers() == 64
+    after = _sync_hist()[(("accepted", "true"),)]
+    assert after[2] - before[2] == 1  # exactly one aggregate call...
+    assert after[1] - before[1] == 64.0  # ...covering all 64 headers
+    assert set(light.headers) == set(range(1, 65))
+    acc = light.accumulator.stats()
+    assert acc["headers"] == 64 and acc["ranges"] == 1
+
+
+def test_sync_headers_chunks_by_batch_and_accumulates():
+    headers, committee, _kp, _ = _bls_chain(20, secret=55_002, tag=b"chunk")
+    light = _stub_light(headers, committee)
+    assert light.sync_headers(batch=7) == 20
+    acc = light.accumulator.stats()
+    assert acc["headers"] == 20 and acc["ranges"] == 3  # 7 + 7 + 6
+    # two clients that verified the same prefix agree on one digest
+    light2 = _stub_light(headers, committee)
+    light2.sync_headers(batch=7)
+    assert light2.accumulator.digest == light.accumulator.digest
+    # a different chunking is a DIFFERENT verification transcript
+    light3 = _stub_light(headers, committee)
+    light3.sync_headers(batch=20)
+    assert light3.accumulator.digest != light.accumulator.digest
+
+
+def test_sync_headers_aggregate_reject_names_culprit():
+    headers, committee, _kp, _ = _bls_chain(3, secret=55_003, tag=b"evil")
+    # tamper INSIDE the signed preimage after signing: linkage still holds
+    # for the tampered header's parent side, but its QC no longer verifies
+    headers[2].gas_used = 999_999
+    headers[2].clear_hash_cache()
+    light = _stub_light(headers, committee)
+    with pytest.raises(ValueError, match="header 3 fails QC"):
+        light.sync_headers()
+    # the aggregate rejected (accepted="false") before the fallback walk
+    snap = _sync_hist()
+    assert (("accepted", "false"),) in snap
+    # the two good headers were adopted by the fallback before the culprit
+    assert light.head == 2
+
+
+def test_sync_headers_breaks_hash_chain():
+    headers, committee, _kp, _ = _bls_chain(4, secret=55_004, tag=b"link")
+    headers[2].parent_info = [ParentInfo(2, b"\xbb" * 32)]
+    headers[2].clear_hash_cache()
+    light = _stub_light(headers, committee)
+    with pytest.raises(ValueError, match="hash chain"):
+        light.sync_headers()
+
+
+def test_verify_header_batch_fallback_modes():
+    headers, committee, kp, scheme = _bls_chain(2, secret=55_005, tag=b"fb")
+    validator = BlockValidator(SUITE)
+    assert verify_header_batch([], committee, validator) is True
+    # genesis / un-QC'd headers are not aggregatable -> None (fallback)
+    bare = BlockHeader(number=1, sealer_list=[committee[0].node_id],
+                       consensus_weights=[1])
+    assert verify_header_batch([bare], committee, validator) is None
+    # structurally invalid (undecodable QC) -> False outright
+    broken = BlockHeader(
+        number=1, sealer_list=[committee[0].node_id],
+        consensus_weights=[1], qc=b"\xff\xff",
+    )
+    assert verify_header_batch([broken], committee, validator) is False
+    # a good chunk still verifies
+    assert verify_header_batch(headers, committee, validator) is True
+
+
+def test_qc_check_inputs_structural_rejects():
+    headers, committee, kp, scheme = _bls_chain(1, secret=55_006, tag=b"qi")
+    validator = BlockValidator(SUITE)
+    h = headers[0]
+    triple = validator.qc_check_inputs(h, committee)
+    assert triple is not None
+    pubs, msg, agg = triple
+    assert pubs == (kp.pub,) and msg == h.hash(SUITE) and len(agg) == 96
+    # sealer-list mismatch
+    other = [ConsensusNode(b"\x77" * 64, weight=1, qc_pub=kp.pub)]
+    with pytest.raises(ValueError, match="sealer"):
+        validator.qc_check_inputs(h, other)
+    # committee-size mismatch inside the cert
+    wrong = replace_qc(h, committee=2)
+    with pytest.raises(ValueError, match="committee"):
+        validator.qc_check_inputs(wrong, committee)
+    # truncated aggregate signature
+    with pytest.raises(ValueError, match="malformed"):
+        validator.qc_check_inputs(replace_qc(h, agg_sig=b"\x01" * 64), committee)
+    # bitmap naming nobody
+    with pytest.raises(ValueError, match="signers"):
+        validator.qc_check_inputs(replace_qc(h, bitmap=b"\x00"), committee)
+    # signer without a registered qc_pub
+    bare_committee = [ConsensusNode(committee[0].node_id, weight=1, qc_pub=b"")]
+    with pytest.raises(ValueError, match="qc_pub"):
+        validator.qc_check_inputs(h, bare_committee)
+
+
+def replace_qc(header, **overrides):
+    cert = QuorumCert.decode(header.qc)
+    forged = BlockHeader.decode(header.encode())
+    forged.qc = QuorumCert(
+        scheme=cert.scheme,
+        committee=overrides.get("committee", cert.committee),
+        bitmap=overrides.get("bitmap", cert.bitmap),
+        agg_sig=overrides.get("agg_sig", cert.agg_sig),
+    ).encode()
+    return forged
+
+
+def test_header_range_accumulator():
+    acc = HeaderRangeAccumulator(SUITE)
+    assert acc.digest == b"\x00" * 32
+    d1 = acc.fold(1, 64, b"\xaa" * 32)
+    d2 = acc.fold(65, 65, b"\xbb" * 32)
+    assert d1 != d2 and acc.digest == d2
+    assert acc.stats()["headers"] == 65 and acc.stats()["ranges"] == 2
+    with pytest.raises(ValueError, match="empty"):
+        acc.fold(9, 8, b"\xcc" * 32)
+    # deterministic: same folds, same digest
+    acc2 = HeaderRangeAccumulator(SUITE)
+    acc2.fold(1, 64, b"\xaa" * 32)
+    assert acc2.fold(65, 65, b"\xbb" * 32) == d2
+
+
+# -- header wire: default-off byte identity ------------------------------------
+
+
+def test_state_commitment_off_keeps_header_bytes_identical():
+    """With no commitment set, the header encodes WITHOUT the trailing
+    section — byte-identical to the pre-succinct wire format — and the
+    commitment enters the hash preimage when present (unlike qc, which is
+    the signature OVER the hash)."""
+    h = BlockHeader(number=7, txs_root=b"\x0c" * 32, timestamp=123)
+    raw = h.encode()
+    back = BlockHeader.decode(raw)
+    assert back.state_commitment == b"" and back.encode() == raw
+    with_c = BlockHeader.decode(raw)
+    with_c.state_commitment = b"\x0d" * 32
+    with_c.clear_hash_cache()
+    assert with_c.encode() != raw
+    assert with_c.hash(SUITE) != h.hash(SUITE)  # inside the preimage
+    rt = BlockHeader.decode(with_c.encode())
+    assert rt.state_commitment == b"\x0d" * 32
+    # stripping it restores the original bytes exactly
+    rt.state_commitment = b""
+    rt.clear_hash_cache()
+    assert rt.encode() == raw
+
+
+# -- live chain ----------------------------------------------------------------
+
+
+@pytest.fixture
+def state_chain(monkeypatch):
+    monkeypatch.setenv("FISCO_STATE_PROOF", "1")
+    nodes, gw = make_chain(4)
+    for height in (1, 2):
+        leader = leader_of(nodes, height)
+        submit_txs(leader, 3, start=height * 10)
+        assert leader.sealer.seal_and_submit()
+    return nodes, gw
+
+
+def test_live_chain_commits_agree_and_match_reference(state_chain):
+    nodes, _gw = state_chain
+    from fisco_bcos_tpu.succinct import state_hash_name, state_pages
+
+    header = nodes[0].ledger.header_by_number(2)
+    assert len(header.state_commitment) == 32
+    assert len(
+        {n.ledger.header_by_number(2).state_commitment for n in nodes}
+    ) == 1  # every replica's verify pass accepted the same commitment
+    ref = reference_state_commitment(
+        nodes[0].storage.traverse(),
+        hasher=state_hash_name(), n_pages=state_pages(),
+    )
+    assert ref == header.state_commitment
+    # proofs at head verify against the committed header's commitment
+    plane = nodes[0].state_plane
+    assert plane is not None
+    reqs = [("s_consensus", b"key"), ("s_config", b"tx_count_limit")]
+    for (t, k), r in zip(reqs, plane.state_proof_batch(reqs)):
+        assert r is not None and r.number == 2
+        assert verify_state_proof(
+            t, k, r, header.state_commitment,
+            hasher=state_hash_name(), n_pages=state_pages(),
+        )
+    assert plane.stats()["promotes"] >= 2
+    # the delta-update histogram recorded every executed block
+    snap = REGISTRY.histogram("fisco_state_commit_update_ms").snapshot()
+    assert sum(c for _, _, c in snap.values()) >= 2
+    from fisco_bcos_tpu.resilience import HEALTH
+
+    assert HEALTH.status("state-plane") == "ok"
+
+
+def test_get_state_proof_rpc(state_chain):
+    from fisco_bcos_tpu.rpc.jsonrpc import JsonRpcImpl
+    from fisco_bcos_tpu.utils.bytesutil import to_hex
+
+    nodes, _gw = state_chain
+    node = nodes[0]
+    rpc = JsonRpcImpl(node)
+    out = rpc.handle(
+        {
+            "jsonrpc": "2.0", "id": 1, "method": "getStateProof",
+            "params": [
+                "group0", "",
+                [
+                    {"table": "s_config", "key": to_hex(b"tx_count_limit")},
+                    {"table": "s_config", "key": to_hex(b"no_such_key")},
+                ],
+                None,
+            ],
+        }
+    )
+    proofs = out["result"]["proofs"]
+    assert proofs[1] is None  # unknown key
+    doc = proofs[0]
+    assert doc["blockNumber"] == 2 and doc["pages"] > 0
+    assert set(doc) >= {"entry", "commitment", "pageProof", "topProof"}
+    assert doc["commitment"] == to_hex(
+        node.ledger.header_by_number(2).state_commitment
+    )
+    # over-cap is an invalid-params error
+    out = rpc.handle(
+        {
+            "jsonrpc": "2.0", "id": 2, "method": "getStateProof",
+            "params": [
+                "group0", "",
+                [{"table": "t", "key": "0x00"}] * (MAX_STATE_PROOF_BATCH + 1),
+                None,
+            ],
+        }
+    )
+    assert out["error"]["code"] == -32602 and "over" in out["error"]["message"]
+
+
+def test_state_plane_disabled_by_default():
+    from fisco_bcos_tpu.ledger import GenesisConfig
+    from fisco_bcos_tpu.node import Node, NodeConfig
+    from fisco_bcos_tpu.rpc.jsonrpc import JsonRpcImpl
+
+    assert os.environ.get("FISCO_STATE_PROOF", "0") == "0"
+    kp = SUITE.signature_impl.generate_keypair(secret=0x8888)
+    cfg = NodeConfig(
+        genesis=GenesisConfig(consensus_nodes=[ConsensusNode(kp.pub, weight=1)])
+    )
+    node = Node(cfg, keypair=kp)
+    assert node.state_plane is None
+    assert node.scheduler.state_plane is None
+    rpc = JsonRpcImpl(node)
+    out = rpc.handle(
+        {
+            "jsonrpc": "2.0", "id": 1, "method": "getStateProof",
+            "params": ["group0", "", [{"table": "t", "key": "0x00"}], None],
+        }
+    )
+    assert out["error"]["code"] == -32602
+    assert "disabled" in out["error"]["message"]
+
+
+def test_lightnode_state_proofs(state_chain):
+    from fisco_bcos_tpu.front import FrontService
+    from fisco_bcos_tpu.lightnode import LightNode, LightNodeService
+
+    nodes, gw = state_chain
+    for n in nodes:
+        LightNodeService(n)
+    lkp = SUITE.signature_impl.generate_keypair(secret=0x44444)
+    front = FrontService(lkp.pub)
+    gw.connect(front)
+    light = LightNode(front, SUITE, nodes[0].ledger.consensus_nodes())
+    light.full_node = nodes[0].node_id
+    assert light.sync_headers() == 2
+    reqs = [
+        ("s_config", b"tx_count_limit"),
+        ("s_consensus", b"key"),
+        ("s_config", b"no_such_key"),
+    ]
+    got = light.get_state_proofs(reqs)
+    assert set(got) == set(reqs[:2])  # unknown key simply absent
+    for tk in reqs[:2]:
+        number, entry_bytes = got[tk]
+        assert number == 2 and entry_bytes
+    # fail fast on an oversize batch (the server drops those silently)
+    with pytest.raises(ValueError, match="over"):
+        light.get_state_proofs([("t", b"%d" % i) for i in range(MAX_STATE_PROOF_BATCH + 1)])
+    # a proof landing on an UNSYNCED header taints the batch
+    leader = leader_of(nodes, 3)
+    submit_txs(leader, 2, start=77)
+    assert leader.sealer.seal_and_submit()
+    with pytest.raises(ValueError, match="unsynced"):
+        light.get_state_proofs([("s_config", b"tx_count_limit")], number=3)
+    # ... and syncing the header clears the taint
+    assert light.sync_headers() == 3
+    got = light.get_state_proofs([("s_config", b"tx_count_limit")], number=3)
+    assert got[("s_config", b"tx_count_limit")][0] == 3
+
+
+def test_failover_rebuild_matches_committed_commitment(state_chain):
+    """After a failover wipe, the base rebuilt from the durable backend
+    reproduces EXACTLY the commitment the committed head carries."""
+    nodes, _gw = state_chain
+    plane = nodes[0].state_plane
+    assert plane.stats()["heights"] >= 1
+    plane.on_failover()
+    st = plane.stats()
+    assert st["evictions"].get("failover", 0) >= 1
+    assert (
+        plane.head_commitment()
+        == nodes[0].ledger.header_by_number(2).state_commitment
+    )

@@ -151,6 +151,15 @@ def _warm_bls(bucket: int) -> None:
 
     hm = ref.ec_mul(ref.G2, 2, ref.FP2_OPS)
     bls.pairing_check_batch([(ref.G1, ref.G2, hm)] * max(bucket, 1))
+    # the succinct-sync multi-pairing program (ISSUE 18): same Miller-loop
+    # core, different fan-in shape — pairs bucket to the next power of two
+    bls.multi_pairing_check([(ref.G1, ref.G2), (ref.G1, hm)])
+
+
+def _warm_poseidon(bucket: int) -> None:
+    from fisco_bcos_tpu.ops import poseidon as pos
+
+    pos.poseidon_batch([b"warm-cache %d" % i for i in range(max(bucket, 1))])
 
 
 def _skip_sharded(_bucket: int):
@@ -181,6 +190,7 @@ WARMERS = {
     "fisco_bcos_tpu/ops/address.py": ("address", _warm_address),
     "fisco_bcos_tpu/ops/merkle.py": ("merkle", _warm_merkle),
     "fisco_bcos_tpu/ops/bls12_381.py": ("bls12_381", _warm_bls),
+    "fisco_bcos_tpu/ops/poseidon.py": ("poseidon", _warm_poseidon),
     "fisco_bcos_tpu/ops/pallas_ec.py": ("pallas_ec", _skip_pallas),
     "fisco_bcos_tpu/parallel/sharding.py": ("sharding", _skip_sharded),
     "fisco_bcos_tpu/crypto/admission.py": ("admission", _warm_admission),
