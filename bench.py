@@ -103,8 +103,15 @@ M_COALESCE = ("device_plane_coalesce_ratio", "reqs/dispatch")
 # p95 inter-node spread of the corrected quorum edge across the measured
 # flood's aligned rounds (fleet observatory; 0 with FISCO_FLEET_OBS=0)
 M_ROUND_SKEW = ("fleet_round_skew_ms_p95", "ms")
+# commit-path copy amplification over the measured flood (ISSUE 19 storage
+# observatory): entries copied per durably-written row, mean across the
+# measured blocks (0 and unmeasured with FISCO_STORAGE_OBS=0)
+M_STORAGE_AMP = ("storage_copy_amplification", "copies/row")
+# the --only storage child's durable-backend batch-write leg; the other
+# five (backend, op) rows/s lines ride along under their dynamic names
+M_STORAGE_ROWS = ("storage_sqlite_write_rows_per_s", "rows/s")
 ALL_METRICS = [M_SECP, M_LATENCY, M_SM2, M_MERKLE, M_FLOOD, M_COALESCE,
-               M_ROUND_SKEW]
+               M_ROUND_SKEW, M_STORAGE_AMP, M_STORAGE_ROWS]
 
 
 _EMITTED: set[str] = set()
@@ -545,6 +552,7 @@ def bench_flood() -> None:
     # wall) is the honest on/off overhead bound on this 1-core host
     prof = None
     warm_ledger = None
+    alloc_window = None
     # measured-window boundary (EVERY round since ISSUE 14, not only under
     # --telemetry): drop the warm/compile round's tx index and stage
     # totals so the round artifact's per-stage vector covers ONLY the
@@ -552,9 +560,14 @@ def bench_flood() -> None:
     # be dominated by cold-vs-warm compile variance.
     from fisco_bcos_tpu.observability import critical_path
     from fisco_bcos_tpu.observability.pipeline import PIPELINE
+    from fisco_bcos_tpu.observability.storagelog import STORAGE
 
     critical_path.clear_indexes()
     PIPELINE.reset()
+    # ISSUE 19: the storage observatory's codec/copy ledger likewise
+    # covers ONLY the measured window (warm-round compile churn would
+    # otherwise dominate the round-over-round codec-bytes diff)
+    STORAGE.reset()
     prev_round_doc = _load_flood_artifact()
     if os.environ.get("FISCO_BENCH_TELEMETRY"):
         from fisco_bcos_tpu.observability.device import LEDGER
@@ -570,11 +583,20 @@ def bench_flood() -> None:
         LEDGER.reset()
         prof = SamplingProfiler(hz=100.0)
         prof.start()
+        if STORAGE.enabled:
+            # ISSUE 19: the tracemalloc window rides the profiler cadence
+            # — same measured round, same on/off overhead accounting
+            from fisco_bcos_tpu.observability.storagelog import (
+                AllocationWindow,
+            )
+
+            alloc_window = AllocationWindow().start()
     t0 = time.perf_counter()
     flood_round(measured_txs, deadline=measure_deadline)
     dt = time.perf_counter() - t0
     if prof is not None:
         prof.stop()
+    alloc_top = alloc_window.top(15) if alloc_window is not None else None
     committed = nodes[0].ledger.total_transaction_count() - before
     if committed < n:
         err = err or f"only {committed}/{n} txs committed"
@@ -610,6 +632,10 @@ def bench_flood() -> None:
     # skew, written every round next to the pipeline artifact (noop and
     # placeholder-emitting when FISCO_FLEET_OBS=0)
     _dump_flood_rounds_artifact(nodes, dt)
+    # ISSUE 19: the storage observatory's commit-path ledger — codec
+    # bytes/block, copy-amplification, per-shard 2PC p95, top alloc sites
+    # (noop and placeholder-emitting with FISCO_STORAGE_OBS=0)
+    _dump_storage_artifact(dt, alloc_top)
     _gate_flood_round(prev_round_doc, tps)
     if plane_enabled():
         plane = get_plane()
@@ -1018,6 +1044,92 @@ def _dump_flood_rounds_artifact(nodes, window_s: float) -> None:
     )
 
 
+def _storage_artifact_path() -> str:
+    base = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(base, "bench_telemetry.flood.storage.json")
+
+
+def _dump_storage_artifact(window_s: float, alloc_top=None) -> None:
+    """ISSUE 19 storage artifact: the storage observatory's view of the
+    measured flood — commit-path codec bytes per block, the
+    copy-amplification ratio (entries copied per durably-written row),
+    per-shard 2PC prepare/commit p95, and (under --telemetry) the top
+    tracemalloc allocation sites attributed to pipeline stages.
+    ``storage_commit`` is the vector tool/check_perf.py diffs round over
+    round (20%-relative + 5.0 absolute-floor gates). With
+    FISCO_STORAGE_OBS=0 the recorder saw nothing: emit the disabled
+    placeholder and write no artifact (the switch must stay a no-op on
+    the flood path)."""
+    from fisco_bcos_tpu.observability.roundlog import percentile
+    from fisco_bcos_tpu.observability.storagelog import STORAGE
+
+    if not STORAGE.enabled:
+        _emit(
+            M_STORAGE_AMP[0], 0.0, M_STORAGE_AMP[1], 0.0,
+            error="storage observatory disabled (FISCO_STORAGE_OBS=0)",
+            measured=False,
+        )
+        return
+    snap = STORAGE.snapshot(last_blocks=128)
+    blocks = [b for b in snap["blocks"] if not b.get("aborted")]
+    n_blocks = max(len(blocks), 1)
+    bytes_per_block = sum(b["bytes_encoded"] for b in blocks) / n_blocks
+    copies_per_block = sum(b["entries_copied"] for b in blocks) / n_blocks
+    rows_per_block = sum(b["rows_written"] for b in blocks) / n_blocks
+    amp = snap["totals"]["copy_amplification_mean"]
+    shard_prep = [
+        ops["prepare"]["p95_ms"]
+        for ops in snap["shards"].values()
+        if "prepare" in ops
+    ]
+    shard_comm = [
+        ops["commit"]["p95_ms"]
+        for ops in snap["shards"].values()
+        if "commit" in ops
+    ]
+    doc = {
+        "tag": "flood",
+        "window_s": round(window_s, 3),
+        "blocks_measured": len(blocks),
+        # the check_perf round-over-round vector — codec bytes/block sits
+        # in the thousands so a +30% regression clears the 5.0 floor
+        "storage_commit": {
+            "codec_bytes_per_block": round(bytes_per_block, 1),
+            "entries_copied_per_block": round(copies_per_block, 1),
+            "shard_prepare_p95_ms": (
+                round(percentile(shard_prep, 95), 3) if shard_prep else 0.0
+            ),
+            "shard_commit_p95_ms": (
+                round(percentile(shard_comm, 95), 3) if shard_comm else 0.0
+            ),
+        },
+        "rows_written_per_block": round(rows_per_block, 1),
+        "copy_amplification": amp,
+        "codec": snap["codec"],
+        "copies": snap["copies"],
+        "pages_rewritten": snap["pages_rewritten"],
+        "shards": snap["shards"],
+        "totals": snap["totals"],
+        "blocks": blocks[-16:],
+    }
+    if alloc_top is not None:
+        doc["alloc_top"] = alloc_top
+    path = _storage_artifact_path()
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    _emit(M_STORAGE_AMP[0], amp, M_STORAGE_AMP[1], amp)
+    top3 = ", ".join(
+        f"{a['site']}={a['kib']:.0f}KiB" for a in (alloc_top or [])[:3]
+    )
+    print(
+        f"# storage ledger: blocks={len(blocks)} "
+        f"codec_bytes/block={bytes_per_block:.0f} amp={amp:.2f} "
+        + (f"alloc_top=[{top3}] " if top3 else "")
+        + f"-> {path}",
+        flush=True,
+    )
+
+
 def _gate_flood_round(prev_doc: dict | None, tps: float) -> None:
     """Consecutive-round flood-TPS regression gate (ISSUE 14): diff this
     round's TPS against the previous round's artifact with the
@@ -1174,6 +1286,42 @@ def _dump_telemetry(tag: str) -> None:
         )
 
 
+def bench_storage_child() -> None:
+    """--only storage child (ISSUE 19): the bench_storage.py backend legs
+    on the round cadence. Rides the parent's budget/deadline split like
+    the scenario children — the leg loop stops at the deadline (a slow
+    disk must yield degraded lines, never a budget-killed child) — and
+    writes the per-(backend, op) rows/s vector to ``bench_storage.json``
+    next to the metric lines."""
+    import bench_storage
+
+    budget = _child_budget_s()
+    deadline = (
+        time.monotonic() + max(budget - 15, 20)
+        if budget is not None
+        else None
+    )
+    n = int(os.environ.get("FISCO_BENCH_STORAGE_ROWS", "20000") or 20000)
+    if budget is not None and budget < 60:
+        # a thin slice measures fewer rows instead of risking the kill
+        n = min(n, 5000)
+    results = bench_storage.run(n, deadline=deadline)
+    doc = {
+        "n_rows": n,
+        "budget_s": budget,
+        "results": results,
+        "rows_per_s": {
+            f"{r['backend']}_{r['op']}": r["value"] for r in results
+        },
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_storage.json"
+    )
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    print(f"# storage bench artifact -> {path}", flush=True)
+
+
 def _child_budget_s() -> float | None:
     """Wall-clock budget handed to this --only child by the parent's
     deadline scheduler (None when run standalone)."""
@@ -1261,7 +1409,9 @@ def main() -> None:
     # cheap-compile-first: the deadline split hands each child
     # remaining/remaining_count, so early finishers donate surplus to the
     # expensive EC children and the flood
-    names = ["merkle", "admission", "sm2", "flood"]
+    # (the storage child is pure host CPU — it runs second so its surplus
+    # donates to the compile-heavy EC children and the flood)
+    names = ["merkle", "storage", "admission", "sm2", "flood"]
     # ROADMAP frontier wired into the round cadence: the isolation
     # victim-ratio (>=0.7x acceptance) and the proof-storm read path are
     # tracked per round alongside flood TPS. FISCO_BENCH_SCENARIOS=0 opts
@@ -1350,6 +1500,7 @@ def _main_only(name: str) -> None:
         "sm2": bench_sm2,
         "merkle": bench_merkle,
         "flood": bench_flood,
+        "storage": bench_storage_child,
     }
     if name.startswith("scenario:"):
         scen = name.split(":", 1)[1]
@@ -1364,7 +1515,10 @@ def _main_only(name: str) -> None:
     if name not in fns:
         print(f"# unknown bench '{name}'", flush=True)
         raise SystemExit(2)
-    _init_jax()
+    if name != "storage":
+        # the storage child is pure host CPU: skipping device init keeps
+        # its slice immune to a flapped TPU tunnel
+        _init_jax()
     try:
         fns[name]()
         _dump_telemetry(name)
@@ -1471,7 +1625,7 @@ if __name__ == "__main__":
         if len(_sys.argv) < 3:
             print(
                 "usage: bench.py [--telemetry] "
-                "[--only admission|sm2|merkle|flood|scenario:<name>] "
+                "[--only admission|sm2|merkle|flood|storage|scenario:<name>] "
                 "[--scenario <name> [--seed N]]"
             )
             raise SystemExit(2)

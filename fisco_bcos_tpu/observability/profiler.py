@@ -245,12 +245,20 @@ class SamplingProfiler:
 _PROFILE_LOCK = threading.Lock()
 
 
-def profile(seconds: float = 2.0, hz: float = DEFAULT_HZ) -> dict:
+def profile(
+    seconds: float = 2.0, hz: float = DEFAULT_HZ, alloc: bool | None = None
+) -> dict:
     """The ``GET /profile?seconds=N`` implementation: sample this process
     for ``seconds`` (clamped to :data:`PROFILE_SECONDS_MAX`) on the calling
     thread and return the report. Single-flight: a second concurrent
     request gets ``{"error": "profiler busy"}`` instead of doubling the
-    overhead."""
+    overhead.
+
+    When the storage observatory is on (``alloc=None`` defers to its
+    switch), a tracemalloc window rides the same sampling cadence and the
+    report gains ``alloc_top`` — the top allocation sites over the window,
+    each attributed to a pipeline stage, so "codec churn on the commit
+    path" is a named list instead of a flamegraph guess."""
     try:
         seconds = float(seconds)
     except (TypeError, ValueError):
@@ -259,8 +267,20 @@ def profile(seconds: float = 2.0, hz: float = DEFAULT_HZ) -> dict:
     if not _PROFILE_LOCK.acquire(blocking=False):
         return {"error": "profiler busy", "seconds": seconds}
     try:
+        if alloc is None:
+            from .storagelog import storage_obs_enabled
+
+            alloc = storage_obs_enabled()
+        window = None
+        if alloc:
+            from .storagelog import AllocationWindow
+
+            window = AllocationWindow().start()
         p = SamplingProfiler(hz=hz)
         p.run_for(seconds)
-        return p.report()
+        report = p.report()
+        if window is not None:
+            report["alloc_top"] = window.top()
+        return report
     finally:
         _PROFILE_LOCK.release()

@@ -1,0 +1,595 @@
+"""Storage observatory — the commit-path codec/copy-amplification ledger
+(ISSUE 19 tentpole).
+
+The ROADMAP's "kill the codec tail" campaign names Entry/codec allocation
+churn, KeyPage copy amplification, and per-key 2PC staging as the
+post-crypto cProfile tail — but nothing measured any of it: the pipeline
+observatory sees ``commit blocked_on=2pc_*`` as an opaque span. This module
+is the cost ledger that makes the columnar-codec/incremental-root refactor
+provable through ``tool/check_perf.py`` gates instead of wall-clock
+anecdotes (the same observatory-before-optimization sequence as PR 9 → the
+PR 14 pipelining and PR 13 → the fused-kernel item).
+
+Four instruments on one process-wide :data:`STORAGE` recorder:
+
+- **Codec accounting** — ``Entry.encode``/``Entry.decode`` report call+byte
+  counts. The codec itself doesn't know who is driving it, so the owning
+  layers tag the work through a contextvar (:func:`codec_ctx`): ``ingress``
+  (backend read → decode), ``commit`` (2PC re-encode on the block commit
+  path), ``copyout`` (cache/page codec on the read path) — untagged work
+  folds under ``""``.
+- **Copy-amplification ledger** — every ``entry.copy()`` seam in
+  keypage/state_storage/cache counts ``(site, table)``; the per-block
+  commit ledger (bounded ring keyed by height, the PR 16 RoundLedger
+  shape) snapshots the counters across each ``scheduler.commit_block``
+  window so rows-logically-written vs entries-physically-copied vs
+  pages-rewritten vs bytes-encoded is a per-block number — copy
+  amplification = copies/row.
+- **2PC shard attribution** — ``storage/distributed.py`` wraps each
+  shard's prepare/commit leg: per-shard latency histograms
+  (``fisco_storage_shard_2pc_ms{op,shard}``), staged rows and staged-byte
+  attribution (measured as the encode-byte delta across the leg — no
+  second encode pass).
+- **Allocation window** — a tracemalloc window riding the PR 9 profiler
+  cadence (:func:`..observability.profiler.profile` wraps its sampling run
+  in one) folding top allocation sites into the report, each attributed to
+  a pipeline stage by module, so "codec churn on the commit path" becomes
+  a named list of sites.
+
+``FISCO_STORAGE_OBS=0`` is the bench A/B switch: every seam is one
+attribute read (``STORAGE.enabled``) and :func:`codec_ctx` hands back one
+shared no-op context manager — zero allocation on the hot path.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import tracemalloc
+from collections import OrderedDict
+
+from ..utils.metrics import REGISTRY
+
+# per-block commit ledgers retained (the PR 16 ring bound)
+BLOCK_CAP = 256
+# per-shard 2PC legs: sub-ms local sqlite staging up to multi-second
+# remote-shard round trips under faults
+SHARD_2PC_BUCKETS_MS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 100.0, 500.0, 2000.0,
+)
+# per-shard latency samples retained for the doc's p95 (per shard, per op)
+_SHARD_SAMPLE_CAP = 512
+
+# codec context tags the owning layers set around their codec-driving work
+CTX_INGRESS = "ingress"   # backend read -> Entry/page decode
+CTX_COMMIT = "commit"     # 2PC staging re-encode on the block commit path
+CTX_COPYOUT = "copyout"   # cache/page codec serving a read
+
+_CTX = contextvars.ContextVar("fisco_storage_ctx", default=("", ""))
+
+
+def storage_obs_enabled() -> bool:
+    return os.environ.get("FISCO_STORAGE_OBS", "1") != "0"
+
+
+class _NoopCtx:
+    """Shared do-nothing context manager — ``codec_ctx`` under
+    ``FISCO_STORAGE_OBS=0`` (zero per-call allocation)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_CTX = _NoopCtx()
+
+
+class _CodecTag:
+    """Sets the (context, table) codec attribution for the calling thread's
+    context; nested tags restore the outer one on exit."""
+
+    __slots__ = ("_val", "_tok")
+
+    def __init__(self, context: str, table: str = ""):
+        self._val = (context, table)
+
+    def __enter__(self):
+        self._tok = _CTX.set(self._val)
+        return self
+
+    def __exit__(self, *exc):
+        _CTX.reset(self._tok)
+        return False
+
+
+def codec_ctx(context: str, table: str = ""):
+    """Tag codec work on this thread as ``context`` (optionally pinned to a
+    table the codec seam can't see). One shared no-op when disabled."""
+    if not STORAGE.enabled:
+        return _NOOP_CTX
+    return _CodecTag(context, table)
+
+
+# pipeline-stage attribution for allocation sites, by module-path fragment
+# (first match wins; checked against the traceback's deepest repo frame)
+_STAGE_BY_MODULE: tuple[tuple[str, str], ...] = (
+    ("txpool", "admission"),
+    ("sealer", "seal"),
+    ("consensus", "consensus"),
+    ("executor", "execute"),
+    ("scheduler", "commit"),
+    ("storage", "commit"),
+    ("codec", "commit"),
+    ("ledger", "commit"),
+    ("crypto", "device"),
+    ("ops", "device"),
+    ("device", "device"),
+    ("gateway", "network"),
+    ("service", "network"),
+    ("rpc", "network"),
+)
+_PKG_MARKER = f"fisco_bcos_tpu{os.sep}"
+
+
+def _stage_of(filename: str) -> str:
+    if _PKG_MARKER not in filename:
+        return "other"
+    rel = filename.split(_PKG_MARKER, 1)[1]
+    for frag, stage in _STAGE_BY_MODULE:
+        if frag in rel:
+            return stage
+    return "other"
+
+
+class AllocationWindow:
+    """A tracemalloc diff window: ``start()`` snapshots, ``top(n)`` diffs
+    and names the top allocation sites with pipeline-stage attribution.
+    Tracing started here is stopped here; a window opened while another
+    owner is already tracing leaves tracing on."""
+
+    FRAMES = 5
+
+    def __init__(self):
+        self._t0 = None
+        self._started_tracing = False
+
+    def start(self) -> "AllocationWindow":
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(self.FRAMES)
+            self._started_tracing = True
+        self._t0 = tracemalloc.take_snapshot()
+        return self
+
+    def top(self, n: int = 15) -> list[dict]:
+        if self._t0 is None:
+            return []
+        snap = tracemalloc.take_snapshot()
+        stats = snap.compare_to(self._t0, "traceback")
+        if self._started_tracing:
+            tracemalloc.stop()
+            self._started_tracing = False
+        self._t0 = None
+        out: list[dict] = []
+        for st in sorted(stats, key=lambda s: -s.size_diff)[: max(n, 0)]:
+            if st.size_diff <= 0:
+                continue
+            frames = [
+                f"{os.path.basename(fr.filename)}:{fr.lineno}"
+                for fr in st.traceback
+            ]
+            # deepest repo frame names the site (tracemalloc tracebacks are
+            # oldest-frame-first, so scan from the end)
+            site = frames[-1] if frames else "?"
+            stage = "other"
+            for fr in reversed(st.traceback):
+                if _PKG_MARKER in fr.filename:
+                    site = f"{os.path.basename(fr.filename)}:{fr.lineno}"
+                    stage = _stage_of(fr.filename)
+                    break
+            out.append(
+                {
+                    "site": site,
+                    "stage": stage,
+                    "kib": round(st.size_diff / 1024.0, 1),
+                    "count": st.count_diff,
+                    "stack": frames,
+                }
+            )
+        return out
+
+
+class BlockCommitRecord:
+    """One block's commit-window storage costs. Mutated only under the
+    owning recorder's lock; ``to_doc`` copies the shard map."""
+
+    __slots__ = (
+        "height", "t_begin", "prepare_ms", "commit_ms", "rows_written",
+        "entries_copied", "pages_rewritten", "bytes_encoded",
+        "encode_calls", "shards", "aborted",
+    )
+
+    def __init__(self, height: int, t_begin: float):
+        self.height = height
+        self.t_begin = t_begin
+        self.prepare_ms = 0.0
+        self.commit_ms = 0.0
+        self.rows_written = 0
+        self.entries_copied = 0
+        self.pages_rewritten = 0
+        self.bytes_encoded = 0
+        self.encode_calls = 0
+        # shard idx -> {"op": {"ms", "rows", "bytes"}} for this block
+        self.shards: dict[int, dict] = {}
+        self.aborted = False
+
+    def to_doc(self) -> dict:
+        rows = self.rows_written
+        return {
+            "height": self.height,
+            "rows_written": rows,
+            "entries_copied": self.entries_copied,
+            "pages_rewritten": self.pages_rewritten,
+            "bytes_encoded": self.bytes_encoded,
+            "encode_calls": self.encode_calls,
+            "copy_amplification": (
+                round(self.entries_copied / rows, 3) if rows > 0 else 0.0
+            ),
+            "prepare_ms": round(self.prepare_ms, 3),
+            "commit_ms": round(self.commit_ms, 3),
+            "shards": {str(i): dict(d) for i, d in self.shards.items()},
+            "aborted": self.aborted,
+        }
+
+
+class StorageRecorder:
+    """Process-wide storage cost recorder. ``clock`` is injectable (ledger
+    mechanics tests and the interleave harness drive deterministic time);
+    ``emit_metrics=False`` keeps harness instances out of the process
+    registry; ``enabled`` overrides the env switch for tests."""
+
+    def __init__(
+        self,
+        clock=time.perf_counter,
+        cap: int = BLOCK_CAP,
+        emit_metrics: bool = True,
+        enabled: bool | None = None,
+    ):
+        self.clock = clock
+        self.cap = int(cap)
+        self.emit_metrics = emit_metrics
+        self.enabled = storage_obs_enabled() if enabled is None else enabled
+        self._lock = threading.Lock()
+        # (op, context, table) -> [calls, bytes]; op in ("encode", "decode")
+        self._codec: dict[tuple[str, str, str], list] = {}
+        # (site, table) -> copies
+        self._copies: dict[tuple[str, str], int] = {}
+        # table -> pages written through the KeyPage repack
+        self._pages: dict[str, int] = {}
+        self._blocks: "OrderedDict[int, BlockCommitRecord]" = OrderedDict()
+        self._cur: BlockCommitRecord | None = None
+        # shard idx -> op -> bounded latency samples (doc p95 source)
+        self._shard_ms: dict[int, dict[str, list]] = {}
+        self._shard_totals: dict[int, dict[str, dict]] = {}
+        # registered pull-gauge names (register once per labeled series)
+        self._gauges: set[str] = set()
+
+    # -- codec seams (entry.py) ---------------------------------------------
+
+    def note_encode(self, n_bytes: int) -> None:
+        if not self.enabled:
+            return
+        context, table = _CTX.get()
+        key = ("encode", context, table)
+        with self._lock:
+            cell = self._codec.get(key)
+            if cell is None:
+                cell = self._codec[key] = [0, 0]
+                self._register_codec_gauge(key)
+            cell[0] += 1
+            cell[1] += n_bytes
+            cur = self._cur
+            if cur is not None and context == CTX_COMMIT:
+                cur.encode_calls += 1
+                cur.bytes_encoded += n_bytes
+
+    def note_decode(self, n_bytes: int) -> None:
+        if not self.enabled:
+            return
+        context, table = _CTX.get()
+        key = ("decode", context, table)
+        with self._lock:
+            cell = self._codec.get(key)
+            if cell is None:
+                cell = self._codec[key] = [0, 0]
+                self._register_codec_gauge(key)
+            cell[0] += 1
+            cell[1] += n_bytes
+
+    def _register_codec_gauge(self, key: tuple[str, str, str]) -> None:
+        """Pull-time gauges per labeled codec series — the hot path only
+        bumps the internal cell; the registry reads it at scrape."""
+        if not self.emit_metrics:
+            return
+        op, context, table = key
+        labels = f'op="{op}",context="{context}",table="{table}"'
+        for suffix, idx in (("calls", 0), ("bytes", 1)):
+            name = f"fisco_storage_codec_{suffix}_total{{{labels}}}"
+            if name in self._gauges:
+                continue
+            self._gauges.add(name)
+            REGISTRY.gauge_fn(
+                name,
+                lambda key=key, idx=idx: float(
+                    self._codec.get(key, (0, 0))[idx]
+                ),
+                help="Entry codec traffic by driving context "
+                "(storage observatory)",
+            )
+
+    # -- copy seams (keypage/state_storage/cache) ---------------------------
+
+    def note_copy(self, site: str, table: str = "") -> None:
+        if not self.enabled:
+            return
+        key = (site, table)
+        with self._lock:
+            n = self._copies.get(key)
+            if n is None:
+                self._copies[key] = 1
+                self._register_copy_gauge(key)
+            else:
+                self._copies[key] = n + 1
+            cur = self._cur
+            if cur is not None:
+                cur.entries_copied += 1
+
+    def _register_copy_gauge(self, key: tuple[str, str]) -> None:
+        if not self.emit_metrics:
+            return
+        site, table = key
+        name = (
+            f'fisco_storage_entry_copies_total{{site="{site}",'
+            f'table="{table}"}}'
+        )
+        if name in self._gauges:
+            return
+        self._gauges.add(name)
+        REGISTRY.gauge_fn(
+            name,
+            lambda key=key: float(self._copies.get(key, 0)),
+            help="physical Entry.copy() count per call site "
+            "(copy-amplification ledger)",
+        )
+
+    def note_pages(self, table: str, n: int) -> None:
+        """KeyPage prepare/set_rows report pages physically re-encoded."""
+        if not self.enabled or n <= 0:
+            return
+        with self._lock:
+            self._pages[table] = self._pages.get(table, 0) + n
+            cur = self._cur
+            if cur is not None:
+                cur.pages_rewritten += n
+
+    # -- per-block commit ledger (scheduler.commit_block) -------------------
+
+    def begin_commit(self, height: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._cur = BlockCommitRecord(height, self.clock())
+
+    def note_commit_rows(self, height: int, rows: int) -> None:
+        """The executor's 2PC prepare reports the block's logical write-set
+        size (overlay dirty rows + the scheduler's ledger rows)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            cur = self._cur
+            if cur is not None and cur.height == height:
+                cur.rows_written += int(rows)
+
+    def end_prepare(self, height: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            cur = self._cur
+            if cur is not None and cur.height == height:
+                cur.prepare_ms = (self.clock() - cur.t_begin) * 1e3
+
+    def finish_commit(self, height: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            cur = self._cur
+            if cur is None or cur.height != height:
+                return
+            cur.commit_ms = (self.clock() - cur.t_begin) * 1e3 - cur.prepare_ms
+            self._cur = None
+            self._blocks[height] = cur
+            while len(self._blocks) > self.cap:
+                self._blocks.popitem(last=False)
+        if self.emit_metrics and cur.rows_written > 0:
+            REGISTRY.observe(
+                "fisco_storage_copy_amplification",
+                cur.entries_copied / cur.rows_written,
+                buckets=(0.5, 1.0, 2.0, 3.0, 5.0, 8.0, 12.0, 20.0, 50.0),
+                help="entries physically copied per row logically written, "
+                "per committed block",
+            )
+
+    def abort_commit(self, height: int) -> None:
+        """A failed commit keeps its partial record (marked) — forensics
+        for the rollback path — without leaving a stuck open window."""
+        if not self.enabled:
+            return
+        with self._lock:
+            cur = self._cur
+            if cur is None or cur.height != height:
+                return
+            cur.aborted = True
+            self._cur = None
+            self._blocks[height] = cur
+            while len(self._blocks) > self.cap:
+                self._blocks.popitem(last=False)
+
+    # -- 2PC shard attribution (storage/distributed.py) ---------------------
+
+    def encode_bytes_now(self) -> int:
+        """Total encode bytes so far (any context) — the delta probe the
+        distributed backend brackets each shard leg with, so staged-byte
+        attribution costs no second encode pass."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            return sum(c[1] for k, c in self._codec.items() if k[0] == "encode")
+
+    def shard_note(
+        self, op: str, shard: int, ms: float, rows: int = 0, n_bytes: int = 0
+    ) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            samples = self._shard_ms.setdefault(shard, {}).setdefault(op, [])
+            samples.append(ms)
+            if len(samples) > _SHARD_SAMPLE_CAP:
+                del samples[: len(samples) - _SHARD_SAMPLE_CAP]
+            tot = self._shard_totals.setdefault(shard, {}).setdefault(
+                op, {"calls": 0, "rows": 0, "bytes": 0}
+            )
+            tot["calls"] += 1
+            tot["rows"] += rows
+            tot["bytes"] += n_bytes
+            cur = self._cur
+            if cur is not None:
+                d = cur.shards.setdefault(shard, {})
+                d[op] = {
+                    "ms": round(ms, 3), "rows": rows, "bytes": n_bytes,
+                }
+        if self.emit_metrics:
+            REGISTRY.observe(
+                "fisco_storage_shard_2pc_ms",
+                ms,
+                buckets=SHARD_2PC_BUCKETS_MS,
+                op=op,
+                shard=str(shard),
+                help="per-shard 2PC leg wall latency (shard attribution)",
+            )
+            if n_bytes:
+                REGISTRY.gauge_set(
+                    f'fisco_storage_shard_staged_bytes{{op="{op}",'
+                    f'shard="{shard}"}}',
+                    float(n_bytes),
+                    help="encoded bytes attributed to the shard's last "
+                    "2PC leg",
+                )
+
+    # -- snapshots -----------------------------------------------------------
+
+    def commit_bytes_total(self) -> int:
+        """Commit-context encode bytes — what ``tool/check_storage.py``
+        reconciles against the durable backend's ground truth."""
+        with self._lock:
+            return sum(
+                c[1]
+                for k, c in self._codec.items()
+                if k[0] == "encode" and k[1] == CTX_COMMIT
+            )
+
+    def blocks_snapshot(self, last: int | None = None) -> list[dict]:
+        with self._lock:
+            docs = [r.to_doc() for r in self._blocks.values()]
+        if last is not None and last >= 0:
+            docs = docs[-last:]
+        return docs
+
+    def shard_doc(self) -> dict:
+        from .roundlog import percentile
+
+        with self._lock:
+            shards = {
+                str(idx): {
+                    op: {
+                        "n": self._shard_totals[idx][op]["calls"],
+                        "rows": self._shard_totals[idx][op]["rows"],
+                        "bytes": self._shard_totals[idx][op]["bytes"],
+                        "p50_ms": round(percentile(samples, 50), 3),
+                        "p95_ms": round(percentile(samples, 95), 3),
+                        "max_ms": round(max(samples), 3) if samples else 0.0,
+                    }
+                    for op, samples in ops.items()
+                }
+                for idx, ops in self._shard_ms.items()
+            }
+        return shards
+
+    def snapshot(self, last_blocks: int = 32) -> dict:
+        """The ``GET /storage`` document body."""
+        with self._lock:
+            codec = {
+                f"{op}:{context or '-'}:{table or '-'}": {
+                    "calls": c[0], "bytes": c[1],
+                }
+                for (op, context, table), c in sorted(self._codec.items())
+            }
+            copies = {
+                f"{site}:{table or '-'}": n
+                for (site, table), n in sorted(self._copies.items())
+            }
+            pages = dict(self._pages)
+        blocks = self.blocks_snapshot(last_blocks)
+        amps = [
+            b["copy_amplification"] for b in blocks if b["rows_written"] > 0
+        ]
+        return {
+            "enabled": self.enabled,
+            "ts": time.time(),
+            "codec": codec,
+            "copies": copies,
+            "pages_rewritten": pages,
+            "blocks": blocks,
+            "shards": self.shard_doc(),
+            "totals": {
+                "encode_bytes": sum(
+                    v["bytes"] for k, v in codec.items()
+                    if k.startswith("encode:")
+                ),
+                "decode_bytes": sum(
+                    v["bytes"] for k, v in codec.items()
+                    if k.startswith("decode:")
+                ),
+                "commit_encode_bytes": self.commit_bytes_total(),
+                "entries_copied": sum(copies.values()),
+                "copy_amplification_mean": (
+                    round(sum(amps) / len(amps), 3) if amps else 0.0
+                ),
+            },
+        }
+
+    def reset(self) -> None:
+        """Bench round boundary: drop accumulated state (gauge
+        registrations persist — they read zeros)."""
+        with self._lock:
+            self._codec.clear()
+            self._copies.clear()
+            self._pages.clear()
+            self._blocks.clear()
+            self._cur = None
+            self._shard_ms.clear()
+            self._shard_totals.clear()
+
+
+# the process singleton every seam reads (`STORAGE.enabled` is the whole
+# hot-path cost when the observatory is off)
+STORAGE = StorageRecorder()
+
+
+def storage_doc() -> dict:
+    """``GET /storage`` (Air direct + the Pro split's facade forward)."""
+    return STORAGE.snapshot()

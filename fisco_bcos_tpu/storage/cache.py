@@ -13,6 +13,7 @@ import threading
 from collections import OrderedDict
 from typing import Iterator
 
+from ..observability.storagelog import STORAGE as _OBS
 from .entry import Entry
 from .interfaces import (
     TransactionalStorage,
@@ -42,7 +43,11 @@ class CacheStorage(TransactionalStorage):
                 self.hits += 1
                 self._cache.move_to_end(k)
                 e = self._cache[k]
-                return None if e is None else e.copy()
+                if e is None:
+                    return None
+                if _OBS.enabled:
+                    _OBS.note_copy("cache.get_row", table)
+                return e.copy()
             self.misses += 1
             gen = self._gen
         e = self.inner.get_row(table, key)
@@ -52,6 +57,8 @@ class CacheStorage(TransactionalStorage):
             # would serve stale state indefinitely. The generation counter
             # bumps on every commit; only same-generation reads may fill.
             if gen == self._gen:
+                if e is not None and _OBS.enabled:
+                    _OBS.note_copy("cache.fill", table)
                 self._cache[k] = None if e is None else e.copy()
                 while len(self._cache) > self.capacity:
                     self._cache.popitem(last=False)
@@ -79,6 +86,8 @@ class CacheStorage(TransactionalStorage):
 
     def _fill(self, table: str, key: bytes, entry: Entry) -> None:
         k = (table, bytes(key))
+        if not entry.deleted and _OBS.enabled:
+            _OBS.note_copy("cache.fill", table)
         with self._lock:
             self._cache[k] = None if entry.deleted else entry.copy()
             self._cache.move_to_end(k)

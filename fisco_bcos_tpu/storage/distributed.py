@@ -32,6 +32,7 @@ from __future__ import annotations
 import hashlib
 from typing import Iterator
 
+from ..observability.storagelog import STORAGE as _OBS
 from ..resilience import HEALTH
 from ..service.rpc import ServiceConnectionError, ServiceRemoteError
 from ..service.storage_service import RemoteStorage
@@ -171,7 +172,23 @@ class DistributedStorage(TransactionalStorage):
             )
         )
         for idx in range(len(self.shards)):
+            if not _OBS.enabled:
+                self.shards[idx].prepare(params, _RowsView(parts[idx]))
+                continue
+            # staged-byte attribution by encode-delta across the leg: the
+            # RemoteStorage client encodes every row for the wire inside
+            # this call, so the codec counter's movement IS the shard's
+            # staged payload — no second encode pass
+            t0 = _OBS.clock()
+            b0 = _OBS.encode_bytes_now()
             self.shards[idx].prepare(params, _RowsView(parts[idx]))
+            _OBS.shard_note(
+                "prepare",
+                idx,
+                (_OBS.clock() - t0) * 1e3,
+                rows=len(parts[idx]),
+                n_bytes=_OBS.encode_bytes_now() - b0,
+            )
 
     def commit(self, params: TwoPCParams) -> None:
         # NEVER let recovery touch the number being committed: its slot is
@@ -180,7 +197,12 @@ class DistributedStorage(TransactionalStorage):
         # with empty slots, silently losing the block's writes
         self.recover_in_flight_if_needed(exclude=params.number)
         for idx in range(len(self.shards)):  # primary first
+            if not _OBS.enabled:
+                self.shards[idx].commit(params)
+                continue
+            t0 = _OBS.clock()
             self.shards[idx].commit(params)
+            _OBS.shard_note("commit", idx, (_OBS.clock() - t0) * 1e3)
         # retire the PREVIOUS block's witness: a commit of N proves N-1 is
         # fully resolved, so at most one live witness row remains instead
         # of one per block forever

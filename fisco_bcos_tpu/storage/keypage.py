@@ -23,6 +23,12 @@ import threading
 from typing import Iterator
 
 from ..codec.flat import FlatReader, FlatWriter
+from ..observability.storagelog import (
+    CTX_COMMIT,
+    CTX_INGRESS,
+    STORAGE as _OBS,
+    codec_ctx,
+)
 from .entry import Entry, EntryStatus
 from .interfaces import (
     TransactionalStorage,
@@ -102,7 +108,11 @@ class KeyPageStorage(TransactionalStorage):
         if cached is not None:
             return list(cached)  # shallow copy: callers mutate the list
         e = self.inner.get_row(PAGE_TABLE, self._page_key(table, start))
-        items = _decode_page(e.get()) if e is not None and not e.deleted else []
+        if e is not None and not e.deleted:
+            with codec_ctx(CTX_INGRESS, table):
+                items = _decode_page(e.get())
+        else:
+            items = []
         if len(self._page_cache) >= self._CACHE_MAX_PAGES:
             self._page_cache.clear()
         self._page_cache[pk] = list(items)
@@ -181,7 +191,11 @@ class KeyPageStorage(TransactionalStorage):
                 return None
             for k, e in self._load_page_locked(table, starts[idx]):
                 if k == key:
-                    return None if e.deleted else e.copy()
+                    if e.deleted:
+                        return None
+                    if _OBS.enabled:
+                        _OBS.note_copy("keypage.get_row", table)
+                    return e.copy()
         return None
 
     def set_row(self, table: str, key: bytes, entry: Entry) -> None:
@@ -204,7 +218,10 @@ class KeyPageStorage(TransactionalStorage):
                     starts.append(key)
                     meta_dirty = True
                 start = starts[self._page_for(starts, key)]
+                if _OBS.enabled:
+                    _OBS.note_copy("keypage.set_rows", table)
                 staged.setdefault(start, {})[key] = entry.copy()
+            pages_written = 0
             for start, pending in staged.items():
                 merged = {k: e for k, e in self._load_page_locked(table, start)}
                 merged.update(pending)
@@ -215,6 +232,9 @@ class KeyPageStorage(TransactionalStorage):
                         self._delete_page_row_locked(table, cstart)
                     else:
                         self._save_page_locked(table, cstart, chunk)
+                        pages_written += 1
+            if _OBS.enabled:
+                _OBS.note_pages(table, pages_written)
             if meta_dirty:
                 self._save_meta_locked(table, starts)
 
@@ -267,6 +287,8 @@ class KeyPageStorage(TransactionalStorage):
                 # pending writes as a dict (last wins), merged into the
                 # decoded page once — per-item list surgery is quadratic
                 # on a 2000-row block write-set
+                if _OBS.enabled:
+                    _OBS.note_copy("keypage.prepare", table)
                 staged.setdefault((table, start), {})[key] = entry.copy()
             rows: list[tuple[str, bytes, Entry]] = []
             for (table, start), pending in staged.items():
@@ -274,6 +296,7 @@ class KeyPageStorage(TransactionalStorage):
                 merged = {k: e for k, e in self._load_page_locked(table, start)}
                 merged.update(pending)
                 ops, _dirty = self._chunk_page(start, sorted(merged.items()), starts)
+                pages_written = 0
                 for cstart, chunk in ops:
                     if chunk is None:
                         rows.append(
@@ -284,13 +307,18 @@ class KeyPageStorage(TransactionalStorage):
                             )
                         )
                     else:
+                        with codec_ctx(CTX_COMMIT, table):
+                            page = _encode_page(chunk)
                         rows.append(
                             (
                                 PAGE_TABLE,
                                 self._page_key(table, cstart),
-                                Entry({"value": _encode_page(chunk)}),
+                                Entry({"value": page}),
                             )
                         )
+                        pages_written += 1
+                if _OBS.enabled:
+                    _OBS.note_pages(table, pages_written)
             for table, starts in metas.items():
                 rows.append(
                     (

@@ -14,6 +14,7 @@ import sqlite3
 import threading
 from typing import Iterator
 
+from ..observability.storagelog import CTX_INGRESS, codec_ctx
 from .entry import Entry
 from .interfaces import TransactionalStorage, TraversableStorage, TwoPCParams
 
@@ -22,6 +23,11 @@ class SQLiteStorage(TransactionalStorage):
     def __init__(self, path: str = ":memory:") -> None:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
+        # durable-write ground truth (tool/check_storage.py reconciles the
+        # storage observatory's codec ledger against these): value bytes
+        # staged by 2PC prepare, and value bytes applied to `kv` by commit
+        self.bytes_staged = 0
+        self.bytes_written = 0
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute(
@@ -47,7 +53,8 @@ class SQLiteStorage(TransactionalStorage):
             ).fetchone()
         if row is None:
             return None
-        e = Entry.decode(row[0])
+        with codec_ctx(CTX_INGRESS, table):
+            e = Entry.decode(row[0])
         return None if e.deleted else e
 
     def set_row(self, table: str, key: bytes, entry: Entry) -> None:
@@ -77,7 +84,9 @@ class SQLiteStorage(TransactionalStorage):
         with self._lock:
             rows = self._conn.execute("SELECT tbl, k, v FROM kv").fetchall()
         for t, k, v in rows:
-            yield t, bytes(k), Entry.decode(v)
+            with codec_ctx(CTX_INGRESS, t):
+                e = Entry.decode(v)
+            yield t, bytes(k), e
 
     # -- 2PC ------------------------------------------------------------
 
@@ -86,13 +95,15 @@ class SQLiteStorage(TransactionalStorage):
         replacement (multi-participant 2PC: several Max executors prepare
         the same block; see MemoryStorage.prepare)."""
         with self._lock:
+            rows = [
+                (params.number, t, bytes(k), e.encode())
+                for t, k, e in writes.traverse()
+            ]
+            self.bytes_staged += sum(len(r[3]) for r in rows)
             self._conn.executemany(
                 "INSERT OR REPLACE INTO pending_2pc (num, tbl, k, v)"
                 " VALUES (?, ?, ?, ?)",
-                [
-                    (params.number, t, bytes(k), e.encode())
-                    for t, k, e in writes.traverse()
-                ],
+                rows,
             )
             self._conn.commit()
 
@@ -101,6 +112,12 @@ class SQLiteStorage(TransactionalStorage):
             # apply + clear the slot in ONE sqlite transaction: a crash
             # mid-commit leaves either the staged slot (re-commit resolves)
             # or the applied state, never half of each
+            staged = self._conn.execute(
+                "SELECT COALESCE(SUM(LENGTH(v)), 0) FROM pending_2pc"
+                " WHERE num=?",
+                (params.number,),
+            ).fetchone()
+            self.bytes_written += int(staged[0])
             self._conn.execute(
                 "INSERT OR REPLACE INTO kv (tbl, k, v)"
                 " SELECT tbl, k, v FROM pending_2pc WHERE num=?",

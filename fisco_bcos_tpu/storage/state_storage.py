@@ -22,6 +22,7 @@ import numpy as np
 
 from ..codec.flat import FlatWriter
 from ..crypto.suite import CryptoSuite
+from ..observability.storagelog import STORAGE as _OBS, codec_ctx
 from .entry import Entry, EntryStatus
 from .interfaces import StorageInterface, TraversableStorage
 
@@ -45,7 +46,11 @@ class StateStorage(TraversableStorage):
         with self._lock:
             e = self._data.get((table, key))
         if e is not None:
-            return None if e.deleted else e.copy()
+            if e.deleted:
+                return None
+            if _OBS.enabled:
+                _OBS.note_copy("state.get_row", table)
+            return e.copy()
         if self.read_track is not None:
             self.read_track.add((table, key))
         return self.prev.get_row(table, key) if self.prev else None
@@ -67,6 +72,8 @@ class StateStorage(TraversableStorage):
     # -- writes -------------------------------------------------------------
 
     def set_row(self, table: str, key: bytes, entry: Entry) -> None:
+        if _OBS.enabled:
+            _OBS.note_copy("state.set_row", table)
         with self._lock:
             self._data[(table, bytes(key))] = entry.copy()
 
@@ -78,7 +85,10 @@ class StateStorage(TraversableStorage):
     def traverse(self) -> Iterator[tuple[str, bytes, Entry]]:
         with self._lock:
             items = list(self._data.items())
+        track = _OBS.enabled
         for (t, k), e in items:
+            if track:
+                _OBS.note_copy("state.traverse", t)
             yield t, k, e.copy()
 
     def dirty_count(self) -> int:
@@ -119,11 +129,12 @@ class StateStorage(TraversableStorage):
         Order-independent XOR root over dirty entries, hashed as one device
         batch (vs the reference's tbb loop, StateStorage.h:457-486)."""
         preimages = []
-        for t, k, e in self.traverse():
-            w = FlatWriter()
-            w.str_(t)
-            w.bytes_(k)
-            preimages.append(w.out() + e.encode())
+        with codec_ctx("hash"):
+            for t, k, e in self.traverse():
+                w = FlatWriter()
+                w.str_(t)
+                w.bytes_(k)
+                preimages.append(w.out() + e.encode())
         if not preimages:
             return lambda: _ZERO32
         resolve = suite.hash_batch_async(preimages)

@@ -37,6 +37,7 @@ from fisco_bcos_tpu.analysis.harnesses import (
     QuorumCollectorHarness,
     RacyCounterHarness,
     SchedulerHarness,
+    StorageObsHarness,
 )
 from fisco_bcos_tpu.analysis.interleave import (
     Explorer,
@@ -190,7 +191,7 @@ def test_deadlock_schedule_is_reported_not_hung():
     "cls",
     [DevicePlaneHarness, ProofPlaneHarness, AdmissionQuotasHarness,
      SchedulerHarness, PipelinedCommitHarness, PipelineObsHarness,
-     QuorumCollectorHarness],
+     QuorumCollectorHarness, StorageObsHarness],
     ids=lambda c: c.name,
 )
 def test_real_harness_seeded_sweep(cls):
@@ -203,7 +204,7 @@ def test_real_harnesses_registry_complete():
     assert set(HARNESSES) == {
         "device-plane", "proof-singleflight", "admission-quotas",
         "scheduler-commit", "pipelined-commit", "pipeline-obs",
-        "qc-collector", "fleet-obs", "torn-quorum",
+        "qc-collector", "fleet-obs", "torn-quorum", "storage-obs",
     }
 
 

@@ -1,0 +1,371 @@
+"""Storage observatory (ISSUE 19): commit-path codec/copy-amplification
+ledger mechanics with an injected clock, context-tag discrimination at the
+Entry codec seam, per-shard 2PC attribution under an injected shard delay,
+the FISCO_STORAGE_OBS=0 shared-noop pins, the keypage copy-in/copy-out
+aliasing pin, and GET /storage over the Air HTTP surface plus the Pro
+split (with dead-facade degradation).
+"""
+
+import json
+import urllib.request
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from fisco_bcos_tpu.observability.storagelog import (  # noqa: E402
+    _NOOP_CTX,
+    CTX_COMMIT,
+    CTX_COPYOUT,
+    CTX_INGRESS,
+    STORAGE,
+    AllocationWindow,
+    StorageRecorder,
+    codec_ctx,
+    storage_doc,
+    storage_obs_enabled,
+)
+from fisco_bcos_tpu.storage.entry import Entry  # noqa: E402
+from fisco_bcos_tpu.storage.keypage import KeyPageStorage  # noqa: E402
+from fisco_bcos_tpu.storage.memory_storage import MemoryStorage  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    """The process singleton backs every seam: pin it enabled and empty so
+    tests neither see nor leave another test's traffic."""
+    was = STORAGE.enabled
+    STORAGE.enabled = True
+    STORAGE.reset()
+    yield
+    STORAGE.enabled = was
+    STORAGE.reset()
+
+
+def _ticker(step: float = 0.01):
+    """Deterministic injected clock: each read advances ``step`` seconds."""
+    t = {"now": 0.0}
+
+    def clock() -> float:
+        t["now"] += step
+        return t["now"]
+
+    return clock
+
+
+# -- per-block commit ledger mechanics ----------------------------------------
+
+
+def test_block_ledger_mechanics_with_injected_clock():
+    rec = StorageRecorder(clock=_ticker(), emit_metrics=False, enabled=True)
+    rec.begin_commit(7)
+    rec.note_commit_rows(7, 10)
+    with codec_ctx(CTX_COMMIT, "t_test"):
+        rec.note_encode(100)
+        rec.note_encode(150)
+    rec.note_copy("keypage.prepare", "t_test")
+    rec.note_copy("state.set_row", "t_test")
+    rec.note_pages("t_test", 2)
+    rec.end_prepare(7)
+    rec.finish_commit(7)
+    (b,) = rec.blocks_snapshot()
+    assert b["height"] == 7
+    assert b["rows_written"] == 10
+    assert b["entries_copied"] == 2
+    assert b["pages_rewritten"] == 2
+    assert b["bytes_encoded"] == 250 and b["encode_calls"] == 2
+    assert b["copy_amplification"] == 0.2
+    # injected clock: begin@0.01, end_prepare@0.02, finish@0.03
+    assert b["prepare_ms"] == pytest.approx(10.0)
+    assert b["commit_ms"] == pytest.approx(10.0)
+    assert b["aborted"] is False
+
+
+def test_block_ring_is_bounded_and_evicts_oldest():
+    rec = StorageRecorder(
+        clock=_ticker(), cap=4, emit_metrics=False, enabled=True
+    )
+    for h in range(1, 11):
+        rec.begin_commit(h)
+        rec.note_commit_rows(h, 1)
+        rec.end_prepare(h)
+        rec.finish_commit(h)
+    heights = [b["height"] for b in rec.blocks_snapshot()]
+    assert heights == [7, 8, 9, 10]
+    assert [b["height"] for b in rec.blocks_snapshot(last=2)] == [9, 10]
+
+
+def test_aborted_commit_keeps_marked_record_and_frees_the_window():
+    rec = StorageRecorder(clock=_ticker(), emit_metrics=False, enabled=True)
+    rec.begin_commit(3)
+    rec.note_commit_rows(3, 5)
+    rec.abort_commit(3)
+    (b,) = rec.blocks_snapshot()
+    assert b["aborted"] is True and b["rows_written"] == 5
+    # the window is closed: the next commit opens cleanly
+    rec.begin_commit(4)
+    rec.end_prepare(4)
+    rec.finish_commit(4)
+    assert [x["height"] for x in rec.blocks_snapshot()] == [3, 4]
+
+
+# -- codec context discrimination ---------------------------------------------
+
+
+def test_codec_context_tags_discriminate_traffic():
+    rec = StorageRecorder(emit_metrics=False, enabled=True)
+    rec.note_encode(5)  # untagged
+    with codec_ctx(CTX_INGRESS, "t_a"):
+        rec.note_decode(11)
+    with codec_ctx(CTX_COMMIT, "t_a"):
+        rec.note_encode(13)
+    with codec_ctx(CTX_COPYOUT):
+        rec.note_encode(17)
+    codec = rec.snapshot()["codec"]
+    assert codec["encode:-:-"] == {"calls": 1, "bytes": 5}
+    assert codec["decode:ingress:t_a"] == {"calls": 1, "bytes": 11}
+    assert codec["encode:commit:t_a"] == {"calls": 1, "bytes": 13}
+    assert codec["encode:copyout:-"] == {"calls": 1, "bytes": 17}
+    assert rec.commit_bytes_total() == 13
+
+
+def test_nested_codec_tags_restore_the_outer_context():
+    rec = StorageRecorder(emit_metrics=False, enabled=True)
+    with codec_ctx(CTX_COMMIT, "outer"):
+        with codec_ctx(CTX_INGRESS, "inner"):
+            rec.note_decode(10)
+        rec.note_encode(20)
+    codec = rec.snapshot()["codec"]
+    assert codec["decode:ingress:inner"]["bytes"] == 10
+    assert codec["encode:commit:outer"]["bytes"] == 20
+
+
+def test_entry_codec_seam_feeds_the_singleton():
+    with codec_ctx(CTX_COMMIT, "t_seam"):
+        buf = Entry().set(b"seam-value").encode()
+        Entry.decode(buf)
+    codec = STORAGE.snapshot()["codec"]
+    assert codec["encode:commit:t_seam"]["bytes"] == len(buf)
+    assert codec["decode:commit:t_seam"]["calls"] == 1
+
+
+# -- per-shard 2PC attribution ------------------------------------------------
+
+
+class _Writes:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def traverse(self):
+        yield from self.rows
+
+
+def test_shard_attribution_pins_an_injected_slow_shard():
+    """A FaultPlan-delayed shard must show up as THAT shard's prepare
+    latency in the shard doc — the attribution the flat 2PC stage time
+    can't provide."""
+    from fisco_bcos_tpu.resilience import (
+        FaultPlan,
+        clear_fault_plan,
+        install_fault_plan,
+    )
+    from fisco_bcos_tpu.service import StorageService
+    from fisco_bcos_tpu.storage.distributed import DistributedStorage
+    from fisco_bcos_tpu.storage.interfaces import TwoPCParams
+
+    backings = [MemoryStorage() for _ in range(3)]
+    svcs = [StorageService(b) for b in backings]
+    for s in svcs:
+        s.start()
+    try:
+        dist = DistributedStorage(
+            [(s.host, s.port) for s in svcs], timeout=5.0
+        )
+        rows = [
+            ("t", b"sh%02d" % i, Entry().set(b"v%d" % i)) for i in range(24)
+        ]
+        install_fault_plan(
+            FaultPlan(seed=19).rule(
+                "delay", "send", f"{svcs[1].port}/prepare", delay_ms=80
+            )
+        )
+        try:
+            dist.prepare(TwoPCParams(number=4), _Writes(rows))
+            dist.commit(TwoPCParams(number=4))
+        finally:
+            clear_fault_plan()
+        shards = STORAGE.shard_doc()
+        assert set(shards) == {"0", "1", "2"}
+        delayed = shards["1"]["prepare"]["p95_ms"]
+        others = max(
+            shards[i]["prepare"]["p95_ms"] for i in ("0", "2")
+        )
+        assert delayed >= 60.0, f"delayed shard not attributed: {shards}"
+        assert delayed > others + 40.0, (delayed, others)
+        # staged rows/bytes attribution rode the same legs (encode-delta,
+        # no second encode pass): every row landed on some shard
+        total_rows = sum(s["prepare"]["rows"] for s in shards.values())
+        total_bytes = sum(s["prepare"]["bytes"] for s in shards.values())
+        assert total_rows >= len(rows)
+        assert total_bytes > 0
+        assert all("commit" in s for s in shards.values())
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+# -- FISCO_STORAGE_OBS=0 noop pins --------------------------------------------
+
+
+def test_env_switch_reads_zero_as_off(monkeypatch):
+    monkeypatch.setenv("FISCO_STORAGE_OBS", "0")
+    assert storage_obs_enabled() is False
+    assert StorageRecorder(emit_metrics=False).enabled is False
+    monkeypatch.setenv("FISCO_STORAGE_OBS", "1")
+    assert storage_obs_enabled() is True
+
+
+def test_obs_off_codec_ctx_is_one_shared_noop():
+    """The disabled hot path allocates NOTHING per call: every codec_ctx
+    returns the one module-level noop context manager."""
+    STORAGE.enabled = False
+    assert codec_ctx(CTX_INGRESS, "t") is _NOOP_CTX
+    assert codec_ctx(CTX_COMMIT) is codec_ctx(CTX_COPYOUT)
+    with codec_ctx(CTX_COMMIT, "t"):  # usable, still records nothing
+        Entry().set(b"off").encode()
+
+
+def test_obs_off_records_nothing_through_every_seam():
+    STORAGE.enabled = False
+    with codec_ctx(CTX_COMMIT, "t_off"):
+        Entry().set(b"off").encode()
+    STORAGE.note_copy("state.set_row", "t_off")
+    STORAGE.note_pages("t_off", 3)
+    STORAGE.begin_commit(9)
+    STORAGE.note_commit_rows(9, 4)
+    STORAGE.shard_note("prepare", 0, 1.5, rows=4, n_bytes=64)
+    STORAGE.finish_commit(9)
+    assert STORAGE.encode_bytes_now() == 0
+    snap = STORAGE.snapshot()
+    assert snap["enabled"] is False
+    assert snap["codec"] == {} and snap["copies"] == {}
+    assert snap["blocks"] == [] and snap["shards"] == {}
+
+
+# -- keypage aliasing pin (satellite: keypage.py shallow-copy audit) ----------
+
+
+def test_keypage_copy_in_copy_out_discipline_holds():
+    """Pin the audit result: KeyPage pages never alias caller-held
+    entries. A mutation of the entry handed to set_rows, or of the entry
+    returned by get_row, must never reach the stored page — if this test
+    fails, keypage grew an aliasing leak and needs copy-on-read at the
+    failing surface."""
+    kp = KeyPageStorage(MemoryStorage())
+    mine = Entry().set(b"original")
+    kp.set_rows("t_pin", [(b"k1", mine)])
+    # copy-in: mutating the caller's entry after staging must not leak
+    mine.set(b"mutated-after-set")
+    assert kp.get_row("t_pin", b"k1").get() == b"original"
+    # copy-out: mutating the returned entry must not poison the page
+    got = kp.get_row("t_pin", b"k1")
+    got.set(b"mutated-read")
+    assert kp.get_row("t_pin", b"k1").get() == b"original"
+    # the copy ledger saw the copy-out (observability of the same seam)
+    copies = STORAGE.snapshot()["copies"]
+    assert copies.get("keypage.get_row:t_pin", 0) >= 2
+    assert copies.get("keypage.set_rows:t_pin", 0) >= 1
+
+
+# -- allocation window --------------------------------------------------------
+
+
+def test_allocation_window_names_sites_with_stage_attribution():
+    w = AllocationWindow().start()
+    blobs = [bytes(4096) for _ in range(256)]
+    top = w.top(10)
+    assert blobs and top
+    # sorted by size: the test's own 1 MiB of blobs dominates the window
+    assert top[0]["kib"] > 100.0
+    for row in top:
+        assert "site" in row and ":" in row["site"]
+        assert "stage" in row and row["stack"]
+
+
+def test_profile_report_carries_alloc_top_when_asked():
+    from fisco_bcos_tpu.observability import profiler
+
+    rep = profiler.profile(0.05, alloc=True)
+    assert isinstance(rep.get("alloc_top"), list)
+    rep_off = profiler.profile(0.05, alloc=False)
+    assert "alloc_top" not in rep_off
+
+
+# -- GET /storage: Air HTTP, Pro split, dead facade ---------------------------
+
+
+def _seed_singleton():
+    STORAGE.begin_commit(42)
+    STORAGE.note_commit_rows(42, 4)
+    with codec_ctx(CTX_COMMIT, "t_air"):
+        STORAGE.note_encode(64)
+    STORAGE.note_copy("state.set_row", "t_air")
+    STORAGE.end_prepare(42)
+    STORAGE.finish_commit(42)
+
+
+def test_storage_endpoint_over_air_http():
+    from fisco_bcos_tpu.rpc.http_server import RpcHttpServer
+
+    _seed_singleton()
+    server = RpcHttpServer(impl=None, port=0, storage=storage_doc)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/storage"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("application/json")
+            doc = json.loads(resp.read())
+    finally:
+        server.stop()
+    assert doc["enabled"] is True
+    assert any(b["height"] == 42 for b in doc["blocks"])
+    assert doc["codec"]["encode:commit:t_air"]["bytes"] == 64
+    assert doc["copies"]["state.set_row:t_air"] == 1
+    assert doc["totals"]["commit_encode_bytes"] == 64
+    assert doc["totals"]["copy_amplification_mean"] == 0.25
+
+
+def test_storage_endpoint_over_pro_split():
+    """The RPC front door forwards /storage to the node core's facade
+    (RemoteTelemetry) — the recorder lives where the scheduler lives."""
+    from fisco_bcos_tpu.service.rpc_service import RpcFacade, RpcService
+
+    _seed_singleton()
+    facade = RpcFacade(impl=None)
+    facade.start()
+    rpc = RpcService(facade.host, facade.port)
+    try:
+        rpc.start()
+        url = f"http://127.0.0.1:{rpc.port}/storage"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.loads(resp.read())
+    finally:
+        rpc.stop()
+        facade.stop()
+    assert doc["enabled"] is True
+    assert any(b["height"] == 42 for b in doc["blocks"])
+    assert doc["codec"]["encode:commit:t_air"]["calls"] == 1
+
+
+def test_remote_telemetry_storage_degrades_on_dead_facade():
+    from fisco_bcos_tpu.service.rpc_service import RemoteTelemetry
+
+    rt = RemoteTelemetry("127.0.0.1", 1, timeout=0.5)
+    try:
+        doc = rt.storage()
+        assert doc["enabled"] is False and "error" in doc
+        assert doc["blocks"] == [] and doc["codec"] == {}
+    finally:
+        rt.close()

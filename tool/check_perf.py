@@ -11,8 +11,12 @@ observatory's per-op queue/compile/transfer/execute phase vector
 ``bench_telemetry.flood.rounds.json``: the fleet observatory's aligned
 consensus-round view — per-phase span p95 across every replica and round
 (``round_phase_ms``: prepare/commit/execute/checkpoint/durable) plus the
-quorum-edge skew percentiles (``skew_ms``). This tool compares two
-artifacts of ANY of these shapes (OLD then NEW) and exits nonzero when:
+quorum-edge skew percentiles (``skew_ms``). Since ISSUE 19 it also writes
+``bench_telemetry.flood.storage.json``: the storage observatory's
+commit-path vector (``storage_commit``: codec bytes per block, entries
+copied per block, per-shard 2PC prepare/commit p95). This tool compares
+two artifacts of ANY of these shapes (OLD then NEW) and exits nonzero
+when:
 
 - any stage's self time REGRESSED by >= --threshold (default 20%) — with
   an absolute floor (--min-ms, default 5 ms) so microsecond stages can't
@@ -22,6 +26,8 @@ artifacts of ANY of these shapes (OLD then NEW) and exits nonzero when:
   kernel regression — it shows separately as ``cold_compiles``); or
 - any consensus phase's round-span p95 regressed by the same gates, or
   the fleet's quorum-edge skew p95 did; or
+- any commit-path storage series (codec bytes/block, entries copied per
+  block, shard 2PC p95) regressed by the same gates; or
 - flood TPS dropped by >= --tps-threshold (default 20%).
 
 Improvements are reported, never fatal. Stages present in only one
@@ -49,11 +55,18 @@ def load_artifact(path: str) -> dict:
         doc = json.load(f)
     if not any(
         k in doc
-        for k in ("stage_self_ms", "flood_tps", "op_phase_ms", "round_phase_ms")
+        for k in (
+            "stage_self_ms",
+            "flood_tps",
+            "op_phase_ms",
+            "round_phase_ms",
+            "storage_commit",
+        )
     ):
         raise ValueError(
             f"{path}: not a round artifact (expected stage_self_ms, "
-            "op_phase_ms, round_phase_ms and/or flood_tps keys)"
+            "op_phase_ms, round_phase_ms, storage_commit and/or "
+            "flood_tps keys)"
         )
     return doc
 
@@ -69,15 +82,17 @@ def diff(
     regressions: list[str] = []
     notes: list[str] = []
 
-    def diff_series(kind: str, noun: str, old_map: dict, new_map: dict):
+    def diff_series(
+        kind: str, noun: str, old_map: dict, new_map: dict, unit: str = " ms"
+    ):
         for name in sorted(set(old_map) | set(new_map)):
             o = old_map.get(name)
             n = new_map.get(name)
             if o is None:
-                notes.append(f"{kind} added: {name} ({n:.1f} ms)")
+                notes.append(f"{kind} added: {name} ({n:.1f}{unit})")
                 continue
             if n is None:
-                notes.append(f"{kind} removed: {name} (was {o:.1f} ms)")
+                notes.append(f"{kind} removed: {name} (was {o:.1f}{unit})")
                 continue
             if n - o >= min_ms and (o <= 0 or (n / o - 1.0) >= threshold):
                 # o == 0 with a real delta is an unbounded regression, not
@@ -86,12 +101,12 @@ def diff(
                     f"+{(n / o - 1.0) * 100.0:.0f}%" if o > 0 else "from zero"
                 )
                 regressions.append(
-                    f"{kind} {name}: {noun} {o:.1f} -> {n:.1f} ms "
+                    f"{kind} {name}: {noun} {o:.1f} -> {n:.1f}{unit} "
                     f"({grew}, threshold {threshold * 100.0:.0f}%)"
                 )
             elif o - n >= min_ms and n > 0 and (o / n - 1.0) >= threshold:
                 notes.append(
-                    f"{kind} {name}: improved {o:.1f} -> {n:.1f} ms "
+                    f"{kind} {name}: improved {o:.1f} -> {n:.1f}{unit} "
                     f"(-{(1.0 - n / o) * 100.0:.0f}%)"
                 )
 
@@ -126,6 +141,15 @@ def diff(
         {
             "quorum_edge_skew": (new.get("skew_ms") or {}).get("p95", 0.0)
         } if "round_phase_ms" in new else {},
+    )
+    # storage-commit artifacts (ISSUE 19): codec bytes/block, entries
+    # copied per block and per-shard 2PC p95 — mixed units, so the diff
+    # prints bare numbers; the same relative + absolute-floor gates apply
+    # (codec bytes/block sits in the thousands, far above the floor)
+    diff_series(
+        "storage", "commit path",
+        old.get("storage_commit") or {}, new.get("storage_commit") or {},
+        unit="",
     )
     o_tps, n_tps = old.get("flood_tps"), new.get("flood_tps")
     if o_tps and n_tps is not None:
