@@ -1219,6 +1219,7 @@ def _dump_device_artifact(tag: str, window_s: float, warm_ledger) -> None:
         "compile_counts": compile_counts(),
         "storm": LEDGER.storm_state(),
         "obs_overhead_s": round(LEDGER.overhead_seconds(), 6),
+        "adjacency": LEDGER.adjacency(),
         "warm_round": warm_ledger,
     }
     base = os.path.dirname(os.path.abspath(__file__))
@@ -1601,6 +1602,40 @@ if __name__ == "__main__":
                 "# --telemetry refused: static-analysis baseline has "
                 f"unreviewed regressions ({len(_new)} new finding(s), "
                 f"{len(_stale)} stale entr(ies))",
+                flush=True,
+            )
+            raise SystemExit(2)
+        # same refusal for the jaxpr baseline: a dashboard artifact must
+        # not be produced while the committed program fingerprints don't
+        # cover the inventory. Fast path — one cheap program re-traced,
+        # coverage/stale checked by NAME against the full inventory
+        # (`--jaxpr` re-traces everything non-slow; too slow for here).
+        from fisco_bcos_tpu.analysis import progaudit as _progaudit
+
+        _jres = _progaudit.audit(
+            programs=["fisco_bcos_tpu/ops/keccak.py:keccak256_blocks"]
+        )
+        _jdiff = _progaudit.diff_audit(
+            _jres, _progaudit.load_jaxpr_baseline()
+        )
+        if not _jdiff["ok"]:
+            for _c in _jdiff["changed"]:
+                print(
+                    f"# jaxpr: CHANGED {_c['key']}: {_c['explanation']}",
+                    flush=True,
+                )
+            for _lbl in ("new", "stale", "missing", "missing_spec"):
+                for _k in _jdiff[_lbl]:
+                    print(f"# jaxpr: {_lbl}: {_k}", flush=True)
+            for _f in _jdiff["failures"]:
+                print(
+                    f"# jaxpr: failure: {_f['key']}: {_f['error']}",
+                    flush=True,
+                )
+            print(
+                "# --telemetry refused: tool/jaxpr_baseline.json is stale "
+                "vs the jit inventory (python -m fisco_bcos_tpu.analysis "
+                "--jaxpr, then --update-jaxpr-baseline after review)",
                 flush=True,
             )
             raise SystemExit(2)

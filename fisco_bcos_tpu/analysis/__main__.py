@@ -54,7 +54,47 @@ def main(argv: list[str] | None = None) -> int:
         "--update-baseline", action="store_true",
         help="rewrite the baseline file to the current finding set",
     )
+    p.add_argument(
+        "--jaxpr", action="store_true",
+        help="abstract-eval the jit inventory and diff fingerprints/costs "
+        "against tool/jaxpr_baseline.json (new/stale/changed/missing all "
+        "fail; slow-marked programs verify by coverage only)",
+    )
+    p.add_argument(
+        "--jaxpr-full", action="store_true",
+        help="like --jaxpr but re-trace slow-marked programs too (the BLS "
+        "pairing Miller loops — minutes-class)",
+    )
+    p.add_argument(
+        "--jaxpr-programs", default=None, metavar="KEYS",
+        help="comma-separated file:qualname (or bare qualname) subset to "
+        "audit; coverage/stale checks still run against the full inventory",
+    )
+    p.add_argument(
+        "--jaxpr-baseline", default=None,
+        help="jaxpr baseline path (default tool/jaxpr_baseline.json)",
+    )
+    p.add_argument(
+        "--update-jaxpr-baseline", action="store_true",
+        help="re-audit the FULL inventory (slow programs included) and "
+        "rewrite the jaxpr baseline — review the diff before committing",
+    )
+    p.add_argument(
+        "--fusion-report", action="store_true",
+        help="rank mergeable program pairs from the jaxpr baseline "
+        "(+ measured dispatch adjacency via --adjacency)",
+    )
+    p.add_argument(
+        "--adjacency", default=None, metavar="JSON",
+        help="device artifact (GET /device or bench_telemetry.*.device."
+        "json) whose 'adjacency' map weights the fusion report",
+    )
     args = p.parse_args(argv)
+
+    if args.update_jaxpr_baseline or args.jaxpr or args.jaxpr_full:
+        return _jaxpr_main(args)
+    if args.fusion_report:
+        return _fusion_main(args)
 
     if args.list_jit:
         from . import jitmap
@@ -152,6 +192,96 @@ def main(argv: list[str] | None = None) -> int:
             f"baselined, {len(stale)} stale baseline entr(ies)"
         )
     return 1 if (new or stale) else 0
+
+
+def _jaxpr_main(args) -> int:
+    """--jaxpr / --jaxpr-full / --update-jaxpr-baseline. Lazy progaudit
+    import: these are the only CLI paths that load jax."""
+    from . import progaudit
+
+    if args.update_jaxpr_baseline:
+        result = progaudit.audit(args.root, include_slow=True)
+        if result["failures"] or result["missing_spec"]:
+            for f in result["failures"]:
+                print(f"audit failure: {f['key']}: {f['error']}")
+            for k in result["missing_spec"]:
+                print(f"no PROGSPEC entry for inventoried program: {k}")
+            return 1
+        progaudit.save_jaxpr_baseline(result, args.jaxpr_baseline)
+        traced = sum(
+            1 for e in result["programs"].values() if "skip" not in e
+        )
+        print(
+            f"jaxpr baseline updated: {traced} program(s) fingerprinted, "
+            f"{len(result['programs']) - traced} skipped with reasons"
+        )
+        return 0
+
+    programs = None
+    if args.jaxpr_programs:
+        programs = [k for k in args.jaxpr_programs.split(",") if k]
+    result = progaudit.audit(
+        args.root, programs=programs, include_slow=args.jaxpr_full
+    )
+    baseline = progaudit.load_jaxpr_baseline(args.jaxpr_baseline)
+    diff = progaudit.diff_audit(result, baseline)
+    if args.format == "json":
+        print(json.dumps(diff, indent=2))
+    else:
+        for key in diff["new"]:
+            print(f"NEW program (baseline it): {key}")
+        for key in diff["stale"]:
+            print(f"stale baseline entry (program deleted?): {key}")
+        for key in diff["missing"]:
+            print(f"inventory program missing from baseline: {key}")
+        for c in diff["changed"]:
+            print(f"CHANGED {c['key']}: {c['explanation']}")
+        for f in diff["failures"]:
+            print(f"audit failure: {f['key']}: {f['error']}")
+        for k in diff["missing_spec"]:
+            print(f"no PROGSPEC entry for inventoried program: {k}")
+        audited = sum(
+            1 for e in result["programs"].values() if "skip" not in e
+        )
+        print(
+            f"jaxpr audit: {audited} traced, "
+            f"{len(result['not_traced'])} deferred "
+            f"(slow/subset), {len(diff['changed'])} changed, "
+            f"{len(diff['new'])} new, {len(diff['stale'])} stale, "
+            f"{len(diff['missing'])} missing"
+        )
+    return 0 if diff["ok"] else 1
+
+
+def _fusion_main(args) -> int:
+    from . import progaudit
+
+    baseline = progaudit.load_jaxpr_baseline(args.jaxpr_baseline)
+    adjacency = None
+    if args.adjacency:
+        with open(args.adjacency, encoding="utf-8") as f:
+            adjacency = json.load(f).get("adjacency") or None
+    report = progaudit.fusion_report(baseline, adjacency)
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        chain = report["admission_chain"]
+        print(
+            "admission chain "
+            + " -> ".join(chain["ops"])
+            + f": ~{chain['predicted_saved_bytes']} B/round saved, "
+            f"{chain['dispatches_collapsed']} dispatches collapsed"
+        )
+        for r in report["pairs"]:
+            print(
+                f"{r['producer']} -> {r['consumer']}  "
+                f"[{r['source']}, x{r['count']}]  "
+                f"~{r['saved_bytes_per_dispatch']} B/dispatch, "
+                f"total ~{r['predicted_saved_bytes']} B"
+            )
+        if not report["pairs"]:
+            print("no rankable pairs (is tool/jaxpr_baseline.json present?)")
+    return 0 if report["pairs"] else 1
 
 
 if __name__ == "__main__":

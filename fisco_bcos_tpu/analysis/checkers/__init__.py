@@ -5,16 +5,22 @@ from __future__ import annotations
 from .atomicity import AtomicityChecker
 from .contracts import ContractChecker
 from .device_dispatch import DeviceDispatchChecker
+from .dtype_drift import DtypeDriftChecker
 from .exceptions import ExceptionHygieneChecker
 from .guarded_state import GuardedStateChecker
+from .host_sync import HostSyncChecker
 from .jit_purity import JitPurityChecker
 from .lock_order import LockOrderChecker
+from .program_coherence import ProgramCoherenceChecker
 from .shape_bucket import ShapeBucketChecker
 
 ALL_CHECKERS = (
     DeviceDispatchChecker,
     ShapeBucketChecker,
     JitPurityChecker,
+    HostSyncChecker,
+    DtypeDriftChecker,
+    ProgramCoherenceChecker,
     LockOrderChecker,
     GuardedStateChecker,
     AtomicityChecker,

@@ -1,0 +1,162 @@
+"""x64/object creep and weak-type widening around traced bodies.
+
+The device kernels are uint32 limb arithmetic end to end: one stray
+``float64``/``int64`` inside a traced body silently doubles a buffer and
+splits a fused loop, and a weakly-typed Python scalar fed to a jitted
+program widens its input signature (a NEW compile per literal dtype).
+The jaxpr auditor pins the realized dtype histogram per program in
+``tool/jaxpr_baseline.json``; this checker catches the SOURCE of drift
+at the AST, before anyone traces.
+
+Rule, inside jit-traced bodies (the :mod:`..jitmap` inventory):
+
+- no ``np.float64``/``jnp.int64``/``uint64``/``complex128``/``object_``
+  attribute loads, and no ``"float64"``-style dtype string literals;
+- no ``astype(float)`` / ``dtype=float``/``int``/``object`` — Python
+  builtin types resolve to x64 under ``jax_enable_x64`` and weak-type
+  otherwise, both drift;
+
+and at program boundaries: no bare Python float literals or
+``float(...)``/``int(...)`` results as positional args in a CALL to a
+jit-inventory name (weak-type widening at the input signature).
+
+Host-side constant prep (``np.uint64`` tables built at import/trace time
+outside traced defs) is deliberately out of scope — numpy on host
+constants folds at trace time and never reaches a device buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import jitmap
+from ..core import Checker, Finding, Source, qualnames
+
+_X64_ATTRS = {
+    "float64", "int64", "uint64", "complex128", "float_", "object_",
+    "longdouble", "float128",
+}
+_X64_STRINGS = {"float64", "int64", "uint64", "complex128", "object"}
+_WEAK_BUILTINS = {"float", "int", "object"}
+
+
+def _called_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+class DtypeDriftChecker(Checker):
+    name = "dtype-drift"
+    description = (
+        "x64/object dtypes inside traced bodies and weak-type scalars at "
+        "program inputs double buffers and fork compiles"
+    )
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        jits = jitmap.collect(sources)
+        jit_names = jitmap.callable_names(jits)
+        out: list[Finding] = []
+        for src in sources:
+            qn = qualnames(src.tree)
+            traced = [j.node for j in jits if j.source is src]
+            for body in traced:
+                symbol = qn.get(body, body.name)
+                out.extend(self._scan_traced(src, body, symbol))
+            out.extend(self._scan_boundaries(src, qn, jit_names))
+        return out
+
+    def _scan_traced(
+        self, src: Source, body: ast.FunctionDef, symbol: str
+    ) -> list[Finding]:
+        found: list[Finding] = []
+        for node in ast.walk(body):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _X64_ATTRS
+                and isinstance(node.ctx, ast.Load)
+            ):
+                if not src.waived(node.lineno, self.name):
+                    found.append(
+                        self.finding(
+                            src, node, symbol, f"x64-{node.attr}",
+                            f"`{node.attr}` inside a traced body — the "
+                            "kernels are 32-bit limb planes; an x64 "
+                            "buffer doubles bytes and splits fusion",
+                        )
+                    )
+            elif isinstance(node, ast.keyword) and node.arg == "dtype":
+                hit = self._dtype_value_drift(node.value)
+                if hit and not src.waived(node.value.lineno, self.name):
+                    found.append(
+                        self.finding(
+                            src, node.value, symbol, f"dtype-{hit}",
+                            f"dtype={hit} inside a traced body drifts the "
+                            "program off its 32-bit plane",
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "astype"
+                    and node.args
+                ):
+                    hit = self._dtype_value_drift(node.args[0])
+                    if hit and not src.waived(node.lineno, self.name):
+                        found.append(
+                            self.finding(
+                                src, node, symbol, f"astype-{hit}",
+                                f"astype({hit}) inside a traced body "
+                                "drifts the program off its 32-bit plane",
+                            )
+                        )
+        return found
+
+    @staticmethod
+    def _dtype_value_drift(value: ast.AST) -> str | None:
+        if isinstance(value, ast.Constant) and value.value in _X64_STRINGS:
+            return str(value.value)
+        if isinstance(value, ast.Name) and value.id in _WEAK_BUILTINS:
+            return value.id
+        if isinstance(value, ast.Attribute) and value.attr in _X64_ATTRS:
+            return value.attr
+        return None
+
+    def _scan_boundaries(
+        self, src: Source, qn: dict, jit_names: set[str]
+    ) -> list[Finding]:
+        found: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            called = _called_name(node)
+            if called not in jit_names:
+                continue
+            for arg in node.args:
+                weak = None
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, float
+                ):
+                    weak = f"float-literal-{arg.value}"
+                elif (
+                    isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id in ("float", "int")
+                ):
+                    weak = f"{arg.func.id}-call"
+                if weak is None or src.waived(node.lineno, self.name):
+                    continue
+                found.append(
+                    self.finding(
+                        src, node, qn.get(node, ""),
+                        f"weak-arg-{called}-{weak}",
+                        f"weakly-typed scalar fed to jitted `{called}` "
+                        "widens its input signature — one extra compile "
+                        "per literal dtype; pass a typed array instead",
+                    )
+                )
+        return found

@@ -1,0 +1,203 @@
+"""Host-sync discipline around device values.
+
+A value returned by a jitted program (or a ``*_device`` helper) is an
+async device future; touching it with ``.item()``,
+``.block_until_ready()``, ``np.asarray``/``np.array``, or an implicit
+``float()``/``int()`` forces a host round-trip that serializes the
+dispatch pipeline. Inside a PIPELINE stage-busy/blocked region or a
+plane-dispatch path that sync steals wall from the stage occupancy the
+PR 9 observatory measures.
+
+Rule: flag any sync expression applied to a local bound from a call to a
+jit-inventory name or a ``*_device``-suffixed callable — and flag
+``.item()``/``.block_until_ready()`` on ANYTHING inside a
+``with PIPELINE.busy(...)/PIPELINE.blocked(...)`` block (a stage region
+must never park on a device future it didn't dispatch).
+
+Intended sync points DO exist — the ops host wrappers materialize device
+results at the plane boundary by design. Those sites carry an in-code
+``# analysis: allow(host-sync, <why>)`` waiver naming the contract; the
+checker keeps every new, unreviewed sync a red diff.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import jitmap
+from ..core import Checker, Finding, Source, qualnames
+
+_NP_MODULES = {"np", "numpy", "onp", "jnp"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_SYNC_BUILTINS = {"float", "int"}
+
+
+def _called_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _is_np_materialize(call: ast.Call) -> bool:
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr in ("asarray", "array")
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id in _NP_MODULES
+    )
+
+
+def _stage_region_lines(tree: ast.Module) -> set[int]:
+    """Line numbers inside ``with PIPELINE.busy(...)/blocked(...)``."""
+    lines: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if (
+                isinstance(ctx, ast.Call)
+                and isinstance(ctx.func, ast.Attribute)
+                and ctx.func.attr in ("busy", "blocked")
+                and isinstance(ctx.func.value, ast.Name)
+                and ctx.func.value.id == "PIPELINE"
+            ):
+                end = getattr(node, "end_lineno", node.lineno)
+                lines.update(range(node.lineno, end + 1))
+                break
+    return lines
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    description = (
+        "host syncs (.item()/np.asarray/block_until_ready/float()) on "
+        "device values serialize the dispatch pipeline — waive only at "
+        "intended plane sync points"
+    )
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        jits = jitmap.collect(sources)
+        jit_names = jitmap.callable_names(jits)
+        out: list[Finding] = []
+        for src in sources:
+            qn = qualnames(src.tree)
+            stage_lines = _stage_region_lines(src.tree)
+            for fn_node in ast.walk(src.tree):
+                if not isinstance(
+                    fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                symbol = qn.get(fn_node, fn_node.name)
+                device_vars = self._device_vars(fn_node, jit_names)
+                for sub in ast.walk(fn_node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    hit = self._sync_detail(
+                        sub, device_vars, jit_names, stage_lines
+                    )
+                    if hit is None:
+                        continue
+                    detail, what = hit
+                    if src.waived(sub.lineno, self.name):
+                        continue
+                    in_stage = sub.lineno in stage_lines
+                    where = (
+                        "inside a PIPELINE stage region "
+                        if in_stage
+                        else ""
+                    )
+                    out.append(
+                        self.finding(
+                            src,
+                            sub,
+                            symbol,
+                            detail,
+                            f"`{what}` forces a host sync on a device "
+                            f"value {where}— it parks the dispatch "
+                            "pipeline on one future; keep results on "
+                            "device or waive the intended plane sync "
+                            "point",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _device_vars(
+        fn_node: ast.AST, jit_names: set[str]
+    ) -> set[str]:
+        """Locals bound (possibly via tuple unpack) from jit/device calls."""
+        names: set[str] = set()
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            if not isinstance(val, ast.Call):
+                continue
+            called = _called_name(val)
+            if called is None or not (
+                called in jit_names or called.endswith("_device")
+            ):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    names.update(
+                        e.id for e in tgt.elts if isinstance(e, ast.Name)
+                    )
+        return names
+
+    @staticmethod
+    def _sync_detail(
+        call: ast.Call,
+        device_vars: set[str],
+        jit_names: set[str],
+        stage_lines: set[int],
+    ) -> tuple[str, str] | None:
+        fn = call.func
+        # x.item() / x.block_until_ready()
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+            if isinstance(fn.value, ast.Name) and fn.value.id in device_vars:
+                return (
+                    f"{fn.attr}-{fn.value.id}",
+                    f"{fn.value.id}.{fn.attr}()",
+                )
+            if call.lineno in stage_lines:
+                return (f"{fn.attr}-in-stage", f".{fn.attr}()")
+            return None
+        # np.asarray(x) / np.array(x) on a device value or a direct jit call
+        if _is_np_materialize(call) and call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Name) and arg.id in device_vars:
+                return (
+                    f"{fn.attr}-{arg.id}",
+                    f"{fn.value.id}.{fn.attr}({arg.id})",
+                )
+            if isinstance(arg, ast.Call):
+                inner = _called_name(arg)
+                if inner is not None and (
+                    inner in jit_names or inner.endswith("_device")
+                ):
+                    return (
+                        f"{fn.attr}-{inner}",
+                        f"{fn.value.id}.{fn.attr}({inner}(...))",
+                    )
+            return None
+        # float(x) / int(x) — the implicit scalar sync
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in _SYNC_BUILTINS
+            and len(call.args) == 1
+            and isinstance(call.args[0], ast.Name)
+            and call.args[0].id in device_vars
+        ):
+            return (
+                f"{fn.id}-{call.args[0].id}",
+                f"{fn.id}({call.args[0].id})",
+            )
+        return None

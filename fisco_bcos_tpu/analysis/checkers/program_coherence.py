@@ -1,0 +1,139 @@
+"""Program-coherence: every jitted program declares its audit shape, and
+nothing pads to a shape the bucket ladder never produces.
+
+Three rules, all against the :mod:`..jitmap` inventory:
+
+- **missing-spec**: a module defining a jit-traced program must carry a
+  module-level ``PROGSPEC`` dict with an entry (inputs or an explicit
+  skip reason) for every traced qualname — that declaration is what lets
+  :mod:`..progaudit` abstract-eval the program without importing guesses,
+  and what ``tool/jaxpr_baseline.json`` keys against.
+- **stale-spec**: a ``PROGSPEC`` key naming no traced def in its module
+  is a leftover from a deleted/renamed program; it would silently drop
+  out of the audit.
+- **pad-off-ladder**: ``pad_rows(x, LITERAL)`` where the literal is not a
+  bucket-ladder rung (powers of two to 2048, then multiples of 2048 —
+  mirrored from ``ops/hash_common._bucket`` WITHOUT the
+  ``FISCO_TEST_BUCKET`` override, which is a test-only quantization):
+  feeding an off-ladder shape compiles a program no warm cache ever
+  holds.
+
+Whether the declared shapes actually abstract-eval is checked at
+``--jaxpr`` time by the engine (an AST checker cannot trace); a spec
+whose shapes fail shows up there as an audit failure, not here.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import jitmap
+from ..core import Checker, Finding, Source
+
+
+def _ladder_bucket(n: int) -> int:
+    if n <= 1:
+        return 1
+    if n <= 2048:
+        return 1 << (n - 1).bit_length()
+    return -(-n // 2048) * 2048
+
+
+def _progspec_keys(tree: ast.Module) -> set[str] | None:
+    """Keys of the module-level PROGSPEC dict; None when absent."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if "PROGSPEC" in targets and isinstance(node.value, ast.Dict):
+                return {
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                }
+    return None
+
+
+class ProgramCoherenceChecker(Checker):
+    name = "program-coherence"
+    description = (
+        "every jitted program needs a PROGSPEC audit shape (no stale "
+        "keys), and pad targets must sit on the bucket ladder"
+    )
+
+    def run(self, sources: list[Source]) -> list[Finding]:
+        jits = jitmap.collect(sources)
+        out: list[Finding] = []
+        by_src: dict[int, list] = {}
+        for j in jits:
+            by_src.setdefault(id(j.source), []).append(j)
+        for src in sources:
+            mine = by_src.get(id(src), [])
+            if mine:
+                out.extend(self._check_specs(src, mine))
+            out.extend(self._check_pads(src))
+        return out
+
+    def _check_specs(self, src: Source, mine: list) -> list[Finding]:
+        found: list[Finding] = []
+        keys = _progspec_keys(src.tree)
+        traced = {j.qualname for j in mine}
+        for j in mine:
+            if keys is not None and j.qualname in keys:
+                continue
+            if src.waived(j.node.lineno, self.name):
+                continue
+            found.append(
+                self.finding(
+                    src, j.node, j.qualname,
+                    f"missing-spec-{j.qualname}",
+                    f"jitted `{j.qualname}` has no PROGSPEC entry — "
+                    "declare its audit shapes (or a skip reason) so the "
+                    "jaxpr baseline covers it",
+                )
+            )
+        for key in sorted((keys or set()) - traced):
+            found.append(
+                self.finding(
+                    src, src.tree, "PROGSPEC", f"stale-spec-{key}",
+                    f"PROGSPEC entry `{key}` names no jit-traced def in "
+                    "this module — deleted/renamed program leaves a dead "
+                    "audit entry",
+                )
+            )
+        return found
+
+    def _check_pads(self, src: Source) -> list[Finding]:
+        found: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name not in ("pad_rows", "_pad_rows") or len(node.args) < 2:
+                continue
+            target = node.args[1]
+            if not (
+                isinstance(target, ast.Constant)
+                and isinstance(target.value, int)
+            ):
+                continue
+            n = target.value
+            if n >= 1 and _ladder_bucket(n) == n:
+                continue
+            if src.waived(node.lineno, self.name):
+                continue
+            found.append(
+                self.finding(
+                    src, node, "", f"pad-off-ladder-{n}",
+                    f"pad_rows(..., {n}) pads to a shape the bucket "
+                    f"ladder never produces (nearest rung: "
+                    f"{_ladder_bucket(max(n, 1))}) — that program misses "
+                    "every warm cache",
+                )
+            )
+        return found

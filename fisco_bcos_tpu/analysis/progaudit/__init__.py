@@ -1,0 +1,45 @@
+"""progaudit — jaxpr-level static analysis of the device-program inventory.
+
+The AST checkers (:mod:`..checkers`) see Python source and the device
+observatory (:mod:`...observability.device`) sees runtime phases; this
+package closes the gap in between: it **abstract-evals** every program in
+the :mod:`..jitmap` inventory under its declared bucket-ladder shape —
+``jax.make_jaxpr`` only, no device, no compile (a tier-1 test pins the
+compile ledger at zero entries during an audit) — and derives, per
+program:
+
+- a **canonical fingerprint** (:mod:`.fingerprint`): a stable hash of the
+  normalized eqn graph (primitive names, shapes, dtypes; invariant to
+  variable naming and to jit-wrapper renames), committed to
+  ``tool/jaxpr_baseline.json`` and diffed like ``analysis_baseline.json``
+  — new AND stale AND changed fingerprints fail, with a per-primitive
+  eqn-count explanation for changes;
+- a **static cost model** (:mod:`.costmodel`): device-op (flop) estimate,
+  input/output/intermediate bytes, and a structural dtype histogram;
+- the **fusion-edge report** (:mod:`.fusion`): static producer/consumer
+  signatures joined with the DevicePlane's measured dispatch adjacency to
+  rank mergeable program pairs by predicted saved transfer bytes — the
+  work-list the ROADMAP's fused admission program starts from.
+
+Program shapes come from ``PROGSPEC`` declarations next to the jitted
+defs themselves (the `program-coherence` checker enforces that every
+inventoried program has one); :mod:`.engine` joins inventory x specs,
+runs the audit and owns the baseline diff.
+
+Everything importable here defers ``import jax`` until an audit actually
+runs, so :mod:`fisco_bcos_tpu.analysis` keeps its jax-free promise for
+the AST-only paths.
+"""
+
+from __future__ import annotations
+
+from .engine import (  # noqa: F401
+    DEFAULT_JAXPR_BASELINE,
+    audit,
+    diff_audit,
+    inventory_keys,
+    load_jaxpr_baseline,
+    save_jaxpr_baseline,
+)
+from .fingerprint import explain_change, fingerprint  # noqa: F401
+from .fusion import ADMISSION_CHAIN, fusion_report  # noqa: F401

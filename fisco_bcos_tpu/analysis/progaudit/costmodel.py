@@ -1,0 +1,143 @@
+"""Static cost model over an abstract-eval'd jaxpr.
+
+Estimates, WITHOUT executing anything:
+
+- ``flops``: device-op count — one op per output element for elementwise
+  primitives, ``2·N·K`` for ``dot_general`` (from its dimension numbers),
+  input-size for reductions. The kernels here are integer limb
+  arithmetic, so "flop" reads as "device lane op"; the number is a
+  RELATIVE ranking signal for the fusion report, not a wall-clock
+  prediction.
+- ``bytes_in`` / ``bytes_out``: program boundary transfer — what a fused
+  neighbor would save by never round-tripping through the host.
+- ``bytes_intermediate``: sum of every eqn's output aval bytes, with
+  ``scan`` bodies multiplied by their trip count — the live-buffer
+  pressure a fusion would add to one program.
+- ``dtypes``: structural output-dtype histogram (each eqn counted once,
+  trip counts NOT applied) — pinned in the baseline so x64 creep inside
+  a traced body is a red diff even when the eqn count is unchanged.
+
+Deliberately ignored: fusion XLA already does within one program,
+layout/padding overhead, and ``while_loop`` trip counts (unknowable
+statically — bodies count once; the repo's kernels use ``scan`` with
+static lengths everywhere it matters). ``cond`` branches count at the
+max across branches.
+"""
+
+from __future__ import annotations
+
+from .fingerprint import _is_closed_jaxpr, _sub_jaxprs
+
+
+def _aval_bytes(aval) -> int:
+    if not (hasattr(aval, "shape") and hasattr(aval, "dtype")):
+        return 0
+    n = 1
+    for d in aval.shape:
+        n *= int(d)
+    return n * int(aval.dtype.itemsize)
+
+
+def _out_elems(eqn) -> int:
+    total = 0
+    for v in eqn.outvars:
+        if hasattr(v.aval, "shape"):
+            n = 1
+            for d in v.aval.shape:
+                n *= int(d)
+            total += n
+    return total
+
+
+def _in_elems(eqn) -> int:
+    total = 0
+    for a in eqn.invars:
+        aval = getattr(a, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            n = 1
+            for d in aval.shape:
+                n *= int(d)
+            total += n
+    return total
+
+
+_REDUCERS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin", "cumsum", "cumlogsumexp",
+    "cummax", "cummin", "cumprod", "sort",
+}
+# structural/zero-cost: data movement the compiler folds into layouts
+_FREE = {
+    "reshape", "squeeze", "broadcast_in_dim", "convert_element_type",
+    "transpose", "slice", "concatenate", "pad", "rev", "copy",
+    "stop_gradient", "bitcast_convert_type",
+}
+
+
+def _eqn_flops(eqn) -> int:
+    name = eqn.primitive.name
+    if name in _FREE:
+        return 0
+    if name == "dot_general":
+        dn = eqn.params.get("dimension_numbers")
+        contract = dn[0][0] if dn else ()
+        lhs = eqn.invars[0].aval
+        k = 1
+        for axis in contract:
+            k *= int(lhs.shape[axis])
+        return 2 * k * _out_elems(eqn)
+    if name in _REDUCERS:
+        return _in_elems(eqn)
+    return _out_elems(eqn)
+
+
+def _trip_count(eqn) -> int:
+    if eqn.primitive.name == "scan":
+        return max(int(eqn.params.get("length", 1)), 1)
+    return 1
+
+
+def _walk(jaxpr, mult: int, acc: dict) -> None:
+    for eqn in jaxpr.eqns:
+        trip = _trip_count(eqn)
+        subs = [
+            s for pv in eqn.params.values() for s in _sub_jaxprs(pv)
+        ]
+        if eqn.primitive.name == "cond" and subs:
+            # branches are alternatives: charge the worst one
+            costs = []
+            for s in subs:
+                sub_acc = {"flops": 0, "bytes_intermediate": 0}
+                _walk(s, mult, sub_acc)
+                costs.append(sub_acc)
+            worst = max(costs, key=lambda c: c["flops"])
+            acc["flops"] += worst["flops"]
+            acc["bytes_intermediate"] += worst["bytes_intermediate"]
+        elif subs:
+            for s in subs:
+                _walk(s, mult * trip, acc)
+        else:
+            acc["flops"] += _eqn_flops(eqn) * mult
+        acc["bytes_intermediate"] += (
+            sum(_aval_bytes(v.aval) for v in eqn.outvars) * mult * trip
+        )
+
+
+def cost(closed_jaxpr) -> dict:
+    """Static cost estimate for one traced program (see module doc)."""
+    jaxpr = (
+        closed_jaxpr.jaxpr if _is_closed_jaxpr(closed_jaxpr) else closed_jaxpr
+    )
+    acc = {"flops": 0, "bytes_intermediate": 0}
+    _walk(jaxpr, 1, acc)
+    out_bytes = 0
+    for a in jaxpr.outvars:
+        aval = getattr(a, "aval", None)
+        if aval is not None:
+            out_bytes += _aval_bytes(aval)
+    return {
+        "flops": int(acc["flops"]),
+        "bytes_in": sum(_aval_bytes(v.aval) for v in jaxpr.invars),
+        "bytes_out": int(out_bytes),
+        "bytes_intermediate": int(acc["bytes_intermediate"]),
+    }

@@ -1,0 +1,202 @@
+"""Canonical jaxpr fingerprints.
+
+A fingerprint is a sha256 over the **normalized** eqn graph of a traced
+program: variables are renumbered in first-appearance order (so the hash
+is invariant to variable naming and trace ordering accidents), literals
+are reduced to (dtype, shape, value digest), avals to (dtype, shape,
+weak-type flag), and eqn params are canonicalized recursively — nested
+jaxprs (``pjit``/``scan``/``cond`` bodies) fold their own canonical form
+in, while compiler bookkeeping params that do not change what the program
+computes (shardings, layouts, donation masks, the jit wrapper's ``name``)
+are dropped so a rename or a sharding annotation is not a semantic drift.
+
+Alongside the hash, :func:`fingerprint` returns a flat **summary**
+(eqn count, primitive histogram, output-dtype histogram) that the
+baseline stores next to the hash; when a fingerprint CHANGES,
+:func:`explain_change` diffs the stored summary against the fresh one to
+say *which* primitives appeared/vanished — a per-eqn explanation instead
+of "hash mismatch".
+
+Duck-typed against jax's jaxpr objects (``.jaxpr``, ``.eqns``,
+``.invars`` …) so no ``jax.core`` import is needed; jax itself is only
+imported by the caller that built the jaxpr.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+# compiler bookkeeping: irrelevant to WHAT the program computes. "name"
+# is the jit wrapper's label — renaming a wrapper must not read as
+# semantic drift (the baseline key already carries the qualname).
+_PARAM_SKIP = {
+    "name", "in_shardings", "out_shardings", "in_layouts", "out_layouts",
+    "resource_env", "donated_invars", "keep_unused", "inline", "backend",
+    "device", "compiler_options_kvs", "jaxpr_id",
+}
+
+
+def _is_jaxpr(v) -> bool:
+    return hasattr(v, "eqns") and hasattr(v, "invars")
+
+
+def _is_closed_jaxpr(v) -> bool:
+    return hasattr(v, "jaxpr") and _is_jaxpr(getattr(v, "jaxpr", None))
+
+
+def _aval_sig(aval) -> list:
+    if hasattr(aval, "shape") and hasattr(aval, "dtype"):
+        sig = [str(aval.dtype), [int(d) for d in aval.shape]]
+        if getattr(aval, "weak_type", False):
+            sig.append("weak")
+        return sig
+    return [type(aval).__name__]
+
+
+def _literal_sig(lit) -> list:
+    import numpy as np
+
+    try:
+        arr = np.asarray(lit.val)
+        digest = hashlib.sha256(arr.tobytes()).hexdigest()[:12]
+        return ["lit", str(arr.dtype), list(arr.shape), digest]
+    except Exception:
+        return ["lit", repr(lit.val)]
+
+
+def _canon_param(v):
+    if _is_closed_jaxpr(v):
+        return {"closed_jaxpr": _canon_jaxpr(v.jaxpr)}
+    if _is_jaxpr(v):
+        return {"jaxpr": _canon_jaxpr(v)}
+    if isinstance(v, (tuple, list)):
+        return [_canon_param(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _canon_param(x) for k, x in sorted(v.items())}
+    if isinstance(v, (int, float, bool, str)) or v is None:
+        return v
+    if isinstance(v, type):
+        return v.__name__
+    # dtypes stringify stably; callables/partials/objects reduce to a
+    # stable name — their repr would leak memory addresses into the hash
+    name = getattr(v, "__name__", None)
+    if name:
+        return f"<{name}>"
+    if type(v).__module__ in ("numpy", "jax.numpy") or "dtype" in type(
+        v
+    ).__name__.lower():
+        return str(v)
+    return f"<{type(v).__name__}>"
+
+
+def _canon_jaxpr(jaxpr) -> dict:
+    ids: dict[int, str] = {}
+
+    def vid(var) -> str:
+        key = id(var)
+        if key not in ids:
+            ids[key] = f"v{len(ids)}"
+        return ids[key]
+
+    def atom(a) -> list:
+        if hasattr(a, "val"):  # Literal
+            return _literal_sig(a)
+        return [vid(a)]
+
+    for v in (*getattr(jaxpr, "constvars", ()), *jaxpr.invars):
+        vid(v)
+    eqns = []
+    for eqn in jaxpr.eqns:
+        eqns.append(
+            {
+                "p": eqn.primitive.name,
+                "in": [atom(a) for a in eqn.invars],
+                "out": [[vid(v)] + _aval_sig(v.aval) for v in eqn.outvars],
+                "params": {
+                    str(k): _canon_param(v)
+                    for k, v in sorted(eqn.params.items())
+                    if k not in _PARAM_SKIP
+                },
+            }
+        )
+    return {
+        "in": [
+            _aval_sig(v.aval)
+            for v in (*getattr(jaxpr, "constvars", ()), *jaxpr.invars)
+        ],
+        "out": [atom(a) for a in jaxpr.outvars],
+        "eqns": eqns,
+    }
+
+
+def _walk_eqns(jaxpr, prims: dict, dtypes: dict) -> int:
+    """Flatten primitive/dtype histograms across nested jaxprs; returns
+    the flat eqn count. Structural counts — a scan body counts once, not
+    per iteration (the cost model applies trip counts, not this)."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        name = eqn.primitive.name
+        prims[name] = prims.get(name, 0) + 1
+        for v in eqn.outvars:
+            if hasattr(v.aval, "dtype"):
+                d = str(v.aval.dtype)
+                dtypes[d] = dtypes.get(d, 0) + 1
+        for pv in eqn.params.values():
+            for sub in _sub_jaxprs(pv):
+                n += _walk_eqns(sub, prims, dtypes)
+    return n
+
+
+def _sub_jaxprs(v):
+    if _is_closed_jaxpr(v):
+        yield v.jaxpr
+    elif _is_jaxpr(v):
+        yield v
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _sub_jaxprs(x)
+
+
+def fingerprint(closed_jaxpr) -> tuple[str, dict]:
+    """(stable hash, summary) for a traced program.
+
+    Accepts the ``jax.make_jaxpr`` result (ClosedJaxpr) or a raw jaxpr.
+    The summary — ``{"eqns", "primitives", "dtypes"}`` with histograms
+    flattened through nested jaxprs — is what the baseline stores to
+    explain future changes.
+    """
+    jaxpr = closed_jaxpr.jaxpr if _is_closed_jaxpr(closed_jaxpr) else closed_jaxpr
+    canon = _canon_jaxpr(jaxpr)
+    blob = json.dumps(canon, sort_keys=True, separators=(",", ":"))
+    digest = hashlib.sha256(blob.encode()).hexdigest()[:16]
+    prims: dict[str, int] = {}
+    dtypes: dict[str, int] = {}
+    n = _walk_eqns(jaxpr, prims, dtypes)
+    return digest, {"eqns": n, "primitives": prims, "dtypes": dtypes}
+
+
+def explain_change(old_summary: dict, new_summary: dict) -> str:
+    """Human-readable per-eqn diff between two fingerprint summaries:
+    which primitives were added/removed/recounted, how the flat eqn count
+    and output-dtype mix moved."""
+    parts: list[str] = []
+    old_n = old_summary.get("eqns", 0)
+    new_n = new_summary.get("eqns", 0)
+    if old_n != new_n:
+        parts.append(f"eqns {old_n} -> {new_n}")
+    for label, field in (("prim", "primitives"), ("dtype", "dtypes")):
+        old_h = old_summary.get(field, {}) or {}
+        new_h = new_summary.get(field, {}) or {}
+        for key in sorted(set(old_h) | set(new_h)):
+            a, b = old_h.get(key, 0), new_h.get(key, 0)
+            if a != b:
+                delta = b - a
+                parts.append(f"{label} {key} {a} -> {b} ({delta:+d})")
+    if not parts:
+        parts.append(
+            "same primitive/dtype mix — shapes, literals or params moved "
+            "(re-audit with --update-jaxpr-baseline after review)"
+        )
+    return "; ".join(parts)

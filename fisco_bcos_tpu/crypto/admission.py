@@ -279,3 +279,23 @@ def admit_batch(
             "admission", (list(payloads), sigs_arr), bsz, _admission_plane_exec
         ))
     return _admit_direct(payloads, sigs65)
+
+
+# -- progaudit shape spec: M=2 message-block dim (the short-payload bucket
+# the flood pads to); both the raw core and the packed wrapper audit.
+PROGSPEC = {
+    "admission_core": {
+        "bucket": 256,
+        "inputs": lambda b: [
+            ((b, 2, 17, 2), "uint32"), ((b,), "int32"),
+            ((b, 16), "uint32"), ((b, 16), "uint32"), ((b,), "int32"),
+        ],
+    },
+    "_admission_packed": {
+        "bucket": 256,
+        "inputs": lambda b: [
+            ((b, 2, 17, 2), "uint32"), ((b,), "int32"),
+            ((b, 16), "uint32"), ((b, 16), "uint32"), ((b,), "int32"),
+        ],
+    },
+}

@@ -533,6 +533,10 @@ class DevicePlane:
             LEDGER.note_phases(
                 op, {"queue": sum((now - r.t_enq) * 1e3 for r in reqs)}
             )
+            # fusion-frontier evidence (ISSUE 20): count the (prev, op)
+            # dispatch edge — what --fusion-report joins with the static
+            # per-program boundary costs
+            LEDGER.note_adjacency(op)
             LEDGER.add_overhead(time.perf_counter() - t_obs)
         if not REGISTRY.enabled:
             return

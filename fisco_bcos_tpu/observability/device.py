@@ -180,6 +180,12 @@ class CompileLedger:
         self._cold_times: dict[str, deque] = {}
         self._storm_ops: set[str] = set()
         self._dispatches: deque = deque(maxlen=int(timeline_cap))
+        # dispatch adjacency: (prev op, op) -> count, fed at device_span
+        # exit and DevicePlane dispatch — the measured half of the
+        # progaudit fusion-edge report (which op pairs run back-to-back,
+        # i.e. which host round-trips a merged program would delete)
+        self._adjacency: dict[tuple[str, str], int] = {}
+        self._last_adj_op: str | None = None
         # bookkeeping wall spent in observatory accounting (device_span
         # exit paths add to it) — the measured-overhead artifact input
         self._overhead_s = 0.0
@@ -390,6 +396,27 @@ class CompileLedger:
                     (op, t0, dur, {k: round(v, 3) for k, v in phases.items()})
                 )
 
+    def note_adjacency(self, op: str) -> None:
+        """One dispatch of ``op`` ended: count the (previous op -> op)
+        edge. Process-global order, deliberately across threads — the
+        plane serializes dispatches anyway, and what the fusion report
+        needs is which programs ran back-to-back on the device."""
+        with self._lock:
+            prev = self._last_adj_op
+            if prev is not None:
+                key = (prev, op)
+                self._adjacency[key] = self._adjacency.get(key, 0) + 1
+            self._last_adj_op = op
+
+    def adjacency(self) -> dict[str, int]:
+        """Measured dispatch-adjacency counts as ``"a->b"`` edges (the
+        fusion report's input; serialized into device artifacts)."""
+        with self._lock:
+            return {
+                f"{a}->{b}": n
+                for (a, b), n in sorted(self._adjacency.items())
+            }
+
     def add_overhead(self, secs: float) -> None:
         with self._lock:
             self._overhead_s += secs
@@ -443,6 +470,8 @@ class CompileLedger:
             self._phase_ms.clear()
             self._cold_times.clear()
             self._dispatches.clear()
+            self._adjacency.clear()
+            self._last_adj_op = None
             self._overhead_s = 0.0
 
 
@@ -573,6 +602,7 @@ def device_doc(tail: int = 64) -> dict:
         "storm": LEDGER.storm_state() if enabled else {"active": False},
         "overhead_s": round(LEDGER.overhead_seconds(), 6),
         "dispatches": LEDGER.dispatches(tail) if enabled else [],
+        "adjacency": LEDGER.adjacency() if enabled else {},
     }
     rows = doc["ledger"]
     doc["totals"] = {
@@ -737,6 +767,7 @@ class device_span:
             if exc_type is None:
                 t_obs = time.perf_counter()
                 self._emit_phases(dt)
+                LEDGER.note_adjacency(self.op)
                 self._obs_s += time.perf_counter() - t_obs
             LEDGER.add_overhead(self._obs_s)
         return False

@@ -47,3 +47,12 @@ def sender_address_device(qx: jax.Array, qy: jax.Array) -> jax.Array:
     )  # [B, 8] little-endian digest words
     idx = jnp.arange(12, 32)
     return (words[:, idx // 4] >> (8 * (idx % 4))) & 0xFF
+
+
+# -- progaudit shape spec (analysis/progaudit: canonical audited bucket) -----
+PROGSPEC = {
+    "sender_address_device": {
+        "bucket": 256,
+        "inputs": lambda b: [((b, 16), "uint32")] * 2,
+    },
+}

@@ -672,6 +672,8 @@ def pairing_check_batch(checks) -> np.ndarray:
         return np.zeros(0, dtype=bool)
     arrays, valid = device_inputs(checks)
     padded = [_pad_rows(a, valid.shape[0]) for a in arrays]
+    # analysis: allow(host-sync, QC admission consumes the verdict bits
+    # synchronously — this IS the pairing call's contract boundary)
     ok = np.asarray(_pairing_check_xla(*padded))
     return (ok & valid)[:bsz]
 
@@ -729,6 +731,8 @@ def multi_pairing_check(pairs) -> bool:
         for c, v in zip(cols, vals):
             c.append(v)
     arrays = [_mont_col(c) for c in cols]
+    # analysis: allow(host-sync, header-sync folds K QCs into ONE aggregate
+    # check and needs its single boolean now — the intended sync point)
     ok = np.asarray(_multi_pairing_xla(*arrays, jnp.asarray(valid)))
     return bool(ok[0])
 
@@ -744,3 +748,21 @@ def hash_to_g2(msg: bytes):
     expansion and cofactor clearing have no batch structure worth a
     kernel; the per-quorum message is hashed once and cached)."""
     return ref.hash_to_g2(msg)
+
+
+# -- progaudit shape spec: lane bucket 4 (multi_pairing_pad's power-of-two
+# ladder). slow: the Miller loop unrolls to ~100k limb eqns — tracing alone
+# is minutes-class, so default audits verify these via baseline coverage
+# only; --jaxpr-full / --update-jaxpr-baseline re-trace them.
+PROGSPEC = {
+    "_pairing_check_xla": {
+        "bucket": 4,
+        "slow": True,
+        "inputs": lambda b: [((b, 24), "uint32")] * 10,
+    },
+    "_multi_pairing_xla": {
+        "bucket": 4,
+        "slow": True,
+        "inputs": lambda b: [((b, 24), "uint32")] * 6 + [((b,), "bool")],
+    },
+}

@@ -359,4 +359,18 @@ def verify_batch(msgs, pubs, sigs) -> np.ndarray:
     inputs = device_inputs(msgs, pubs, sigs)
     with device_span("ed25519_verify", bsz):  # default key = batch bucket
         ok = _verify_xla(*inputs)
+        # analysis: allow(host-sync, wrapper-boundary materialization —
+        # callers receive host bools; the plane overlaps batches, not lanes)
         return np.asarray(ok)[:bsz]
+
+
+# -- progaudit shape spec (analysis/progaudit: canonical audited bucket) -----
+PROGSPEC = {
+    "_verify_xla": {
+        "bucket": 256,
+        "inputs": lambda b: [
+            ((b, 16), "uint32"), ((b, 16), "uint32"), ((b, 16), "uint32"),
+            ((b,), "int32"), ((b, 16), "uint32"), ((b,), "int32"),
+        ],
+    },
+}

@@ -194,4 +194,15 @@ def keccak256_batch_async(msgs):
     n = len(msgs)
     blocks, nblocks = pad_keccak(msgs)  # batch dim bucketed; slice below
     words = keccak256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
+    # analysis: allow(host-sync, deferred resolver — the sync happens when
+    # the caller RESOLVES the plane future, not at dispatch)
     return lambda: digest_words_to_bytes_le(np.asarray(words))[:n]
+
+
+# -- progaudit shape spec (analysis/progaudit: canonical audited bucket) -----
+PROGSPEC = {
+    "keccak256_blocks": {
+        "bucket": 256,
+        "inputs": lambda b: [((b, 1, 17, 2), "uint32"), ((b,), "int32")],
+    },
+}

@@ -400,3 +400,14 @@ def merkle_root(
     The device_span lives in :func:`merkle_root_async` — a second one here
     would double-count the dispatch."""
     return merkle_root_async(leaves, width=width, hasher=hasher)()
+
+
+# -- progaudit shape spec: the root program is a maker product — audit the
+# width-16 keccak tree at one ladder leaf count.
+PROGSPEC = {
+    "_device_root_fn.run": {
+        "bucket": 256,
+        "call": lambda b: _device_root_fn(b, 16),
+        "inputs": lambda b: [((b, 32), "uint8")],
+    },
+}

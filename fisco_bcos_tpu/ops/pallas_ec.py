@@ -267,3 +267,11 @@ def verify_pallas(z, r, s, qx, qy):
         gt,
     )
     return ok[:b]
+
+
+# -- progaudit shape spec: pallas kernels never trace off-TPU --------------
+PROGSPEC = {
+    "_recover_call.run": {"skip": "pallas kernels are TPU-only"},
+    "_verify_call.run": {"skip": "pallas kernels are TPU-only"},
+    "_sm2_verify_call.run": {"skip": "pallas kernels are TPU-only"},
+}

@@ -183,6 +183,8 @@ def poseidon_batch_async(msgs):
     words = poseidon_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
 
     def resolve() -> np.ndarray:
+        # analysis: allow(host-sync, deferred resolver — the sync happens
+        # when the caller RESOLVES the plane future, not at dispatch)
         ints = rows_to_ints(np.asarray(words))
         raw = b"".join(v.to_bytes(32, "big") for v in ints[:n])
         return np.frombuffer(raw, dtype=np.uint8).reshape(n, 32).copy()
@@ -197,3 +199,14 @@ def poseidon_batch(msgs) -> np.ndarray:
     n = len(msgs)
     with device_span("poseidon", n, shape_key=bucket_batch(n)):
         return poseidon_batch_async(msgs)()
+
+
+# -- progaudit shape spec (analysis/progaudit: canonical audited bucket) -----
+PROGSPEC = {
+    "poseidon_blocks": {
+        "bucket": 256,
+        "inputs": lambda b: [
+            ((b, 1, RATE, 16), "uint32"), ((b,), "int32"),
+        ],
+    },
+}

@@ -278,6 +278,8 @@ def verify_batch(
             za, ra, sa = jnp.asarray(z), jnp.asarray(r), jnp.asarray(s)
             qxa, qya = jnp.asarray(qx), jnp.asarray(qy)
         out = verify_device(za, ra, sa, qxa, qya)
+        # analysis: allow(host-sync, wrapper-boundary materialization —
+        # callers receive host bools; the plane overlaps batches, not lanes)
         return np.asarray(out)[:bsz]
 
 
@@ -302,7 +304,24 @@ def recover_batch(
             )
         qx, qy, ok = recover_device(za, ra, sa, va)
         pubs = np.concatenate(
+            # analysis: allow(host-sync, recover's contract returns host
+            # pubkey bytes for address derivation + dedup — intended sync)
             [limbs_to_bytes_be(np.asarray(qx)), limbs_to_bytes_be(np.asarray(qy))],
             axis=-1,
         )
+        # analysis: allow(host-sync, same boundary: ok bits ride the same
+        # device round-trip as the pubkeys above)
         return pubs[:bsz], np.asarray(ok)[:bsz]
+
+
+# -- progaudit shape spec (analysis/progaudit: canonical audited bucket) -----
+PROGSPEC = {
+    "_verify_xla": {
+        "bucket": 256,
+        "inputs": lambda b: [((b, 16), "uint32")] * 5,
+    },
+    "_recover_xla": {
+        "bucket": 256,
+        "inputs": lambda b: [((b, 16), "uint32")] * 3 + [((b,), "int32")],
+    },
+}

@@ -170,6 +170,8 @@ def verify_batch(
             ea, ra, sa = jnp.asarray(e), jnp.asarray(r), jnp.asarray(s)
             qxa, qya = jnp.asarray(qx), jnp.asarray(qy)
         out = verify_device(ea, ra, sa, qxa, qya)
+        # analysis: allow(host-sync, wrapper-boundary materialization —
+        # callers receive host bools; the plane overlaps batches, not lanes)
         return np.asarray(out)[:bsz]
 
 
@@ -187,3 +189,12 @@ def recover_batch(
     )
     out = np.where(ok[:, None], pubs, np.zeros_like(pubs))
     return out, ok
+
+
+# -- progaudit shape spec (analysis/progaudit: canonical audited bucket) -----
+PROGSPEC = {
+    "_verify_xla": {
+        "bucket": 256,
+        "inputs": lambda b: [((b, 16), "uint32")] * 5,
+    },
+}

@@ -126,4 +126,15 @@ def sm3_batch_async(msgs):
     n = len(msgs)
     blocks, nblocks = pad_md64(msgs)  # batch dim bucketed; slice below
     words = sm3_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
+    # analysis: allow(host-sync, deferred resolver — the sync happens when
+    # the caller RESOLVES the plane future, not at dispatch)
     return lambda: digest_words_to_bytes_be(np.asarray(words))[:n]
+
+
+# -- progaudit shape spec (analysis/progaudit: canonical audited bucket) -----
+PROGSPEC = {
+    "sm3_blocks": {
+        "bucket": 256,
+        "inputs": lambda b: [((b, 1, 16), "uint32"), ((b,), "int32")],
+    },
+}

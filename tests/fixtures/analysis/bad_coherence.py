@@ -1,0 +1,14 @@
+"""Fixture: jitted program with no PROGSPEC + off-ladder padding
+(program-coherence checker)."""
+
+import jax
+from fisco_bcos_tpu.ops.hash_common import pad_rows
+
+
+@jax.jit
+def orphan(x):  # no PROGSPEC entry anywhere in this module
+    return x + 1
+
+
+def feed(x):
+    return orphan(pad_rows(x, 100))  # 100 is not a bucket-ladder rung

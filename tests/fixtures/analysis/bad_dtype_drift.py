@@ -1,0 +1,19 @@
+"""Fixture: x64 creep in a traced body + weak-type widening (dtype-drift)."""
+
+import jax
+import jax.numpy as jnp
+
+PROGSPEC = {
+    "drifty": {"skip": "fixture"},
+}
+
+
+@jax.jit
+def drifty(x):
+    acc = jnp.zeros(x.shape, jnp.float64)  # x64 buffer in a 32-bit plane
+    widened = x.astype(float)  # weak builtin dtype
+    return acc + widened
+
+
+def feed(x):
+    return drifty(x * 1.5) + drifty(2.0)  # bare float literal widens input

@@ -1,0 +1,22 @@
+"""Fixture: host sync on a device value (host-sync checker)."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+
+# PROGSPEC so the coherence checker's missing-spec rule stays quiet — this
+# fixture demonstrates host-sync only
+PROGSPEC = {
+    "kernel": {"skip": "fixture"},
+}
+
+
+def wrapper(arr):
+    out = kernel(arr)
+    scale = float(out)  # implicit scalar sync on a device value
+    return np.asarray(out) * scale  # materializes the future mid-pipeline

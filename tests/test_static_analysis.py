@@ -178,6 +178,28 @@ def test_fixture_atomicity(fixture_findings):
     assert base + "get_singleton:unlocked-lazy-init-_SINGLETON" in got
 
 
+def test_fixture_host_sync(fixture_findings):
+    got = _keys(fixture_findings, "host-sync")
+    base = "host-sync:tests/fixtures/analysis/bad_host_sync.py:wrapper:"
+    assert base + "asarray-out" in got
+    assert base + "float-out" in got
+
+
+def test_fixture_dtype_drift(fixture_findings):
+    got = _keys(fixture_findings, "dtype-drift")
+    base = "dtype-drift:tests/fixtures/analysis/bad_dtype_drift.py:"
+    assert base + "drifty:x64-float64" in got
+    assert base + "drifty:astype-float" in got
+    assert base + "feed:weak-arg-drifty-float-literal-2.0" in got
+
+
+def test_fixture_program_coherence(fixture_findings):
+    got = _keys(fixture_findings, "program-coherence")
+    base = "program-coherence:tests/fixtures/analysis/bad_coherence.py:"
+    assert base + "orphan:missing-spec-orphan" in got
+    assert base + ":pad-off-ladder-100" in got
+
+
 def test_clean_fixture_has_no_findings(fixture_findings):
     noise = [
         f for f in fixture_findings if f.file.endswith("/clean.py")
@@ -264,15 +286,55 @@ def test_repo_jit_inventory_is_substantial():
     assert len(jits) >= 15, [j.qualname for j in jits]
 
 
+# The pinned jit inventory, by NAME (sorted ``file:qualname``). A count
+# pin (the previous form) tells a reader "something changed" without
+# saying WHAT; the name pin makes the failure self-explanatory and — the
+# ISSUE 20 point — is exactly the key set tool/jaxpr_baseline.json must
+# cover, so progaudit's coverage/stale diff and this test agree on the
+# universe. A new jitted program must be added here AND get a PROGSPEC
+# entry (progaudit) AND a tool/warm_cache.py warmer.
+PINNED_JIT_PROGRAMS = [
+    "fisco_bcos_tpu/crypto/admission.py:_admission_packed",
+    "fisco_bcos_tpu/crypto/admission.py:admission_core",
+    "fisco_bcos_tpu/ops/address.py:sender_address_device",
+    "fisco_bcos_tpu/ops/bls12_381.py:_multi_pairing_xla",
+    "fisco_bcos_tpu/ops/bls12_381.py:_pairing_check_xla",
+    "fisco_bcos_tpu/ops/ed25519.py:_verify_xla",
+    "fisco_bcos_tpu/ops/keccak.py:keccak256_blocks",
+    "fisco_bcos_tpu/ops/merkle.py:_device_root_fn.run",
+    "fisco_bcos_tpu/ops/pallas_ec.py:_recover_call.run",
+    "fisco_bcos_tpu/ops/pallas_ec.py:_sm2_verify_call.run",
+    "fisco_bcos_tpu/ops/pallas_ec.py:_verify_call.run",
+    "fisco_bcos_tpu/ops/poseidon.py:poseidon_blocks",
+    "fisco_bcos_tpu/ops/secp256k1.py:_recover_xla",
+    "fisco_bcos_tpu/ops/secp256k1.py:_verify_xla",
+    "fisco_bcos_tpu/ops/sha256.py:sha256_blocks",
+    "fisco_bcos_tpu/ops/sm2.py:_verify_xla",
+    "fisco_bcos_tpu/ops/sm3.py:sm3_blocks",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_admission.local",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_admission_packed.local",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_ed25519_verify.local",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_merkle_root.local",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_qc_check.local",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_sm2_verify.local",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_state_root.local",
+    "fisco_bcos_tpu/parallel/sharding.py:sharded_verify.local",
+]
+
+
 def test_repo_jit_inventory_pinned_and_covers_bls():
-    """ISSUE 13 satellite: the inventory includes the PR 12 BLS pairing
-    program (``ops/bls12_381.py``) and the count is PINNED — a new jitted
-    program must update this number (and get a tool/warm_cache.py warmer,
-    which walks the same inventory)."""
+    """ISSUE 13 satellite, upgraded by ISSUE 20: the inventory is PINNED
+    by sorted program NAMES, not a bare count — on drift the assertion
+    names exactly which programs appeared and which vanished."""
     progs = jitmap.inventory()
-    assert len(progs) == 25, [
-        f"{p['file']}:{p['qualname']}" for p in progs
-    ]
+    got = sorted(f"{p['file']}:{p['qualname']}" for p in progs)
+    unexpected = sorted(set(got) - set(PINNED_JIT_PROGRAMS))
+    vanished = sorted(set(PINNED_JIT_PROGRAMS) - set(got))
+    assert got == PINNED_JIT_PROGRAMS, (
+        f"jit inventory drifted: +{unexpected} -{vanished} "
+        "(update PINNED_JIT_PROGRAMS, the program's PROGSPEC, "
+        "tool/jaxpr_baseline.json and tool/warm_cache.py together)"
+    )
     bls = [p for p in progs if p["file"] == "fisco_bcos_tpu/ops/bls12_381.py"]
     assert [p["qualname"] for p in bls] == [
         "_pairing_check_xla", "_multi_pairing_xla"
